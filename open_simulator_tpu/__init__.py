@@ -1,0 +1,27 @@
+"""open-simulator-tpu: a TPU-native cluster-scheduling simulator.
+
+A from-scratch re-design of the capabilities of `alibaba/open-simulator`
+(reference at /root/reference, pure Go) for TPU hardware:
+
+- Cluster state lives as HBM-resident tensors (node capacity matrix, pod
+  request matrix, vocab-encoded labels/taints/selectors).
+- The kube-scheduler filter/score plugin pipeline (reference:
+  vendor/k8s.io/kubernetes/pkg/scheduler) is re-implemented as pure JAX
+  functions fused over the node axis and driven by a `lax.scan`
+  sequential-commit loop that reproduces the serial one-pod-at-a-time
+  semantics of the reference (pkg/simulator/simulator.go:218-243) without
+  its goroutine/channel handshake.
+- Capacity planning (reference pkg/apply/apply.go:186-239) is a batched
+  what-if sweep over candidate node counts/specs, shardable over a TPU
+  device mesh.
+
+Layout:
+  models/     host-side k8s object model, YAML ingestion, workload->pod
+              controller emulation, chart rendering
+  ops/        JAX tensor encoding + filter/score/scan kernels
+  scheduler/  oracle (serial python reference) + TPU engine + Simulate facade
+  parallel/   device-mesh sharding for sweeps and huge clusters
+  apply/      capacity planner + reports
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,41 @@
+"""Cluster construction from config directories.
+
+Mirrors CreateClusterResourceFromClusterConfig
+(pkg/simulator/simulator.go:444-459) and
+MatchAndSetLocalStorageAnnotationOnNode (pkg/simulator/utils.go:293-309):
+every YAML under the directory is demuxed by kind, and any `<node>.json`
+file whose basename matches a node name becomes that node's
+`simon/node-local-storage` annotation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .decode import ResourceTypes, list_files, load_directory
+from .workloads import ANNO_NODE_LOCAL_STORAGE
+
+
+def match_and_set_local_storage(nodes: list, dir_path: str):
+    storage = {}
+    for p in list_files(dir_path):
+        if not p.endswith(".json"):
+            continue
+        name = os.path.splitext(os.path.basename(p))[0]
+        with open(p) as f:
+            try:
+                storage[name] = json.dumps(json.load(f))
+            except json.JSONDecodeError:
+                continue
+    for node in nodes:
+        meta = node.setdefault("metadata", {})
+        name = meta.get("name", "")
+        if name in storage:
+            meta.setdefault("annotations", {})[ANNO_NODE_LOCAL_STORAGE] = storage[name]
+
+
+def cluster_from_config_dir(path: str) -> ResourceTypes:
+    resources = load_directory(path)
+    match_and_set_local_storage(resources.nodes, path)
+    return resources

@@ -1,0 +1,272 @@
+"""Label selectors, node affinity, taints and tolerations.
+
+Host-side implementations of the matching semantics the reference gets
+from k8s.io/apimachinery and k8s.io/component-helpers:
+
+- label selector match (matchLabels + matchExpressions In/NotIn/Exists/
+  DoesNotExist), used by pod-affinity terms and topology-spread
+  constraints (vendor/.../interpodaffinity/filtering.go,
+  podtopologyspread/filtering.go)
+- node selector / node affinity terms incl. Gt/Lt and matchFields
+  (vendor/.../framework/plugins/helper/node_affinity.go)
+- toleration / taint matching (vendor/k8s.io/api/core/v1/toleration.go,
+  used by the TaintToleration plugin and daemon.Predicates)
+
+These run on the host both in the serial oracle and in the tensor
+encoder (which precomputes match matrices for the JAX scan).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+# ---------------------------------------------------------------- selectors
+
+
+def match_labels_selector(selector: Optional[dict], labels: dict) -> bool:
+    """LabelSelector (matchLabels + matchExpressions) vs a label map.
+
+    A nil selector matches nothing; an empty selector matches everything
+    (k8s LabelSelectorAsSelector semantics).
+    """
+    if selector is None:
+        return False
+    ml = selector.get("matchLabels") or {}
+    for k, v in ml.items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        if not _match_expression(expr, labels):
+            return False
+    return True
+
+
+def _match_expression(expr: dict, labels: dict) -> bool:
+    key = expr.get("key", "")
+    op = expr.get("operator", "")
+    values = expr.get("values") or []
+    present = key in labels
+    val = labels.get(key)
+    if op == "In":
+        return present and val in values
+    if op == "NotIn":
+        return not present or val not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    return False
+
+
+# ------------------------------------------------------------ node affinity
+
+
+def _match_node_expression(expr: dict, labels: dict) -> bool:
+    key = expr.get("key", "")
+    op = expr.get("operator", "")
+    values = expr.get("values") or []
+    present = key in labels
+    val = labels.get(key)
+    if op == "In":
+        return present and val in values
+    if op == "NotIn":
+        return not present or val not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    if op in ("Gt", "Lt"):
+        if not present or len(values) != 1:
+            return False
+        try:
+            lhs = int(val)
+            rhs = int(values[0])
+        except (TypeError, ValueError):
+            return False
+        return lhs > rhs if op == "Gt" else lhs < rhs
+    return False
+
+
+def match_node_selector_term(term: dict, node_labels: dict, node_fields: dict) -> bool:
+    """One NodeSelectorTerm: ANDs matchExpressions (labels) + matchFields.
+
+    A term with no (valid) requirements matches nothing, per k8s
+    nodeaffinity.NewNodeSelector.
+    """
+    exprs = term.get("matchExpressions") or []
+    fields = term.get("matchFields") or []
+    if not exprs and not fields:
+        return False
+    for e in exprs:
+        if not _match_node_expression(e, node_labels):
+            return False
+    for e in fields:
+        if not _match_node_expression(e, node_fields):
+            return False
+    return True
+
+
+def match_node_selector(node_selector: dict, node_labels: dict, node_fields: dict) -> bool:
+    """NodeSelector: OR over terms. Empty term list matches nothing."""
+    terms = node_selector.get("nodeSelectorTerms") or []
+    return any(match_node_selector_term(t, node_labels, node_fields) for t in terms)
+
+
+def pod_matches_node_selector_and_affinity(pod_spec: dict, node: "dict") -> bool:
+    """PodMatchesNodeSelectorAndAffinityTerms (vendor/.../plugins/helper).
+
+    nodeSelector (exact label map) AND requiredDuringScheduling node
+    affinity. Used by the NodeAffinity filter, daemonset eligibility and
+    topology-spread candidate-node filtering.
+    """
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    fields = {"metadata.name": (node.get("metadata") or {}).get("name", "")}
+    ns = pod_spec.get("nodeSelector") or {}
+    for k, v in ns.items():
+        if labels.get(k) != v:
+            return False
+    affinity = pod_spec.get("affinity") or {}
+    node_aff = affinity.get("nodeAffinity") or {}
+    required = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if required is not None:
+        if not match_node_selector(required, labels, fields):
+            return False
+    return True
+
+
+def preferred_node_affinity_score(pod_spec: dict, node: dict) -> int:
+    """Sum of weights of matching preferred scheduling terms.
+
+    NodeAffinity.Score (vendor/.../nodeaffinity/node_affinity.go:77-107).
+    An empty preferred term matches all objects per the API comment, but
+    NewPreferredSchedulingTerms skips terms with no requirements, so an
+    empty term contributes nothing.
+    """
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    fields = {"metadata.name": (node.get("metadata") or {}).get("name", "")}
+    affinity = pod_spec.get("affinity") or {}
+    node_aff = affinity.get("nodeAffinity") or {}
+    total = 0
+    for wterm in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        pref = wterm.get("preference") or {}
+        if match_node_selector_term(pref, labels, fields):
+            total += int(wterm.get("weight", 0))
+    return total
+
+
+# --------------------------------------------------------- taints/tolerations
+
+
+def toleration_tolerates_taint(tol: dict, taint: dict) -> bool:
+    """v1.Toleration.ToleratesTaint."""
+    t_effect = tol.get("effect", "")
+    if t_effect and t_effect != taint.get("effect", ""):
+        return False
+    t_key = tol.get("key", "")
+    if t_key and t_key != taint.get("key", ""):
+        return False
+    op = tol.get("operator") or "Equal"
+    if op == "Exists":
+        return True
+    if op == "Equal":
+        return tol.get("value", "") == taint.get("value", "")
+    return False
+
+
+def tolerations_tolerate_taint(tolerations: list, taint: dict) -> bool:
+    return any(toleration_tolerates_taint(t, taint) for t in tolerations or [])
+
+
+def find_untolerated_taint(taints: list, tolerations: list, effects=("NoSchedule", "NoExecute")):
+    """FindMatchingUntoleratedTaint filtered to scheduling effects.
+
+    Returns the first taint (in node order) with an effect in `effects`
+    that no toleration tolerates, or None.
+    """
+    for taint in taints or []:
+        if taint.get("effect") not in effects:
+            continue
+        if not tolerations_tolerate_taint(tolerations, taint):
+            return taint
+    return None
+
+
+def count_intolerable_prefer_no_schedule(taints: list, tolerations: list) -> int:
+    """TaintToleration score input (taint_toleration.go:123-135).
+
+    Only tolerations with empty effect or PreferNoSchedule are considered
+    (getAllTolerationPreferNoSchedule).
+    """
+    prefer_tols = [
+        t for t in tolerations or [] if not t.get("effect") or t.get("effect") == "PreferNoSchedule"
+    ]
+    n = 0
+    for taint in taints or []:
+        if taint.get("effect") != "PreferNoSchedule":
+            continue
+        if not tolerations_tolerate_taint(prefer_tols, taint):
+            n += 1
+    return n
+
+
+# ------------------------------------------------------------- affinity terms
+
+
+@dataclass
+class AffinityTerm:
+    """A required/preferred pod (anti)affinity term, pre-resolved.
+
+    Mirrors framework.AffinityTerm (vendor/.../framework/types.go): the
+    term's namespaces default to the owning pod's namespace when the term
+    lists none.
+    """
+
+    selector: Optional[dict]
+    topology_key: str
+    namespaces: frozenset
+    weight: int = 0  # only for preferred terms
+
+    def matches_pod(self, pod: dict) -> bool:
+        meta = pod.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        if ns not in self.namespaces:
+            return False
+        return match_labels_selector(self.selector, meta.get("labels") or {})
+
+
+def _get_terms(pod: dict, kind: str, mode: str) -> list:
+    spec = pod.get("spec") or {}
+    affinity = spec.get("affinity") or {}
+    section = affinity.get(kind) or {}
+    return section.get(mode) or []
+
+
+def resolve_affinity_terms(pod: dict, kind: str, mode: str) -> list:
+    """Extract AffinityTerms from a pod.
+
+    kind: 'podAffinity' | 'podAntiAffinity'
+    mode: 'requiredDuringSchedulingIgnoredDuringExecution' |
+          'preferredDuringSchedulingIgnoredDuringExecution'
+    """
+    meta = pod.get("metadata") or {}
+    own_ns = meta.get("namespace") or "default"
+    out = []
+    for raw in _get_terms(pod, kind, mode):
+        weight = 0
+        term = raw
+        if mode.startswith("preferred"):
+            weight = int(raw.get("weight", 0))
+            term = raw.get("podAffinityTerm") or {}
+        namespaces = term.get("namespaces") or []
+        ns_set = frozenset(namespaces) if namespaces else frozenset([own_ns])
+        out.append(
+            AffinityTerm(
+                selector=term.get("labelSelector"),
+                topology_key=term.get("topologyKey", ""),
+                namespaces=ns_set,
+                weight=weight,
+            )
+        )
+    return out

@@ -1,0 +1,151 @@
+"""Pod resource-request computation.
+
+Reproduces:
+- computePodResourceRequest: max(sum(containers), each init container)
+  + overhead (vendor/.../noderesources/fit.go:148-165)
+- resourcehelper.PodRequestsAndLimits (used by the Simon plugin score,
+  pkg/simulator/plugin/simon.go:45)
+- the non-zero default requests used by scoring
+  (vendor/.../scheduler/util/non_zero.go: 100m CPU / 200MB memory)
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..utils.quantity import parse_quantity
+
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL = "ephemeral-storage"
+PODS = "pods"
+
+DEFAULT_MILLI_CPU = 100  # 0.1 core
+DEFAULT_MEMORY = 200 * 1024 * 1024  # 200MB
+
+_NATIVE = {CPU, MEMORY, EPHEMERAL, PODS, "hugepages-1Gi", "hugepages-2Mi"}
+
+
+def is_extended_resource(name: str) -> bool:
+    """v1helper.IsExtendedResourceName approximation: non-native, has a
+    domain prefix that is not kubernetes.io, and is not hugepages."""
+    if name in _NATIVE or name.startswith("hugepages-"):
+        return False
+    if name.startswith("requests."):
+        return False
+    return True
+
+
+def is_scalar_resource(name: str) -> bool:
+    """Resources tracked in NodeInfo ScalarResources: extended resources,
+    hugepages, and attachable volumes."""
+    return is_extended_resource(name) or name.startswith("hugepages-") or name.startswith(
+        "attachable-volumes-"
+    )
+
+
+def _add(acc: dict, rl: dict):
+    for name, q in (rl or {}).items():
+        acc[name] = acc.get(name, Fraction(0)) + parse_quantity(q)
+
+
+def _set_max(acc: dict, rl: dict):
+    for name, q in (rl or {}).items():
+        v = parse_quantity(q)
+        if v > acc.get(name, Fraction(0)):
+            acc[name] = v
+
+
+def pod_requests(pod: dict) -> dict:
+    """max(sum over containers, any init container) + overhead.
+
+    Returns {resource_name: Fraction base units}.
+    """
+    spec = pod.get("spec") or {}
+    acc: dict = {}
+    for c in spec.get("containers") or []:
+        _add(acc, (c.get("resources") or {}).get("requests"))
+    for c in spec.get("initContainers") or []:
+        _set_max(acc, (c.get("resources") or {}).get("requests"))
+    _add(acc, spec.get("overhead"))
+    return acc
+
+
+def pod_limits(pod: dict) -> dict:
+    spec = pod.get("spec") or {}
+    acc: dict = {}
+    for c in spec.get("containers") or []:
+        _add(acc, (c.get("resources") or {}).get("limits"))
+    for c in spec.get("initContainers") or []:
+        _set_max(acc, (c.get("resources") or {}).get("limits"))
+    _add(acc, spec.get("overhead"))
+    return acc
+
+
+def pod_request_milli_cpu(pod: dict) -> int:
+    v = pod_requests(pod).get(CPU, Fraction(0)) * 1000
+    return -((-v.numerator) // v.denominator)
+
+
+def pod_request_int(pod: dict, resource: str) -> int:
+    v = pod_requests(pod).get(resource, Fraction(0))
+    return -((-v.numerator) // v.denominator)
+
+
+def pod_nonzero_request(pod: dict, resource: str) -> int:
+    """calculatePodResourceRequest with GetNonzeroRequestForResource:
+    per-container defaulting of unset cpu/memory requests, then
+    max(sum(containers), each init container) + overhead.
+    (vendor/.../noderesources/resource_allocation.go:117-141)
+    """
+    spec = pod.get("spec") or {}
+
+    def nonzero(requests: dict) -> int:
+        requests = requests or {}
+        if resource == CPU:
+            if CPU not in requests:
+                return DEFAULT_MILLI_CPU
+            v = parse_quantity(requests[CPU]) * 1000
+            return -((-v.numerator) // v.denominator)
+        if resource == MEMORY:
+            if MEMORY not in requests:
+                return DEFAULT_MEMORY
+            v = parse_quantity(requests[MEMORY])
+            return -((-v.numerator) // v.denominator)
+        v = parse_quantity(requests.get(resource))
+        return -((-v.numerator) // v.denominator)
+
+    total = 0
+    for c in spec.get("containers") or []:
+        total += nonzero((c.get("resources") or {}).get("requests"))
+    for c in spec.get("initContainers") or []:
+        v = nonzero((c.get("resources") or {}).get("requests"))
+        if v > total:
+            total = v
+    overhead = spec.get("overhead") or {}
+    if resource in overhead:
+        # reference quirk preserved: calculatePodResourceRequest adds
+        # overhead via Quantity.Value() even for CPU, mixing whole cores
+        # into a millicore total (resource_allocation.go:134-137)
+        q = parse_quantity(overhead[resource])
+        total += -((-q.numerator) // q.denominator)
+    return total
+
+
+def node_allocatable(node: dict) -> dict:
+    """Node allocatable as {resource: Fraction base units}."""
+    status = node.get("status") or {}
+    alloc = status.get("allocatable")
+    if alloc is None:
+        alloc = status.get("capacity") or {}
+    return {name: parse_quantity(q) for name, q in alloc.items()}
+
+
+def node_alloc_milli_cpu(node: dict) -> int:
+    v = node_allocatable(node).get(CPU, Fraction(0)) * 1000
+    return v.numerator // v.denominator
+
+
+def node_alloc_int(node: dict, resource: str) -> int:
+    v = node_allocatable(node).get(resource, Fraction(0))
+    return v.numerator // v.denominator

@@ -1,0 +1,183 @@
+"""Local-storage and GPU-share codecs.
+
+Mirrors pkg/utils/utils.go:541-654 (NodeStorage / VolumeRequest /
+GetPodLocalPVCs) and the open-gpu-share annotation helpers
+(vendor/github.com/alibaba/open-gpu-share/pkg/utils/pod.go, node.go).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..utils.quantity import q_value
+from .workloads import (
+    ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
+    SC_LVM,
+)
+
+GPU_MEM_ANNO = "alibabacloud.com/gpu-mem"
+GPU_COUNT_ANNO = "alibabacloud.com/gpu-count"
+GPU_INDEX_ANNO = "alibabacloud.com/gpu-index"
+GPU_MODEL_LABEL = "alibabacloud.com/gpu-card-model"
+
+
+def _to_int(v, default=0) -> int:
+    if v is None:
+        return default
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, float)):
+        return int(v)
+    try:
+        return q_value(v)
+    except (ValueError, ZeroDivisionError):
+        return default
+
+
+def _to_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() == "true"
+
+
+@dataclass
+class VG:
+    name: str
+    capacity: int
+    requested: int = 0
+
+
+@dataclass
+class Device:
+    name: str
+    capacity: int
+    media_type: str = "hdd"  # 'ssd' | 'hdd'
+    is_allocated: bool = False
+
+
+@dataclass
+class NodeStorage:
+    vgs: List[VG] = field(default_factory=list)
+    devices: List[Device] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "vgs": [
+                    {"name": vg.name, "capacity": str(vg.capacity), "requested": str(vg.requested)}
+                    for vg in self.vgs
+                ],
+                "devices": [
+                    {
+                        "name": d.name,
+                        "device": d.name,
+                        "capacity": str(d.capacity),
+                        "mediaType": d.media_type,
+                        "isAllocated": "true" if d.is_allocated else "false",
+                    }
+                    for d in self.devices
+                ],
+            }
+        )
+
+
+def parse_node_storage(node: dict) -> Optional[NodeStorage]:
+    """GetNodeStorage: decode the simon/node-local-storage annotation."""
+    anno = (node.get("metadata") or {}).get("annotations") or {}
+    raw = anno.get(ANNO_NODE_LOCAL_STORAGE)
+    if raw is None:
+        return None
+    data = json.loads(raw) if isinstance(raw, str) else raw
+    vgs = [
+        VG(
+            name=vg.get("name", ""),
+            capacity=_to_int(vg.get("capacity")),
+            requested=_to_int(vg.get("requested")),
+        )
+        for vg in data.get("vgs") or []
+    ]
+    devices = [
+        Device(
+            name=d.get("device") or d.get("name") or "",
+            capacity=_to_int(d.get("capacity")),
+            media_type=str(d.get("mediaType", "hdd")).lower(),
+            is_allocated=_to_bool(d.get("isAllocated", False)),
+        )
+        for d in data.get("devices") or []
+    ]
+    return NodeStorage(vgs=vgs, devices=devices)
+
+
+def set_node_storage(node: dict, storage: NodeStorage):
+    meta = node.setdefault("metadata", {})
+    meta.setdefault("annotations", {})[ANNO_NODE_LOCAL_STORAGE] = storage.to_json()
+
+
+@dataclass
+class LocalVolume:
+    size: int
+    kind: str  # 'LVM' | 'SSD' | 'HDD'
+    sc_name: str
+
+    @property
+    def is_lvm(self) -> bool:
+        return self.sc_name in SC_LVM or self.kind == "LVM"
+
+
+def parse_pod_local_volumes(pod: dict):
+    """GetPodLocalPVCs: split the simon/pod-local-storage volumes into
+    (lvm, device) requests."""
+    anno = (pod.get("metadata") or {}).get("annotations") or {}
+    raw = anno.get(ANNO_POD_LOCAL_STORAGE)
+    if raw is None:
+        return [], []
+    data = json.loads(raw) if isinstance(raw, str) else raw
+    lvm, device = [], []
+    for v in data.get("volumes") or []:
+        kind = v.get("kind", "")
+        if kind not in ("LVM", "SSD", "HDD"):
+            continue
+        vol = LocalVolume(size=_to_int(v.get("size")), kind=kind, sc_name=v.get("scName", ""))
+        if vol.is_lvm:
+            lvm.append(vol)
+        else:
+            device.append(vol)
+    return lvm, device
+
+
+# --------------------------------------------------------------- GPU share
+
+
+def pod_gpu_request(pod: dict):
+    """(per-GPU memory, gpu count) from pod annotations
+    (GetGpuMemoryAndCountFromPodAnnotation)."""
+    anno = (pod.get("metadata") or {}).get("annotations") or {}
+    mem = _to_int(anno.get(GPU_MEM_ANNO))
+    count = _to_int(anno.get(GPU_COUNT_ANNO))
+    return mem, count
+
+
+def pod_gpu_memory(pod: dict) -> int:
+    anno = (pod.get("metadata") or {}).get("annotations") or {}
+    return _to_int(anno.get(GPU_MEM_ANNO))
+
+
+def node_total_gpu_memory(node: dict) -> int:
+    """GetTotalGpuMemory: node capacity alibabacloud.com/gpu-mem."""
+    cap = (node.get("status") or {}).get("capacity") or {}
+    return _to_int(cap.get(GPU_MEM_ANNO))
+
+
+def node_gpu_count(node: dict) -> int:
+    cap = (node.get("status") or {}).get("capacity") or {}
+    return _to_int(cap.get(GPU_COUNT_ANNO))
+
+
+def node_gpu_per_device_memory(node: dict) -> int:
+    count = node_gpu_count(node)
+    if count <= 0:
+        return 0
+    return node_total_gpu_memory(node) // count

@@ -1,0 +1,354 @@
+"""Workload -> pod controller emulation.
+
+Re-implements pkg/utils/utils.go:133-500 (MakeValidPodsBy* /
+MakeValidPod / AddWorkloadInfoToPod / SetObjectMetaFromObject) and the
+daemonset eligibility path (utils.go:357-398 + the vendored
+daemon.Predicates, daemon_controller.go:1251-1258).
+
+Faithful quirks preserved on purpose (they are observable semantics):
+- Generated pods take their labels/annotations from the OWNER object,
+  not from spec.template.metadata (SetObjectMetaFromObject,
+  utils.go:336-347). This is how e.g. GPU annotations on a ReplicaSet
+  reach its pods, and what affinity self-matching sees.
+- Deployment pods go through an intermediate ReplicaSet whose
+  labels/annotations come from the Deployment.
+- StatefulSet pod names are `<name>-<ordinal>`; all other generated pods
+  are `<owner>-<hash>` (hash width 5 for pods, 10 for workloads).
+- PVC volumes are rewritten to hostPath /tmp; env/mounts/probes dropped
+  (MakeValidPod, utils.go:410-492).
+- StatefulSet volumeClaimTemplates become the `simon/pod-local-storage`
+  annotation (utils.go:273-316).
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import itertools
+import json
+
+from . import labels as lbl
+from ..utils.quantity import q_value
+
+# pkg/type/const.go
+ANNO_WORKLOAD_KIND = "simon/workload-kind"
+ANNO_WORKLOAD_NAME = "simon/workload-name"
+ANNO_WORKLOAD_NAMESPACE = "simon/workload-namespace"
+ANNO_NODE_LOCAL_STORAGE = "simon/node-local-storage"
+ANNO_POD_LOCAL_STORAGE = "simon/pod-local-storage"
+ANNO_NODE_GPU_SHARE = "simon/node-gpu-share"
+LABEL_NEW_NODE = "simon/new-node"
+LABEL_APP_NAME = "simon/app-name"
+NEW_NODE_NAME_PREFIX = "simon"
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+MAX_NUM_NEW_NODE = 100
+WORKLOAD_HASH_DIGITS = 10
+POD_HASH_DIGITS = 5
+
+# open-local storage class names (pkg/utils/const.go)
+SC_LVM = ("open-local-lvm", "yoda-lvm")
+SC_SSD = (
+    "open-local-device-ssd",
+    "open-local-mountpoint-ssd",
+    "yoda-mountpoint-ssd",
+    "yoda-device-ssd",
+)
+SC_HDD = (
+    "open-local-device-hdd",
+    "open-local-mountpoint-hdd",
+    "yoda-mountpoint-hdd",
+    "yoda-device-hdd",
+)
+
+_name_counter = itertools.count()
+
+
+def reset_name_counter():
+    """Deterministic generated-name suffixes for reproducible tests."""
+    global _name_counter
+    _name_counter = itertools.count()
+
+
+def _hash_suffix(digits: int) -> str:
+    n = next(_name_counter)
+    return hashlib.sha256(str(n).encode()).hexdigest()[:digits]
+
+
+def _meta_from_owner(owner: dict, kind: str, gen_pod: bool) -> dict:
+    """SetObjectMetaFromObject: name = owner-<hash>, labels/annotations
+    copied from the owner, ownerReference recorded."""
+    ometa = owner.get("metadata") or {}
+    name = ometa.get("name", "")
+    return {
+        "name": f"{name}-{_hash_suffix(POD_HASH_DIGITS if gen_pod else WORKLOAD_HASH_DIGITS)}",
+        "namespace": ometa.get("namespace"),
+        "generateName": name,
+        "annotations": dict(ometa.get("annotations") or {}),
+        "labels": dict(ometa.get("labels") or {}),
+        "ownerReferences": [
+            {
+                "kind": kind,
+                "name": name,
+                "controller": True,
+            }
+        ],
+    }
+
+
+def make_valid_pod(pod: dict) -> dict:
+    """MakeValidPod: defaulting + sanitization (utils.go:410-492)."""
+    pod = copy.deepcopy(pod)
+    meta = pod.setdefault("metadata", {})
+    meta.setdefault("labels", {})
+    if not meta.get("namespace"):
+        meta["namespace"] = "default"
+    meta.setdefault("annotations", {})
+    spec = pod.setdefault("spec", {})
+    if not spec.get("dnsPolicy"):
+        spec["dnsPolicy"] = "ClusterFirst"
+    if not spec.get("restartPolicy"):
+        spec["restartPolicy"] = "Always"
+    if not spec.get("schedulerName"):
+        spec["schedulerName"] = DEFAULT_SCHEDULER_NAME
+    spec.pop("imagePullSecrets", None)
+    for key in ("initContainers", "containers"):
+        for c in spec.get(key) or []:
+            c.pop("volumeMounts", None)
+            c.pop("env", None)
+            c.pop("livenessProbe", None)
+            c.pop("readinessProbe", None)
+            c.pop("startupProbe", None)
+            sc = c.get("securityContext")
+            if sc is not None and "privileged" in sc:
+                sc["privileged"] = False
+    for v in spec.get("volumes") or []:
+        if "persistentVolumeClaim" in v:
+            v.pop("persistentVolumeClaim")
+            v["hostPath"] = {"path": "/tmp"}
+    _validate_pod(pod)
+    return pod
+
+
+def _validate_pod(pod: dict):
+    """Light subset of k8s ValidatePodCreate: the invariants the
+    simulator actually depends on."""
+    spec = pod.get("spec") or {}
+    if not spec.get("containers"):
+        raise ValueError(f"invalid pod {pod.get('metadata', {}).get('name')}: no containers")
+    name = (pod.get("metadata") or {}).get("name") or ""
+    if not name:
+        raise ValueError("invalid pod: empty name")
+
+
+def add_workload_info(pod: dict, kind: str, name: str, namespace: str) -> dict:
+    anno = pod["metadata"].setdefault("annotations", {})
+    anno[ANNO_WORKLOAD_KIND] = kind
+    anno[ANNO_WORKLOAD_NAME] = name
+    anno[ANNO_WORKLOAD_NAMESPACE] = namespace
+    return pod
+
+
+def _expand_template(owner: dict, kind: str, count: int) -> list:
+    ometa = owner.get("metadata") or {}
+    pods = []
+    for _ in range(count):
+        pod = {
+            "metadata": _meta_from_owner(owner, kind, gen_pod=True),
+            "spec": copy.deepcopy(((owner.get("spec") or {}).get("template") or {}).get("spec") or {}),
+        }
+        pod = make_valid_pod(pod)
+        add_workload_info(pod, kind, ometa.get("name", ""), ometa.get("namespace", ""))
+        pods.append(pod)
+    return pods
+
+
+def pods_from_replica_set(rs: dict) -> list:
+    replicas = (rs.get("spec") or {}).get("replicas")
+    return _expand_template(rs, "ReplicaSet", 1 if replicas is None else int(replicas))
+
+
+def pods_from_deployment(deploy: dict) -> list:
+    spec = deploy.get("spec") or {}
+    # intermediate ReplicaSet named <deploy>-<hash10>, owned by the
+    # Deployment (generateReplicaSetFromDeployment, utils.go:185-195);
+    # pods then carry an ownerReference to the RS
+    rs = {
+        "kind": "ReplicaSet",
+        "metadata": _meta_from_owner(deploy, "Deployment", gen_pod=False),
+        "spec": {
+            "selector": spec.get("selector"),
+            "replicas": spec.get("replicas"),
+            "template": spec.get("template"),
+        },
+    }
+    return pods_from_replica_set(rs)
+
+
+def pods_from_replication_controller(rc: dict) -> list:
+    replicas = (rc.get("spec") or {}).get("replicas")
+    return _expand_template(rc, "ReplicationController", 1 if replicas is None else int(replicas))
+
+
+def pods_from_job(job: dict) -> list:
+    completions = (job.get("spec") or {}).get("completions")
+    return _expand_template(job, "Job", 1 if completions is None else int(completions))
+
+
+def pods_from_cron_job(cronjob: dict) -> list:
+    spec = cronjob.get("spec") or {}
+    job_template = spec.get("jobTemplate") or {}
+    meta = _meta_from_owner(cronjob, "CronJob", gen_pod=False)
+    anno = dict((job_template.get("metadata") or {}).get("annotations") or {})
+    anno["cronjob.kubernetes.io/instantiate"] = "manual"
+    meta["annotations"] = anno
+    job = {
+        "kind": "Job",
+        "metadata": meta,
+        "spec": (job_template.get("spec") or {}),
+    }
+    return pods_from_job(job)
+
+
+def pods_from_stateful_set(sts: dict) -> list:
+    spec = sts.get("spec") or {}
+    replicas = spec.get("replicas")
+    count = 1 if replicas is None else int(replicas)
+    name = (sts.get("metadata") or {}).get("name", "")
+    pods = _expand_template(sts, "StatefulSet", count)
+    for ordinal, pod in enumerate(pods):
+        pod["metadata"]["name"] = f"{name}-{ordinal}"
+    _set_storage_annotation(pods, spec.get("volumeClaimTemplates") or [])
+    return pods
+
+
+def _set_storage_annotation(pods: list, volume_claim_templates: list):
+    """volumeClaimTemplates -> simon/pod-local-storage annotation
+    (utils.go:273-316). Size is serialized as a string per the Go
+    `json:"size,string"` tag."""
+    volumes = []
+    for pvc in volume_claim_templates:
+        sc = (pvc.get("spec") or {}).get("storageClassName")
+        if sc is None:
+            continue
+        requested = q_value(
+            (((pvc.get("spec") or {}).get("resources") or {}).get("requests") or {}).get("storage")
+        )
+        if sc in SC_LVM:
+            kind = "LVM"
+        elif sc in SC_SSD:
+            kind = "SSD"
+        elif sc in SC_HDD:
+            kind = "HDD"
+        else:
+            continue
+        volumes.append({"size": str(requested), "kind": kind, "scName": sc})
+    if not volumes:
+        volumes = []
+    payload = json.dumps({"volumes": volumes})
+    for pod in pods:
+        pod["metadata"].setdefault("annotations", {})[ANNO_POD_LOCAL_STORAGE] = payload
+
+
+def pod_from_pod(pod: dict) -> dict:
+    return make_valid_pod(pod)
+
+
+# ------------------------------------------------------------------ daemonset
+
+
+def _pin_pod_to_node(pod_spec: dict, node_name: str):
+    """SetDaemonSetPodNodeNameByNodeAffinity (utils.go:812-857): inject a
+    required matchFields metadata.name term; existing terms get their
+    matchFields replaced (matchExpressions kept)."""
+    req = {"key": "metadata.name", "operator": "In", "values": [node_name]}
+    affinity = pod_spec.setdefault("affinity", {})
+    node_aff = affinity.setdefault("nodeAffinity", {})
+    required = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not required or not required.get("nodeSelectorTerms"):
+        node_aff["requiredDuringSchedulingIgnoredDuringExecution"] = {
+            "nodeSelectorTerms": [{"matchFields": [req]}]
+        }
+        return
+    for term in required["nodeSelectorTerms"]:
+        term["matchFields"] = [req]
+
+
+def node_should_run_pod(node: dict, pod: dict) -> bool:
+    """daemon.Predicates subset used by NodeShouldRunPod
+    (utils.go:356-367): nodeName + node affinity + NoSchedule/NoExecute
+    taints."""
+    if node is None:
+        return False
+    spec = pod.get("spec") or {}
+    node_name = (node.get("metadata") or {}).get("name", "")
+    if spec.get("nodeName") and spec["nodeName"] != node_name:
+        return False
+    if not lbl.pod_matches_node_selector_and_affinity(spec, node):
+        return False
+    taints = (node.get("spec") or {}).get("taints") or []
+    if lbl.find_untolerated_taint(taints, spec.get("tolerations")) is not None:
+        return False
+    return True
+
+
+def pods_from_daemon_set(ds: dict, nodes: list) -> list:
+    """One pinned pod per eligible node (utils.go:369-398)."""
+    meta = ds.get("metadata") or {}
+    pods = []
+    for node in nodes:
+        node_name = (node.get("metadata") or {}).get("name", "")
+        pod = {
+            "metadata": _meta_from_owner(ds, "DaemonSet", gen_pod=True),
+            "spec": copy.deepcopy(((ds.get("spec") or {}).get("template") or {}).get("spec") or {}),
+        }
+        _pin_pod_to_node(pod["spec"], node_name)
+        pod = make_valid_pod(pod)
+        add_workload_info(pod, "DaemonSet", meta.get("name", ""), meta.get("namespace", ""))
+        if node_should_run_pod(node, pod):
+            pods.append(pod)
+    return pods
+
+
+# ------------------------------------------------------------------- facade
+
+
+def pods_excluding_daemon_sets(resources) -> list:
+    """GetValidPodExcludeDaemonSet (pkg/simulator/utils.go:76-136)."""
+    pods = []
+    for p in resources.pods:
+        pods.append(pod_from_pod(p))
+    for d in resources.deployments:
+        pods.extend(pods_from_deployment(d))
+    for rs in resources.replica_sets:
+        pods.extend(pods_from_replica_set(rs))
+    for rc in resources.replication_controllers:
+        pods.extend(pods_from_replication_controller(rc))
+    for sts in resources.stateful_sets:
+        pods.extend(pods_from_stateful_set(sts))
+    for job in resources.jobs:
+        pods.extend(pods_from_job(job))
+    for cj in resources.cron_jobs:
+        pods.extend(pods_from_cron_job(cj))
+    return pods
+
+
+def generate_valid_pods_from_app(app_name: str, resources, nodes: list) -> list:
+    """GenerateValidPodsFromAppResources (pkg/simulator/utils.go:36-73):
+    regular workloads + per-node daemonset pods, all labelled with the
+    app name."""
+    pods = pods_excluding_daemon_sets(resources)
+    for ds in resources.daemon_sets:
+        pods.extend(pods_from_daemon_set(ds, nodes))
+    for pod in pods:
+        pod["metadata"].setdefault("labels", {})[LABEL_APP_NAME] = app_name
+    return pods
+
+
+def make_valid_node(node: dict, node_name: str) -> dict:
+    """MakeValidNodeByNode (utils.go:502-516)."""
+    node = copy.deepcopy(node)
+    meta = node.setdefault("metadata", {})
+    meta["name"] = node_name
+    meta.setdefault("labels", {})["kubernetes.io/hostname"] = node_name
+    meta.setdefault("annotations", {})
+    return node

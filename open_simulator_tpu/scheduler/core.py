@@ -1,0 +1,151 @@
+"""Simulate facade: one-shot cluster + ordered app deployment.
+
+Mirrors pkg/simulator/core.go:64-103 (Simulate) and the relevant parts of
+pkg/simulator/simulator.go:
+- cluster workloads (incl. per-node daemonset pods) are expanded and
+  scheduled first (RunCluster -> syncClusterResourceList -> schedulePods)
+- then each app in configured order (ScheduleApp): expand, sort by the
+  affinity/toleration queues, schedule serially
+- pods that fail to schedule are removed from the cluster and reported
+  with their reason (simulator.go:231-240)
+
+Deviation (documented): the reference sorts app pods with Go sort.Sort
+and comparators that are not strict weak orders (pkg/algo/affinity.go:21,
+toleration.go:19), yielding an arbitrary deterministic permutation. We
+use stable sorts with the evident intent: pods with nodeSelector first,
+then pods with tolerations first.
+
+The `engine` argument selects the scheduling backend:
+- "oracle": the serial Python reference implementation
+- "tpu": the JAX sequential-commit scan (ops/scan.py), which must agree
+  with the oracle placement-for-placement
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..models.decode import ResourceTypes
+from ..models import workloads as wl
+from .oracle import Oracle
+
+
+@dataclass
+class UnscheduledPod:
+    pod: dict
+    reason: str
+
+
+@dataclass
+class NodeStatus:
+    node: dict
+    pods: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class SimulateResult:
+    unscheduled_pods: List[UnscheduledPod] = field(default_factory=list)
+    node_status: List[NodeStatus] = field(default_factory=list)
+
+    @property
+    def all_scheduled(self) -> bool:
+        return not self.unscheduled_pods
+
+
+@dataclass
+class AppResource:
+    name: str
+    resource: ResourceTypes
+
+
+def _sort_app_pods(pods: List[dict]) -> List[dict]:
+    pods = sorted(pods, key=lambda p: (p.get("spec") or {}).get("nodeSelector") is None)
+    pods = sorted(pods, key=lambda p: (p.get("spec") or {}).get("tolerations") is None)
+    return pods
+
+
+class Simulator:
+    """In-memory cluster + serial scheduler (the fake apiserver +
+    scheduler goroutine of the reference collapse into this object)."""
+
+    def __init__(self, engine: str = "oracle"):
+        self.engine_kind = engine
+        self.oracle: Optional[Oracle] = None
+        self.cluster_pods: List[dict] = []
+
+    # RunCluster (simulator.go:159-164)
+    def run_cluster(self, cluster: ResourceTypes) -> SimulateResult:
+        self.oracle = Oracle(cluster.nodes)
+        pods = wl.pods_excluding_daemon_sets(cluster)
+        for ds in cluster.daemon_sets:
+            pods.extend(wl.pods_from_daemon_set(ds, cluster.nodes))
+        return self._schedule_pods(pods)
+
+    # ScheduleApp (simulator.go:166-184)
+    def schedule_app(self, app: AppResource) -> SimulateResult:
+        nodes = [ns.node for ns in self.oracle.nodes]
+        pods = wl.generate_valid_pods_from_app(app.name, app.resource, nodes)
+        pods = _sort_app_pods(pods)
+        return self._schedule_pods(pods)
+
+    def _schedule_pods(self, pods: List[dict]) -> SimulateResult:
+        failed: List[UnscheduledPod] = []
+        if self.engine_kind == "tpu":
+            failed = self._schedule_pods_tpu(pods)
+        else:
+            for pod in pods:
+                if (pod.get("spec") or {}).get("nodeName"):
+                    self.oracle.place_existing_pod(pod)
+                    self.cluster_pods.append(pod)
+                    continue
+                node_name, reason = self.oracle.schedule_pod(pod)
+                if node_name is None:
+                    failed.append(UnscheduledPod(pod=pod, reason=reason))
+                else:
+                    self.cluster_pods.append(pod)
+        return SimulateResult(unscheduled_pods=failed, node_status=self.node_status())
+
+    def _schedule_pods_tpu(self, pods: List[dict]) -> List[UnscheduledPod]:
+        from .engine import TpuEngine  # lazy: keeps jax import optional
+
+        failed: List[UnscheduledPod] = []
+        pinned = [p for p in pods if (p.get("spec") or {}).get("nodeName")]
+        for pod in pinned:
+            self.oracle.place_existing_pod(pod)
+            self.cluster_pods.append(pod)
+        loose = [p for p in pods if not (p.get("spec") or {}).get("nodeName")]
+        if not loose:
+            return failed
+        engine = TpuEngine(self.oracle)
+        placements, reasons = engine.schedule(loose)
+        for pod, node_idx, reason in zip(loose, placements, reasons):
+            if node_idx < 0:
+                failed.append(
+                    UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reason))
+                )
+            else:
+                engine.commit_host(pod, node_idx)
+                self.cluster_pods.append(pod)
+        return failed
+
+    def node_status(self) -> List[NodeStatus]:
+        out = []
+        for ns in self.oracle.nodes:
+            out.append(NodeStatus(node=ns.node, pods=list(ns.pods)))
+        return out
+
+
+def simulate(
+    cluster: ResourceTypes, apps: List[AppResource], engine: str = "oracle"
+) -> SimulateResult:
+    """One-shot simulation (core.go:64-103)."""
+    sim = Simulator(engine=engine)
+    cluster = cluster.copy()
+    failed: List[UnscheduledPod] = []
+    result = sim.run_cluster(cluster)
+    failed.extend(result.unscheduled_pods)
+    for app in apps:
+        result = sim.schedule_app(app)
+        failed.extend(result.unscheduled_pods)
+    return SimulateResult(unscheduled_pods=failed, node_status=sim.node_status())

@@ -1,0 +1,1001 @@
+"""Serial oracle scheduler.
+
+A pure-Python, bit-exact reimplementation of one kube-scheduler v1.20.5
+scheduling cycle (vendor/.../scheduler/core/generic_scheduler.go:131-180)
+with the simulator's plugin profile:
+
+  Filter:  NodeUnschedulable, NodeName, TaintToleration, NodeAffinity,
+           NodePorts, NodeResourcesFit, PodTopologySpread,
+           InterPodAffinity, Open-Local, Open-Gpu-Share
+  Score:   NodeResourcesBalancedAllocation(1), ImageLocality(1),
+           InterPodAffinity(1), NodeResourcesLeastAllocated(1),
+           NodeAffinity(1), NodePreferAvoidPods(10000),
+           PodTopologySpread(2), TaintToleration(1), Simon(1),
+           Open-Local(1), Open-Gpu-Share(1)
+           (default registry algorithmprovider/registry.go:118-131 plus
+           the three custom plugins appended by
+           pkg/simulator/utils.go:229-241)
+
+The volume plugins of the default profile (VolumeRestrictions,
+NodeVolumeLimits, VolumeBinding, VolumeZone) are vacuous here because
+MakeValidPod rewrites every PVC volume to a hostPath (pkg/utils/
+utils.go:476-484), so no pod ever carries a PVC volume source.
+
+Deviation from the reference (documented, deliberate): selectHost uses
+reservoir sampling among top-score nodes (generic_scheduler.go:186-209,
+rand.Intn) — we pin the deterministic first maximum in node order so the
+oracle and the TPU engine agree bit-for-bit.
+
+This oracle exists for conformance: the JAX engine
+(open_simulator_tpu/ops/scan.py) must reproduce its placements exactly.
+It is also the semantic documentation of every plugin formula.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Dict, List, Optional, Tuple
+
+from ..models import labels as lbl
+from ..models import requests as req
+from ..models import storage as stor
+from ..models.workloads import DEFAULT_SCHEDULER_NAME
+
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0
+
+# ImageLocality thresholds (vendor/.../imagelocality/image_locality.go)
+_MB = 1024 * 1024
+IMG_MIN_THRESHOLD = 23 * _MB
+IMG_MAX_CONTAINER_THRESHOLD = 1000 * _MB
+
+HARD_POD_AFFINITY_WEIGHT = 1  # interpodaffinity args default
+
+
+# ---------------------------------------------------------------- node state
+
+
+@dataclass
+class GpuState:
+    """Per-device GPU memory accounting (open-gpu-share GpuNodeInfo)."""
+
+    count: int
+    per_device_mem: int
+    used: List[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.used:
+            self.used = [0] * self.count
+
+    def available(self) -> List[int]:
+        return [self.per_device_mem - u for u in self.used]
+
+    def allocatable_count(self) -> int:
+        """Number of fully-idle devices (NodeGpuInfo.GpuAllocatable)."""
+        return sum(1 for u in self.used if u == 0)
+
+    def allocate_gpu_ids(self, per_gpu_mem: int, count: int) -> Optional[List[int]]:
+        """AllocateGpuId (gpunodeinfo.go:232-291).
+
+        1 GPU: tightest fit (min idle memory that still fits, lowest
+        device id wins ties via strict '<' on idle memory).
+        k GPUs: two-pointer greedy packing in device-id order.
+        """
+        if per_gpu_mem <= 0 or count <= 0:
+            return None
+        avail = self.available()
+        if count == 1:
+            best, best_mem = None, None
+            for dev in range(self.count):
+                idle = avail[dev]
+                if idle >= per_gpu_mem:
+                    if best is None or idle < best_mem:
+                        best, best_mem = dev, idle
+            return None if best is None else [best]
+        out: List[int] = []
+        dev = 0
+        picked = 0
+        while dev < self.count and picked < count:
+            if avail[dev] >= per_gpu_mem:
+                out.append(dev)
+                avail[dev] -= per_gpu_mem
+                picked += 1
+            else:
+                dev += 1
+        return out if picked == count else None
+
+    def commit(self, devs: List[int], per_gpu_mem: int):
+        for d in devs:
+            self.used[d] += per_gpu_mem
+
+
+@dataclass
+class NodeState:
+    node: dict
+    index: int
+    pods: List[dict] = field(default_factory=list)
+    # Requested (true requests) and NonZeroRequested (scoring defaults)
+    req_mcpu: int = 0
+    req_mem: int = 0
+    req_eph: int = 0
+    req_scalar: Dict[str, int] = field(default_factory=dict)
+    nz_mcpu: int = 0
+    nz_mem: int = 0
+    used_ports: set = field(default_factory=set)  # (ip, proto, port)
+    gpu: Optional[GpuState] = None
+    storage: Optional[stor.NodeStorage] = None
+    # mutable allocatable (gpu-count is updated by the GPU plugin Reserve)
+    alloc: Dict[str, Fraction] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return (self.node.get("metadata") or {}).get("name", "")
+
+    @property
+    def labels(self) -> dict:
+        return (self.node.get("metadata") or {}).get("labels") or {}
+
+    def alloc_milli_cpu(self) -> int:
+        v = self.alloc.get(req.CPU, Fraction(0)) * 1000
+        return v.numerator // v.denominator
+
+    def alloc_int(self, resource: str) -> int:
+        v = self.alloc.get(resource, Fraction(0))
+        return v.numerator // v.denominator
+
+
+def _pod_host_ports(pod: dict) -> List[Tuple[str, str, int]]:
+    spec = pod.get("spec") or {}
+    host_net = bool(spec.get("hostNetwork"))
+    out = []
+    for c in spec.get("containers") or []:
+        for p in c.get("ports") or []:
+            port = p.get("hostPort")
+            if not port and host_net:
+                port = p.get("containerPort")
+            if not port:
+                continue
+            ip = p.get("hostIP") or "0.0.0.0"
+            proto = p.get("protocol") or "TCP"
+            out.append((ip, proto, int(port)))
+    return out
+
+
+def _ports_conflict(want: List[Tuple[str, str, int]], used: set) -> bool:
+    for ip, proto, port in want:
+        for uip, uproto, uport in used:
+            if uport != port or uproto != proto:
+                continue
+            if ip == "0.0.0.0" or uip == "0.0.0.0" or ip == uip:
+                return True
+    return False
+
+
+# ------------------------------------------------------------------- oracle
+
+
+class Oracle:
+    """Serial scheduler over mutable node states."""
+
+    def __init__(self, nodes: List[dict]):
+        self.nodes: List[NodeState] = []
+        self.node_index: Dict[str, int] = {}
+        for n in nodes:
+            self.add_node(n)
+
+    # -- cluster mutation ---------------------------------------------------
+
+    def add_node(self, node: dict):
+        import copy as _copy
+
+        # deep-copy: binding writes annotations (storage, gpu) into the
+        # node; the caller's ResourceTypes must stay reusable across runs
+        node = _copy.deepcopy(node)
+        ns = NodeState(node=node, index=len(self.nodes))
+        ns.alloc = req.node_allocatable(node)
+        gpu_count = stor.node_gpu_count(node)
+        if gpu_count > 0:
+            ns.gpu = GpuState(count=gpu_count, per_device_mem=stor.node_gpu_per_device_memory(node))
+        ns.storage = stor.parse_node_storage(node)
+        self.nodes.append(ns)
+        self.node_index[ns.name] = ns.index
+
+    def place_existing_pod(self, pod: dict):
+        """Admit a pod that already has spec.nodeName (no scheduling).
+
+        GPU accounting mirrors the reference cache build from running
+        pods (open-gpu-share cache.AddOrUpdatePod): a pod carrying a
+        gpu-index annotation charges those devices; one without an index
+        gets devices allocated as AllocateGpuId would.
+        """
+        name = (pod.get("spec") or {}).get("nodeName")
+        if name not in self.node_index:
+            return
+        ns = self.nodes[self.node_index[name]]
+        gpu_mem, gpu_cnt = stor.pod_gpu_request(pod)
+        if gpu_mem > 0 and ns.gpu is not None:
+            anno = (pod.get("metadata") or {}).get("annotations") or {}
+            idx = anno.get(stor.GPU_INDEX_ANNO)
+            if idx:
+                devs = [int(d) for d in str(idx).split("-") if str(d).isdigit()]
+            else:
+                devs = ns.gpu.allocate_gpu_ids(gpu_mem, gpu_cnt or 1)
+            if devs:
+                ns.gpu.commit(devs, gpu_mem)
+                ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
+        self._commit(pod, ns)
+
+    # -- the scheduling cycle ----------------------------------------------
+
+    def schedule_pod(self, pod: dict) -> Tuple[Optional[str], str]:
+        """One scheduleOne cycle. Returns (node_name, reason)."""
+        feasible, reasons = self._find_feasible(pod)
+        if not feasible:
+            return None, self._failure_message(pod, reasons)
+        scores = self._prioritize(pod, feasible)
+        best = feasible[0]
+        best_score = scores[0]
+        for ns, sc in zip(feasible[1:], scores[1:]):
+            if sc > best_score:
+                best, best_score = ns, sc
+        self._reserve_and_bind(pod, best)
+        return best.name, ""
+
+    # -- filters ------------------------------------------------------------
+
+    def _find_feasible(self, pod: dict):
+        spec = pod.get("spec") or {}
+        meta = pod.get("metadata") or {}
+        pod_req = req.pod_requests(pod)
+        want_ports = _pod_host_ports(pod)
+        topo_state = self._topology_spread_prefilter(pod)
+        ipa_state = self._interpod_prefilter(pod)
+        lvm_vols, dev_vols = stor.parse_pod_local_volumes(pod)
+        gpu_mem, gpu_cnt = stor.pod_gpu_request(pod)
+        pod_gpu_mem_total = stor.pod_gpu_memory(pod)
+
+        feasible = []
+        reasons: Dict[str, int] = {}
+
+        def fail(reason: str):
+            reasons[reason] = reasons.get(reason, 0) + 1
+
+        for ns in self.nodes:
+            node = ns.node
+            nspec = node.get("spec") or {}
+            # NodeUnschedulable
+            if nspec.get("unschedulable") and not lbl.tolerations_tolerate_taint(
+                spec.get("tolerations") or [],
+                {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"},
+            ):
+                fail("node(s) were unschedulable")
+                continue
+            # NodeName
+            if spec.get("nodeName") and spec["nodeName"] != ns.name:
+                fail("node(s) didn't match the requested hostname")
+                continue
+            # TaintToleration
+            taint = lbl.find_untolerated_taint(
+                nspec.get("taints") or [], spec.get("tolerations") or []
+            )
+            if taint is not None:
+                fail(
+                    "node(s) had taint {%s: %s}, that the pod didn't tolerate"
+                    % (taint.get("key", ""), taint.get("value", ""))
+                )
+                continue
+            # NodeAffinity
+            if not lbl.pod_matches_node_selector_and_affinity(spec, node):
+                fail("node(s) didn't match node selector")
+                continue
+            # NodePorts
+            if _ports_conflict(want_ports, ns.used_ports):
+                fail("node(s) didn't have free ports for the requested pod ports")
+                continue
+            # NodeResourcesFit
+            r = self._fits_resources(pod_req, ns)
+            if r:
+                fail(r)
+                continue
+            # PodTopologySpread
+            if not self._topology_spread_filter(pod, topo_state, ns):
+                fail("node(s) didn't match pod topology spread constraints")
+                continue
+            # InterPodAffinity
+            r = self._interpod_filter(pod, ipa_state, ns)
+            if r:
+                fail(r)
+                continue
+            # Open-Local
+            r = self._open_local_filter(lvm_vols, dev_vols, ns)
+            if r:
+                fail(r)
+                continue
+            # Open-Gpu-Share
+            if pod_gpu_mem_total > 0:
+                if ns.gpu is None or ns.gpu.count * ns.gpu.per_device_mem < pod_gpu_mem_total:
+                    fail("Insufficient GPU memory")
+                    continue
+                if ns.gpu.allocate_gpu_ids(gpu_mem, gpu_cnt) is None:
+                    fail("No GPU device can fit the pod")
+                    continue
+            feasible.append(ns)
+        return feasible, reasons
+
+    def _fits_resources(self, pod_req: dict, ns: NodeState) -> Optional[str]:
+        """fitsRequest (noderesources/fit.go:230-303)."""
+        allowed_pods = ns.alloc_int(req.PODS)
+        if len(ns.pods) + 1 > allowed_pods:
+            return "Too many pods"
+        mcpu = pod_req.get(req.CPU, Fraction(0)) * 1000
+        mcpu = -((-mcpu.numerator) // mcpu.denominator)
+        mem = pod_req.get(req.MEMORY, Fraction(0))
+        mem = -((-mem.numerator) // mem.denominator)
+        eph = pod_req.get(req.EPHEMERAL, Fraction(0))
+        eph = -((-eph.numerator) // eph.denominator)
+        scalars = {
+            name: v
+            for name, v in pod_req.items()
+            if name not in (req.CPU, req.MEMORY, req.EPHEMERAL, req.PODS)
+            and req.is_scalar_resource(name)
+        }
+        if mcpu == 0 and mem == 0 and eph == 0 and not scalars:
+            return None
+        if ns.alloc_milli_cpu() < mcpu + ns.req_mcpu:
+            return "Insufficient cpu"
+        if ns.alloc_int(req.MEMORY) < mem + ns.req_mem:
+            return "Insufficient memory"
+        if ns.alloc_int(req.EPHEMERAL) < eph + ns.req_eph:
+            return "Insufficient ephemeral-storage"
+        for name, v in scalars.items():
+            iv = -((-v.numerator) // v.denominator)
+            if ns.alloc_int(name) < iv + ns.req_scalar.get(name, 0):
+                return f"Insufficient {name}"
+        return None
+
+    # -- topology spread ----------------------------------------------------
+
+    def _hard_spread_constraints(self, pod: dict) -> list:
+        out = []
+        for c in (pod.get("spec") or {}).get("topologySpreadConstraints") or []:
+            if c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule":
+                out.append(c)
+        return out
+
+    def _soft_spread_constraints(self, pod: dict) -> list:
+        return [
+            c
+            for c in (pod.get("spec") or {}).get("topologySpreadConstraints") or []
+            if c.get("whenUnsatisfiable") == "ScheduleAnyway"
+        ]
+
+    def _count_matching_pods(self, ns: NodeState, selector, namespace: str) -> int:
+        """countPodsMatchSelector: same namespace, selector match, not
+        terminating (we have no deletion timestamps)."""
+        n = 0
+        for p in ns.pods:
+            pm = p.get("metadata") or {}
+            if (pm.get("namespace") or "default") != namespace:
+                continue
+            if lbl.match_labels_selector(selector, pm.get("labels") or {}):
+                n += 1
+        return n
+
+    def _topology_spread_prefilter(self, pod: dict):
+        """calPreFilterState (podtopologyspread/filtering.go:197-275)."""
+        constraints = self._hard_spread_constraints(pod)
+        if not constraints:
+            return None
+        namespace = (pod.get("metadata") or {}).get("namespace") or "default"
+        spec = pod.get("spec") or {}
+        # candidate topology domains: nodes passing nodeSelector/affinity
+        # and having every constraint topology key
+        counts: List[Dict[str, int]] = [dict() for _ in constraints]
+        for ns in self.nodes:
+            node = ns.node
+            if not lbl.pod_matches_node_selector_and_affinity(spec, node):
+                continue
+            nl = ns.labels
+            if not all(c.get("topologyKey", "") in nl for c in constraints):
+                continue
+            for i, c in enumerate(constraints):
+                counts[i].setdefault(nl[c["topologyKey"]], 0)
+        for ns in self.nodes:
+            nl = ns.labels
+            for i, c in enumerate(constraints):
+                key = c.get("topologyKey", "")
+                if key not in nl or nl[key] not in counts[i]:
+                    continue
+                counts[i][nl[key]] += self._count_matching_pods(
+                    ns, c.get("labelSelector"), namespace
+                )
+        min_counts = [min(v.values()) if v else 0 for v in counts]
+        return constraints, counts, min_counts
+
+    def _topology_spread_filter(self, pod: dict, state, ns: NodeState) -> bool:
+        if state is None:
+            return True
+        constraints, counts, min_counts = state
+        meta = pod.get("metadata") or {}
+        pod_labels = meta.get("labels") or {}
+        nl = ns.labels
+        for i, c in enumerate(constraints):
+            key = c.get("topologyKey", "")
+            if key not in nl:
+                return False
+            self_match = 1 if lbl.match_labels_selector(c.get("labelSelector"), pod_labels) else 0
+            match_num = counts[i].get(nl[key], 0)
+            skew = match_num + self_match - min_counts[i]
+            if skew > int(c.get("maxSkew", 1)):
+                return False
+        return True
+
+    # -- interpod affinity --------------------------------------------------
+
+    def _interpod_prefilter(self, pod: dict):
+        """PreFilter (interpodaffinity/filtering.go:241-275): three
+        topology-pair count maps."""
+        req_aff = lbl.resolve_affinity_terms(
+            pod, "podAffinity", "requiredDuringSchedulingIgnoredDuringExecution"
+        )
+        req_anti = lbl.resolve_affinity_terms(
+            pod, "podAntiAffinity", "requiredDuringSchedulingIgnoredDuringExecution"
+        )
+        # existing pods' required anti-affinity vs the incoming pod
+        existing_anti: Dict[Tuple[str, str], int] = {}
+        for ns in self.nodes:
+            nl = ns.labels
+            for p in ns.pods:
+                for term in lbl.resolve_affinity_terms(
+                    p, "podAntiAffinity", "requiredDuringSchedulingIgnoredDuringExecution"
+                ):
+                    if term.matches_pod(pod) and term.topology_key in nl:
+                        pair = (term.topology_key, nl[term.topology_key])
+                        existing_anti[pair] = existing_anti.get(pair, 0) + 1
+        # incoming pod's terms vs existing pods
+        aff_counts: Dict[Tuple[str, str], int] = {}
+        anti_counts: Dict[Tuple[str, str], int] = {}
+        for ns in self.nodes:
+            nl = ns.labels
+            for p in ns.pods:
+                # affinity: pod must match ALL terms to count
+                if req_aff and all(t.matches_pod(p) for t in req_aff):
+                    for t in req_aff:
+                        if t.topology_key in nl:
+                            pair = (t.topology_key, nl[t.topology_key])
+                            aff_counts[pair] = aff_counts.get(pair, 0) + 1
+                for t in req_anti:
+                    if t.matches_pod(p) and t.topology_key in nl:
+                        pair = (t.topology_key, nl[t.topology_key])
+                        anti_counts[pair] = anti_counts.get(pair, 0) + 1
+        return req_aff, req_anti, existing_anti, aff_counts, anti_counts
+
+    def _interpod_filter(self, pod: dict, state, ns: NodeState) -> Optional[str]:
+        req_aff, req_anti, existing_anti, aff_counts, anti_counts = state
+        nl = ns.labels
+        # satisfyPodAffinity
+        if req_aff:
+            pods_exist = True
+            for t in req_aff:
+                if t.topology_key not in nl:
+                    return "node(s) didn't match pod affinity rules"
+                if aff_counts.get((t.topology_key, nl[t.topology_key]), 0) <= 0:
+                    pods_exist = False
+            if not pods_exist:
+                # bootstrap: no matching pod anywhere and the pod matches
+                # its own affinity terms
+                if not (not aff_counts and all(t.matches_pod(pod) for t in req_aff)):
+                    return "node(s) didn't match pod affinity rules"
+        # satisfyPodAntiAffinity
+        for t in req_anti:
+            if t.topology_key in nl and anti_counts.get((t.topology_key, nl[t.topology_key]), 0) > 0:
+                return "node(s) didn't match pod anti-affinity rules"
+        # satisfyExistingPodsAntiAffinity
+        if existing_anti:
+            for k, v in nl.items():
+                if existing_anti.get((k, v), 0) > 0:
+                    return "node(s) didn't satisfy existing pods anti-affinity rules"
+        return None
+
+    # -- open-local ---------------------------------------------------------
+
+    def _lvm_fit(self, lvm_vols, storage: stor.NodeStorage) -> Optional[list]:
+        """ProcessLVMPVCPredicate/Priority with the Binpack strategy:
+        tightest VG first. Returns allocation [(vg_index, size)] or None.
+
+        Our pod volumes never carry an explicit VG (the reference's
+        simon/pod-local-storage volumes don't either), so only the
+        without-VG path matters.
+        """
+        free = [vg.capacity - vg.requested for vg in storage.vgs]
+        if not storage.vgs:
+            return None
+        out = []
+        for vol in lvm_vols:
+            order = sorted(range(len(free)), key=lambda i: free[i])
+            placed = False
+            for i in order:
+                if free[i] >= vol.size:
+                    free[i] -= vol.size
+                    out.append((i, vol.size))
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return out
+
+    def _device_fit(self, dev_vols, storage: stor.NodeStorage) -> Optional[list]:
+        """ProcessDevicePVC: SSD then HDD; volumes ascending by size
+        against free devices ascending by capacity. Returns [(device
+        index in storage.devices, size)] or None."""
+        out = []
+        for media in ("ssd", "hdd"):
+            vols = sorted(
+                [v for v in dev_vols if v.kind.lower() == media], key=lambda v: v.size
+            )
+            if not vols:
+                continue
+            devs = [
+                (i, d)
+                for i, d in enumerate(storage.devices)
+                if not d.is_allocated and d.media_type == media
+            ]
+            if len(devs) < len(vols):
+                return None
+            devs.sort(key=lambda t: t[1].capacity)
+            vi = 0
+            for j, (idx, d) in enumerate(devs):
+                if vi >= len(vols):
+                    break
+                if d.capacity < vols[vi].size:
+                    if j == len(devs) - 1:
+                        return None
+                    continue
+                out.append((idx, vols[vi].size))
+                vi += 1
+            if vi < len(vols):
+                return None
+        return out
+
+    def _open_local_filter(self, lvm_vols, dev_vols, ns: NodeState) -> Optional[str]:
+        if not lvm_vols and not dev_vols:
+            return None
+        if ns.storage is None:
+            return "no local storage on node"
+        if lvm_vols and self._lvm_fit(lvm_vols, ns.storage) is None:
+            return "not enough LVM storage"
+        if dev_vols and self._device_fit(dev_vols, ns.storage) is None:
+            return "not enough device storage"
+        return None
+
+    # -- scoring ------------------------------------------------------------
+
+    def _prioritize(self, pod: dict, feasible: List[NodeState]) -> List[int]:
+        """prioritizeNodes: per-plugin score + normalize + weighted sum
+        (generic_scheduler.go:470-566)."""
+        total = [0] * len(feasible)
+
+        def add(scores: List[int], weight: int):
+            for i, s in enumerate(scores):
+                total[i] += s * weight
+
+        add(self._score_balanced_allocation(pod, feasible), 1)
+        add(self._score_image_locality(pod, feasible), 1)
+        add(self._score_interpod_affinity(pod, feasible), 1)
+        add(self._score_least_allocated(pod, feasible), 1)
+        add(self._score_node_affinity(pod, feasible), 1)
+        add(self._score_prefer_avoid_pods(pod, feasible), 10000)
+        add(self._score_topology_spread(pod, feasible), 2)
+        add(self._score_taint_toleration(pod, feasible), 1)
+        add(self._score_simon(pod, feasible), 1)
+        add(self._score_open_local(pod, feasible), 1)
+        add(self._score_gpu_share(pod, feasible), 1)
+        return total
+
+    @staticmethod
+    def _default_normalize(scores: List[int], reverse: bool) -> List[int]:
+        max_count = max(scores) if scores else 0
+        if max_count == 0:
+            return [MAX_NODE_SCORE if reverse else 0 for _ in scores]
+        out = []
+        for s in scores:
+            v = MAX_NODE_SCORE * s // max_count
+            out.append(MAX_NODE_SCORE - v if reverse else v)
+        return out
+
+    @staticmethod
+    def _minmax_normalize(scores: List[int]) -> List[int]:
+        """Simon/Open-Local/Open-Gpu-Share NormalizeScore
+        (simon.go:75-100): min-max rescale, all-equal -> MinNodeScore."""
+        if not scores:
+            return scores
+        hi, lo = max(scores), min(scores)
+        old_range = hi - lo
+        if old_range == 0:
+            return [MIN_NODE_SCORE for _ in scores]
+        return [
+            (s - lo) * (MAX_NODE_SCORE - MIN_NODE_SCORE) // old_range + MIN_NODE_SCORE
+            for s in scores
+        ]
+
+    def _score_balanced_allocation(self, pod: dict, feasible) -> List[int]:
+        cpu_req = req.pod_nonzero_request(pod, req.CPU)
+        mem_req = req.pod_nonzero_request(pod, req.MEMORY)
+        out = []
+        for ns in feasible:
+            cpu_alloc = ns.alloc_milli_cpu()
+            mem_alloc = ns.alloc_int(req.MEMORY)
+            cpu_frac = (ns.nz_mcpu + cpu_req) / cpu_alloc if cpu_alloc else 1.0
+            mem_frac = (ns.nz_mem + mem_req) / mem_alloc if mem_alloc else 1.0
+            if cpu_frac >= 1 or mem_frac >= 1:
+                out.append(0)
+                continue
+            out.append(int((1 - abs(cpu_frac - mem_frac)) * MAX_NODE_SCORE))
+        return out
+
+    def _score_least_allocated(self, pod: dict, feasible) -> List[int]:
+        cpu_req = req.pod_nonzero_request(pod, req.CPU)
+        mem_req = req.pod_nonzero_request(pod, req.MEMORY)
+        out = []
+        for ns in feasible:
+            cpu_alloc = ns.alloc_milli_cpu()
+            mem_alloc = ns.alloc_int(req.MEMORY)
+
+            def least(requested, capacity):
+                if capacity == 0 or requested > capacity:
+                    return 0
+                return (capacity - requested) * MAX_NODE_SCORE // capacity
+
+            s = least(ns.nz_mcpu + cpu_req, cpu_alloc) + least(ns.nz_mem + mem_req, mem_alloc)
+            out.append(s // 2)
+        return out
+
+    def _score_image_locality(self, pod: dict, feasible) -> List[int]:
+        containers = (pod.get("spec") or {}).get("containers") or []
+        if not containers:
+            return [0] * len(feasible)
+        total_nodes = len(self.nodes)
+        wanted = set()
+        for c in containers:
+            name = c.get("image", "")
+            if ":" not in name.rsplit("/", 1)[-1]:
+                name = name + ":latest"
+            wanted.add(name)
+        # image -> number of nodes having it (ImageStateSummary.NumNodes),
+        # computed once per cycle rather than per candidate node
+        spread: Dict[str, int] = {w: 0 for w in wanted}
+        for ns in self.nodes:
+            seen = set()
+            for img in ((ns.node.get("status") or {}).get("images")) or []:
+                for n in img.get("names") or []:
+                    if n in wanted and n not in seen:
+                        spread[n] += 1
+                        seen.add(n)
+        out = []
+        for ns in feasible:
+            images = {}
+            for img in ((ns.node.get("status") or {}).get("images")) or []:
+                size = int(img.get("sizeBytes", 0))
+                for name in img.get("names") or []:
+                    if name in wanted:
+                        images[name] = size
+            s = 0
+            for c in containers:
+                name = c.get("image", "")
+                if ":" not in name.rsplit("/", 1)[-1]:
+                    name = name + ":latest"
+                if name in images:
+                    s += int(images[name] * (spread[name] / total_nodes))
+            max_threshold = IMG_MAX_CONTAINER_THRESHOLD * len(containers)
+            s = min(max(s, IMG_MIN_THRESHOLD), max_threshold)
+            out.append(MAX_NODE_SCORE * (s - IMG_MIN_THRESHOLD) // (max_threshold - IMG_MIN_THRESHOLD))
+        return out
+
+    def _score_node_affinity(self, pod: dict, feasible) -> List[int]:
+        raw = [lbl.preferred_node_affinity_score(pod.get("spec") or {}, ns.node) for ns in feasible]
+        return self._default_normalize(raw, reverse=False)
+
+    def _score_taint_toleration(self, pod: dict, feasible) -> List[int]:
+        tolerations = (pod.get("spec") or {}).get("tolerations") or []
+        raw = [
+            lbl.count_intolerable_prefer_no_schedule(
+                (ns.node.get("spec") or {}).get("taints") or [], tolerations
+            )
+            for ns in feasible
+        ]
+        return self._default_normalize(raw, reverse=True)
+
+    def _score_prefer_avoid_pods(self, pod: dict, feasible) -> List[int]:
+        """NodePreferAvoidPods: 0 when the node's
+        scheduler.alpha.kubernetes.io/preferAvoidPods annotation matches
+        the pod's RC/RS controller, else 100."""
+        refs = (pod.get("metadata") or {}).get("ownerReferences") or []
+        ctrl = next((r for r in refs if r.get("controller")), None)
+        if ctrl is not None and ctrl.get("kind") not in ("ReplicationController", "ReplicaSet"):
+            ctrl = None
+        out = []
+        for ns in feasible:
+            if ctrl is None:
+                out.append(MAX_NODE_SCORE)
+                continue
+            anno = (ns.node.get("metadata") or {}).get("annotations") or {}
+            raw = anno.get("scheduler.alpha.kubernetes.io/preferAvoidPods")
+            avoided = False
+            if raw:
+                import json as _json
+
+                try:
+                    avoids = _json.loads(raw)
+                    for item in avoids.get("preferAvoidPods") or []:
+                        pc = ((item.get("podSignature") or {}).get("podController")) or {}
+                        if pc.get("kind") == ctrl.get("kind") and (
+                            not pc.get("uid") or pc.get("uid") == ctrl.get("uid")
+                        ):
+                            avoided = True
+                except (ValueError, AttributeError):
+                    avoided = False
+            out.append(0 if avoided else MAX_NODE_SCORE)
+        return out
+
+    def _score_topology_spread(self, pod: dict, feasible) -> List[int]:
+        """PodTopologySpread PreScore/Score/NormalizeScore
+        (podtopologyspread/scoring.go)."""
+        constraints = self._soft_spread_constraints(pod)
+        if not constraints:
+            # empty state: every node normalizes to MaxNodeScore
+            return [MAX_NODE_SCORE] * len(feasible)
+        namespace = (pod.get("metadata") or {}).get("namespace") or "default"
+        spec = pod.get("spec") or {}
+        # candidate domains from FEASIBLE nodes; ignored = feasible nodes
+        # missing a topology key
+        ignored = set()
+        pair_counts: List[Dict[str, int]] = [dict() for _ in constraints]
+        topo_size = [0] * len(constraints)
+        for ns in feasible:
+            nl = ns.labels
+            if not all(c.get("topologyKey", "") in nl for c in constraints):
+                ignored.add(ns.index)
+                continue
+            for i, c in enumerate(constraints):
+                key = c["topologyKey"]
+                if key == "kubernetes.io/hostname":
+                    continue
+                val = nl[key]
+                if val not in pair_counts[i]:
+                    pair_counts[i][val] = 0
+                    topo_size[i] += 1
+        weights = []
+        for i, c in enumerate(constraints):
+            sz = topo_size[i]
+            if c.get("topologyKey") == "kubernetes.io/hostname":
+                sz = len(feasible) - len(ignored)
+            weights.append(math.log(sz + 2))
+        # count matching pods over ALL nodes that qualify
+        for ns in self.nodes:
+            nl = ns.labels
+            if not lbl.pod_matches_node_selector_and_affinity(spec, ns.node):
+                continue
+            if not all(c.get("topologyKey", "") in nl for c in constraints):
+                continue
+            for i, c in enumerate(constraints):
+                key = c["topologyKey"]
+                if key == "kubernetes.io/hostname":
+                    continue
+                val = nl[key]
+                if val in pair_counts[i]:
+                    pair_counts[i][val] += self._count_matching_pods(
+                        ns, c.get("labelSelector"), namespace
+                    )
+        raw = []
+        for ns in feasible:
+            if ns.index in ignored:
+                raw.append(-1)  # invalidScore marker
+                continue
+            score = 0.0
+            nl = ns.labels
+            for i, c in enumerate(constraints):
+                key = c.get("topologyKey", "")
+                if key in nl:
+                    if key == "kubernetes.io/hostname":
+                        cnt = self._count_matching_pods(ns, c.get("labelSelector"), namespace)
+                    else:
+                        cnt = pair_counts[i].get(nl[key], 0)
+                    score += cnt * weights[i] + (int(c.get("maxSkew", 1)) - 1)
+            raw.append(int(score))
+        # normalize
+        valid = [s for s in raw if s != -1]
+        if not valid:
+            return [0] * len(feasible)
+        min_s, max_s = min(valid), max(valid)
+        out = []
+        for s in raw:
+            if s == -1:
+                out.append(0)
+            elif max_s == 0:
+                out.append(MAX_NODE_SCORE)
+            else:
+                out.append(MAX_NODE_SCORE * (max_s + min_s - s) // max_s)
+        return out
+
+    def _score_interpod_affinity(self, pod: dict, feasible) -> List[int]:
+        """InterPodAffinity PreScore/Score/NormalizeScore
+        (interpodaffinity/scoring.go)."""
+        pref_aff = lbl.resolve_affinity_terms(
+            pod, "podAffinity", "preferredDuringSchedulingIgnoredDuringExecution"
+        )
+        pref_anti = lbl.resolve_affinity_terms(
+            pod, "podAntiAffinity", "preferredDuringSchedulingIgnoredDuringExecution"
+        )
+        topo_score: Dict[Tuple[str, str], int] = {}
+
+        def bump(term: lbl.AffinityTerm, target: dict, node_labels: dict, mult: int):
+            if not node_labels:
+                return
+            if term.matches_pod(target) and term.topology_key in node_labels:
+                pair = (term.topology_key, node_labels[term.topology_key])
+                topo_score[pair] = topo_score.get(pair, 0) + term.weight * mult
+
+        for ns in self.nodes:
+            nl = ns.labels
+            for existing in ns.pods:
+                for t in pref_aff:
+                    bump(t, existing, nl, 1)
+                for t in pref_anti:
+                    bump(t, existing, nl, -1)
+                for t in lbl.resolve_affinity_terms(
+                    existing, "podAffinity", "requiredDuringSchedulingIgnoredDuringExecution"
+                ):
+                    t2 = lbl.AffinityTerm(
+                        t.selector, t.topology_key, t.namespaces, HARD_POD_AFFINITY_WEIGHT
+                    )
+                    bump(t2, pod, nl, 1)
+                for t in lbl.resolve_affinity_terms(
+                    existing, "podAffinity", "preferredDuringSchedulingIgnoredDuringExecution"
+                ):
+                    bump(t, pod, nl, 1)
+                for t in lbl.resolve_affinity_terms(
+                    existing, "podAntiAffinity", "preferredDuringSchedulingIgnoredDuringExecution"
+                ):
+                    bump(t, pod, nl, -1)
+        raw = []
+        for ns in feasible:
+            s = 0
+            for (key, val), v in topo_score.items():
+                if ns.labels.get(key) == val:
+                    s += v
+            raw.append(s)
+        if not topo_score:
+            return [0] * len(feasible)
+        max_c = max(max(raw), 0)
+        min_c = min(min(raw), 0)
+        diff = max_c - min_c
+        out = []
+        for s in raw:
+            if diff > 0:
+                out.append(int(MAX_NODE_SCORE * (s - min_c) / diff))
+            else:
+                out.append(0)
+        return out
+
+    def _simon_raw(self, pod: dict, ns: NodeState) -> int:
+        """Simon plugin Score (plugin/simon.go:44-67): max over node
+        allocatable resources of share(podReq, alloc - podReq)."""
+        requests = req.pod_requests(pod)
+        limits = req.pod_limits(pod)
+        if not requests and not limits:
+            return MAX_NODE_SCORE
+        res = 0.0
+        for name, alloc in ns.alloc.items():
+            pr = float(requests.get(name, Fraction(0)))
+            avail = float(alloc) - pr
+            if avail == 0:
+                share = 0.0 if pr == 0 else 1.0
+            else:
+                share = pr / avail
+            if share > res:
+                res = share
+        return int((MAX_NODE_SCORE - MIN_NODE_SCORE) * res)
+
+    def _score_simon(self, pod: dict, feasible) -> List[int]:
+        raw = [self._simon_raw(pod, ns) for ns in feasible]
+        return self._minmax_normalize(raw)
+
+    def _score_gpu_share(self, pod: dict, feasible) -> List[int]:
+        # identical formula to Simon (open-gpu-share.go:84-109)
+        raw = [self._simon_raw(pod, ns) for ns in feasible]
+        return self._minmax_normalize(raw)
+
+    def _score_open_local(self, pod: dict, feasible) -> List[int]:
+        """Open-Local Score (open-local.go:93-137): ScoreLVM (binpack:
+        sum used/capacity over touched VGs / count * 10) + ScoreDevice
+        (sum requested/allocated / count * 10), then min-max normalized."""
+        lvm_vols, dev_vols = stor.parse_pod_local_volumes(pod)
+        raw = []
+        for ns in feasible:
+            if not lvm_vols and not dev_vols:
+                raw.append(0)
+                continue
+            if ns.storage is None:
+                raw.append(0)
+                continue
+            score = 0
+            if lvm_vols:
+                alloc = self._lvm_fit(lvm_vols, ns.storage)
+                if alloc:
+                    per_vg: Dict[int, int] = {}
+                    for vg_idx, size in alloc:
+                        per_vg[vg_idx] = per_vg.get(vg_idx, 0) + size
+                    f = 0.0
+                    for vg_idx, used in per_vg.items():
+                        f += used / ns.storage.vgs[vg_idx].capacity
+                    score += int(f / len(per_vg) * 10)
+            if dev_vols:
+                alloc = self._device_fit(dev_vols, ns.storage)
+                if alloc:
+                    f = 0.0
+                    for dev_idx, size in alloc:
+                        f += size / ns.storage.devices[dev_idx].capacity
+                    score += int(f / len(alloc) * 10)
+            raw.append(score)
+        return self._minmax_normalize(raw)
+
+    # -- reserve + bind -----------------------------------------------------
+
+    def _reserve_and_bind(self, pod: dict, ns: NodeState):
+        meta = pod.setdefault("metadata", {})
+        spec = pod.setdefault("spec", {})
+        # Open-Gpu-Share Reserve: allocate device ids, update node
+        gpu_mem, gpu_cnt = stor.pod_gpu_request(pod)
+        if stor.pod_gpu_memory(pod) > 0 and ns.gpu is not None:
+            devs = ns.gpu.allocate_gpu_ids(gpu_mem, gpu_cnt)
+            if devs is not None:
+                ns.gpu.commit(devs, gpu_mem)
+                meta.setdefault("annotations", {})[stor.GPU_INDEX_ANNO] = "-".join(
+                    str(d) for d in devs
+                )
+                ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
+        # Open-Local Bind: commit VG/device allocation
+        lvm_vols, dev_vols = stor.parse_pod_local_volumes(pod)
+        if ns.storage is not None and (lvm_vols or dev_vols):
+            alloc = self._lvm_fit(lvm_vols, ns.storage) if lvm_vols else []
+            for vg_idx, size in alloc or []:
+                ns.storage.vgs[vg_idx].requested += size
+            dalloc = self._device_fit(dev_vols, ns.storage) if dev_vols else []
+            for dev_idx, _size in dalloc or []:
+                ns.storage.devices[dev_idx].is_allocated = True
+            stor.set_node_storage(ns.node, ns.storage)
+        # Simon Bind
+        spec["nodeName"] = ns.name
+        pod.setdefault("status", {})["phase"] = "Running"
+        self._commit(pod, ns)
+
+    def _commit(self, pod: dict, ns: NodeState):
+        """NodeInfo.AddPod accounting."""
+        ns.pods.append(pod)
+        ns.req_mcpu += req.pod_request_milli_cpu(pod)
+        ns.req_mem += req.pod_request_int(pod, req.MEMORY)
+        ns.req_eph += req.pod_request_int(pod, req.EPHEMERAL)
+        for name, v in req.pod_requests(pod).items():
+            if name in (req.CPU, req.MEMORY, req.EPHEMERAL):
+                continue
+            if req.is_scalar_resource(name):
+                iv = -((-v.numerator) // v.denominator)
+                ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
+        ns.nz_mcpu += req.pod_nonzero_request(pod, req.CPU)
+        ns.nz_mem += req.pod_nonzero_request(pod, req.MEMORY)
+        for port in _pod_host_ports(pod):
+            ns.used_ports.add(port)
+
+    # -- misc ---------------------------------------------------------------
+
+    @staticmethod
+    def _failure_message(pod: dict, reasons: Dict[str, int]) -> str:
+        meta = pod.get("metadata") or {}
+        parts = ", ".join(f"{n} {r}" for r, n in sorted(reasons.items()))
+        total = sum(reasons.values())
+        return (
+            f"failed to schedule pod ({meta.get('namespace', 'default')}/{meta.get('name', '')}): "
+            f"Unschedulable: 0/{total} nodes are available: {parts}."
+        )

@@ -1,0 +1,1 @@
+from .quantity import parse_quantity, q_value, q_milli, q_float, format_quantity_bin  # noqa: F401

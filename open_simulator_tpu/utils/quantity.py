@@ -1,0 +1,82 @@
+"""Kubernetes resource.Quantity parsing.
+
+Mirrors the semantics the reference relies on via
+k8s.io/apimachinery/pkg/api/resource (Value(), MilliValue(),
+AsApproximateFloat64()) for the quantity formats that appear in cluster
+YAML: plain integers ("4"), decimals ("0.5"), milli ("100m"), binary
+suffixes ("9216Mi", "61255492Ki") and decimal suffixes ("5G"), plus
+scientific notation ("1e3").
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+_BIN = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+_DEC = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 1000),
+    "": Fraction(1),
+    "k": Fraction(10**3),
+    "M": Fraction(10**6),
+    "G": Fraction(10**9),
+    "T": Fraction(10**12),
+    "P": Fraction(10**15),
+    "E": Fraction(10**18),
+}
+
+
+def parse_quantity(value) -> Fraction:
+    """Parse a k8s quantity into an exact Fraction of base units."""
+    if value is None:
+        return Fraction(0)
+    if isinstance(value, bool):
+        raise ValueError(f"invalid quantity: {value!r}")
+    if isinstance(value, (int, float)):
+        return Fraction(str(value))
+    s = str(value).strip()
+    if not s:
+        return Fraction(0)
+    suffix = ""
+    if len(s) >= 2 and s[-2:] in _BIN:
+        suffix, num = s[-2:], s[:-2]
+        return Fraction(num) * _BIN[suffix]
+    if s[-1] in _DEC and not s[-1].isdigit():
+        suffix, num = s[-1], s[:-1]
+        return Fraction(num) * _DEC[suffix]
+    # plain number, possibly scientific notation
+    return Fraction(s)
+
+
+def q_value(value) -> int:
+    """Quantity.Value(): base units rounded up to the nearest integer."""
+    f = parse_quantity(value)
+    return -((-f.numerator) // f.denominator)  # ceil
+
+
+def q_milli(value) -> int:
+    """Quantity.MilliValue(): value * 1000, rounded up."""
+    f = parse_quantity(value) * 1000
+    return -((-f.numerator) // f.denominator)
+
+
+def q_float(value) -> float:
+    """Quantity.AsApproximateFloat64()."""
+    return float(parse_quantity(value))
+
+
+def format_quantity_bin(n: int) -> str:
+    """Render base units with binary suffix when evenly divisible (reports)."""
+    for suf in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+        d = _BIN[suf]
+        if n and n % d == 0:
+            return f"{n // d}{suf}"
+    return str(n)

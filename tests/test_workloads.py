@@ -1,0 +1,126 @@
+"""Workload -> pod expansion invariants, mirroring the replica-count
+checks of the reference unit test (pkg/simulator/core_test.go:364-591
+checkResult)."""
+
+from open_simulator_tpu.models.decode import load_directory
+from open_simulator_tpu.models import workloads as wl
+
+
+def _simple():
+    return load_directory("/root/reference/example/application/simple")
+
+
+def test_deployment_expansion_count_and_metadata():
+    res = _simple()
+    deploy = next(d for d in res.deployments if d["metadata"]["name"] == "busybox-deploy")
+    pods = wl.pods_from_deployment(deploy)
+    assert len(pods) == deploy["spec"]["replicas"]
+    for p in pods:
+        assert p["metadata"]["namespace"] == "simple"
+        # labels come from the OWNER object, not the template
+        assert p["metadata"]["labels"]["app"] == "busybox-deploy"
+        assert p["metadata"]["annotations"][wl.ANNO_WORKLOAD_KIND] == "ReplicaSet"
+        assert p["spec"]["schedulerName"] == "default-scheduler"
+        assert p["spec"]["dnsPolicy"] == "ClusterFirst"
+        # tolerations preserved from the template spec
+        assert p["spec"]["tolerations"][0]["key"] == "node-role.kubernetes.io/master"
+
+
+def test_statefulset_ordinal_names_and_storage_annotation():
+    sts = {
+        "kind": "StatefulSet",
+        "metadata": {"name": "db", "namespace": "x", "labels": {"app": "db"}},
+        "spec": {
+            "replicas": 3,
+            "template": {"spec": {"containers": [{"name": "c", "image": "img"}]}},
+            "volumeClaimTemplates": [
+                {
+                    "spec": {
+                        "storageClassName": "open-local-lvm",
+                        "resources": {"requests": {"storage": "10Gi"}},
+                    }
+                }
+            ],
+        },
+    }
+    pods = wl.pods_from_stateful_set(sts)
+    assert [p["metadata"]["name"] for p in pods] == ["db-0", "db-1", "db-2"]
+    import json
+
+    vols = json.loads(pods[0]["metadata"]["annotations"][wl.ANNO_POD_LOCAL_STORAGE])
+    assert vols["volumes"] == [
+        {"size": str(10 * 1024**3), "kind": "LVM", "scName": "open-local-lvm"}
+    ]
+
+
+def test_job_completions():
+    job = {
+        "kind": "Job",
+        "metadata": {"name": "j"},
+        "spec": {
+            "completions": 5,
+            "template": {"spec": {"containers": [{"name": "c", "image": "i"}]}},
+        },
+    }
+    assert len(wl.pods_from_job(job)) == 5
+
+
+def test_pvc_volume_rewritten_to_hostpath():
+    pod = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [{"name": "c", "image": "i"}],
+            "volumes": [{"name": "v", "persistentVolumeClaim": {"claimName": "x"}}],
+        },
+    }
+    out = wl.make_valid_pod(pod)
+    assert out["spec"]["volumes"][0]["hostPath"] == {"path": "/tmp"}
+    assert "persistentVolumeClaim" not in out["spec"]["volumes"][0]
+
+
+def test_daemonset_pins_and_skips_ineligible_nodes():
+    res = _simple()
+    ds = next(d for d in res.daemon_sets if d["metadata"]["name"] == "busybox-ds")
+    master = {
+        "metadata": {
+            "name": "m1",
+            "labels": {"node-role.kubernetes.io/master": "", "beta.kubernetes.io/os": "linux"},
+        }
+    }
+    worker = {
+        "metadata": {"name": "w1", "labels": {"beta.kubernetes.io/os": "linux"}}
+    }
+    tainted = {
+        "metadata": {"name": "w2", "labels": {"beta.kubernetes.io/os": "linux"}},
+        "spec": {"taints": [{"key": "dedicated", "effect": "NoSchedule"}]},
+    }
+    # the ds requires node-role.kubernetes.io/master DoesNotExist
+    pods = wl.pods_from_daemon_set(ds, [master, worker, tainted])
+    assert len(pods) == 1
+    terms = pods[0]["spec"]["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"]
+    assert any(
+        t.get("matchFields") == [{"key": "metadata.name", "operator": "In", "values": ["w1"]}]
+        for t in terms
+    )
+
+
+def test_daemonset_tolerations_allow_tainted_node():
+    ds = {
+        "kind": "DaemonSet",
+        "metadata": {"name": "d", "namespace": "kube-system"},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [{"name": "c", "image": "i"}],
+                    "tolerations": [{"operator": "Exists"}],
+                }
+            }
+        },
+    }
+    tainted = {
+        "metadata": {"name": "w2", "labels": {}},
+        "spec": {"taints": [{"key": "dedicated", "effect": "NoSchedule"}]},
+    }
+    assert len(wl.pods_from_daemon_set(ds, [tainted])) == 1

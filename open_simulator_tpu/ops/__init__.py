@@ -1,0 +1,13 @@
+"""JAX ops for the TPU engine.
+
+int64/float64 are enabled globally: cluster resource quantities (memory
+bytes, VG bytes, GPU memory) exceed int32 range and the engine must be
+bit-exact against the integer arithmetic of the serial oracle. On TPU,
+s64 is lowered to 32-bit pairs by XLA; the hot arithmetic (compares,
+adds over the node axis) stays cheap, and scores that tolerate rounding
+use f32.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,452 @@
+"""Host-side tensorization of cluster + pod-batch state.
+
+The fake-apiserver object store of the reference (client-go ObjectTracker
++ scheduler cache snapshot, vendor/.../internal/cache/snapshot.go:29)
+collapses into dense arrays:
+
+- per-node allocatable vectors (cpu milli, memory bytes, ephemeral,
+  pod slots) and a generic `[R, N]` allocatable matrix for the Simon
+  max-share score (plugin/simon.go:44-67)
+- per-pod-CLASS static matrices `[U, N]`: everything that does not
+  depend on placement state — taint/affinity/nodename/unschedulable
+  feasibility, preferred-node-affinity raw scores, PreferNoSchedule
+  intolerable-taint counts, NodePreferAvoidPods, ImageLocality, Simon
+  raw shares. Pods expanded from the same workload share a class, so
+  the O(pods x nodes) host work shrinks to O(classes x nodes).
+- a small host-port vocabulary with a pairwise conflict matrix
+  (wildcard-IP semantics of HostPortInfo.CheckConflict)
+- per-device GPU memory state for the open-gpu-share plugin
+
+Dynamic state (requested resources, pod counts, port usage, GPU usage)
+lives in the scan carry (ops/scan.py).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models import labels as lbl
+from ..models import requests as req
+from ..models import storage as stor
+from ..scheduler.oracle import (
+    GpuState,
+    NodeState,
+    Oracle,
+    _pod_host_ports,
+    IMG_MIN_THRESHOLD,
+    IMG_MAX_CONTAINER_THRESHOLD,
+    MAX_NODE_SCORE,
+)
+
+
+class EngineUnsupported(Exception):
+    """Raised when the pod batch (or existing cluster state) uses a
+    feature the vectorized engine does not cover yet; the caller falls
+    back to the serial oracle."""
+
+
+def _ceil(v: Fraction) -> int:
+    return -((-v.numerator) // v.denominator)
+
+
+def _has_pod_affinity(pod: dict) -> bool:
+    aff = ((pod.get("spec") or {}).get("affinity")) or {}
+    return bool(aff.get("podAffinity") or aff.get("podAntiAffinity"))
+
+
+def _has_spread(pod: dict) -> bool:
+    return bool((pod.get("spec") or {}).get("topologySpreadConstraints"))
+
+
+def _has_local_storage(pod: dict) -> bool:
+    lvm, dev = stor.parse_pod_local_volumes(pod)
+    return bool(lvm or dev)
+
+
+@dataclass
+class ClusterStatic:
+    """Placement-independent cluster tensors."""
+
+    n: int
+    node_names: List[str]
+    alloc_mcpu: np.ndarray  # [N] i64
+    alloc_mem: np.ndarray  # [N] i64
+    alloc_eph: np.ndarray  # [N] i64
+    alloc_pods: np.ndarray  # [N] i64
+    # Simon score: allocatable matrix over the union of resource names
+    simon_resources: List[str]
+    simon_alloc: np.ndarray  # [R, N] f64
+    # scalar (extended) resources tracked by NodeResourcesFit
+    scalar_names: List[str]
+    scalar_alloc: np.ndarray  # [S, N] i64
+    # GPU share
+    g: int  # max devices on any node
+    gpu_count: np.ndarray  # [N] i64
+    gpu_per_dev: np.ndarray  # [N] i64
+    gpu_total: np.ndarray  # [N] i64 (capacity gpu-mem)
+    # ports vocabulary
+    port_vocab: List[tuple]
+    port_conflict: np.ndarray  # [Pt, Pt] bool
+
+
+@dataclass
+class DynamicState:
+    """The scan carry, as host arrays (mirrors oracle NodeState)."""
+
+    used_mcpu: np.ndarray
+    used_mem: np.ndarray
+    used_eph: np.ndarray
+    used_scalar: np.ndarray  # [S, N]
+    nz_mcpu: np.ndarray
+    nz_mem: np.ndarray
+    pod_cnt: np.ndarray
+    ports_used: np.ndarray  # [N, Pt] bool
+    gpu_used: np.ndarray  # [N, G] i64
+
+
+@dataclass
+class PodBatch:
+    """A batch of pods to schedule, class-deduplicated."""
+
+    p: int
+    u: int
+    class_of_pod: np.ndarray  # [P] i32
+    pinned_node: np.ndarray  # [P] i32, -1 when loose
+    # per-class request vectors
+    req_mcpu: np.ndarray  # [U]
+    req_mem: np.ndarray
+    req_eph: np.ndarray
+    req_scalar: np.ndarray  # [U, S]
+    has_request: np.ndarray  # [U] bool (any nonzero native/scalar request)
+    nz_mcpu: np.ndarray
+    nz_mem: np.ndarray
+    gpu_mem: np.ndarray  # [U] per-GPU memory
+    gpu_cnt: np.ndarray  # [U]
+    want_ports: np.ndarray  # [U, Pt] bool (ports the pod binds)
+    conflict_ports: np.ndarray  # [U, Pt] bool (vocab entries that would conflict)
+    # static per-class matrices
+    static_feasible: np.ndarray  # [U, N] bool
+    simon_raw: np.ndarray  # [U, N] i64
+    nodeaff_raw: np.ndarray  # [U, N] i64
+    taint_intol: np.ndarray  # [U, N] i64
+    avoid_score: np.ndarray  # [U, N] i64
+    image_score: np.ndarray  # [U, N] i64
+
+
+def _class_key(pod: dict) -> str:
+    spec = pod.get("spec") or {}
+    meta = pod.get("metadata") or {}
+    anno = meta.get("annotations") or {}
+    refs = meta.get("ownerReferences") or []
+    ctrl = next((r for r in refs if r.get("controller")), None)
+    containers = [
+        {
+            "resources": c.get("resources"),
+            "ports": c.get("ports"),
+            "image": c.get("image"),
+        }
+        for c in spec.get("containers") or []
+    ]
+    inits = [{"resources": c.get("resources")} for c in spec.get("initContainers") or []]
+    key = {
+        "ns": meta.get("namespace"),
+        "nodeSelector": spec.get("nodeSelector"),
+        "affinity": spec.get("affinity"),
+        "tolerations": spec.get("tolerations"),
+        "nodeName": spec.get("nodeName"),
+        "hostNetwork": spec.get("hostNetwork"),
+        "overhead": spec.get("overhead"),
+        "containers": containers,
+        "inits": inits,
+        "gpu_mem": anno.get(stor.GPU_MEM_ANNO),
+        "gpu_cnt": anno.get(stor.GPU_COUNT_ANNO),
+        "owner_kind": (ctrl or {}).get("kind"),
+    }
+    return json.dumps(key, sort_keys=True, default=str)
+
+
+def encode_cluster(oracle: Oracle) -> ClusterStatic:
+    nodes = oracle.nodes
+    n = len(nodes)
+    alloc_mcpu = np.array([ns.alloc_milli_cpu() for ns in nodes], dtype=np.int64)
+    alloc_mem = np.array([ns.alloc_int(req.MEMORY) for ns in nodes], dtype=np.int64)
+    alloc_eph = np.array([ns.alloc_int(req.EPHEMERAL) for ns in nodes], dtype=np.int64)
+    alloc_pods = np.array([ns.alloc_int(req.PODS) for ns in nodes], dtype=np.int64)
+
+    simon_resources = sorted({name for ns in nodes for name in ns.alloc})
+    simon_alloc = np.zeros((len(simon_resources), n), dtype=np.float64)
+    for r_i, name in enumerate(simon_resources):
+        for n_i, ns in enumerate(nodes):
+            simon_alloc[r_i, n_i] = float(ns.alloc.get(name, Fraction(0)))
+
+    scalar_names = sorted(
+        {
+            name
+            for ns in nodes
+            for name in ns.alloc
+            if name not in (req.CPU, req.MEMORY, req.EPHEMERAL, req.PODS)
+            and req.is_scalar_resource(name)
+        }
+    )
+    scalar_alloc = np.zeros((len(scalar_names), n), dtype=np.int64)
+    for s_i, name in enumerate(scalar_names):
+        for n_i, ns in enumerate(nodes):
+            scalar_alloc[s_i, n_i] = ns.alloc_int(name)
+
+    gpu_count = np.array([ns.gpu.count if ns.gpu else 0 for ns in nodes], dtype=np.int64)
+    gpu_per_dev = np.array(
+        [ns.gpu.per_device_mem if ns.gpu else 0 for ns in nodes], dtype=np.int64
+    )
+    gpu_total = np.array(
+        [stor.node_total_gpu_memory(ns.node) for ns in nodes], dtype=np.int64
+    )
+    g = int(gpu_count.max()) if n else 0
+
+    # port vocab built later (needs the pod batch); placeholder
+    return ClusterStatic(
+        n=n,
+        node_names=[ns.name for ns in nodes],
+        alloc_mcpu=alloc_mcpu,
+        alloc_mem=alloc_mem,
+        alloc_eph=alloc_eph,
+        alloc_pods=alloc_pods,
+        simon_resources=simon_resources,
+        simon_alloc=simon_alloc,
+        scalar_names=scalar_names,
+        scalar_alloc=scalar_alloc,
+        g=g,
+        gpu_count=gpu_count,
+        gpu_per_dev=gpu_per_dev,
+        gpu_total=gpu_total,
+        port_vocab=[],
+        port_conflict=np.zeros((0, 0), dtype=bool),
+    )
+
+
+def encode_dynamic(oracle: Oracle, cluster: ClusterStatic) -> DynamicState:
+    nodes = oracle.nodes
+    n = cluster.n
+    s = len(cluster.scalar_names)
+    pt = len(cluster.port_vocab)
+    g = max(cluster.g, 1)
+    st = DynamicState(
+        used_mcpu=np.array([ns.req_mcpu for ns in nodes], dtype=np.int64),
+        used_mem=np.array([ns.req_mem for ns in nodes], dtype=np.int64),
+        used_eph=np.array([ns.req_eph for ns in nodes], dtype=np.int64),
+        used_scalar=np.zeros((s, n), dtype=np.int64),
+        nz_mcpu=np.array([ns.nz_mcpu for ns in nodes], dtype=np.int64),
+        nz_mem=np.array([ns.nz_mem for ns in nodes], dtype=np.int64),
+        pod_cnt=np.array([len(ns.pods) for ns in nodes], dtype=np.int64),
+        ports_used=np.zeros((n, pt), dtype=bool),
+        gpu_used=np.zeros((n, g), dtype=np.int64),
+    )
+    for s_i, name in enumerate(cluster.scalar_names):
+        for n_i, ns in enumerate(nodes):
+            st.used_scalar[s_i, n_i] = ns.req_scalar.get(name, 0)
+    for n_i, ns in enumerate(nodes):
+        for port in ns.used_ports:
+            if port in cluster.port_vocab:
+                st.ports_used[n_i, cluster.port_vocab.index(port)] = True
+        if ns.gpu:
+            for g_i, used in enumerate(ns.gpu.used):
+                st.gpu_used[n_i, g_i] = used
+    return st
+
+
+def _ports_conflict_pair(a: tuple, b: tuple) -> bool:
+    (aip, aproto, aport), (bip, bproto, bport) = a, b
+    if aport != bport or aproto != bproto:
+        return False
+    return aip == "0.0.0.0" or bip == "0.0.0.0" or aip == bip
+
+
+def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> PodBatch:
+    """Build class-deduplicated static tensors for a pod batch.
+
+    Raises EngineUnsupported for features the scan does not cover yet
+    (inter-pod affinity, topology spread, open-local volumes) — both on
+    incoming pods and on pods already in the cluster (whose terms would
+    influence scoring of newcomers).
+    """
+    for pod in pods:
+        if _has_pod_affinity(pod) or _has_spread(pod) or _has_local_storage(pod):
+            raise EngineUnsupported("pod uses affinity/spread/local-storage")
+    for ns in oracle.nodes:
+        for pod in ns.pods:
+            if _has_pod_affinity(pod):
+                raise EngineUnsupported("existing pod has pod-affinity terms")
+
+    # port vocabulary over batch + existing usage
+    vocab: List[tuple] = []
+    seen = set()
+    for ns in oracle.nodes:
+        for port in sorted(ns.used_ports):
+            if port not in seen:
+                seen.add(port)
+                vocab.append(port)
+    for pod in pods:
+        for port in _pod_host_ports(pod):
+            if port not in seen:
+                seen.add(port)
+                vocab.append(port)
+    pt = len(vocab)
+    conflict = np.zeros((pt, pt), dtype=bool)
+    for i in range(pt):
+        for j in range(pt):
+            conflict[i, j] = _ports_conflict_pair(vocab[i], vocab[j])
+    cluster.port_vocab = vocab
+    cluster.port_conflict = conflict
+
+    # class dedup
+    class_ids: Dict[str, int] = {}
+    class_pods: List[dict] = []
+    class_of_pod = np.zeros(len(pods), dtype=np.int32)
+    pinned = np.full(len(pods), -1, dtype=np.int32)
+    for p_i, pod in enumerate(pods):
+        key = _class_key(pod)
+        if key not in class_ids:
+            class_ids[key] = len(class_pods)
+            class_pods.append(pod)
+        class_of_pod[p_i] = class_ids[key]
+        node_name = (pod.get("spec") or {}).get("nodeName")
+        if node_name:
+            pinned[p_i] = oracle.node_index.get(node_name, -1)
+
+    u = len(class_pods)
+    n = cluster.n
+    s = len(cluster.scalar_names)
+
+    req_mcpu = np.zeros(u, dtype=np.int64)
+    req_mem = np.zeros(u, dtype=np.int64)
+    req_eph = np.zeros(u, dtype=np.int64)
+    req_scalar = np.zeros((u, s), dtype=np.int64)
+    has_request = np.zeros(u, dtype=bool)
+    nz_mcpu = np.zeros(u, dtype=np.int64)
+    nz_mem = np.zeros(u, dtype=np.int64)
+    gpu_mem = np.zeros(u, dtype=np.int64)
+    gpu_cnt = np.zeros(u, dtype=np.int64)
+    want_ports = np.zeros((u, pt), dtype=bool)
+    conflict_ports = np.zeros((u, pt), dtype=bool)
+    static_feasible = np.ones((u, n), dtype=bool)
+    simon_raw = np.zeros((u, n), dtype=np.int64)
+    nodeaff_raw = np.zeros((u, n), dtype=np.int64)
+    taint_intol = np.zeros((u, n), dtype=np.int64)
+    avoid_score = np.zeros((u, n), dtype=np.int64)
+    image_score = np.zeros((u, n), dtype=np.int64)
+
+    for u_i, pod in enumerate(class_pods):
+        spec = pod.get("spec") or {}
+        requests = req.pod_requests(pod)
+        req_mcpu[u_i] = _ceil(requests.get(req.CPU, Fraction(0)) * 1000)
+        req_mem[u_i] = _ceil(requests.get(req.MEMORY, Fraction(0)))
+        req_eph[u_i] = _ceil(requests.get(req.EPHEMERAL, Fraction(0)))
+        any_scalar = False
+        for s_i, name in enumerate(cluster.scalar_names):
+            if name in requests:
+                req_scalar[u_i, s_i] = _ceil(requests[name])
+                any_scalar = any_scalar or req_scalar[u_i, s_i] != 0
+        # scalar request on a resource NO node advertises still blocks
+        # scheduling via fitsRequest; treat as statically infeasible
+        unknown_scalar = any(
+            name not in (req.CPU, req.MEMORY, req.EPHEMERAL, req.PODS)
+            and req.is_scalar_resource(name)
+            and name not in cluster.scalar_names
+            and _ceil(requests[name]) > 0
+            for name in requests
+        )
+        has_request[u_i] = bool(
+            req_mcpu[u_i] or req_mem[u_i] or req_eph[u_i] or any_scalar or unknown_scalar
+        )
+        nz_mcpu[u_i] = req.pod_nonzero_request(pod, req.CPU)
+        nz_mem[u_i] = req.pod_nonzero_request(pod, req.MEMORY)
+        g_mem, g_cnt = stor.pod_gpu_request(pod)
+        gpu_mem[u_i] = g_mem
+        gpu_cnt[u_i] = g_cnt
+        for port in _pod_host_ports(pod):
+            w_i = vocab.index(port)
+            want_ports[u_i, w_i] = True
+        conflict_ports[u_i] = (
+            want_ports[u_i].astype(np.int32) @ conflict.astype(np.int32)
+        ) > 0
+
+        tolerations = spec.get("tolerations") or []
+        unsched_tolerated = lbl.tolerations_tolerate_taint(
+            tolerations,
+            {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"},
+        )
+        simon_req = {name: float(requests.get(name, Fraction(0))) for name in cluster.simon_resources}
+        simon_empty = not requests and not req.pod_limits(pod)
+
+        for n_i, ns in enumerate(oracle.nodes):
+            node = ns.node
+            nspec = node.get("spec") or {}
+            ok = True
+            if nspec.get("unschedulable") and not unsched_tolerated:
+                ok = False
+            if ok and unknown_scalar:
+                ok = False
+            if ok and lbl.find_untolerated_taint(nspec.get("taints") or [], tolerations):
+                ok = False
+            if ok and not lbl.pod_matches_node_selector_and_affinity(spec, node):
+                ok = False
+            static_feasible[u_i, n_i] = ok
+            nodeaff_raw[u_i, n_i] = lbl.preferred_node_affinity_score(spec, node)
+            taint_intol[u_i, n_i] = lbl.count_intolerable_prefer_no_schedule(
+                nspec.get("taints") or [], tolerations
+            )
+            # Simon raw share (static: pod annotations never enter podReq)
+            if simon_empty:
+                simon_raw[u_i, n_i] = MAX_NODE_SCORE
+            else:
+                res = 0.0
+                for r_i, name in enumerate(cluster.simon_resources):
+                    pr = simon_req[name]
+                    avail = cluster.simon_alloc[r_i, n_i] - pr
+                    share = (0.0 if pr == 0 else 1.0) if avail == 0 else pr / avail
+                    res = max(res, share)
+                simon_raw[u_i, n_i] = int(MAX_NODE_SCORE * res)
+        avoid_score[u_i] = _avoid_scores(pod, oracle)
+        image_score[u_i] = _image_scores(pod, oracle)
+
+    return PodBatch(
+        p=len(pods),
+        u=u,
+        class_of_pod=class_of_pod,
+        pinned_node=pinned,
+        req_mcpu=req_mcpu,
+        req_mem=req_mem,
+        req_eph=req_eph,
+        req_scalar=req_scalar,
+        has_request=has_request,
+        nz_mcpu=nz_mcpu,
+        nz_mem=nz_mem,
+        gpu_mem=gpu_mem,
+        gpu_cnt=gpu_cnt,
+        want_ports=want_ports,
+        conflict_ports=conflict_ports,
+        static_feasible=static_feasible,
+        simon_raw=simon_raw,
+        nodeaff_raw=nodeaff_raw,
+        taint_intol=taint_intol,
+        avoid_score=avoid_score,
+        image_score=image_score,
+    )
+
+
+def _avoid_scores(pod: dict, oracle: Oracle) -> np.ndarray:
+    out = np.zeros(len(oracle.nodes), dtype=np.int64)
+    scores = Oracle._score_prefer_avoid_pods(oracle, pod, oracle.nodes)
+    out[:] = scores
+    return out
+
+
+def _image_scores(pod: dict, oracle: Oracle) -> np.ndarray:
+    out = np.zeros(len(oracle.nodes), dtype=np.int64)
+    scores = Oracle._score_image_locality(oracle, pod, oracle.nodes)
+    out[:] = scores
+    return out

@@ -1,0 +1,239 @@
+"""Sequential-commit scheduling scan.
+
+The serial one-pod-at-a-time semantics of the reference
+(pkg/simulator/simulator.go:218-243: create pod -> block until the
+scheduler round-trips -> next pod) become a `jax.lax.scan` over the pod
+queue. Each step is the whole scheduling cycle of
+generic_scheduler.Schedule (core/generic_scheduler.go:131-180) fused
+over the node axis:
+
+  filter  = static_feasible  & NodeResourcesFit & NodePorts & GPU fit
+  score   = Balanced + Least + ImageLocality + NodeAffinity'
+            + PreferAvoid*10000 + TopologySpread' * 2 + TaintToleration'
+            + Simon' + GpuShare' + OpenLocal'     (' = normalized)
+  select  = first-index argmax over feasible nodes
+  commit  = rank-1 state update (requested vectors, pod count, ports,
+            per-device GPU memory)
+
+All integer arithmetic is int64 to bit-match the serial oracle.
+selectHost tie-break is pinned to the first maximum in node order (the
+reference reservoir-samples, generic_scheduler.go:186-209 — documented
+deviation shared with the oracle).
+
+Pinned pods (spec.nodeName already set) flow through the same scan as
+forced placements so that interleavings of pinned and loose pods see
+the same intermediate states as the serial path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MAX_SCORE = 100
+
+
+class ScanStatic(NamedTuple):
+    """Arrays closed over by the compiled scan (static per batch)."""
+
+    alloc_mcpu: jnp.ndarray  # [N]
+    alloc_mem: jnp.ndarray
+    alloc_eph: jnp.ndarray
+    alloc_pods: jnp.ndarray
+    scalar_alloc: jnp.ndarray  # [S, N]
+    gpu_per_dev: jnp.ndarray  # [N]
+    gpu_total: jnp.ndarray  # [N]
+    gpu_count: jnp.ndarray  # [N]
+    dev_valid: jnp.ndarray  # [N, G] bool (device exists on node)
+    # per-class static matrices
+    static_feasible: jnp.ndarray  # [U, N]
+    simon_raw: jnp.ndarray  # [U, N]
+    nodeaff_raw: jnp.ndarray  # [U, N]
+    taint_intol: jnp.ndarray  # [U, N]
+    avoid_score: jnp.ndarray  # [U, N]
+    image_score: jnp.ndarray  # [U, N]
+    # per-class request vectors
+    req_mcpu: jnp.ndarray  # [U]
+    req_mem: jnp.ndarray
+    req_eph: jnp.ndarray
+    req_scalar: jnp.ndarray  # [U, S]
+    has_request: jnp.ndarray  # [U] bool
+    nz_mcpu: jnp.ndarray
+    nz_mem: jnp.ndarray
+    gpu_mem: jnp.ndarray  # [U]
+    gpu_cnt: jnp.ndarray  # [U]
+    want_ports: jnp.ndarray  # [U, Pt]
+    conflict_ports: jnp.ndarray  # [U, Pt]
+
+
+class ScanState(NamedTuple):
+    used_mcpu: jnp.ndarray  # [N]
+    used_mem: jnp.ndarray
+    used_eph: jnp.ndarray
+    used_scalar: jnp.ndarray  # [S, N]
+    nz_mcpu: jnp.ndarray
+    nz_mem: jnp.ndarray
+    pod_cnt: jnp.ndarray
+    ports_used: jnp.ndarray  # [N, Pt] bool
+    gpu_used: jnp.ndarray  # [N, G]
+
+
+def _default_normalize(raw, feasible, reverse: bool):
+    """DefaultNormalizeScore (plugins/helper/normalize_score.go:26-53)
+    over the feasible set."""
+    masked = jnp.where(feasible, raw, 0)
+    max_count = jnp.max(masked)
+    base = jnp.where(max_count > 0, MAX_SCORE * raw // jnp.maximum(max_count, 1), 0)
+    if reverse:
+        out = jnp.where(max_count > 0, MAX_SCORE - base, MAX_SCORE)
+    else:
+        out = base
+    return out
+
+
+def _minmax_normalize(raw, feasible):
+    """Simon/GpuShare/OpenLocal NormalizeScore (plugin/simon.go:75-100)
+    over the feasible set; all-equal collapses to MinNodeScore=0."""
+    big = jnp.iinfo(jnp.int64).max
+    hi = jnp.max(jnp.where(feasible, raw, -big))
+    lo = jnp.min(jnp.where(feasible, raw, big))
+    rng = hi - lo
+    return jnp.where(rng > 0, (raw - lo) * MAX_SCORE // jnp.maximum(rng, 1), 0)
+
+
+def _least_requested(requested, capacity):
+    """leastRequestedScore (noderesources/least_allocated.go:108-117)."""
+    ok = (capacity > 0) & (requested <= capacity)
+    return jnp.where(ok, (capacity - requested) * MAX_SCORE // jnp.maximum(capacity, 1), 0)
+
+
+def _gpu_allocate(avail, dev_valid, per_gpu_mem, count):
+    """AllocateGpuId vectorized (open-gpu-share gpunodeinfo.go:232-291).
+
+    Returns (found[N], take[N, G]): take = per-device number of GPU
+    shares allocated. Single-GPU: tightest fit (min idle that fits,
+    first index on ties). Multi-GPU: two-pointer greedy in device order,
+    a device may host several shares.
+    """
+    fits = dev_valid & (avail >= per_gpu_mem)  # [N, G]
+    # single
+    big = jnp.iinfo(jnp.int64).max
+    key = jnp.where(fits, avail, big)
+    best = jnp.argmin(key, axis=1)  # first index on ties: matches strict '<'
+    single_found = jnp.any(fits, axis=1)
+    single_take = jax.nn.one_hot(best, avail.shape[1], dtype=jnp.int64) * single_found[
+        :, None
+    ].astype(jnp.int64)
+    # multi: capacity in units of per_gpu_mem per device, greedy prefix
+    cap = jnp.where(dev_valid, avail // jnp.maximum(per_gpu_mem, 1), 0)
+    cap = jnp.maximum(cap, 0)
+    prefix = jnp.cumsum(cap, axis=1) - cap  # exclusive prefix
+    multi_take = jnp.clip(count - prefix, 0, cap)
+    multi_found = jnp.sum(cap, axis=1) >= count
+    take = jnp.where(count == 1, single_take, multi_take)
+    found = jnp.where(count == 1, single_found, multi_found)
+    return found, take
+
+
+@partial(jax.jit, static_argnums=())
+def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node):
+    """Schedule every pod in order; returns (placements[P], final state).
+
+    placements[p] = node index, or -1 when unschedulable.
+    """
+
+    def step(state: ScanState, inp):
+        u, pin = inp
+        feasible = static.static_feasible[u]
+        # NodeResourcesFit (noderesources/fit.go:230-303)
+        fit_pods = state.pod_cnt + 1 <= static.alloc_pods
+        fit_cpu = static.alloc_mcpu >= static.req_mcpu[u] + state.used_mcpu
+        fit_mem = static.alloc_mem >= static.req_mem[u] + state.used_mem
+        fit_eph = static.alloc_eph >= static.req_eph[u] + state.used_eph
+        fit_scalar = jnp.all(
+            static.scalar_alloc >= static.req_scalar[u][:, None] + state.used_scalar,
+            axis=0,
+        )
+        fit_res = fit_cpu & fit_mem & fit_eph & fit_scalar
+        # zero-request pods skip everything but the pod-count check
+        fit = fit_pods & (fit_res | ~static.has_request[u])
+        # NodePorts
+        port_clash = jnp.any(state.ports_used & static.conflict_ports[u][None, :], axis=1)
+        # GPU share
+        avail = static.gpu_per_dev[:, None] - state.gpu_used
+        gpu_found, gpu_take = _gpu_allocate(
+            avail, static.dev_valid, static.gpu_mem[u], static.gpu_cnt[u]
+        )
+        needs_gpu = static.gpu_mem[u] > 0
+        gpu_ok = ~needs_gpu | ((static.gpu_total >= static.gpu_mem[u]) & gpu_found)
+
+        feasible = feasible & fit & ~port_clash & gpu_ok
+
+        # ---- scores ----
+        cpu_req_total = state.nz_mcpu + static.nz_mcpu[u]
+        mem_req_total = state.nz_mem + static.nz_mem[u]
+        least = (
+            _least_requested(cpu_req_total, static.alloc_mcpu)
+            + _least_requested(mem_req_total, static.alloc_mem)
+        ) // 2
+        cpu_frac = cpu_req_total / jnp.maximum(static.alloc_mcpu, 1)
+        cpu_frac = jnp.where(static.alloc_mcpu > 0, cpu_frac, 1.0)
+        mem_frac = mem_req_total / jnp.maximum(static.alloc_mem, 1)
+        mem_frac = jnp.where(static.alloc_mem > 0, mem_frac, 1.0)
+        balanced = jnp.where(
+            (cpu_frac >= 1) | (mem_frac >= 1),
+            0,
+            ((1 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE).astype(jnp.int64),
+        )
+        nodeaff = _default_normalize(static.nodeaff_raw[u], feasible, reverse=False)
+        tainttol = _default_normalize(static.taint_intol[u], feasible, reverse=True)
+        simon = _minmax_normalize(static.simon_raw[u], feasible)
+        # PodTopologySpread with no constraints normalizes every node to
+        # MaxNodeScore (scoring.go NormalizeScore maxScore==0 branch);
+        # InterPodAffinity and Open-Local contribute 0 without terms.
+        spread = MAX_SCORE
+        total = (
+            balanced
+            + static.image_score[u]
+            + least
+            + nodeaff
+            + static.avoid_score[u] * 10000
+            + spread * 2
+            + tainttol
+            + simon  # Simon plugin
+            + simon  # Open-Gpu-Share plugin (identical formula)
+        )
+
+        # ---- select: first max over feasible; pinned overrides ----
+        neg = jnp.iinfo(jnp.int64).min
+        masked = jnp.where(feasible, total, neg)
+        best = jnp.argmax(masked)
+        found = jnp.any(feasible)
+        placement = jnp.where(pin >= 0, pin, jnp.where(found, best, -1))
+
+        # ---- commit ----
+        commit = placement >= 0
+        onehot = (
+            jax.nn.one_hot(jnp.maximum(placement, 0), static.alloc_mcpu.shape[0], dtype=jnp.int64)
+            * commit.astype(jnp.int64)
+        )
+        new_state = ScanState(
+            used_mcpu=state.used_mcpu + onehot * static.req_mcpu[u],
+            used_mem=state.used_mem + onehot * static.req_mem[u],
+            used_eph=state.used_eph + onehot * static.req_eph[u],
+            used_scalar=state.used_scalar + onehot[None, :] * static.req_scalar[u][:, None],
+            nz_mcpu=state.nz_mcpu + onehot * static.nz_mcpu[u],
+            nz_mem=state.nz_mem + onehot * static.nz_mem[u],
+            pod_cnt=state.pod_cnt + onehot,
+            ports_used=state.ports_used
+            | (onehot.astype(bool)[:, None] & static.want_ports[u][None, :]),
+            gpu_used=state.gpu_used
+            + jnp.where(needs_gpu, onehot[:, None] * gpu_take * static.gpu_mem[u], 0),
+            )
+        return new_state, placement
+
+    final_state, placements = jax.lax.scan(step, init, (class_of_pod, pinned_node))
+    return placements, final_state

@@ -94,38 +94,61 @@ class Simulator:
         if self.engine_kind == "tpu":
             failed = self._schedule_pods_tpu(pods)
         else:
-            for pod in pods:
-                if (pod.get("spec") or {}).get("nodeName"):
-                    self.oracle.place_existing_pod(pod)
-                    self.cluster_pods.append(pod)
-                    continue
-                node_name, reason = self.oracle.schedule_pod(pod)
-                if node_name is None:
-                    failed.append(UnscheduledPod(pod=pod, reason=reason))
-                else:
-                    self.cluster_pods.append(pod)
+            failed = self._schedule_pods_oracle(pods)
         return SimulateResult(unscheduled_pods=failed, node_status=self.node_status())
 
-    def _schedule_pods_tpu(self, pods: List[dict]) -> List[UnscheduledPod]:
-        from .engine import TpuEngine  # lazy: keeps jax import optional
-
+    def _schedule_pods_oracle(self, pods: List[dict]) -> List[UnscheduledPod]:
         failed: List[UnscheduledPod] = []
-        pinned = [p for p in pods if (p.get("spec") or {}).get("nodeName")]
-        for pod in pinned:
-            self.oracle.place_existing_pod(pod)
-            self.cluster_pods.append(pod)
-        loose = [p for p in pods if not (p.get("spec") or {}).get("nodeName")]
-        if not loose:
-            return failed
+        for pod in pods:
+            if (pod.get("spec") or {}).get("nodeName"):
+                self.oracle.place_existing_pod(pod)
+                self.cluster_pods.append(pod)
+                continue
+            node_name, reason = self.oracle.schedule_pod(pod)
+            if node_name is None:
+                failed.append(UnscheduledPod(pod=pod, reason=reason))
+            else:
+                self.cluster_pods.append(pod)
+        return failed
+
+    def _schedule_pods_tpu(self, pods: List[dict]) -> List[UnscheduledPod]:
+        """JAX scan path. Pods keep their order (pinned pods are forced
+        placements inside the scan). On EngineUnsupported features the
+        whole batch falls back to the serial oracle — identical results,
+        host speed."""
+        from .engine import EngineUnsupported, TpuEngine
+
+        # pods pinned to unknown nodes never reach the scheduler
+        # (reference: created in the tracker, no bind event)
+        batch, dangling = [], []
+        for p in pods:
+            name = (p.get("spec") or {}).get("nodeName")
+            if name and name not in self.oracle.node_index:
+                dangling.append(p)
+            else:
+                batch.append(p)
+        self.cluster_pods.extend(dangling)
+        if not batch:
+            return []
         engine = TpuEngine(self.oracle)
-        placements, reasons = engine.schedule(loose)
-        for pod, node_idx, reason in zip(loose, placements, reasons):
-            if node_idx < 0:
+        try:
+            placements = engine.schedule(batch)
+        except EngineUnsupported:
+            return self._schedule_pods_oracle(batch)
+        failed: List[UnscheduledPod] = []
+        for pod, node_idx in zip(batch, placements):
+            if (pod.get("spec") or {}).get("nodeName"):
+                self.oracle.place_existing_pod(pod)
+                self.cluster_pods.append(pod)
+            elif node_idx < 0:
+                # oracle state here equals the scan state at this step
+                # (commits are replayed in order), so reasons are exact
+                _, reasons = self.oracle._find_feasible(pod)
                 failed.append(
-                    UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reason))
+                    UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reasons))
                 )
             else:
-                engine.commit_host(pod, node_idx)
+                engine.commit_host(pod, int(node_idx))
                 self.cluster_pods.append(pod)
         return failed
 

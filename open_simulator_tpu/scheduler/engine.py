@@ -1,0 +1,107 @@
+"""TPU engine: drives the JAX sequential-commit scan and mirrors its
+placements back into the host-side Oracle state.
+
+The Oracle stays the single source of truth for object-level state
+(annotations, reports, reason strings); the scan is the compute path.
+Every commit the scan makes is replayed on the host through the same
+binding code the oracle uses, so oracle state after an engine batch is
+identical to having scheduled serially — this is asserted by the
+conformance tests (tests/test_engine_conformance.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ops.encode import (
+    ClusterStatic,
+    EngineUnsupported,
+    PodBatch,
+    encode_batch,
+    encode_cluster,
+    encode_dynamic,
+)
+from .oracle import Oracle
+
+__all__ = ["TpuEngine", "EngineUnsupported"]
+
+
+class TpuEngine:
+    def __init__(self, oracle: Oracle):
+        self.oracle = oracle
+
+    def schedule(self, pods: List[dict]) -> np.ndarray:
+        """Returns placements[P]: node index or -1 (unschedulable).
+
+        Pods with a spec.nodeName naming an unknown node must be
+        filtered out by the caller (the reference leaves them dangling
+        in the tracker, simulator.go:221-229).
+        """
+        import jax.numpy as jnp
+
+        from ..ops import scan as scan_ops
+
+        oracle = self.oracle
+        cluster = encode_cluster(oracle)
+        batch = encode_batch(oracle, cluster, pods)
+        dyn = encode_dynamic(oracle, cluster)
+
+        n = cluster.n
+        g = max(cluster.g, 1)
+        dev_valid = np.zeros((n, g), dtype=bool)
+        for i in range(n):
+            dev_valid[i, : cluster.gpu_count[i]] = True
+
+        static = scan_ops.ScanStatic(
+            alloc_mcpu=jnp.asarray(cluster.alloc_mcpu),
+            alloc_mem=jnp.asarray(cluster.alloc_mem),
+            alloc_eph=jnp.asarray(cluster.alloc_eph),
+            alloc_pods=jnp.asarray(cluster.alloc_pods),
+            scalar_alloc=jnp.asarray(cluster.scalar_alloc),
+            gpu_per_dev=jnp.asarray(cluster.gpu_per_dev),
+            gpu_total=jnp.asarray(cluster.gpu_total),
+            gpu_count=jnp.asarray(cluster.gpu_count),
+            dev_valid=jnp.asarray(dev_valid),
+            static_feasible=jnp.asarray(batch.static_feasible),
+            simon_raw=jnp.asarray(batch.simon_raw),
+            nodeaff_raw=jnp.asarray(batch.nodeaff_raw),
+            taint_intol=jnp.asarray(batch.taint_intol),
+            avoid_score=jnp.asarray(batch.avoid_score),
+            image_score=jnp.asarray(batch.image_score),
+            req_mcpu=jnp.asarray(batch.req_mcpu),
+            req_mem=jnp.asarray(batch.req_mem),
+            req_eph=jnp.asarray(batch.req_eph),
+            req_scalar=jnp.asarray(batch.req_scalar),
+            has_request=jnp.asarray(batch.has_request),
+            nz_mcpu=jnp.asarray(batch.nz_mcpu),
+            nz_mem=jnp.asarray(batch.nz_mem),
+            gpu_mem=jnp.asarray(batch.gpu_mem),
+            gpu_cnt=jnp.asarray(batch.gpu_cnt),
+            want_ports=jnp.asarray(batch.want_ports),
+            conflict_ports=jnp.asarray(batch.conflict_ports),
+        )
+        init = scan_ops.ScanState(
+            used_mcpu=jnp.asarray(dyn.used_mcpu),
+            used_mem=jnp.asarray(dyn.used_mem),
+            used_eph=jnp.asarray(dyn.used_eph),
+            used_scalar=jnp.asarray(dyn.used_scalar),
+            nz_mcpu=jnp.asarray(dyn.nz_mcpu),
+            nz_mem=jnp.asarray(dyn.nz_mem),
+            pod_cnt=jnp.asarray(dyn.pod_cnt),
+            ports_used=jnp.asarray(dyn.ports_used),
+            gpu_used=jnp.asarray(dyn.gpu_used),
+        )
+        placements, _ = scan_ops.run_scan(
+            static,
+            init,
+            jnp.asarray(batch.class_of_pod),
+            jnp.asarray(batch.pinned_node),
+        )
+        return np.asarray(placements)
+
+    def commit_host(self, pod: dict, node_idx: int):
+        """Replay one placement into oracle state (same binding code the
+        serial path uses, incl. GPU/storage side effects)."""
+        self.oracle._reserve_and_bind(pod, self.oracle.nodes[int(node_idx)])

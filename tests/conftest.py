@@ -1,12 +1,26 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so the
-multi-chip sharding paths compile and run without TPU hardware."""
+multi-chip sharding paths compile and run without TPU hardware.
+
+The axon TPU plugin registers itself from sitecustomize at interpreter
+startup and its backend init can block every JAX call (including CPU)
+when the relay/chip lease is unavailable. Tests must never depend on
+TPU health, so if the axon site dir is on PYTHONPATH we re-exec pytest
+once with it stripped.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The axon sitecustomize imports jax at interpreter startup, freezing
+# jax_platforms from the parent env ("axon") before our env var can
+# land; config.update is authoritative either way.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

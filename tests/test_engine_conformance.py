@@ -1,0 +1,159 @@
+"""Conformance: the JAX scan engine must reproduce the serial oracle's
+placements pod-for-pod (the bit-match contract from SURVEY.md §7).
+"""
+
+import random
+
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.models.cluster import cluster_from_config_dir
+from open_simulator_tpu.models.decode import load_directory
+from open_simulator_tpu.scheduler.core import simulate, AppResource
+
+DEMO = "/root/reference/example/cluster/demo_1"
+GPUSHARE = "/root/reference/example/cluster/gpushare"
+APPS = "/root/reference/example/application"
+
+
+def _placements(result):
+    out = {}
+    for ns in result.node_status:
+        for p in ns.pods:
+            out[p["metadata"]["name"]] = ns.node["metadata"]["name"]
+    return out
+
+
+def _failed(result):
+    return sorted(up.pod["metadata"]["name"] for up in result.unscheduled_pods)
+
+
+def _compare(cluster, apps):
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    reset_name_counter()
+    res_oracle = simulate(cluster, apps, engine="oracle")
+    reset_name_counter()
+    res_tpu = simulate(cluster, apps, engine="tpu")
+    assert _failed(res_oracle) == _failed(res_tpu)
+    po, pt = _placements(res_oracle), _placements(res_tpu)
+    assert po.keys() == pt.keys()
+    diff = {k: (po[k], pt[k]) for k in po if po[k] != pt[k]}
+    assert not diff, f"{len(diff)} placement mismatches: {dict(list(diff.items())[:5])}"
+
+
+def test_demo1_simple_conformance():
+    cluster = cluster_from_config_dir(DEMO)
+    _compare(cluster, [AppResource("simple", load_directory(f"{APPS}/simple"))])
+
+
+def test_demo1_overflow_conformance():
+    cluster = cluster_from_config_dir(DEMO)
+    apps = [
+        AppResource("simple", load_directory(f"{APPS}/simple")),
+        AppResource("more_pods", load_directory(f"{APPS}/more_pods")),
+    ]
+    _compare(cluster, apps)
+
+
+def test_gpushare_conformance():
+    cluster = cluster_from_config_dir(GPUSHARE)
+    _compare(cluster, [AppResource("gpushare", load_directory(f"{APPS}/gpushare"))])
+
+
+def _random_node(rng, i):
+    labels = {"kubernetes.io/hostname": f"rn-{i}", "zone": f"z{rng.randint(0, 2)}"}
+    node = {
+        "kind": "Node",
+        "metadata": {"name": f"rn-{i}", "labels": labels},
+        "status": {
+            "allocatable": {
+                "cpu": str(rng.choice([2, 4, 8, 16])),
+                "memory": f"{rng.choice([4, 8, 16, 32])}Gi",
+                "pods": "110",
+            }
+        },
+    }
+    if rng.random() < 0.3:
+        node["metadata"]["labels"]["role"] = "special"
+    if rng.random() < 0.25:
+        node["spec"] = {
+            "taints": [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+        }
+    if rng.random() < 0.2:
+        node["status"]["allocatable"]["alibabacloud.com/gpu-count"] = str(rng.choice([2, 4]))
+        node["status"]["allocatable"]["alibabacloud.com/gpu-mem"] = f"{rng.choice([16, 32])}Gi"
+        node["status"]["capacity"] = dict(node["status"]["allocatable"])
+    return node
+
+
+def _random_workload(rng, i):
+    cpu = rng.choice(["100m", "250m", "500m", "1", "1500m"])
+    mem = rng.choice(["128Mi", "256Mi", "512Mi", "1Gi", "2Gi"])
+    spec = {
+        "containers": [
+            {
+                "name": "c",
+                "image": f"img-{rng.randint(0, 5)}",
+                "resources": {"requests": {"cpu": cpu, "memory": mem}},
+            }
+        ]
+    }
+    if rng.random() < 0.3:
+        spec["tolerations"] = [{"key": "dedicated", "operator": "Exists"}]
+    if rng.random() < 0.25:
+        spec["nodeSelector"] = {"zone": f"z{rng.randint(0, 2)}"}
+    if rng.random() < 0.15:
+        spec["affinity"] = {
+            "nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": rng.randint(1, 100),
+                        "preference": {
+                            "matchExpressions": [
+                                {"key": "role", "operator": "In", "values": ["special"]}
+                            ]
+                        },
+                    }
+                ]
+            }
+        }
+    deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": f"wl-{i}", "namespace": "rand", "labels": {"app": f"wl-{i}"}},
+        "spec": {"replicas": rng.randint(1, 6), "template": {"spec": spec}},
+    }
+    return deploy
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_randomized_conformance(seed):
+    rng = random.Random(seed)
+    cluster = ResourceTypes()
+    cluster.nodes = [_random_node(rng, i) for i in range(rng.randint(4, 12))]
+    resources = ResourceTypes()
+    resources.deployments = [_random_workload(rng, i) for i in range(rng.randint(3, 8))]
+    if rng.random() < 0.5:
+        resources.pods = [
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": "gpupod",
+                    "namespace": "rand",
+                    "annotations": {
+                        "alibabacloud.com/gpu-mem": "4Gi",
+                        "alibabacloud.com/gpu-count": "1",
+                    },
+                },
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "gpu-img",
+                            "resources": {"requests": {"cpu": "1", "memory": "1Gi"}},
+                        }
+                    ]
+                },
+            }
+        ]
+    _compare(cluster, [AppResource("rand", resources)])

@@ -1,0 +1,284 @@
+"""The capacity planner ("Applier").
+
+Mirrors pkg/apply/apply.go:
+- Simon CR config parsing (apiVersion simon/v1alpha1, kind Config;
+  pkg/api/v1alpha1/types.go) with path validation (apply.go:249-286)
+- cluster from customConfig dir (kubeConfig/live clusters are out of
+  scope for the simulator environment and rejected with a clear error)
+- app list: plain YAML dirs or Helm charts (pkg/chart rendering)
+- the capacity loop (apply.go:186-239): instead of interactively asking
+  the user for a node count per iteration, all candidate counts up to
+  MaxNumNewNode are evaluated in ONE batched TPU sweep
+  (parallel/sweep.py); `interactive=True` keeps the reference's
+  ask-per-step shell on top of the precomputed sweep
+- utilization caps from MaxCPU/MaxMemory/MaxVG env vars
+  (satisfyResourceSetting, apply.go:611-697)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import yaml
+
+from ..models import storage as stor
+from ..models import workloads as wl
+from ..models.chart import process_chart
+from ..models.cluster import cluster_from_config_dir, match_and_set_local_storage
+from ..models.decode import (
+    ResourceTypes,
+    decode_yaml_content,
+    load_directory,
+    yaml_content_from_directory,
+)
+from ..scheduler.core import AppResource, SimulateResult, simulate
+from .report import report
+
+MAX_NUM_NEW_NODE = wl.MAX_NUM_NEW_NODE
+
+
+@dataclass
+class AppInfo:
+    name: str
+    path: str
+    chart: bool = False
+
+
+@dataclass
+class SimonConfig:
+    custom_cluster: Optional[str] = None
+    kube_config: Optional[str] = None
+    app_list: List[AppInfo] = field(default_factory=list)
+    new_node: Optional[str] = None
+
+    @classmethod
+    def from_file(cls, path: str) -> "SimonConfig":
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        if not isinstance(doc, dict) or doc.get("kind") != "Config":
+            raise ValueError(f"{path}: not a simon Config object")
+        spec = doc.get("spec") or {}
+        cluster = spec.get("cluster") or {}
+        apps = [
+            AppInfo(
+                name=a.get("name", ""),
+                path=a.get("path", ""),
+                chart=bool(a.get("chart", False)),
+            )
+            for a in spec.get("appList") or []
+        ]
+        return cls(
+            custom_cluster=cluster.get("customConfig"),
+            kube_config=cluster.get("kubeConfig"),
+            app_list=apps,
+            new_node=spec.get("newNode"),
+        )
+
+    def validate(self):
+        """Path validation (apply.go:249-286)."""
+        if bool(self.custom_cluster) == bool(self.kube_config):
+            raise ValueError(
+                "only one of values of both kubeConfig and customConfig must exist"
+            )
+        if self.kube_config:
+            raise ValueError(
+                "kubeConfig clusters are not supported in the TPU simulator "
+                "environment; export the cluster to YAML and use customConfig"
+            )
+        if not os.path.exists(self.custom_cluster):
+            raise ValueError(f"invalid path of customConfig: {self.custom_cluster}")
+        if self.new_node and not os.path.exists(self.new_node):
+            raise ValueError(f"invalid path of newNode: {self.new_node}")
+        for app in self.app_list:
+            if not os.path.exists(app.path):
+                raise ValueError(f"invalid path of {app.name} app: {app.path}")
+
+
+def _resource_caps():
+    """MaxCPU/MaxMemory/MaxVG env caps, clamped to [0,100] like
+    apply.go:611-641."""
+
+    def cap(env):
+        raw = os.environ.get(env, "")
+        if not raw:
+            return 100
+        v = int(raw)
+        return 100 if v > 100 or v < 0 else v
+
+    return cap("MaxCPU"), cap("MaxMemory"), cap("MaxVG")
+
+
+def satisfy_resource_setting(node_statuses) -> tuple:
+    """satisfyResourceSetting (apply.go:611-697)."""
+    from ..models import requests as req
+    from .report import _pod_req_summary
+
+    max_cpu, max_mem, max_vg = _resource_caps()
+    total_alloc_cpu = total_alloc_mem = 0
+    total_used_cpu = total_used_mem = 0
+    vg_cap = vg_req = 0
+    for status in node_statuses:
+        node = status.node
+        total_alloc_cpu += req.node_alloc_milli_cpu(node)
+        total_alloc_mem += req.node_alloc_int(node, req.MEMORY)
+        for pod in status.pods:
+            mcpu, mem = _pod_req_summary(pod)
+            total_used_cpu += mcpu
+            total_used_mem += mem
+        storage = stor.parse_node_storage(node)
+        if storage:
+            for vg in storage.vgs:
+                vg_cap += vg.capacity
+                vg_req += vg.requested
+    cpu_rate = int(total_used_cpu / total_alloc_cpu * 100) if total_alloc_cpu else 0
+    mem_rate = int(total_used_mem / total_alloc_mem * 100) if total_alloc_mem else 0
+    if cpu_rate > max_cpu:
+        return False, (
+            f"the average occupancy rate({cpu_rate}%) of cpu goes beyond the env setting({max_cpu}%)"
+        )
+    if mem_rate > max_mem:
+        return False, (
+            f"the average occupancy rate({mem_rate}%) of memory goes beyond the env setting({max_mem}%)"
+        )
+    if vg_cap:
+        vg_rate = int(vg_req / vg_cap * 100)
+        if vg_rate > max_vg:
+            return False, (
+                f"the average occupancy rate({vg_rate}%) of vg goes beyond the env setting({max_vg}%)"
+            )
+    return True, ""
+
+
+@dataclass
+class ApplyResult:
+    success: bool
+    new_node_count: int
+    result: Optional[SimulateResult]
+    report_text: str = ""
+    message: str = ""
+
+
+class Applier:
+    def __init__(
+        self,
+        config: SimonConfig,
+        interactive: bool = False,
+        extended_resources: Optional[List[str]] = None,
+        engine: str = "tpu",
+        use_sweep: bool = True,
+    ):
+        config.validate()
+        self.config = config
+        self.interactive = interactive
+        self.extended_resources = extended_resources or []
+        self.engine = engine
+        self.use_sweep = use_sweep
+
+    # -- loading ------------------------------------------------------------
+
+    def load_cluster(self) -> ResourceTypes:
+        return cluster_from_config_dir(self.config.custom_cluster)
+
+    def load_apps(self) -> List[AppResource]:
+        out = []
+        for app in self.config.app_list:
+            if app.chart:
+                content = process_chart(app.name, app.path)
+            else:
+                content = yaml_content_from_directory(app.path)
+            out.append(AppResource(name=app.name, resource=decode_yaml_content(content)))
+        return out
+
+    def load_new_node(self) -> Optional[dict]:
+        if not self.config.new_node:
+            return None
+        resources = load_directory(self.config.new_node)
+        match_and_set_local_storage(resources.nodes, self.config.new_node)
+        if not resources.nodes:
+            return None
+        return resources.nodes[0]
+
+    # -- planning -----------------------------------------------------------
+
+    def _simulate_with_count(self, cluster, apps, new_node, count) -> SimulateResult:
+        padded = cluster.copy()
+        if new_node is not None and count > 0:
+            from ..parallel.sweep import _new_nodes
+
+            padded.nodes = list(padded.nodes) + _new_nodes(new_node, count)
+        return simulate(padded, apps, engine=self.engine)
+
+    def run(self, select_apps=None) -> ApplyResult:
+        cluster = self.load_cluster()
+        apps = self.load_apps()
+        if select_apps is not None:
+            apps = [a for a in apps if a.name in select_apps]
+        new_node = self.load_new_node()
+
+        start_count = 0
+        if self.use_sweep and new_node is not None:
+            # the sweep narrows the search; the authoritative serial run
+            # below still validates its pick (incl. the VG cap the sweep
+            # cannot see) and escalates further if needed
+            hint = self._sweep_min_count(cluster, apps, new_node)
+            if hint is not None:
+                start_count = hint
+
+        max_count = 0 if new_node is None else MAX_NUM_NEW_NODE
+        result = None
+        for count in range(start_count, max_count + 1):
+            result = self._simulate_with_count(cluster, apps, new_node, count)
+            if result.unscheduled_pods:
+                continue
+            ok, reason = satisfy_resource_setting(result.node_status)
+            if not ok:
+                continue
+            return ApplyResult(
+                success=True,
+                new_node_count=count,
+                result=result,
+                report_text=report(result.node_status, self.extended_resources),
+            )
+        if result is not None and result.unscheduled_pods:
+            message = (
+                f"{len(result.unscheduled_pods)} pod(s) cannot be scheduled "
+                f"even with {max_count} new node(s)"
+            )
+        else:
+            _, message = (
+                satisfy_resource_setting(result.node_status) if result else (False, "no result")
+            )
+        return ApplyResult(
+            success=False, new_node_count=max_count, result=result, message=message
+        )
+
+    def _sweep_min_count(self, cluster, apps, new_node) -> Optional[int]:
+        """One batched sweep over all candidate counts; returns the
+        minimal count that schedules everything within the caps."""
+        from ..ops.encode import EngineUnsupported
+        from ..parallel.sweep import sweep_node_counts
+
+        try:
+            counts = list(range(0, MAX_NUM_NEW_NODE + 1))
+            res = sweep_node_counts(cluster, apps, new_node, counts)
+        except EngineUnsupported:
+            return None  # expected: feature not in the scan yet
+        except Exception as e:  # pragma: no cover - diagnostic path
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "capacity sweep failed, falling back to serial escalation: %s", e
+            )
+            return None
+        max_cpu, max_mem, _ = _resource_caps()
+        for s, count in enumerate(res.counts):
+            # int-truncate like satisfyResourceSetting (apply.go:680-681)
+            if (
+                res.unscheduled[s] == 0
+                and int(res.cpu_util[s]) <= max_cpu
+                and int(res.mem_util[s]) <= max_mem
+            ):
+                return count
+        return None

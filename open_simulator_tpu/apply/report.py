@@ -1,0 +1,226 @@
+"""Placement report tables.
+
+Mirrors pkg/apply/apply.go:309-609 (reportClusterInfo / reportNodeInfo):
+node info table, extended-resource tables (local storage VG/device, GPU
+per-device), and the per-node pod table. Rendered with a small built-in
+ASCII table writer (the reference uses olekukonko/tablewriter).
+"""
+
+from __future__ import annotations
+
+import json
+from fractions import Fraction
+from typing import List, Optional
+
+from ..models import requests as req
+from ..models import storage as stor
+from ..models import workloads as wl
+from ..utils.quantity import format_quantity_bin
+
+
+def render_table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+
+    def line(ch="-", junction="+"):
+        return junction + junction.join(ch * (w + 2) for w in widths) + junction
+
+    def fmt_row(cells):
+        return "| " + " | ".join(str(c).ljust(w) for c, w in zip(cells, widths)) + " |"
+
+    out = [line(), fmt_row(headers), line("=")]
+    for row in rows:
+        out.append(fmt_row(row))
+        out.append(line())
+    return "\n".join(out)
+
+
+def _fmt_cpu(mcpu: int) -> str:
+    if mcpu % 1000 == 0:
+        return str(mcpu // 1000)
+    return f"{mcpu}m"
+
+
+def _pct(numer: float, denom: float) -> int:
+    return int(numer / denom * 100) if denom else 0
+
+
+def _pod_req_summary(pod: dict):
+    requests = req.pod_requests(pod)
+    mcpu = requests.get(req.CPU, Fraction(0)) * 1000
+    mcpu = mcpu.numerator // mcpu.denominator
+    mem = requests.get(req.MEMORY, Fraction(0))
+    mem = mem.numerator // mem.denominator
+    return mcpu, mem
+
+
+def report(node_statuses, extended_resources: Optional[List[str]] = None) -> str:
+    extended_resources = extended_resources or []
+    out = ["Node Info"]
+    out.append(_node_table(node_statuses, extended_resources))
+    if extended_resources:
+        out.append("")
+        out.append("Extended Resource Info")
+        if "open-local" in extended_resources:
+            out.append("Node Local Storage")
+            out.append(_storage_table(node_statuses))
+        if "gpu" in extended_resources:
+            out.append("GPU Node Resource")
+            out.append(_gpu_table(node_statuses))
+    out.append("")
+    out.append("Pod Info")
+    out.append(_pod_table(node_statuses, extended_resources))
+    return "\n".join(out)
+
+
+def _node_table(node_statuses, extended_resources) -> str:
+    headers = ["Node", "CPU Allocatable", "CPU Requests", "Memory Allocatable", "Memory Requests"]
+    gpu = "gpu" in extended_resources
+    if gpu:
+        headers += ["GPU Mem Allocatable", "GPU Mem Requests"]
+    headers += ["Pod Count", "New Node"]
+    rows = []
+    for status in node_statuses:
+        node = status.node
+        alloc_mcpu = req.node_alloc_milli_cpu(node)
+        alloc_mem = req.node_alloc_int(node, req.MEMORY)
+        used_mcpu = used_mem = 0
+        gpu_req = 0
+        for pod in status.pods:
+            mcpu, mem = _pod_req_summary(pod)
+            used_mcpu += mcpu
+            used_mem += mem
+            g_mem, g_cnt = stor.pod_gpu_request(pod)
+            gpu_req += g_mem * g_cnt
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        row = [
+            (node.get("metadata") or {}).get("name", ""),
+            _fmt_cpu(alloc_mcpu),
+            f"{_fmt_cpu(used_mcpu)}({_pct(used_mcpu, alloc_mcpu)}%)",
+            format_quantity_bin(alloc_mem),
+            f"{format_quantity_bin(used_mem)}({_pct(used_mem, alloc_mem)}%)",
+        ]
+        if gpu:
+            total = stor.node_total_gpu_memory(node)
+            row += [
+                format_quantity_bin(total),
+                f"{format_quantity_bin(gpu_req)}({_pct(gpu_req, total)}%)",
+            ]
+        row += [str(len(status.pods)), "√" if wl.LABEL_NEW_NODE in labels else ""]
+        rows.append(row)
+    return render_table(headers, rows)
+
+
+def _storage_table(node_statuses) -> str:
+    headers = ["Node", "Storage Kind", "Storage Name", "Storage Allocatable", "Storage Requests"]
+    rows = []
+    for status in node_statuses:
+        node = status.node
+        storage = stor.parse_node_storage(node)
+        if storage is None:
+            continue
+        name = (node.get("metadata") or {}).get("name", "")
+        for vg in storage.vgs:
+            rows.append(
+                [
+                    name,
+                    "VG",
+                    vg.name,
+                    format_quantity_bin(vg.capacity),
+                    f"{format_quantity_bin(vg.requested)}({_pct(vg.requested, vg.capacity)}%)",
+                ]
+            )
+        for dev in storage.devices:
+            rows.append(
+                [
+                    name,
+                    f"Device({dev.media_type})",
+                    dev.name,
+                    format_quantity_bin(dev.capacity),
+                    "used" if dev.is_allocated else "unused",
+                ]
+            )
+    return render_table(headers, rows)
+
+
+def _gpu_table(node_statuses) -> str:
+    headers = ["Node", "GPU ID", "GPU Request/Capacity", "Pod List"]
+    rows = []
+    for status in node_statuses:
+        node = status.node
+        count = stor.node_gpu_count(node)
+        if count == 0:
+            continue
+        name = (node.get("metadata") or {}).get("name", "")
+        per_dev = stor.node_gpu_per_device_memory(node)
+        used = [0] * count
+        pods_per_dev: List[List[str]] = [[] for _ in range(count)]
+        for pod in status.pods:
+            mem, _cnt = stor.pod_gpu_request(pod)
+            if mem <= 0:
+                continue
+            idx = ((pod.get("metadata") or {}).get("annotations") or {}).get(stor.GPU_INDEX_ANNO)
+            if idx is None:
+                continue
+            for d in str(idx).split("-"):
+                d = int(d)
+                used[d] += mem
+                pods_per_dev[d].append(pod["metadata"]["name"])
+        total_used = sum(used)
+        rows.append(
+            [
+                name,
+                "ALL",
+                f"{format_quantity_bin(total_used)}/{format_quantity_bin(per_dev * count)}",
+                "",
+            ]
+        )
+        for d in range(count):
+            rows.append(
+                [
+                    name,
+                    str(d),
+                    f"{format_quantity_bin(used[d])}/{format_quantity_bin(per_dev)}",
+                    ", ".join(pods_per_dev[d]),
+                ]
+            )
+    return render_table(headers, rows)
+
+
+def _pod_table(node_statuses, extended_resources) -> str:
+    headers = ["Node", "Pod", "CPU Requests", "Memory Requests"]
+    local = "open-local" in extended_resources
+    gpu = "gpu" in extended_resources
+    if local:
+        headers.append("Volume Request")
+    if gpu:
+        headers.append("GPU Mem Requests")
+    headers.append("APP Name")
+    rows = []
+    for status in node_statuses:
+        node = status.node
+        node_name = (node.get("metadata") or {}).get("name", "")
+        alloc_mcpu = req.node_alloc_milli_cpu(node)
+        alloc_mem = req.node_alloc_int(node, req.MEMORY)
+        for pod in status.pods:
+            mcpu, mem = _pod_req_summary(pod)
+            meta = pod.get("metadata") or {}
+            row = [
+                node_name,
+                f"{meta.get('namespace', 'default')}/{meta.get('name', '')}",
+                f"{_fmt_cpu(mcpu)}({_pct(mcpu, alloc_mcpu)}%)",
+                f"{format_quantity_bin(mem)}({_pct(mem, alloc_mem)}%)",
+            ]
+            if local:
+                lvm, dev = stor.parse_pod_local_volumes(pod)
+                vols = [f"{v.kind}:{format_quantity_bin(v.size)}" for v in lvm + dev]
+                row.append(", ".join(vols))
+            if gpu:
+                g_mem, g_cnt = stor.pod_gpu_request(pod)
+                idx = (meta.get("annotations") or {}).get(stor.GPU_INDEX_ANNO, "")
+                row.append(f"{format_quantity_bin(g_mem)}x{g_cnt}@{idx}" if g_mem else "")
+            row.append((meta.get("labels") or {}).get(wl.LABEL_APP_NAME, ""))
+            rows.append(row)
+    return render_table(headers, rows)

@@ -1,0 +1,157 @@
+"""simon-compatible CLI.
+
+Mirrors cmd/simon (cmd/simon/simon.go, cmd/apply/apply.go):
+
+  simon apply -f <simon-config.yaml> [-i] [--extended-resources gpu,open-local]
+        [--engine tpu|oracle] [--no-sweep]
+  simon version
+  simon gen-doc
+
+Log level comes from the LogLevel env var (cmd/simon/simon.go:60-80).
+The --default-scheduler-config and --use-greed flags of the reference
+are accepted for compatibility; like in the reference at this revision
+they have no effect on the simulation (SURVEY.md §2.1: dead options,
+pkg/apply/apply.go:80-81).
+
+Run as `python -m open_simulator_tpu.cli ...` or via the `simon`
+console script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from . import __version__
+
+
+def _setup_logging():
+    level = os.environ.get("LogLevel", "info").lower()
+    levels = {
+        "debug": logging.DEBUG,
+        "info": logging.INFO,
+        "warn": logging.WARNING,
+        "warning": logging.WARNING,
+        "error": logging.ERROR,
+    }
+    logging.basicConfig(level=levels.get(level, logging.INFO), format="%(levelname)s %(message)s")
+
+
+def _force_platform():
+    # SIMON_FORCE_CPU=1 pins JAX to the CPU backend (config.update is
+    # the only override that works after a TPU plugin froze the env)
+    if os.environ.get("SIMON_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def cmd_apply(args) -> int:
+    from .apply.applier import Applier, SimonConfig
+
+    _force_platform()
+    try:
+        config = SimonConfig.from_file(args.simon_config)
+        applier = Applier(
+            config,
+            interactive=args.interactive,
+            extended_resources=args.extended_resources,
+            engine=args.engine,
+            use_sweep=not args.no_sweep,
+        )
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    select = None
+    if args.interactive:
+        names = [a.name for a in config.app_list]
+        print("Apps in config:")
+        for i, n in enumerate(names):
+            print(f"  [{i}] {n}")
+        raw = input("Confirm your apps (comma-separated indices, empty = all): ").strip()
+        if raw:
+            idx = {int(x) for x in raw.split(",")}
+            select = [n for i, n in enumerate(names) if i in idx]
+    result = applier.run(select_apps=select)
+    if not result.success:
+        print(result.message)
+        if result.result is not None:
+            for i, up in enumerate(result.result.unscheduled_pods):
+                meta = up.pod.get("metadata") or {}
+                print(f"{i:4d} {meta.get('namespace')}/{meta.get('name')}: {up.reason}")
+        return 2
+    print("Simulation success!")
+    if result.new_node_count:
+        print(f"new nodes added: {result.new_node_count}")
+    print(result.report_text)
+    return 0
+
+
+def cmd_version(_args) -> int:
+    print(f"simon-tpu version {__version__}")
+    return 0
+
+
+def cmd_gen_doc(args) -> int:
+    """Markdown CLI docs (cmd/doc/generate_markdown.go)."""
+    parser = build_parser()
+    out_dir = args.output
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "simon.md")
+    with open(path, "w") as f:
+        f.write("# simon\n\n```\n")
+        f.write(parser.format_help())
+        f.write("```\n")
+    print(f"wrote {path}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="simon", description="TPU-native cluster simulator")
+    sub = parser.add_subparsers(dest="command")
+
+    p_apply = sub.add_parser("apply", help="simulate deploying applications")
+    p_apply.add_argument("-f", "--simon-config", required=True, help="simon config file path")
+    p_apply.add_argument("-i", "--interactive", action="store_true", help="interactive mode")
+    p_apply.add_argument(
+        "--extended-resources",
+        type=lambda s: [x for x in s.split(",") if x],
+        default=[],
+        help="extended resource reports: gpu,open-local",
+    )
+    p_apply.add_argument(
+        "--default-scheduler-config", default="", help="accepted for compatibility (unused)"
+    )
+    p_apply.add_argument(
+        "--use-greed", action="store_true", help="accepted for compatibility (unused)"
+    )
+    p_apply.add_argument("--engine", choices=["tpu", "oracle"], default="tpu")
+    p_apply.add_argument(
+        "--no-sweep", action="store_true", help="disable the batched capacity sweep"
+    )
+    p_apply.set_defaults(func=cmd_apply)
+
+    p_version = sub.add_parser("version", help="print version")
+    p_version.set_defaults(func=cmd_version)
+
+    p_doc = sub.add_parser("gen-doc", help="generate markdown CLI docs")
+    p_doc.add_argument("--output", default="docs/commandline")
+    p_doc.set_defaults(func=cmd_gen_doc)
+    return parser
+
+
+def main(argv=None) -> int:
+    _setup_logging()
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "func", None):
+        parser.print_help()
+        return 0
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,309 @@
+"""Offline Helm-chart rendering.
+
+Mirrors pkg/chart/chart.go:18-118 (ProcessChart): load Chart.yaml +
+values.yaml, render templates with fabricated release values
+(Release.Name = app name, Namespace default, Revision 1, Service Helm),
+skip NOTES.txt, and emit manifests in Helm's InstallOrder.
+
+The helm Go engine is not available here, so this module implements the
+Go-template subset that k8s charts of this shape actually use:
+
+  {{ .Values.a.b }} / {{ $.Values.a.b }}   dotted lookups
+  {{ .Release.Name }}                       release object
+  {{ int EXPR }} {{ quote EXPR }} {{ default D EXPR }} {{ toYaml EXPR }}
+  {{- if EXPR }} ... {{- else }} ... {{- end }}   with Go truthiness
+  {{- range ... }} is NOT supported (none of the target charts use it)
+
+Unknown/missing paths render empty (non-strict mode).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+import yaml
+
+# helm releaseutil.InstallOrder
+INSTALL_ORDER = [
+    "Namespace",
+    "NetworkPolicy",
+    "ResourceQuota",
+    "LimitRange",
+    "PodSecurityPolicy",
+    "PodDisruptionBudget",
+    "ServiceAccount",
+    "Secret",
+    "SecretList",
+    "ConfigMap",
+    "StorageClass",
+    "PersistentVolume",
+    "PersistentVolumeClaim",
+    "CustomResourceDefinition",
+    "ClusterRole",
+    "ClusterRoleList",
+    "ClusterRoleBinding",
+    "ClusterRoleBindingList",
+    "Role",
+    "RoleList",
+    "RoleBinding",
+    "RoleBindingList",
+    "Service",
+    "DaemonSet",
+    "Pod",
+    "ReplicationController",
+    "ReplicaSet",
+    "Deployment",
+    "HorizontalPodAutoscaler",
+    "StatefulSet",
+    "Job",
+    "CronJob",
+    "Ingress",
+    "APIService",
+]
+_ORDER_INDEX = {k: i for i, k in enumerate(INSTALL_ORDER)}
+
+_TOKEN = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}")
+
+
+class _Missing:
+    """Sentinel for unresolved paths (renders empty, falsy)."""
+
+    def __str__(self):
+        return ""
+
+    def __bool__(self):
+        return False
+
+
+MISSING = _Missing()
+
+
+def _lookup(context: dict, path: str):
+    cur = context
+    for part in path.split("."):
+        if not part:
+            continue
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return MISSING
+    return cur
+
+
+def _truthy(v) -> bool:
+    if v is MISSING or v is None:
+        return False
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return v != 0
+    if isinstance(v, (str, list, dict)):
+        return len(v) > 0
+    return True
+
+
+def _eval_expr(expr: str, context: dict):
+    expr = expr.strip()
+    if not expr:
+        return MISSING
+    # pipelines: a | b | c
+    if "|" in expr:
+        parts = [p.strip() for p in expr.split("|")]
+        val = _eval_expr(parts[0], context)
+        for fn in parts[1:]:
+            val = _apply_func(fn.split() + [val], context, piped=True)
+        return val
+    tokens = _split_tokens(expr)
+    if len(tokens) == 1:
+        tok = tokens[0]
+        if tok.startswith(('"', "'")):
+            return tok[1:-1]
+        if tok.startswith("$."):
+            return _lookup(context, tok[2:])
+        if tok.startswith("."):
+            return _lookup(context, tok[1:])
+        if tok in ("true", "false"):
+            return tok == "true"
+        try:
+            return int(tok)
+        except ValueError:
+            try:
+                return float(tok)
+            except ValueError:
+                return MISSING
+    return _apply_func(tokens, context)
+
+
+def _split_tokens(expr: str) -> List[str]:
+    out, cur, quote = [], "", None
+    for ch in expr:
+        if quote:
+            cur += ch
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur += ch
+        elif ch.isspace():
+            if cur:
+                out.append(cur)
+                cur = ""
+        else:
+            cur += ch
+    if cur:
+        out.append(cur)
+    return out
+
+
+def _apply_func(tokens, context, piped=False):
+    name = tokens[0]
+    args = [
+        t if not isinstance(t, str) else _eval_expr(t, context) for t in tokens[1:]
+    ]
+    if name == "int":
+        v = args[0] if args else MISSING
+        try:
+            return int(float(str(v))) if not isinstance(v, bool) and v is not MISSING else 0
+        except (TypeError, ValueError):
+            return 0
+    if name == "quote":
+        v = args[0] if args else ""
+        return f'"{v}"'
+    if name == "default":
+        # default DEFAULT VALUE
+        if len(args) >= 2:
+            return args[1] if _truthy(args[1]) else args[0]
+        return args[0] if args else MISSING
+    if name == "toYaml":
+        v = args[0] if args else None
+        if v is MISSING or v is None:
+            return ""
+        return yaml.safe_dump(v, default_flow_style=False).rstrip()
+    if name in ("eq", "ne"):
+        if len(args) >= 2:
+            same = str(args[0]) == str(args[1])
+            return same if name == "eq" else not same
+        return False
+    if name == "not":
+        return not _truthy(args[0] if args else MISSING)
+    # unknown function: pass through last arg
+    return args[-1] if args else MISSING
+
+
+def render_template(text: str, context: dict) -> str:
+    """Render the supported Go-template subset."""
+    # tokenize into literals and actions with trim markers applied
+    parts = []  # (kind, payload)
+    pos = 0
+    for m in _TOKEN.finditer(text):
+        lit = text[pos : m.start()]
+        if m.group(1) == "-":
+            lit = lit.rstrip()
+        parts.append(("lit", lit))
+        parts.append(("act", (m.group(2), m.group(3) == "-")))
+        pos = m.end()
+    parts.append(("lit", text[pos:]))
+
+    # post-process right-trim: a trailing '-' on an action trims leading
+    # whitespace of the following literal
+    out: List[str] = []
+    stack: List[bool] = []  # emit states for if/else nesting
+    trim_next = False
+
+    def emitting():
+        return all(stack)
+
+    for kind, payload in parts:
+        if kind == "lit":
+            lit = payload
+            if trim_next:
+                lit = lit.lstrip()
+                trim_next = False
+            if emitting():
+                out.append(lit)
+            continue
+        action, rtrim = payload
+        trim_next = rtrim
+        if action.startswith("if "):
+            cond = _truthy(_eval_expr(action[3:], context)) if emitting() else False
+            stack.append(cond)
+        elif action == "else":
+            if stack:
+                stack[-1] = not stack[-1]
+        elif action.startswith("else if "):
+            if stack:
+                stack[-1] = (not stack[-1]) and _truthy(_eval_expr(action[8:], context))
+        elif action == "end":
+            if stack:
+                stack.pop()
+        elif action.startswith("/*"):
+            continue  # comment
+        else:
+            if emitting():
+                v = _eval_expr(action, context)
+                out.append("" if v is MISSING or v is None else str(v))
+    return "".join(out)
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for k, v in (override or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def process_chart(name: str, path: str, extra_values: Optional[dict] = None) -> List[str]:
+    """ProcessChart (pkg/chart/chart.go:18-41): render a chart directory
+    into a list of YAML manifest strings in install order."""
+    chart_file = os.path.join(path, "Chart.yaml")
+    if not os.path.isfile(chart_file):
+        raise ValueError(f"{path}: not a helm chart (no Chart.yaml)")
+    values = {}
+    values_file = os.path.join(path, "values.yaml")
+    if os.path.isfile(values_file):
+        with open(values_file) as f:
+            values = yaml.safe_load(f) or {}
+    if extra_values:
+        values = _deep_merge(values, extra_values)
+    context = {
+        "Values": values,
+        "Release": {
+            "Name": name,
+            "Namespace": "default",
+            "IsUpgrade": False,
+            "IsInstall": True,
+            "Revision": 1,
+            "Service": "Helm",
+        },
+        "Chart": yaml.safe_load(open(chart_file)) or {},
+    }
+    manifests = []  # (kind, rendered)
+    tdir = os.path.join(path, "templates")
+    for root, _, files in os.walk(tdir):
+        for fname in sorted(files):
+            if fname.endswith("NOTES.txt") or fname.startswith("_"):
+                continue
+            if not fname.endswith((".yaml", ".yml", ".tpl")):
+                continue
+            with open(os.path.join(root, fname)) as f:
+                rendered = render_template(f.read(), context)
+            if not rendered.strip():
+                continue
+            for doc_text in re.split(r"^---\s*$", rendered, flags=re.M):
+                if not doc_text.strip():
+                    continue
+                try:
+                    doc = yaml.safe_load(doc_text)
+                except yaml.YAMLError:
+                    continue
+                if not isinstance(doc, dict) or "kind" not in doc:
+                    continue
+                manifests.append((doc.get("kind", ""), doc_text))
+    manifests.sort(key=lambda kv: _ORDER_INDEX.get(kv[0], len(INSTALL_ORDER)))
+    return [m for _, m in manifests]

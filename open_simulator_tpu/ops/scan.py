@@ -138,16 +138,46 @@ def _gpu_allocate(avail, dev_valid, per_gpu_mem, count):
     return found, take
 
 
+INACTIVE = -2  # pod not present in this scenario (capacity-sweep masking)
+
+
 @partial(jax.jit, static_argnums=())
 def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node):
     """Schedule every pod in order; returns (placements[P], final state).
 
     placements[p] = node index, or -1 when unschedulable.
     """
+    n = static.alloc_mcpu.shape[0]
+    p = class_of_pod.shape[0]
+    return run_scan_masked(
+        static,
+        init,
+        class_of_pod,
+        pinned_node,
+        jnp.ones((n,), bool),
+        jnp.ones((p,), bool),
+    )
+
+
+@partial(jax.jit, static_argnums=())
+def run_scan_masked(
+    static: ScanStatic,
+    init: ScanState,
+    class_of_pod,
+    pinned_node,
+    node_valid,
+    pod_active,
+):
+    """run_scan with scenario masks for the capacity sweep
+    (pkg/apply/apply.go:186-239 re-imagined as a batched what-if):
+    `node_valid[n]` gates candidate nodes, `pod_active[p]` skips pods
+    that do not exist in this scenario (e.g. daemonset pods of disabled
+    new nodes). Inactive pods commit nothing and report INACTIVE.
+    """
 
     def step(state: ScanState, inp):
-        u, pin = inp
-        feasible = static.static_feasible[u]
+        u, pin, active = inp
+        feasible = static.static_feasible[u] & node_valid
         # NodeResourcesFit (noderesources/fit.go:230-303)
         fit_pods = state.pod_cnt + 1 <= static.alloc_pods
         fit_cpu = static.alloc_mcpu >= static.req_mcpu[u] + state.used_mcpu
@@ -213,6 +243,11 @@ def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node):
         best = jnp.argmax(masked)
         found = jnp.any(feasible)
         placement = jnp.where(pin >= 0, pin, jnp.where(found, best, -1))
+        # a pod pinned to a masked-out node does not exist in this
+        # scenario; never commit resources outside node_valid
+        pin_ok = node_valid[jnp.maximum(pin, 0)]
+        placement = jnp.where((pin >= 0) & ~pin_ok, INACTIVE, placement)
+        placement = jnp.where(active, placement, INACTIVE)
 
         # ---- commit ----
         commit = placement >= 0
@@ -235,5 +270,7 @@ def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node):
             )
         return new_state, placement
 
-    final_state, placements = jax.lax.scan(step, init, (class_of_pod, pinned_node))
+    final_state, placements = jax.lax.scan(
+        step, init, (class_of_pod, pinned_node, pod_active)
+    )
     return placements, final_state

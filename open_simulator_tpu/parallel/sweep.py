@@ -1,0 +1,209 @@
+"""Batched capacity-planning sweep over a TPU device mesh.
+
+The reference's capacity loop is interactive: guess a node count, re-run
+the whole simulation, ask the user (pkg/apply/apply.go:186-239). Here
+every candidate count is one scenario of a single batched computation:
+
+- the cluster is padded with `max_count` copies of the candidate node
+  spec (named `simon-%02d` with the `simon/new-node` label, mirroring
+  newFakeNodes, apply.go:288-306)
+- scenario s enables the first s new nodes via a node-validity mask and
+  drops daemonset pods that belong to disabled nodes via a pod-activity
+  mask (the reference regenerates them per run)
+- `vmap(run_scan_masked)` evaluates all scenarios at once; over a
+  `jax.sharding.Mesh` the scenario axis is sharded across devices with
+  `shard_map` — scenarios are independent, so the only communication is
+  the result gather (this is the "distributed backend": XLA collectives
+  over ICI, not a port of anything — the reference is single-process)
+
+Returns per-scenario unscheduled counts and cluster utilization, from
+which the planner picks the minimal feasible count
+(satisfyResourceSetting caps, apply.go:611-697).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from ..models import workloads as wl
+from ..models.decode import ResourceTypes
+from ..scheduler.core import AppResource, _sort_app_pods
+from ..scheduler.oracle import Oracle
+
+
+@dataclass
+class SweepResult:
+    counts: List[int]
+    unscheduled: np.ndarray  # [Sc] number of unschedulable (active) pods
+    cpu_util: np.ndarray  # [Sc] percent
+    mem_util: np.ndarray  # [Sc] percent
+    placements: np.ndarray  # [Sc, P] node index / -1 / -2(inactive)
+    pods: List[dict]
+    node_names: List[str]
+
+
+def _new_nodes(spec: dict, count: int) -> List[dict]:
+    out = []
+    for i in range(count):
+        node = wl.make_valid_node(copy.deepcopy(spec), f"{wl.NEW_NODE_NAME_PREFIX}-{i:02d}")
+        node["metadata"].setdefault("labels", {})[wl.LABEL_NEW_NODE] = ""
+        out.append(node)
+    return out
+
+
+def _daemonset_target(pod: dict) -> Optional[str]:
+    """The node a daemonset pod is pinned to via its matchFields term."""
+    aff = ((pod.get("spec") or {}).get("affinity") or {}).get("nodeAffinity") or {}
+    required = aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    for term in required.get("nodeSelectorTerms") or []:
+        for f in term.get("matchFields") or []:
+            if f.get("key") == "metadata.name" and f.get("operator") == "In":
+                values = f.get("values") or []
+                if values:
+                    return values[0]
+    return None
+
+
+def sweep_node_counts(
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    new_node_spec: Optional[dict],
+    counts: List[int],
+    mesh=None,
+) -> SweepResult:
+    """Evaluate `counts` candidate new-node counts in one batched run."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import scan as scan_ops
+    from ..ops.encode import encode_batch, encode_cluster, encode_dynamic
+
+    max_count = max(counts) if new_node_spec is not None else 0
+    padded = cluster.copy()
+    padded.nodes = list(padded.nodes) + _new_nodes(new_node_spec, max_count)
+
+    # Build oracle at full padding; generate the full pod sequence the
+    # serial path would see (cluster pods first, then apps in order).
+    oracle = Oracle(padded.nodes)
+    pods: List[dict] = []
+    pods.extend(wl.pods_excluding_daemon_sets(padded))
+    for ds in padded.daemon_sets:
+        pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
+    for app in apps:
+        app_pods = wl.generate_valid_pods_from_app(app.name, app.resource, padded.nodes)
+        pods.extend(_sort_app_pods(app_pods))
+
+    n_base = len(padded.nodes) - max_count
+    n = len(padded.nodes)
+
+    # per-scenario masks
+    sc = len(counts)
+    node_valid = np.ones((sc, n), dtype=bool)
+    for s, c in enumerate(counts):
+        node_valid[s, n_base + c :] = False
+    pod_active = np.ones((sc, len(pods)), dtype=bool)
+    name_to_idx = oracle.node_index
+    for p_i, pod in enumerate(pods):
+        target = _daemonset_target(pod)
+        if target is not None and target in name_to_idx:
+            t = name_to_idx[target]
+            pod_active[:, p_i] = node_valid[:, t]
+
+    cluster_enc = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster_enc, pods)
+    dyn = encode_dynamic(oracle, cluster_enc)
+
+    g = max(cluster_enc.g, 1)
+    dev_valid = np.zeros((n, g), dtype=bool)
+    for i in range(n):
+        dev_valid[i, : cluster_enc.gpu_count[i]] = True
+
+    static = scan_ops.ScanStatic(
+        alloc_mcpu=jnp.asarray(cluster_enc.alloc_mcpu),
+        alloc_mem=jnp.asarray(cluster_enc.alloc_mem),
+        alloc_eph=jnp.asarray(cluster_enc.alloc_eph),
+        alloc_pods=jnp.asarray(cluster_enc.alloc_pods),
+        scalar_alloc=jnp.asarray(cluster_enc.scalar_alloc),
+        gpu_per_dev=jnp.asarray(cluster_enc.gpu_per_dev),
+        gpu_total=jnp.asarray(cluster_enc.gpu_total),
+        gpu_count=jnp.asarray(cluster_enc.gpu_count),
+        dev_valid=jnp.asarray(dev_valid),
+        static_feasible=jnp.asarray(batch.static_feasible),
+        simon_raw=jnp.asarray(batch.simon_raw),
+        nodeaff_raw=jnp.asarray(batch.nodeaff_raw),
+        taint_intol=jnp.asarray(batch.taint_intol),
+        avoid_score=jnp.asarray(batch.avoid_score),
+        image_score=jnp.asarray(batch.image_score),
+        req_mcpu=jnp.asarray(batch.req_mcpu),
+        req_mem=jnp.asarray(batch.req_mem),
+        req_eph=jnp.asarray(batch.req_eph),
+        req_scalar=jnp.asarray(batch.req_scalar),
+        has_request=jnp.asarray(batch.has_request),
+        nz_mcpu=jnp.asarray(batch.nz_mcpu),
+        nz_mem=jnp.asarray(batch.nz_mem),
+        gpu_mem=jnp.asarray(batch.gpu_mem),
+        gpu_cnt=jnp.asarray(batch.gpu_cnt),
+        want_ports=jnp.asarray(batch.want_ports),
+        conflict_ports=jnp.asarray(batch.conflict_ports),
+    )
+    init = scan_ops.ScanState(
+        used_mcpu=jnp.asarray(dyn.used_mcpu),
+        used_mem=jnp.asarray(dyn.used_mem),
+        used_eph=jnp.asarray(dyn.used_eph),
+        used_scalar=jnp.asarray(dyn.used_scalar),
+        nz_mcpu=jnp.asarray(dyn.nz_mcpu),
+        nz_mem=jnp.asarray(dyn.nz_mem),
+        pod_cnt=jnp.asarray(dyn.pod_cnt),
+        ports_used=jnp.asarray(dyn.ports_used),
+        gpu_used=jnp.asarray(dyn.gpu_used),
+    )
+    class_arr = jnp.asarray(batch.class_of_pod)
+    pinned_arr = jnp.asarray(batch.pinned_node)
+
+    def one_scenario(valid, active):
+        placements, final = scan_ops.run_scan_masked(
+            static, init, class_arr, pinned_arr, valid, active
+        )
+        unsched = jnp.sum(placements == -1)
+        denom_cpu = jnp.sum(jnp.where(valid, static.alloc_mcpu, 0))
+        denom_mem = jnp.sum(jnp.where(valid, static.alloc_mem, 0))
+        cpu_util = 100.0 * jnp.sum(jnp.where(valid, final.used_mcpu, 0)) / jnp.maximum(denom_cpu, 1)
+        mem_util = 100.0 * jnp.sum(jnp.where(valid, final.used_mem, 0)) / jnp.maximum(denom_mem, 1)
+        return placements, unsched, cpu_util, mem_util
+
+    sweep_fn = jax.vmap(one_scenario)
+
+    valid_j = jnp.asarray(node_valid)
+    active_j = jnp.asarray(pod_active)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        n_dev = mesh.devices.size
+        pad = (-sc) % n_dev
+        if pad:
+            valid_j = jnp.concatenate([valid_j, jnp.repeat(valid_j[-1:], pad, 0)])
+            active_j = jnp.concatenate([active_j, jnp.repeat(active_j[-1:], pad, 0)])
+        sharding = NamedSharding(mesh, P(axis))
+        valid_j = jax.device_put(valid_j, sharding)
+        active_j = jax.device_put(active_j, sharding)
+        out = jax.jit(sweep_fn, in_shardings=(sharding, sharding))(valid_j, active_j)
+        placements, unsched, cpu_util, mem_util = (np.asarray(o)[:sc] for o in out)
+    else:
+        out = jax.jit(sweep_fn)(valid_j, active_j)
+        placements, unsched, cpu_util, mem_util = (np.asarray(o) for o in out)
+
+    return SweepResult(
+        counts=list(counts),
+        unscheduled=unsched,
+        cpu_util=cpu_util,
+        mem_util=mem_util,
+        placements=placements,
+        pods=pods,
+        node_names=[ns.name for ns in oracle.nodes],
+    )

@@ -1,0 +1,178 @@
+"""Capacity planner: batched sweep vs serial escalation, env caps,
+Simon CR config parsing, CLI plumbing."""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource
+from open_simulator_tpu.parallel.sweep import sweep_node_counts
+
+
+def _node(name, cpu="4", mem="8Gi"):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}},
+    }
+
+
+def _deploy(name, replicas, cpu="1", mem="1Gi"):
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "cap", "labels": {"app": name}},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "i",
+                            "resources": {"requests": {"cpu": cpu, "memory": mem}},
+                        }
+                    ]
+                }
+            },
+        },
+    }
+
+
+def test_sweep_finds_minimal_count():
+    cluster = ResourceTypes()
+    cluster.nodes = [_node("base-0"), _node("base-1")]
+    resources = ResourceTypes()
+    # 20 x 1cpu pods; base capacity 8 cpu => need 12 more cpu => 3 new
+    # 4-cpu nodes
+    resources.deployments = [_deploy("web", 20)]
+    apps = [AppResource("cap", resources)]
+    new_node = _node("template")
+    res = sweep_node_counts(cluster, apps, new_node, counts=list(range(0, 8)))
+    feasible = [c for c, u in zip(res.counts, res.unscheduled) if u == 0]
+    assert feasible, res.unscheduled
+    assert min(feasible) == 3
+    # monotone: more nodes never schedule fewer pods
+    assert all(
+        a >= b for a, b in zip(res.unscheduled[:-1], res.unscheduled[1:])
+    ), res.unscheduled
+
+
+def test_sweep_daemonset_pods_follow_node_count():
+    cluster = ResourceTypes()
+    cluster.nodes = [_node("base-0")]
+    resources = ResourceTypes()
+    resources.daemon_sets = [
+        {
+            "kind": "DaemonSet",
+            "metadata": {"name": "agent", "namespace": "cap", "labels": {"app": "agent"}},
+            "spec": {
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "i",
+                                "resources": {"requests": {"cpu": "100m"}},
+                            }
+                        ]
+                    }
+                }
+            },
+        }
+    ]
+    apps = [AppResource("cap", resources)]
+    res = sweep_node_counts(cluster, apps, _node("template"), counts=[0, 2])
+    # scenario 0: only the base-node daemonset pod is active
+    placed0 = (res.placements[0] >= 0).sum()
+    placed2 = (res.placements[1] >= 0).sum()
+    assert placed0 == 1
+    assert placed2 == 3  # one per node
+    inactive0 = (res.placements[0] == -2).sum()
+    assert inactive0 == 2  # the two disabled new-node ds pods
+
+
+def test_sweep_on_device_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("scenario",))
+    cluster = ResourceTypes()
+    cluster.nodes = [_node("base-0")]
+    resources = ResourceTypes()
+    resources.deployments = [_deploy("web", 6)]
+    apps = [AppResource("cap", resources)]
+    res = sweep_node_counts(cluster, apps, _node("template"), counts=list(range(6)), mesh=mesh)
+    feasible = [c for c, u in zip(res.counts, res.unscheduled) if u == 0]
+    assert feasible and min(feasible) == 1
+
+
+def test_simon_config_parse_and_validate(tmp_path):
+    from open_simulator_tpu.apply.applier import SimonConfig
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        """
+apiVersion: simon/v1alpha1
+kind: Config
+metadata:
+  name: test
+spec:
+  cluster:
+    customConfig: /does/not/exist
+  appList:
+    - name: a
+      path: /also/missing
+"""
+    )
+    config = SimonConfig.from_file(str(cfg))
+    with pytest.raises(ValueError, match="customConfig"):
+        config.validate()
+
+
+def test_applier_end_to_end(tmp_path):
+    import yaml as _yaml
+
+    from open_simulator_tpu.apply.applier import Applier, SimonConfig
+
+    cluster_dir = tmp_path / "cluster"
+    cluster_dir.mkdir()
+    for i in range(2):
+        (cluster_dir / f"n{i}.yaml").write_text(_yaml.safe_dump(_node(f"n{i}")))
+    app_dir = tmp_path / "app"
+    app_dir.mkdir()
+    (app_dir / "deploy.yaml").write_text(_yaml.safe_dump(_deploy("web", 10)))
+    newnode_dir = tmp_path / "newnode"
+    newnode_dir.mkdir()
+    (newnode_dir / "node.yaml").write_text(_yaml.safe_dump(_node("template")))
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        _yaml.safe_dump(
+            {
+                "apiVersion": "simon/v1alpha1",
+                "kind": "Config",
+                "metadata": {"name": "t"},
+                "spec": {
+                    "cluster": {"customConfig": str(cluster_dir)},
+                    "appList": [{"name": "web", "path": str(app_dir)}],
+                    "newNode": str(newnode_dir),
+                },
+            }
+        )
+    )
+    applier = Applier(SimonConfig.from_file(str(cfg)))
+    result = applier.run()
+    assert result.success
+    # 10 cpu needed, 8 available => 1 new node
+    assert result.new_node_count == 1
+    assert "Node Info" in result.report_text
+    assert "simon-00" in result.report_text
+
+
+def test_cli_version_and_gen_doc(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    assert main(["version"]) == 0
+    assert "simon-tpu version" in capsys.readouterr().out
+    assert main(["gen-doc", "--output", str(tmp_path)]) == 0
+    assert (tmp_path / "simon.md").exists()

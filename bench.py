@@ -120,7 +120,13 @@ def main():
     import numpy as np
 
     from open_simulator_tpu.ops import scan as scan_ops
-    from open_simulator_tpu.ops.encode import encode_batch, encode_cluster, encode_dynamic
+    from open_simulator_tpu.ops.encode import (
+        encode_batch,
+        encode_cluster,
+        encode_dynamic,
+        to_scan_static,
+        to_scan_state,
+    )
     from open_simulator_tpu.scheduler.oracle import Oracle
 
     nodes, pods = build_scenario()
@@ -128,48 +134,8 @@ def main():
     cluster = encode_cluster(oracle)
     batch = encode_batch(oracle, cluster, pods)
     dyn = encode_dynamic(oracle, cluster)
-
-    n, g = cluster.n, max(cluster.g, 1)
-    dev_valid = np.zeros((n, g), dtype=bool)
-    static = scan_ops.ScanStatic(
-        alloc_mcpu=jnp.asarray(cluster.alloc_mcpu),
-        alloc_mem=jnp.asarray(cluster.alloc_mem),
-        alloc_eph=jnp.asarray(cluster.alloc_eph),
-        alloc_pods=jnp.asarray(cluster.alloc_pods),
-        scalar_alloc=jnp.asarray(cluster.scalar_alloc),
-        gpu_per_dev=jnp.asarray(cluster.gpu_per_dev),
-        gpu_total=jnp.asarray(cluster.gpu_total),
-        gpu_count=jnp.asarray(cluster.gpu_count),
-        dev_valid=jnp.asarray(dev_valid),
-        static_feasible=jnp.asarray(batch.static_feasible),
-        simon_raw=jnp.asarray(batch.simon_raw),
-        nodeaff_raw=jnp.asarray(batch.nodeaff_raw),
-        taint_intol=jnp.asarray(batch.taint_intol),
-        avoid_score=jnp.asarray(batch.avoid_score),
-        image_score=jnp.asarray(batch.image_score),
-        req_mcpu=jnp.asarray(batch.req_mcpu),
-        req_mem=jnp.asarray(batch.req_mem),
-        req_eph=jnp.asarray(batch.req_eph),
-        req_scalar=jnp.asarray(batch.req_scalar),
-        has_request=jnp.asarray(batch.has_request),
-        nz_mcpu=jnp.asarray(batch.nz_mcpu),
-        nz_mem=jnp.asarray(batch.nz_mem),
-        gpu_mem=jnp.asarray(batch.gpu_mem),
-        gpu_cnt=jnp.asarray(batch.gpu_cnt),
-        want_ports=jnp.asarray(batch.want_ports),
-        conflict_ports=jnp.asarray(batch.conflict_ports),
-    )
-    init = scan_ops.ScanState(
-        used_mcpu=jnp.asarray(dyn.used_mcpu),
-        used_mem=jnp.asarray(dyn.used_mem),
-        used_eph=jnp.asarray(dyn.used_eph),
-        used_scalar=jnp.asarray(dyn.used_scalar),
-        nz_mcpu=jnp.asarray(dyn.nz_mcpu),
-        nz_mem=jnp.asarray(dyn.nz_mem),
-        pod_cnt=jnp.asarray(dyn.pod_cnt),
-        ports_used=jnp.asarray(dyn.ports_used),
-        gpu_used=jnp.asarray(dyn.gpu_used),
-    )
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
     class_arr = jnp.asarray(batch.class_of_pod)
     pinned_arr = jnp.asarray(batch.pinned_node)
 

@@ -257,14 +257,11 @@ class Applier:
     def _sweep_min_count(self, cluster, apps, new_node) -> Optional[int]:
         """One batched sweep over all candidate counts; returns the
         minimal count that schedules everything within the caps."""
-        from ..ops.encode import EngineUnsupported
         from ..parallel.sweep import sweep_node_counts
 
         try:
             counts = list(range(0, MAX_NUM_NEW_NODE + 1))
             res = sweep_node_counts(cluster, apps, new_node, counts)
-        except EngineUnsupported:
-            return None  # expected: feature not in the scan yet
         except Exception as e:  # pragma: no cover - diagnostic path
             import logging
 

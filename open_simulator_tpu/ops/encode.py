@@ -33,6 +33,7 @@ import numpy as np
 from ..models import labels as lbl
 from ..models import requests as req
 from ..models import storage as stor
+from .terms import TermTables, build_term_tables
 from ..scheduler.oracle import (
     GpuState,
     NodeState,
@@ -44,28 +45,8 @@ from ..scheduler.oracle import (
 )
 
 
-class EngineUnsupported(Exception):
-    """Raised when the pod batch (or existing cluster state) uses a
-    feature the vectorized engine does not cover yet; the caller falls
-    back to the serial oracle."""
-
-
 def _ceil(v: Fraction) -> int:
     return -((-v.numerator) // v.denominator)
-
-
-def _has_pod_affinity(pod: dict) -> bool:
-    aff = ((pod.get("spec") or {}).get("affinity")) or {}
-    return bool(aff.get("podAffinity") or aff.get("podAntiAffinity"))
-
-
-def _has_spread(pod: dict) -> bool:
-    return bool((pod.get("spec") or {}).get("topologySpreadConstraints"))
-
-
-def _has_local_storage(pod: dict) -> bool:
-    lvm, dev = stor.parse_pod_local_volumes(pod)
-    return bool(lvm or dev)
 
 
 @dataclass
@@ -89,6 +70,19 @@ class ClusterStatic:
     gpu_count: np.ndarray  # [N] i64
     gpu_per_dev: np.ndarray  # [N] i64
     gpu_total: np.ndarray  # [N] i64 (capacity gpu-mem)
+    # open-local storage: VGs and exclusive devices (devices sorted
+    # ascending by capacity per media type, CheckExclusiveResource...
+    # semantics, open-local algo/common.go:290-351)
+    v: int  # max VGs per node
+    vg_cap: np.ndarray  # [N, V] i64
+    vg_valid: np.ndarray  # [N, V] bool
+    has_storage: np.ndarray  # [N] bool (node has the storage annotation)
+    d_ssd: int
+    d_hdd: int
+    ssd_cap: np.ndarray  # [N, Ds] i64 (ascending)
+    ssd_valid: np.ndarray  # [N, Ds] bool
+    hdd_cap: np.ndarray  # [N, Dh] i64 (ascending)
+    hdd_valid: np.ndarray  # [N, Dh] bool
     # ports vocabulary
     port_vocab: List[tuple]
     port_conflict: np.ndarray  # [Pt, Pt] bool
@@ -107,6 +101,9 @@ class DynamicState:
     pod_cnt: np.ndarray
     ports_used: np.ndarray  # [N, Pt] bool
     gpu_used: np.ndarray  # [N, G] i64
+    vg_used: np.ndarray  # [N, V] i64
+    ssd_used: np.ndarray  # [N, Ds] bool
+    hdd_used: np.ndarray  # [N, Dh] bool
 
 
 @dataclass
@@ -129,6 +126,12 @@ class PodBatch:
     gpu_cnt: np.ndarray  # [U]
     want_ports: np.ndarray  # [U, Pt] bool (ports the pod binds)
     conflict_ports: np.ndarray  # [U, Pt] bool (vocab entries that would conflict)
+    # open-local volume requests (sizes padded with 0)
+    lvm_sizes: np.ndarray  # [U, Lv] i64, in declaration order
+    ssd_sizes: np.ndarray  # [U, Sv] i64, ascending
+    hdd_sizes: np.ndarray  # [U, Hv] i64, ascending
+    wants_storage: np.ndarray  # [U] bool
+    terms: TermTables  # affinity/spread tables
     # static per-class matrices
     static_feasible: np.ndarray  # [U, N] bool
     simon_raw: np.ndarray  # [U, N] i64
@@ -155,8 +158,10 @@ def _class_key(pod: dict) -> str:
     inits = [{"resources": c.get("resources")} for c in spec.get("initContainers") or []]
     key = {
         "ns": meta.get("namespace"),
+        "labels": meta.get("labels"),
         "nodeSelector": spec.get("nodeSelector"),
         "affinity": spec.get("affinity"),
+        "topologySpreadConstraints": spec.get("topologySpreadConstraints"),
         "tolerations": spec.get("tolerations"),
         "nodeName": spec.get("nodeName"),
         "hostNetwork": spec.get("hostNetwork"),
@@ -165,6 +170,7 @@ def _class_key(pod: dict) -> str:
         "inits": inits,
         "gpu_mem": anno.get(stor.GPU_MEM_ANNO),
         "gpu_cnt": anno.get(stor.GPU_COUNT_ANNO),
+        "local_storage": anno.get(stor.ANNO_POD_LOCAL_STORAGE),
         "owner_kind": (ctrl or {}).get("kind"),
     }
     return json.dumps(key, sort_keys=True, default=str)
@@ -207,6 +213,51 @@ def encode_cluster(oracle: Oracle) -> ClusterStatic:
     )
     g = int(gpu_count.max()) if n else 0
 
+    # open-local storage layout
+    has_storage = np.array([ns.storage is not None for ns in nodes], dtype=bool)
+    v = max((len(ns.storage.vgs) for ns in nodes if ns.storage), default=0)
+    d_ssd = max(
+        (
+            sum(1 for d in ns.storage.devices if d.media_type == "ssd")
+            for ns in nodes
+            if ns.storage
+        ),
+        default=0,
+    )
+    d_hdd = max(
+        (
+            sum(1 for d in ns.storage.devices if d.media_type == "hdd")
+            for ns in nodes
+            if ns.storage
+        ),
+        default=0,
+    )
+    vg_cap = np.zeros((n, max(v, 1)), dtype=np.int64)
+    vg_valid = np.zeros((n, max(v, 1)), dtype=bool)
+    ssd_cap = np.zeros((n, max(d_ssd, 1)), dtype=np.int64)
+    ssd_valid = np.zeros((n, max(d_ssd, 1)), dtype=bool)
+    hdd_cap = np.zeros((n, max(d_hdd, 1)), dtype=np.int64)
+    hdd_valid = np.zeros((n, max(d_hdd, 1)), dtype=bool)
+    for n_i, ns in enumerate(nodes):
+        if not ns.storage:
+            continue
+        for v_i, vg in enumerate(ns.storage.vgs):
+            vg_cap[n_i, v_i] = vg.capacity
+            vg_valid[n_i, v_i] = True
+        # devices ascending by capacity (stable), matching the oracle's
+        # _device_fit sort; is_allocated state goes in DynamicState
+        for media, cap_arr, valid_arr in (
+            ("ssd", ssd_cap, ssd_valid),
+            ("hdd", hdd_cap, hdd_valid),
+        ):
+            devs = sorted(
+                (d for d in ns.storage.devices if d.media_type == media),
+                key=lambda d: d.capacity,
+            )
+            for d_i, dev in enumerate(devs):
+                cap_arr[n_i, d_i] = dev.capacity
+                valid_arr[n_i, d_i] = True
+
     # port vocab built later (needs the pod batch); placeholder
     return ClusterStatic(
         n=n,
@@ -223,6 +274,16 @@ def encode_cluster(oracle: Oracle) -> ClusterStatic:
         gpu_count=gpu_count,
         gpu_per_dev=gpu_per_dev,
         gpu_total=gpu_total,
+        v=v,
+        vg_cap=vg_cap,
+        vg_valid=vg_valid,
+        has_storage=has_storage,
+        d_ssd=d_ssd,
+        d_hdd=d_hdd,
+        ssd_cap=ssd_cap,
+        ssd_valid=ssd_valid,
+        hdd_cap=hdd_cap,
+        hdd_valid=hdd_valid,
         port_vocab=[],
         port_conflict=np.zeros((0, 0), dtype=bool),
     )
@@ -244,6 +305,9 @@ def encode_dynamic(oracle: Oracle, cluster: ClusterStatic) -> DynamicState:
         pod_cnt=np.array([len(ns.pods) for ns in nodes], dtype=np.int64),
         ports_used=np.zeros((n, pt), dtype=bool),
         gpu_used=np.zeros((n, g), dtype=np.int64),
+        vg_used=np.zeros((n, max(cluster.v, 1)), dtype=np.int64),
+        ssd_used=np.zeros((n, max(cluster.d_ssd, 1)), dtype=bool),
+        hdd_used=np.zeros((n, max(cluster.d_hdd, 1)), dtype=bool),
     )
     for s_i, name in enumerate(cluster.scalar_names):
         for n_i, ns in enumerate(nodes):
@@ -255,6 +319,16 @@ def encode_dynamic(oracle: Oracle, cluster: ClusterStatic) -> DynamicState:
         if ns.gpu:
             for g_i, used in enumerate(ns.gpu.used):
                 st.gpu_used[n_i, g_i] = used
+        if ns.storage:
+            for v_i, vg in enumerate(ns.storage.vgs):
+                st.vg_used[n_i, v_i] = vg.requested
+            for media, used_arr in (("ssd", st.ssd_used), ("hdd", st.hdd_used)):
+                devs = sorted(
+                    (d for d in ns.storage.devices if d.media_type == media),
+                    key=lambda d: d.capacity,
+                )
+                for d_i, dev in enumerate(devs):
+                    used_arr[n_i, d_i] = dev.is_allocated
     return st
 
 
@@ -266,21 +340,7 @@ def _ports_conflict_pair(a: tuple, b: tuple) -> bool:
 
 
 def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> PodBatch:
-    """Build class-deduplicated static tensors for a pod batch.
-
-    Raises EngineUnsupported for features the scan does not cover yet
-    (inter-pod affinity, topology spread, open-local volumes) — both on
-    incoming pods and on pods already in the cluster (whose terms would
-    influence scoring of newcomers).
-    """
-    for pod in pods:
-        if _has_pod_affinity(pod) or _has_spread(pod) or _has_local_storage(pod):
-            raise EngineUnsupported("pod uses affinity/spread/local-storage")
-    for ns in oracle.nodes:
-        for pod in ns.pods:
-            if _has_pod_affinity(pod):
-                raise EngineUnsupported("existing pod has pod-affinity terms")
-
+    """Build class-deduplicated static tensors for a pod batch."""
     # port vocabulary over batch + existing usage
     vocab: List[tuple] = []
     seen = set()
@@ -332,6 +392,18 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
     gpu_cnt = np.zeros(u, dtype=np.int64)
     want_ports = np.zeros((u, pt), dtype=bool)
     conflict_ports = np.zeros((u, pt), dtype=bool)
+    class_volumes = [stor.parse_pod_local_volumes(p) for p in class_pods]
+    lv = max((len(lvm) for lvm, _dev in class_volumes), default=0)
+    sv = max(
+        (sum(1 for d in dev if d.kind == "SSD") for _lvm, dev in class_volumes), default=0
+    )
+    hv = max(
+        (sum(1 for d in dev if d.kind == "HDD") for _lvm, dev in class_volumes), default=0
+    )
+    lvm_sizes = np.zeros((u, max(lv, 1)), dtype=np.int64)
+    ssd_sizes = np.zeros((u, max(sv, 1)), dtype=np.int64)
+    hdd_sizes = np.zeros((u, max(hv, 1)), dtype=np.int64)
+    wants_storage = np.zeros(u, dtype=bool)
     static_feasible = np.ones((u, n), dtype=bool)
     simon_raw = np.zeros((u, n), dtype=np.int64)
     nodeaff_raw = np.zeros((u, n), dtype=np.int64)
@@ -367,6 +439,16 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
         g_mem, g_cnt = stor.pod_gpu_request(pod)
         gpu_mem[u_i] = g_mem
         gpu_cnt[u_i] = g_cnt
+        lvm_vols, dev_vols = class_volumes[u_i]
+        wants_storage[u_i] = bool(lvm_vols or dev_vols)
+        for i, vol in enumerate(lvm_vols):
+            lvm_sizes[u_i, i] = vol.size
+        # device volumes ascending by size per media (the oracle's
+        # _device_fit sorts them the same way)
+        for kind, arr in (("SSD", ssd_sizes), ("HDD", hdd_sizes)):
+            sizes = sorted(v.size for v in dev_vols if v.kind == kind)
+            for i, size in enumerate(sizes):
+                arr[u_i, i] = size
         for port in _pod_host_ports(pod):
             w_i = vocab.index(port)
             want_ports[u_i, w_i] = True
@@ -413,6 +495,8 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
         avoid_score[u_i] = _avoid_scores(pod, oracle)
         image_score[u_i] = _image_scores(pod, oracle)
 
+    terms = build_term_tables(oracle, class_pods)
+
     return PodBatch(
         p=len(pods),
         u=u,
@@ -429,12 +513,120 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
         gpu_cnt=gpu_cnt,
         want_ports=want_ports,
         conflict_ports=conflict_ports,
+        lvm_sizes=lvm_sizes,
+        ssd_sizes=ssd_sizes,
+        hdd_sizes=hdd_sizes,
+        wants_storage=wants_storage,
+        terms=terms,
         static_feasible=static_feasible,
         simon_raw=simon_raw,
         nodeaff_raw=nodeaff_raw,
         taint_intol=taint_intol,
         avoid_score=avoid_score,
         image_score=image_score,
+    )
+
+
+def to_scan_static(cluster: ClusterStatic, batch: PodBatch):
+    """Assemble the ScanStatic NamedTuple (device arrays) from host
+    encodings — the single place the scan's input layout is defined."""
+    import jax.numpy as jnp
+
+    from . import scan as scan_ops
+
+    n, g = cluster.n, max(cluster.g, 1)
+    dev_valid = np.zeros((n, g), dtype=bool)
+    for i in range(n):
+        dev_valid[i, : cluster.gpu_count[i]] = True
+    return scan_ops.ScanStatic(
+        alloc_mcpu=jnp.asarray(cluster.alloc_mcpu),
+        alloc_mem=jnp.asarray(cluster.alloc_mem),
+        alloc_eph=jnp.asarray(cluster.alloc_eph),
+        alloc_pods=jnp.asarray(cluster.alloc_pods),
+        scalar_alloc=jnp.asarray(cluster.scalar_alloc),
+        gpu_per_dev=jnp.asarray(cluster.gpu_per_dev),
+        gpu_total=jnp.asarray(cluster.gpu_total),
+        gpu_count=jnp.asarray(cluster.gpu_count),
+        dev_valid=jnp.asarray(dev_valid),
+        vg_cap=jnp.asarray(cluster.vg_cap),
+        vg_valid=jnp.asarray(cluster.vg_valid),
+        has_storage=jnp.asarray(cluster.has_storage),
+        ssd_cap=jnp.asarray(cluster.ssd_cap),
+        ssd_valid=jnp.asarray(cluster.ssd_valid),
+        hdd_cap=jnp.asarray(cluster.hdd_cap),
+        hdd_valid=jnp.asarray(cluster.hdd_valid),
+        static_feasible=jnp.asarray(batch.static_feasible),
+        simon_raw=jnp.asarray(batch.simon_raw),
+        nodeaff_raw=jnp.asarray(batch.nodeaff_raw),
+        taint_intol=jnp.asarray(batch.taint_intol),
+        avoid_score=jnp.asarray(batch.avoid_score),
+        image_score=jnp.asarray(batch.image_score),
+        req_mcpu=jnp.asarray(batch.req_mcpu),
+        req_mem=jnp.asarray(batch.req_mem),
+        req_eph=jnp.asarray(batch.req_eph),
+        req_scalar=jnp.asarray(batch.req_scalar),
+        has_request=jnp.asarray(batch.has_request),
+        nz_mcpu=jnp.asarray(batch.nz_mcpu),
+        nz_mem=jnp.asarray(batch.nz_mem),
+        gpu_mem=jnp.asarray(batch.gpu_mem),
+        gpu_cnt=jnp.asarray(batch.gpu_cnt),
+        want_ports=jnp.asarray(batch.want_ports),
+        conflict_ports=jnp.asarray(batch.conflict_ports),
+        lvm_sizes=jnp.asarray(batch.lvm_sizes),
+        ssd_sizes=jnp.asarray(batch.ssd_sizes),
+        hdd_sizes=jnp.asarray(batch.hdd_sizes),
+        wants_storage=jnp.asarray(batch.wants_storage),
+        topo_val=jnp.asarray(batch.terms.topo_val),
+        term_match=jnp.asarray(batch.terms.match),
+        carry_anti_req=jnp.asarray(batch.terms.carry_anti_req),
+        carry_aff_req=jnp.asarray(batch.terms.carry_aff_req),
+        carry_aff_pref_w=jnp.asarray(batch.terms.carry_aff_pref_w),
+        carry_anti_pref_w=jnp.asarray(batch.terms.carry_anti_pref_w),
+        cls_rows=jnp.asarray(batch.terms.cls_rows),
+        group_rows=jnp.asarray(batch.terms.group_rows),
+        group_of_row=jnp.asarray(batch.terms.group_of_row),
+        match_all=jnp.asarray(batch.terms.match_all),
+        cls_group_rows=jnp.asarray(batch.terms.cls_group_rows),
+        cls_group_id=jnp.asarray(batch.terms.cls_group_id),
+        h_row=jnp.asarray(batch.terms.h_row),
+        h_self=jnp.asarray(batch.terms.h_self),
+        h_max_skew=jnp.asarray(batch.terms.h_max_skew),
+        h_cand_nodes=jnp.asarray(batch.terms.h_cand_nodes),
+        cls_h_rows=jnp.asarray(batch.terms.cls_h_rows),
+        s_row=jnp.asarray(batch.terms.s_row),
+        s_is_host=jnp.asarray(batch.terms.s_is_host),
+        s_max_skew=jnp.asarray(batch.terms.s_max_skew),
+        s_q=jnp.asarray(batch.terms.s_q),
+        cls_s_rows=jnp.asarray(batch.terms.cls_s_rows),
+        cls_s_haskeys=jnp.asarray(batch.terms.cls_s_haskeys),
+    )
+
+
+def to_scan_state(dyn: DynamicState, batch: PodBatch):
+    import jax.numpy as jnp
+
+    from . import scan as scan_ops
+
+    return scan_ops.ScanState(
+        used_mcpu=jnp.asarray(dyn.used_mcpu),
+        used_mem=jnp.asarray(dyn.used_mem),
+        used_eph=jnp.asarray(dyn.used_eph),
+        used_scalar=jnp.asarray(dyn.used_scalar),
+        nz_mcpu=jnp.asarray(dyn.nz_mcpu),
+        nz_mem=jnp.asarray(dyn.nz_mem),
+        pod_cnt=jnp.asarray(dyn.pod_cnt),
+        ports_used=jnp.asarray(dyn.ports_used),
+        gpu_used=jnp.asarray(dyn.gpu_used),
+        vg_used=jnp.asarray(dyn.vg_used),
+        ssd_used=jnp.asarray(dyn.ssd_used),
+        hdd_used=jnp.asarray(dyn.hdd_used),
+        tgt=jnp.asarray(batch.terms.init_tgt),
+        own_anti_req=jnp.asarray(batch.terms.init_own_anti_req),
+        own_aff_req=jnp.asarray(batch.terms.init_own_aff_req),
+        own_aff_pref_w=jnp.asarray(batch.terms.init_own_aff_pref_w),
+        own_anti_pref_w=jnp.asarray(batch.terms.init_own_anti_pref_w),
+        group_counts=jnp.asarray(batch.terms.init_group_counts),
+        soft_counts=jnp.asarray(batch.terms.init_soft_counts),
     )
 
 

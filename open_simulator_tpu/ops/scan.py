@@ -48,6 +48,14 @@ class ScanStatic(NamedTuple):
     gpu_total: jnp.ndarray  # [N]
     gpu_count: jnp.ndarray  # [N]
     dev_valid: jnp.ndarray  # [N, G] bool (device exists on node)
+    # open-local storage
+    vg_cap: jnp.ndarray  # [N, V]
+    vg_valid: jnp.ndarray  # [N, V]
+    has_storage: jnp.ndarray  # [N] bool
+    ssd_cap: jnp.ndarray  # [N, Ds] ascending
+    ssd_valid: jnp.ndarray  # [N, Ds]
+    hdd_cap: jnp.ndarray  # [N, Dh] ascending
+    hdd_valid: jnp.ndarray  # [N, Dh]
     # per-class static matrices
     static_feasible: jnp.ndarray  # [U, N]
     simon_raw: jnp.ndarray  # [U, N]
@@ -67,6 +75,34 @@ class ScanStatic(NamedTuple):
     gpu_cnt: jnp.ndarray  # [U]
     want_ports: jnp.ndarray  # [U, Pt]
     conflict_ports: jnp.ndarray  # [U, Pt]
+    lvm_sizes: jnp.ndarray  # [U, Lv]
+    ssd_sizes: jnp.ndarray  # [U, Sv] ascending
+    hdd_sizes: jnp.ndarray  # [U, Hv] ascending
+    wants_storage: jnp.ndarray  # [U] bool
+    # inter-pod affinity + topology spread term tables (ops/terms.py)
+    topo_val: jnp.ndarray  # [T, N] i32
+    term_match: jnp.ndarray  # [T, U] bool
+    carry_anti_req: jnp.ndarray  # [T, U]
+    carry_aff_req: jnp.ndarray  # [T, U]
+    carry_aff_pref_w: jnp.ndarray  # [T, U]
+    carry_anti_pref_w: jnp.ndarray  # [T, U]
+    cls_rows: jnp.ndarray  # [U, Rmax]
+    group_rows: jnp.ndarray  # [A]
+    group_of_row: jnp.ndarray  # [A]
+    match_all: jnp.ndarray  # [Gn, U]
+    cls_group_rows: jnp.ndarray  # [U, Gmax]
+    cls_group_id: jnp.ndarray  # [U]
+    h_row: jnp.ndarray  # [Ch]
+    h_self: jnp.ndarray  # [Ch, U]
+    h_max_skew: jnp.ndarray  # [Ch]
+    h_cand_nodes: jnp.ndarray  # [Ch, N]
+    cls_h_rows: jnp.ndarray  # [U, Hmax]
+    s_row: jnp.ndarray  # [Cs]
+    s_is_host: jnp.ndarray  # [Cs]
+    s_max_skew: jnp.ndarray  # [Cs]
+    s_q: jnp.ndarray  # [Cs, N]
+    cls_s_rows: jnp.ndarray  # [U, Smax]
+    cls_s_haskeys: jnp.ndarray  # [U, N]
 
 
 class ScanState(NamedTuple):
@@ -79,6 +115,17 @@ class ScanState(NamedTuple):
     pod_cnt: jnp.ndarray
     ports_used: jnp.ndarray  # [N, Pt] bool
     gpu_used: jnp.ndarray  # [N, G]
+    vg_used: jnp.ndarray  # [N, V]
+    ssd_used: jnp.ndarray  # [N, Ds] bool
+    hdd_used: jnp.ndarray  # [N, Dh] bool
+    # affinity/spread counts over (term row, topology value)
+    tgt: jnp.ndarray  # [T, V] pods matching row selector at value
+    own_anti_req: jnp.ndarray  # [T, V] carried required anti-affinity
+    own_aff_req: jnp.ndarray  # [T, V] carried required affinity
+    own_aff_pref_w: jnp.ndarray  # [T, V] carried preferred-affinity weight
+    own_anti_pref_w: jnp.ndarray  # [T, V] carried preferred-anti weight
+    group_counts: jnp.ndarray  # [A, V] all-terms-match counts per group row
+    soft_counts: jnp.ndarray  # [Cs, V] qualifying-node-restricted counts
 
 
 def _default_normalize(raw, feasible, reverse: bool):
@@ -108,6 +155,292 @@ def _least_requested(requested, capacity):
     """leastRequestedScore (noderesources/least_allocated.go:108-117)."""
     ok = (capacity > 0) & (requested <= capacity)
     return jnp.where(ok, (capacity - requested) * MAX_SCORE // jnp.maximum(capacity, 1), 0)
+
+
+def _local_storage_eval(static: "ScanStatic", state: "ScanState", u):
+    """Open-Local filter + score + hypothetical allocation, all nodes
+    at once.
+
+    LVM (open-local common.go ProcessLVMPVCPredicate/Binpack): each
+    volume in declaration order goes to the VG with the least free
+    space that still fits (ties: lowest VG index). Devices
+    (ProcessDevicePVC): per media type, volumes ascending meet free
+    devices ascending by capacity, first fit. Score = ScoreLVM +
+    ScoreDevice (common.go:660-692, 753-761) with the Binpack strategy.
+
+    Returns (ok[N], raw_score[N], vg_take[N,V], ssd_take[N,Ds] bool,
+    hdd_take[N,Dh] bool).
+    """
+    n, v = static.vg_cap.shape
+    big = jnp.iinfo(jnp.int64).max
+    wants = static.wants_storage[u]
+
+    vg_take = jnp.zeros((n, v), dtype=jnp.int64)
+    lvm_ok = jnp.ones((n,), dtype=bool)
+    for i in range(static.lvm_sizes.shape[1]):
+        size = static.lvm_sizes[u, i]
+        free = static.vg_cap - state.vg_used - vg_take
+        eligible = static.vg_valid & (free >= size)
+        chosen = jnp.argmin(jnp.where(eligible, free, big), axis=1)
+        ok_i = jnp.any(eligible, axis=1)
+        onehot = jax.nn.one_hot(chosen, v, dtype=jnp.int64) * ok_i[:, None]
+        active = size > 0
+        vg_take = vg_take + jnp.where(active, onehot * size, 0)
+        lvm_ok = lvm_ok & (ok_i | ~active)
+
+    def fit_devices(cap, valid, used, sizes):
+        """First-fit of ascending sizes onto ascending-capacity free
+        devices; returns (ok[N], take[N,D] bool, frac_sum[N], count)."""
+        d = cap.shape[1]
+        take = jnp.zeros(cap.shape, dtype=bool)
+        ok = jnp.ones((cap.shape[0],), dtype=bool)
+        frac = jnp.zeros((cap.shape[0],), dtype=jnp.float64)
+        cnt = jnp.zeros((cap.shape[0],), dtype=jnp.int64)
+        for i in range(sizes.shape[1]):
+            size = sizes[u, i]
+            active = size > 0
+            eligible = valid & ~used & ~take & (cap >= size)
+            ok_i = jnp.any(eligible, axis=1)
+            # first eligible in ascending-capacity order
+            chosen = jnp.argmax(eligible, axis=1)
+            onehot = jax.nn.one_hot(chosen, d, dtype=bool) & eligible.any(axis=1)[:, None]
+            take = take | (onehot & active)
+            chosen_cap = jnp.take_along_axis(cap, chosen[:, None], axis=1)[:, 0]
+            frac = frac + jnp.where(
+                active & ok_i, size / jnp.maximum(chosen_cap, 1), 0.0
+            )
+            cnt = cnt + jnp.where(active & ok_i, 1, 0)
+            ok = ok & (ok_i | ~active)
+        return ok, take, frac, cnt
+
+    ssd_ok, ssd_take, ssd_frac, ssd_cnt = fit_devices(
+        static.ssd_cap, static.ssd_valid, state.ssd_used, static.ssd_sizes
+    )
+    hdd_ok, hdd_take, hdd_frac, hdd_cnt = fit_devices(
+        static.hdd_cap, static.hdd_valid, state.hdd_used, static.hdd_sizes
+    )
+
+    ok = (~wants) | (static.has_storage & lvm_ok & ssd_ok & hdd_ok)
+
+    # ScoreLVM (Binpack): mean over touched VGs of used/capacity * 10
+    touched = vg_take > 0
+    lvm_frac = jnp.sum(
+        jnp.where(touched, vg_take / jnp.maximum(static.vg_cap, 1), 0.0), axis=1
+    )
+    lvm_cnt = jnp.sum(touched, axis=1)
+    lvm_score = jnp.where(
+        lvm_cnt > 0, (lvm_frac / jnp.maximum(lvm_cnt, 1) * 10).astype(jnp.int64), 0
+    )
+    # ScoreDevice: mean over ALL device units of requested/allocated * 10
+    dev_cnt = ssd_cnt + hdd_cnt
+    dev_score = jnp.where(
+        dev_cnt > 0,
+        ((ssd_frac + hdd_frac) / jnp.maximum(dev_cnt, 1) * 10).astype(jnp.int64),
+        0,
+    )
+    raw = jnp.where(wants & static.has_storage, lvm_score + dev_score, 0)
+    return ok, raw, vg_take, ssd_take, hdd_take
+
+
+HARD_POD_AFFINITY_WEIGHT = 1  # interpodaffinity args default
+
+
+def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid):
+    """InterPodAffinity filter + raw score and PodTopologySpread hard
+    filter + soft score for pod class u over all nodes.
+
+    Returns (ipa_ok[N], spread_ok[N], ipa_raw[N] i64, soft_score fn).
+    The soft-spread score depends on the feasible set, so it is returned
+    as a closure evaluated after all filters are combined.
+    """
+    n = static.topo_val.shape[1]
+    big = jnp.iinfo(jnp.int64).max
+
+    # ---- relevant term rows of this class --------------------------------
+    rows = static.cls_rows[u]  # [R]
+    rvalid = rows >= 0
+    r = jnp.maximum(rows, 0)
+    vals = static.topo_val[r]  # [R, N]
+    has = (vals >= 0) & rvalid[:, None]
+    vv = jnp.maximum(vals, 0)
+
+    def gather(counts):
+        return jnp.where(has, jnp.take_along_axis(counts[r], vv, axis=1), 0)
+
+    tgt_at = gather(state.tgt)
+    own_anti_at = gather(state.own_anti_req)
+    own_affreq_at = gather(state.own_aff_req)
+    own_affpref_at = gather(state.own_aff_pref_w)
+    own_antipref_at = gather(state.own_anti_pref_w)
+
+    m = static.term_match[r, u] & rvalid  # [R]
+    c_anti = jnp.where(rvalid, static.carry_anti_req[r, u], 0)
+    c_paff = jnp.where(rvalid, static.carry_aff_pref_w[r, u], 0)
+    c_panti = jnp.where(rvalid, static.carry_anti_pref_w[r, u], 0)
+
+    # satisfyExistingPodsAntiAffinity (filtering.go:313-326)
+    fail_exist_anti = jnp.any(m[:, None] & (own_anti_at > 0), axis=0)
+    # satisfyPodAntiAffinity (filtering.go:329-340)
+    fail_own_anti = jnp.any((c_anti > 0)[:, None] & (tgt_at > 0), axis=0)
+
+    # InterPodAffinity raw score (scoring.go processExistingPod)
+    ipa_raw = jnp.sum(
+        (c_paff - c_panti)[:, None] * tgt_at
+        + m[:, None]
+        * (
+            HARD_POD_AFFINITY_WEIGHT * own_affreq_at
+            + own_affpref_at
+            - own_antipref_at
+        ),
+        axis=0,
+    )
+
+    # satisfyPodAffinity (filtering.go:343-371)
+    garc = static.cls_group_rows[u]  # [Gm]
+    gvalid = garc >= 0
+    ga = jnp.maximum(garc, 0)
+    g_term_rows = static.group_rows[ga]
+    gvals = static.topo_val[g_term_rows]  # [Gm, N]
+    has_g = gvals >= 0
+    gc = jnp.where(
+        has_g, jnp.take_along_axis(state.group_counts[ga], jnp.maximum(gvals, 0), axis=1), 0
+    )
+    keys_ok = jnp.all(has_g | ~gvalid[:, None], axis=0)
+    pods_exist = jnp.all((gc > 0) | ~gvalid[:, None], axis=0)
+    total_counts = jnp.sum(jnp.where(gvalid[:, None], state.group_counts[ga], 0))
+    gid = static.cls_group_id[u]
+    self_ok = static.match_all[jnp.maximum(gid, 0), u]
+    bootstrap = (total_counts == 0) & self_ok
+    aff_ok = (gid < 0) | (keys_ok & (pods_exist | bootstrap))
+
+    ipa_ok = aff_ok & ~fail_own_anti & ~fail_exist_anti
+
+    # ---- hard topology spread (filtering.go:276-337) ---------------------
+    # candidate topology VALUES derive from candidate NODES restricted
+    # by the scenario's node_valid mask (capacity sweep correctness)
+    hc = static.cls_h_rows[u]  # [Hm]
+    hvalid = hc >= 0
+    h = jnp.maximum(hc, 0)
+    hrow = static.h_row[h]
+    hvals = static.topo_val[hrow]  # [Hm, N]
+    cand_nodes = static.h_cand_nodes[h] & node_valid[None, :]  # [Hm, N]
+    v_dim = state.tgt.shape[1]
+
+    def cand_row(vals_r, cn_r):
+        return (
+            jnp.zeros((v_dim,), bool).at[jnp.maximum(vals_r, 0)].max(cn_r & (vals_r >= 0))
+        )
+
+    cand = jax.vmap(cand_row)(hvals, cand_nodes)  # [Hm, V]
+    counts_h = state.tgt[hrow]  # [Hm, V]
+    minc = jnp.min(jnp.where(cand, counts_h, big), axis=1)
+    minc = jnp.where(jnp.any(cand, axis=1), minc, 0)
+    pair_in = (
+        jnp.take_along_axis(cand, jnp.maximum(hvals, 0).astype(jnp.int32), axis=1)
+        & (hvals >= 0)
+    )
+    cnt_eff = jnp.where(
+        pair_in, jnp.take_along_axis(counts_h, jnp.maximum(hvals, 0), axis=1), 0
+    )
+    selfm = static.h_self[h, u]
+    skew = cnt_eff + selfm[:, None] - minc[:, None]
+    ok_c = (skew <= static.h_max_skew[h][:, None]) & (hvals >= 0)
+    spread_ok = jnp.all(ok_c | ~hvalid[:, None], axis=0)
+
+    # ---- soft topology spread score (scoring.go) -------------------------
+    sc = static.cls_s_rows[u]
+    svalid = sc >= 0
+    s = jnp.maximum(sc, 0)
+    has_soft = jnp.any(svalid)
+
+    def soft_score(feasible_final):
+        srow = static.s_row[s]
+        svals = static.topo_val[srow]  # [Sm, N]
+        has_keys = static.cls_s_haskeys[u]  # [N]
+        eligible = feasible_final & has_keys
+        is_host = static.s_is_host[s]
+        v_dim = state.tgt.shape[1]
+
+        def present_row(vals_r):
+            return (
+                jnp.zeros((v_dim,), bool)
+                .at[jnp.maximum(vals_r, 0)]
+                .max(eligible & (vals_r >= 0))
+            )
+
+        present = jax.vmap(present_row)(svals)  # [Sm, V]
+        sz_nonhost = jnp.sum(present, axis=1)
+        sz = jnp.where(is_host, jnp.sum(eligible), sz_nonhost)
+        weight = jnp.log(sz.astype(jnp.float64) + 2.0)
+        cnt_soft = jnp.take_along_axis(state.soft_counts[s], jnp.maximum(svals, 0), axis=1)
+        cnt_host = jnp.take_along_axis(state.tgt[srow], jnp.maximum(svals, 0), axis=1)
+        cnt = jnp.where(is_host[:, None], cnt_host, cnt_soft) * (svals >= 0)
+        score_f = jnp.sum(
+            jnp.where(
+                svalid[:, None],
+                cnt * weight[:, None] + (static.s_max_skew[s] - 1)[:, None].astype(jnp.float64),
+                0.0,
+            ),
+            axis=0,
+        )
+        raw = score_f.astype(jnp.int64)
+        valid = feasible_final & has_keys
+        any_valid = jnp.any(valid)
+        mx = jnp.max(jnp.where(valid, raw, -big))
+        mn = jnp.min(jnp.where(valid, raw, big))
+        normalized = jnp.where(
+            mx == 0, MAX_SCORE, MAX_SCORE * (mx + mn - raw) // jnp.maximum(mx, 1)
+        )
+        out = jnp.where(valid, normalized, 0)
+        out = jnp.where(any_valid, out, 0)
+        return jnp.where(has_soft, out, MAX_SCORE)
+
+    return ipa_ok, spread_ok, ipa_raw, soft_score
+
+
+def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit):
+    """Rank-1 count updates after a commit (AddPod semantics of the
+    PreFilterExtensions / next cycle's PreScore recomputation)."""
+    node = jnp.maximum(placement, 0)
+    inc = commit.astype(jnp.int64)
+
+    rows = static.cls_rows[u]
+    rvalid = rows >= 0
+    r = jnp.maximum(rows, 0)
+    val = static.topo_val[r, node]  # [R]
+    ok = (val >= 0) & rvalid
+    vv = jnp.maximum(val, 0)
+    m = (static.term_match[r, u] & ok).astype(jnp.int64) * inc
+
+    tgt = state.tgt.at[r, vv].add(m)
+    own_anti = state.own_anti_req.at[r, vv].add(
+        jnp.where(ok, static.carry_anti_req[r, u], 0) * inc
+    )
+    own_aff = state.own_aff_req.at[r, vv].add(
+        jnp.where(ok, static.carry_aff_req[r, u], 0) * inc
+    )
+    own_paff = state.own_aff_pref_w.at[r, vv].add(
+        jnp.where(ok, static.carry_aff_pref_w[r, u], 0) * inc
+    )
+    own_panti = state.own_anti_pref_w.at[r, vv].add(
+        jnp.where(ok, static.carry_anti_pref_w[r, u], 0) * inc
+    )
+
+    # group counts: all A rows
+    a_dim = static.group_rows.shape[0]
+    g_val = static.topo_val[static.group_rows, node]  # [A]
+    g_ok = g_val >= 0
+    g_inc = (static.match_all[static.group_of_row, u] & g_ok).astype(jnp.int64) * inc
+    group_counts = state.group_counts.at[jnp.arange(a_dim), jnp.maximum(g_val, 0)].add(g_inc)
+
+    # soft spread counts: all Cs rows, restricted to qualifying nodes
+    cs_dim = static.s_row.shape[0]
+    s_val = static.topo_val[static.s_row, node]  # [Cs]
+    s_ok = (s_val >= 0) & static.s_q[jnp.arange(cs_dim), node]
+    s_inc = (static.term_match[static.s_row, u] & s_ok).astype(jnp.int64) * inc
+    soft_counts = state.soft_counts.at[jnp.arange(cs_dim), jnp.maximum(s_val, 0)].add(s_inc)
+
+    return tgt, own_anti, own_aff, own_paff, own_panti, group_counts, soft_counts
 
 
 def _gpu_allocate(avail, dev_valid, per_gpu_mem, count):
@@ -199,8 +532,14 @@ def run_scan_masked(
         )
         needs_gpu = static.gpu_mem[u] > 0
         gpu_ok = ~needs_gpu | ((static.gpu_total >= static.gpu_mem[u]) & gpu_found)
+        # Open-Local
+        local_ok, local_raw, vg_take, ssd_take, hdd_take = _local_storage_eval(
+            static, state, u
+        )
+        # InterPodAffinity + PodTopologySpread
+        ipa_ok, spread_ok, ipa_raw, soft_score = _terms_eval(static, state, u, node_valid)
 
-        feasible = feasible & fit & ~port_clash & gpu_ok
+        feasible = feasible & fit & ~port_clash & gpu_ok & local_ok & ipa_ok & spread_ok
 
         # ---- scores ----
         cpu_req_total = state.nz_mcpu + static.nz_mcpu[u]
@@ -221,20 +560,32 @@ def run_scan_masked(
         nodeaff = _default_normalize(static.nodeaff_raw[u], feasible, reverse=False)
         tainttol = _default_normalize(static.taint_intol[u], feasible, reverse=True)
         simon = _minmax_normalize(static.simon_raw[u], feasible)
-        # PodTopologySpread with no constraints normalizes every node to
-        # MaxNodeScore (scoring.go NormalizeScore maxScore==0 branch);
-        # InterPodAffinity and Open-Local contribute 0 without terms.
-        spread = MAX_SCORE
+        local = _minmax_normalize(local_raw, feasible)
+        # PodTopologySpread soft score (all MaxNodeScore when the pod has
+        # no soft constraints — NormalizeScore maxScore==0 branch)
+        spread = soft_score(feasible)
+        # InterPodAffinity NormalizeScore (scoring.go:246-270): bounds
+        # include 0, float divide, int64 truncation
+        ipa_mx = jnp.maximum(jnp.max(jnp.where(feasible, ipa_raw, 0)), 0)
+        ipa_mn = jnp.minimum(jnp.min(jnp.where(feasible, ipa_raw, 0)), 0)
+        ipa_diff = (ipa_mx - ipa_mn).astype(jnp.float64)
+        ipa = jnp.where(
+            ipa_diff > 0,
+            (MAX_SCORE * (ipa_raw - ipa_mn) / jnp.maximum(ipa_diff, 1.0)).astype(jnp.int64),
+            0,
+        )
         total = (
             balanced
             + static.image_score[u]
             + least
             + nodeaff
             + static.avoid_score[u] * 10000
+            + ipa
             + spread * 2
             + tainttol
             + simon  # Simon plugin
             + simon  # Open-Gpu-Share plugin (identical formula)
+            + local  # Open-Local plugin
         )
 
         # ---- select: first max over feasible; pinned overrides ----
@@ -251,6 +602,9 @@ def run_scan_masked(
 
         # ---- commit ----
         commit = placement >= 0
+        tgt, own_anti, own_aff, own_paff, own_panti, group_counts, soft_counts = (
+            _terms_commit(static, state, u, placement, commit)
+        )
         onehot = (
             jax.nn.one_hot(jnp.maximum(placement, 0), static.alloc_mcpu.shape[0], dtype=jnp.int64)
             * commit.astype(jnp.int64)
@@ -267,6 +621,16 @@ def run_scan_masked(
             | (onehot.astype(bool)[:, None] & static.want_ports[u][None, :]),
             gpu_used=state.gpu_used
             + jnp.where(needs_gpu, onehot[:, None] * gpu_take * static.gpu_mem[u], 0),
+            vg_used=state.vg_used + onehot[:, None] * vg_take,
+            ssd_used=state.ssd_used | (onehot.astype(bool)[:, None] & ssd_take),
+            hdd_used=state.hdd_used | (onehot.astype(bool)[:, None] & hdd_take),
+            tgt=tgt,
+            own_anti_req=own_anti,
+            own_aff_req=own_aff,
+            own_aff_pref_w=own_paff,
+            own_anti_pref_w=own_panti,
+            group_counts=group_counts,
+            soft_counts=soft_counts,
             )
         return new_state, placement
 

@@ -80,7 +80,13 @@ def sweep_node_counts(
     import jax.numpy as jnp
 
     from ..ops import scan as scan_ops
-    from ..ops.encode import encode_batch, encode_cluster, encode_dynamic
+    from ..ops.encode import (
+        encode_batch,
+        encode_cluster,
+        encode_dynamic,
+        to_scan_static,
+        to_scan_state,
+    )
 
     max_count = max(counts) if new_node_spec is not None else 0
     padded = cluster.copy()
@@ -116,51 +122,8 @@ def sweep_node_counts(
     cluster_enc = encode_cluster(oracle)
     batch = encode_batch(oracle, cluster_enc, pods)
     dyn = encode_dynamic(oracle, cluster_enc)
-
-    g = max(cluster_enc.g, 1)
-    dev_valid = np.zeros((n, g), dtype=bool)
-    for i in range(n):
-        dev_valid[i, : cluster_enc.gpu_count[i]] = True
-
-    static = scan_ops.ScanStatic(
-        alloc_mcpu=jnp.asarray(cluster_enc.alloc_mcpu),
-        alloc_mem=jnp.asarray(cluster_enc.alloc_mem),
-        alloc_eph=jnp.asarray(cluster_enc.alloc_eph),
-        alloc_pods=jnp.asarray(cluster_enc.alloc_pods),
-        scalar_alloc=jnp.asarray(cluster_enc.scalar_alloc),
-        gpu_per_dev=jnp.asarray(cluster_enc.gpu_per_dev),
-        gpu_total=jnp.asarray(cluster_enc.gpu_total),
-        gpu_count=jnp.asarray(cluster_enc.gpu_count),
-        dev_valid=jnp.asarray(dev_valid),
-        static_feasible=jnp.asarray(batch.static_feasible),
-        simon_raw=jnp.asarray(batch.simon_raw),
-        nodeaff_raw=jnp.asarray(batch.nodeaff_raw),
-        taint_intol=jnp.asarray(batch.taint_intol),
-        avoid_score=jnp.asarray(batch.avoid_score),
-        image_score=jnp.asarray(batch.image_score),
-        req_mcpu=jnp.asarray(batch.req_mcpu),
-        req_mem=jnp.asarray(batch.req_mem),
-        req_eph=jnp.asarray(batch.req_eph),
-        req_scalar=jnp.asarray(batch.req_scalar),
-        has_request=jnp.asarray(batch.has_request),
-        nz_mcpu=jnp.asarray(batch.nz_mcpu),
-        nz_mem=jnp.asarray(batch.nz_mem),
-        gpu_mem=jnp.asarray(batch.gpu_mem),
-        gpu_cnt=jnp.asarray(batch.gpu_cnt),
-        want_ports=jnp.asarray(batch.want_ports),
-        conflict_ports=jnp.asarray(batch.conflict_ports),
-    )
-    init = scan_ops.ScanState(
-        used_mcpu=jnp.asarray(dyn.used_mcpu),
-        used_mem=jnp.asarray(dyn.used_mem),
-        used_eph=jnp.asarray(dyn.used_eph),
-        used_scalar=jnp.asarray(dyn.used_scalar),
-        nz_mcpu=jnp.asarray(dyn.nz_mcpu),
-        nz_mem=jnp.asarray(dyn.nz_mem),
-        pod_cnt=jnp.asarray(dyn.pod_cnt),
-        ports_used=jnp.asarray(dyn.ports_used),
-        gpu_used=jnp.asarray(dyn.gpu_used),
-    )
+    static = to_scan_static(cluster_enc, batch)
+    init = to_scan_state(dyn, batch)
     class_arr = jnp.asarray(batch.class_of_pod)
     pinned_arr = jnp.asarray(batch.pinned_node)
 

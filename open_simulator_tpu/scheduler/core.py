@@ -113,10 +113,8 @@ class Simulator:
 
     def _schedule_pods_tpu(self, pods: List[dict]) -> List[UnscheduledPod]:
         """JAX scan path. Pods keep their order (pinned pods are forced
-        placements inside the scan). On EngineUnsupported features the
-        whole batch falls back to the serial oracle — identical results,
-        host speed."""
-        from .engine import EngineUnsupported, TpuEngine
+        placements inside the scan)."""
+        from .engine import TpuEngine
 
         # pods pinned to unknown nodes never reach the scheduler
         # (reference: created in the tracker, no bind event)
@@ -131,10 +129,7 @@ class Simulator:
         if not batch:
             return []
         engine = TpuEngine(self.oracle)
-        try:
-            placements = engine.schedule(batch)
-        except EngineUnsupported:
-            return self._schedule_pods_oracle(batch)
+        placements = engine.schedule(batch)
         failed: List[UnscheduledPod] = []
         for pod, node_idx in zip(batch, placements):
             if (pod.get("spec") or {}).get("nodeName"):

@@ -17,7 +17,6 @@ import numpy as np
 
 from ..ops.encode import (
     ClusterStatic,
-    EngineUnsupported,
     PodBatch,
     encode_batch,
     encode_cluster,
@@ -25,7 +24,7 @@ from ..ops.encode import (
 )
 from .oracle import Oracle
 
-__all__ = ["TpuEngine", "EngineUnsupported"]
+__all__ = ["TpuEngine"]
 
 
 class TpuEngine:
@@ -42,57 +41,14 @@ class TpuEngine:
         import jax.numpy as jnp
 
         from ..ops import scan as scan_ops
+        from ..ops.encode import to_scan_static, to_scan_state
 
         oracle = self.oracle
         cluster = encode_cluster(oracle)
         batch = encode_batch(oracle, cluster, pods)
         dyn = encode_dynamic(oracle, cluster)
-
-        n = cluster.n
-        g = max(cluster.g, 1)
-        dev_valid = np.zeros((n, g), dtype=bool)
-        for i in range(n):
-            dev_valid[i, : cluster.gpu_count[i]] = True
-
-        static = scan_ops.ScanStatic(
-            alloc_mcpu=jnp.asarray(cluster.alloc_mcpu),
-            alloc_mem=jnp.asarray(cluster.alloc_mem),
-            alloc_eph=jnp.asarray(cluster.alloc_eph),
-            alloc_pods=jnp.asarray(cluster.alloc_pods),
-            scalar_alloc=jnp.asarray(cluster.scalar_alloc),
-            gpu_per_dev=jnp.asarray(cluster.gpu_per_dev),
-            gpu_total=jnp.asarray(cluster.gpu_total),
-            gpu_count=jnp.asarray(cluster.gpu_count),
-            dev_valid=jnp.asarray(dev_valid),
-            static_feasible=jnp.asarray(batch.static_feasible),
-            simon_raw=jnp.asarray(batch.simon_raw),
-            nodeaff_raw=jnp.asarray(batch.nodeaff_raw),
-            taint_intol=jnp.asarray(batch.taint_intol),
-            avoid_score=jnp.asarray(batch.avoid_score),
-            image_score=jnp.asarray(batch.image_score),
-            req_mcpu=jnp.asarray(batch.req_mcpu),
-            req_mem=jnp.asarray(batch.req_mem),
-            req_eph=jnp.asarray(batch.req_eph),
-            req_scalar=jnp.asarray(batch.req_scalar),
-            has_request=jnp.asarray(batch.has_request),
-            nz_mcpu=jnp.asarray(batch.nz_mcpu),
-            nz_mem=jnp.asarray(batch.nz_mem),
-            gpu_mem=jnp.asarray(batch.gpu_mem),
-            gpu_cnt=jnp.asarray(batch.gpu_cnt),
-            want_ports=jnp.asarray(batch.want_ports),
-            conflict_ports=jnp.asarray(batch.conflict_ports),
-        )
-        init = scan_ops.ScanState(
-            used_mcpu=jnp.asarray(dyn.used_mcpu),
-            used_mem=jnp.asarray(dyn.used_mem),
-            used_eph=jnp.asarray(dyn.used_eph),
-            used_scalar=jnp.asarray(dyn.used_scalar),
-            nz_mcpu=jnp.asarray(dyn.nz_mcpu),
-            nz_mem=jnp.asarray(dyn.nz_mem),
-            pod_cnt=jnp.asarray(dyn.pod_cnt),
-            ports_used=jnp.asarray(dyn.ports_used),
-            gpu_used=jnp.asarray(dyn.gpu_used),
-        )
+        static = to_scan_static(cluster, batch)
+        init = to_scan_state(dyn, batch)
         placements, _ = scan_ops.run_scan(
             static,
             init,

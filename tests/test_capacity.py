@@ -176,3 +176,57 @@ def test_cli_version_and_gen_doc(tmp_path, capsys):
     assert "simon-tpu version" in capsys.readouterr().out
     assert main(["gen-doc", "--output", str(tmp_path)]) == 0
     assert (tmp_path / "simon.md").exists()
+
+
+def test_sweep_with_hostname_spread_matches_serial():
+    """Regression: candidate topology domains must follow each sweep
+    scenario's node_valid mask — padded-but-disabled nodes previously
+    forced min-count 0 and made scenarios spuriously unschedulable."""
+    from open_simulator_tpu.models.workloads import reset_name_counter
+    from open_simulator_tpu.parallel.sweep import _new_nodes
+    from open_simulator_tpu.scheduler.core import simulate
+
+    cluster = ResourceTypes()
+    cluster.nodes = [_node("base-0"), _node("base-1")]
+    resources = ResourceTypes()
+    resources.stateful_sets = [
+        {
+            "kind": "StatefulSet",
+            "metadata": {"name": "spread", "namespace": "cap", "labels": {"app": "spread"}},
+            "spec": {
+                "replicas": 8,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "i",
+                                "resources": {"requests": {"cpu": "1"}},
+                            }
+                        ],
+                        "topologySpreadConstraints": [
+                            {
+                                "maxSkew": 2,
+                                "topologyKey": "kubernetes.io/hostname",
+                                "whenUnsatisfiable": "DoNotSchedule",
+                                "labelSelector": {"matchLabels": {"app": "spread"}},
+                            }
+                        ],
+                    }
+                },
+            },
+        }
+    ]
+    apps = [AppResource("cap", resources)]
+    res = sweep_node_counts(cluster, apps, _node("template"), counts=[0, 1, 2, 3])
+    # cross-check each scenario against a direct serial simulation
+    for s, count in enumerate(res.counts):
+        reset_name_counter()
+        padded = cluster.copy()
+        padded.nodes = list(padded.nodes) + _new_nodes(_node("template"), count)
+        serial = simulate(padded, apps, engine="oracle")
+        assert int(res.unscheduled[s]) == len(serial.unscheduled_pods), (
+            count,
+            int(res.unscheduled[s]),
+            len(serial.unscheduled_pods),
+        )

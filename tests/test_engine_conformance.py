@@ -157,3 +157,175 @@ def test_randomized_conformance(seed):
             }
         ]
     _compare(cluster, [AppResource("rand", resources)])
+
+
+def _storage_node(rng, i):
+    import json as _json
+
+    node = _random_node(rng, 100 + i)
+    vgs = [
+        {"name": f"vg{j}", "capacity": str(rng.choice([50, 100, 200]) * 1024**3), "requested": "0"}
+        for j in range(rng.randint(1, 3))
+    ]
+    devices = [
+        {
+            "name": f"/dev/vd{j}",
+            "device": f"/dev/vd{j}",
+            "capacity": str(rng.choice([100, 200]) * 1024**3),
+            "mediaType": rng.choice(["ssd", "hdd"]),
+            "isAllocated": "false",
+        }
+        for j in range(rng.randint(0, 3))
+    ]
+    node["metadata"].setdefault("annotations", {})[
+        "simon/node-local-storage"
+    ] = _json.dumps({"vgs": vgs, "devices": devices})
+    return node
+
+
+def _storage_sts(rng, i):
+    scs = ["open-local-lvm", "open-local-device-ssd", "open-local-device-hdd"]
+    vcts = [
+        {
+            "spec": {
+                "storageClassName": rng.choice(scs),
+                "resources": {"requests": {"storage": f"{rng.choice([10, 40, 80])}Gi"}},
+            }
+        }
+        for _ in range(rng.randint(1, 2))
+    ]
+    return {
+        "kind": "StatefulSet",
+        "metadata": {"name": f"sts-{i}", "namespace": "st", "labels": {"app": f"sts-{i}"}},
+        "spec": {
+            "replicas": rng.randint(1, 5),
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "db",
+                            "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}},
+                        }
+                    ]
+                }
+            },
+            "volumeClaimTemplates": vcts,
+        },
+    }
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12, 13])
+def test_local_storage_conformance(seed):
+    rng = random.Random(seed)
+    cluster = ResourceTypes()
+    cluster.nodes = [_storage_node(rng, i) for i in range(rng.randint(3, 8))] + [
+        _random_node(rng, i) for i in range(2)
+    ]
+    resources = ResourceTypes()
+    resources.stateful_sets = [_storage_sts(rng, i) for i in range(rng.randint(2, 5))]
+    resources.deployments = [_random_workload(rng, 50)]
+    _compare(cluster, [AppResource("storage", resources)])
+
+
+def _affinity_sts(rng, i):
+    """StatefulSet with random required/preferred (anti)affinity and
+    topology spread — the BASELINE.json stress shape."""
+    name = f"asts-{i}"
+    spec = {
+        "containers": [
+            {
+                "name": "c",
+                "image": "db",
+                "resources": {
+                    "requests": {"cpu": rng.choice(["250m", "500m", "1"]), "memory": "512Mi"}
+                },
+            }
+        ]
+    }
+    affinity = {}
+    kind = rng.random()
+    selector = {"matchLabels": {"app": name}}
+    if kind < 0.45:
+        affinity["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": selector,
+                    "topologyKey": rng.choice(["kubernetes.io/hostname", "zone"]),
+                }
+            ]
+        }
+    elif kind < 0.7:
+        affinity["podAntiAffinity"] = {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "weight": rng.randint(1, 100),
+                    "podAffinityTerm": {
+                        "labelSelector": selector,
+                        "topologyKey": "kubernetes.io/hostname",
+                    },
+                }
+            ]
+        }
+    elif kind < 0.85:
+        affinity["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": selector, "topologyKey": "zone"}
+            ]
+        }
+    else:
+        affinity["podAffinity"] = {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "weight": rng.randint(1, 100),
+                    "podAffinityTerm": {
+                        "labelSelector": {"matchLabels": {"app": f"asts-{max(0, i - 1)}"}},
+                        "topologyKey": "zone",
+                    },
+                }
+            ]
+        }
+    if affinity:
+        spec["affinity"] = affinity
+    if rng.random() < 0.5:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": rng.choice([1, 2]),
+                "topologyKey": rng.choice(["zone", "kubernetes.io/hostname"]),
+                "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                "labelSelector": selector,
+            }
+        ]
+    return {
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "namespace": "aff", "labels": {"app": name}},
+        "spec": {"replicas": rng.randint(2, 6), "template": {"spec": spec}},
+    }
+
+
+@pytest.mark.parametrize("seed", [20, 21, 22, 23, 24, 25])
+def test_affinity_spread_conformance(seed):
+    rng = random.Random(seed)
+    cluster = ResourceTypes()
+    cluster.nodes = [_random_node(rng, i) for i in range(rng.randint(5, 12))]
+    resources = ResourceTypes()
+    resources.stateful_sets = [_affinity_sts(rng, i) for i in range(rng.randint(3, 8))]
+    resources.deployments = [_random_workload(rng, 70)]
+    _compare(cluster, [AppResource("aff", resources)])
+
+
+def test_affinity_across_apps_sees_existing_pods():
+    """Terms of pods placed by an earlier app must constrain a later
+    app (existing-pod anti-affinity + preferred contributions)."""
+    rng = random.Random(99)
+    cluster = ResourceTypes()
+    cluster.nodes = [_random_node(rng, i) for i in range(8)]
+    first = ResourceTypes()
+    first.stateful_sets = [_affinity_sts(rng, 0), _affinity_sts(rng, 1)]
+    second = ResourceTypes()
+    second.stateful_sets = [_affinity_sts(rng, 2)]
+    second.deployments = [_random_workload(rng, 71)]
+    _compare(
+        cluster,
+        [AppResource("first", first), AppResource("second", second)],
+    )

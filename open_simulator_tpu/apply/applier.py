@@ -168,6 +168,7 @@ class Applier:
         extended_resources: Optional[List[str]] = None,
         engine: str = "tpu",
         use_sweep: bool = True,
+        use_greed: bool = False,
     ):
         config.validate()
         self.config = config
@@ -175,6 +176,7 @@ class Applier:
         self.extended_resources = extended_resources or []
         self.engine = engine
         self.use_sweep = use_sweep
+        self.use_greed = use_greed
 
     # -- loading ------------------------------------------------------------
 
@@ -208,7 +210,7 @@ class Applier:
             from ..parallel.sweep import _new_nodes
 
             padded.nodes = list(padded.nodes) + _new_nodes(new_node, count)
-        return simulate(padded, apps, engine=self.engine)
+        return simulate(padded, apps, engine=self.engine, use_greed=self.use_greed)
 
     def run(self, select_apps=None) -> ApplyResult:
         cluster = self.load_cluster()

@@ -8,10 +8,11 @@ Mirrors cmd/simon (cmd/simon/simon.go, cmd/apply/apply.go):
   simon gen-doc
 
 Log level comes from the LogLevel env var (cmd/simon/simon.go:60-80).
-The --default-scheduler-config and --use-greed flags of the reference
-are accepted for compatibility; like in the reference at this revision
-they have no effect on the simulation (SURVEY.md §2.1: dead options,
-pkg/apply/apply.go:80-81).
+--default-scheduler-config is accepted for compatibility but has no
+effect, matching the reference where it is a dead option
+(SURVEY.md §2.1, pkg/apply/apply.go:80-81). --use-greed — also dead in
+the reference — actually applies the GreedQueue ordering here
+(scheduler/queues.py).
 
 Run as `python -m open_simulator_tpu.cli ...` or via the `simon`
 console script.
@@ -60,6 +61,7 @@ def cmd_apply(args) -> int:
             extended_resources=args.extended_resources,
             engine=args.engine,
             use_sweep=not args.no_sweep,
+            use_greed=args.use_greed,
         )
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -76,6 +78,13 @@ def cmd_apply(args) -> int:
             idx = {int(x) for x in raw.split(",")}
             select = [n for i, n in enumerate(names) if i in idx]
     result = applier.run(select_apps=select)
+    if args.snapshot and result.result is not None:
+        from .scheduler.snapshot import save_snapshot
+
+        save_snapshot(result.result, args.snapshot)
+    if args.format == "json":
+        print(_result_json(result))
+        return 0 if result.success else 2
     if not result.success:
         print(result.message)
         if result.result is not None:
@@ -88,6 +97,51 @@ def cmd_apply(args) -> int:
         print(f"new nodes added: {result.new_node_count}")
     print(result.report_text)
     return 0
+
+
+def _result_json(result) -> str:
+    """Structured results (SURVEY.md §5: structured results + optional
+    table renderer instead of ASCII-only)."""
+    import json
+
+    from .models.workloads import LABEL_NEW_NODE
+
+    out = {
+        "success": result.success,
+        "newNodeCount": result.new_node_count,
+        "message": result.message,
+        "nodes": [],
+        "unscheduledPods": [],
+    }
+    if result.result is not None:
+        for ns in result.result.node_status:
+            meta = ns.node.get("metadata") or {}
+            out["nodes"].append(
+                {
+                    "name": meta.get("name"),
+                    "newNode": LABEL_NEW_NODE in (meta.get("labels") or {}),
+                    "pods": [
+                        {
+                            "namespace": (p.get("metadata") or {}).get("namespace"),
+                            "name": (p.get("metadata") or {}).get("name"),
+                            "app": ((p.get("metadata") or {}).get("labels") or {}).get(
+                                "simon/app-name"
+                            ),
+                        }
+                        for p in ns.pods
+                    ],
+                }
+            )
+        for up in result.result.unscheduled_pods:
+            meta = up.pod.get("metadata") or {}
+            out["unscheduledPods"].append(
+                {
+                    "namespace": meta.get("namespace"),
+                    "name": meta.get("name"),
+                    "reason": up.reason,
+                }
+            )
+    return json.dumps(out)
 
 
 def cmd_version(_args) -> int:
@@ -126,11 +180,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--default-scheduler-config", default="", help="accepted for compatibility (unused)"
     )
     p_apply.add_argument(
-        "--use-greed", action="store_true", help="accepted for compatibility (unused)"
+        "--use-greed",
+        action="store_true",
+        help="order pods by descending dominant-resource share (dead flag in the reference; functional here)",
     )
     p_apply.add_argument("--engine", choices=["tpu", "oracle"], default="tpu")
     p_apply.add_argument(
         "--no-sweep", action="store_true", help="disable the batched capacity sweep"
+    )
+    p_apply.add_argument(
+        "--format", choices=["table", "json"], default="table", help="result output format"
+    )
+    p_apply.add_argument(
+        "--snapshot", default="", help="write the resulting cluster snapshot to this file"
     )
     p_apply.set_defaults(func=cmd_apply)
 

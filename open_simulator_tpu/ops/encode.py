@@ -132,6 +132,10 @@ class PodBatch:
     hdd_sizes: np.ndarray  # [U, Hv] i64, ascending
     wants_storage: np.ndarray  # [U] bool
     terms: TermTables  # affinity/spread tables
+    # out-of-tree custom plugins (stateless: folded per class)
+    custom_raw: np.ndarray  # [K, U, N] i64 raw scores (K>=1, dummy row 0)
+    custom_mode: np.ndarray  # [K] i32: 0 none, 1 default, 2 reverse, 3 minmax
+    custom_weight: np.ndarray  # [K] i64
     # static per-class matrices
     static_feasible: np.ndarray  # [U, N] bool
     simon_raw: np.ndarray  # [U, N] i64
@@ -495,6 +499,26 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
         avoid_score[u_i] = _avoid_scores(pod, oracle)
         image_score[u_i] = _image_scores(pod, oracle)
 
+    # out-of-tree custom plugins: stateless verdicts folded per class
+    # (the engine-side analogue of WithFrameworkOutOfTreeRegistry)
+    plugins = oracle.registry.plugins
+    k = max(len(plugins), 1)
+    custom_raw = np.zeros((k, u, n), dtype=np.int64)
+    custom_mode = np.zeros(k, dtype=np.int32)
+    custom_weight = np.zeros(k, dtype=np.int64)
+    mode_ids = {"none": 0, "default": 1, "reverse": 2, "minmax": 3}
+    for k_i, plugin in enumerate(plugins):
+        custom_mode[k_i] = mode_ids[plugin.normalize]
+        custom_weight[k_i] = plugin.weight
+        for u_i, pod in enumerate(class_pods):
+            for n_i, ns in enumerate(oracle.nodes):
+                if not static_feasible[u_i, n_i]:
+                    continue  # already ruled out; raw score is masked anyway
+                if not plugin.filter(pod, ns.node):
+                    static_feasible[u_i, n_i] = False
+                else:
+                    custom_raw[k_i, u_i, n_i] = int(plugin.score(pod, ns.node))
+
     terms = build_term_tables(oracle, class_pods)
 
     return PodBatch(
@@ -518,6 +542,9 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
         hdd_sizes=hdd_sizes,
         wants_storage=wants_storage,
         terms=terms,
+        custom_raw=custom_raw,
+        custom_mode=custom_mode,
+        custom_weight=custom_weight,
         static_feasible=static_feasible,
         simon_raw=simon_raw,
         nodeaff_raw=nodeaff_raw,
@@ -599,6 +626,9 @@ def to_scan_static(cluster: ClusterStatic, batch: PodBatch):
         s_q=jnp.asarray(batch.terms.s_q),
         cls_s_rows=jnp.asarray(batch.terms.cls_s_rows),
         cls_s_haskeys=jnp.asarray(batch.terms.cls_s_haskeys),
+        custom_raw=jnp.asarray(batch.custom_raw),
+        custom_mode=jnp.asarray(batch.custom_mode),
+        custom_weight=jnp.asarray(batch.custom_weight),
     )
 
 

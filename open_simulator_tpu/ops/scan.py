@@ -103,6 +103,9 @@ class ScanStatic(NamedTuple):
     s_q: jnp.ndarray  # [Cs, N]
     cls_s_rows: jnp.ndarray  # [U, Smax]
     cls_s_haskeys: jnp.ndarray  # [U, N]
+    custom_raw: jnp.ndarray  # [K, U, N]
+    custom_mode: jnp.ndarray  # [K]
+    custom_weight: jnp.ndarray  # [K]
 
 
 class ScanState(NamedTuple):
@@ -587,6 +590,21 @@ def run_scan_masked(
             + simon  # Open-Gpu-Share plugin (identical formula)
             + local  # Open-Local plugin
         )
+        # out-of-tree custom plugins (static K, unrolled)
+        for k_i in range(static.custom_raw.shape[0]):
+            raw_k = static.custom_raw[k_i, u]
+            mode = static.custom_mode[k_i]
+            norm_default = _default_normalize(raw_k, feasible, reverse=False)
+            norm_reverse = _default_normalize(raw_k, feasible, reverse=True)
+            norm_minmax = _minmax_normalize(raw_k, feasible)
+            score_k = jnp.where(
+                mode == 0,
+                raw_k,
+                jnp.where(
+                    mode == 1, norm_default, jnp.where(mode == 2, norm_reverse, norm_minmax)
+                ),
+            )
+            total = total + score_k * static.custom_weight[k_i]
 
         # ---- select: first max over feasible; pinned overrides ----
         neg = jnp.iinfo(jnp.int64).min

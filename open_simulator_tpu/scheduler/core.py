@@ -60,17 +60,18 @@ class AppResource:
 
 
 def _sort_app_pods(pods: List[dict]) -> List[dict]:
-    pods = sorted(pods, key=lambda p: (p.get("spec") or {}).get("nodeSelector") is None)
-    pods = sorted(pods, key=lambda p: (p.get("spec") or {}).get("tolerations") is None)
-    return pods
+    from .queues import affinity_sort, toleration_sort
+
+    return toleration_sort(affinity_sort(pods))
 
 
 class Simulator:
     """In-memory cluster + serial scheduler (the fake apiserver +
     scheduler goroutine of the reference collapse into this object)."""
 
-    def __init__(self, engine: str = "oracle"):
+    def __init__(self, engine: str = "oracle", use_greed: bool = False):
         self.engine_kind = engine
+        self.use_greed = use_greed
         self.oracle: Optional[Oracle] = None
         self.cluster_pods: List[dict] = []
 
@@ -86,6 +87,10 @@ class Simulator:
     def schedule_app(self, app: AppResource) -> SimulateResult:
         nodes = [ns.node for ns in self.oracle.nodes]
         pods = wl.generate_valid_pods_from_app(app.name, app.resource, nodes)
+        if self.use_greed:
+            from .queues import greed_sort
+
+            pods = greed_sort(nodes, pods)
         pods = _sort_app_pods(pods)
         return self._schedule_pods(pods)
 
@@ -155,10 +160,13 @@ class Simulator:
 
 
 def simulate(
-    cluster: ResourceTypes, apps: List[AppResource], engine: str = "oracle"
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    engine: str = "oracle",
+    use_greed: bool = False,
 ) -> SimulateResult:
     """One-shot simulation (core.go:64-103)."""
-    sim = Simulator(engine=engine)
+    sim = Simulator(engine=engine, use_greed=use_greed)
     cluster = cluster.copy()
     failed: List[UnscheduledPod] = []
     result = sim.run_cluster(cluster)

@@ -179,7 +179,12 @@ def _ports_conflict(want: List[Tuple[str, str, int]], used: set) -> bool:
 class Oracle:
     """Serial scheduler over mutable node states."""
 
-    def __init__(self, nodes: List[dict]):
+    def __init__(self, nodes: List[dict], registry=None):
+        if registry is None:
+            from .plugins import default_registry
+
+            registry = default_registry
+        self.registry = registry
         self.nodes: List[NodeState] = []
         self.node_index: Dict[str, int] = {}
         for n in nodes:
@@ -321,6 +326,15 @@ class Oracle:
                 if ns.gpu.allocate_gpu_ids(gpu_mem, gpu_cnt) is None:
                     fail("No GPU device can fit the pod")
                     continue
+            # out-of-tree custom plugins (stateless filter contract)
+            rejected = False
+            for plugin in self.registry.plugins:
+                if not plugin.filter(pod, ns.node):
+                    fail(f"node(s) didn't pass plugin {plugin.name}")
+                    rejected = True
+                    break
+            if rejected:
+                continue
             feasible.append(ns)
         return feasible, reasons
 
@@ -592,6 +606,15 @@ class Oracle:
         add(self._score_simon(pod, feasible), 1)
         add(self._score_open_local(pod, feasible), 1)
         add(self._score_gpu_share(pod, feasible), 1)
+        for plugin in self.registry.plugins:
+            raw = [int(plugin.score(pod, ns.node)) for ns in feasible]
+            if plugin.normalize == "default":
+                raw = self._default_normalize(raw, reverse=False)
+            elif plugin.normalize == "reverse":
+                raw = self._default_normalize(raw, reverse=True)
+            elif plugin.normalize == "minmax":
+                raw = self._minmax_normalize(raw)
+            add(raw, plugin.weight)
         return total
 
     @staticmethod

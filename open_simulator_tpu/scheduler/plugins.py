@@ -1,0 +1,78 @@
+"""Custom scheduling-plugin extension API.
+
+The reference's headline extensibility is the scheduler-framework
+out-of-tree plugin registry (pkg/simulator/simulator.go:127-137 +
+GetAndSetSchedulerConfig injecting Simon/Open-Local/Open-Gpu-Share into
+the plugin sets). The TPU engine's equivalent: a registry of
+*stateless* host plugins whose verdicts are evaluated once per pod
+class and folded into the scan's static tensors —
+
+    class MyPlugin(SchedulerPlugin):
+        name = "My-Plugin"
+        weight = 1
+        def filter(self, pod, node) -> bool: ...
+        def score(self, pod, node) -> int: ...      # raw 0..100
+        normalize = "none" | "default" | "reverse" | "minmax"
+
+`filter` ANDs into the static feasibility matrix; `score` is
+normalized over the feasible set in-scan like the built-ins
+(DefaultNormalizeScore / min-max, helper semantics of
+vendor/.../plugins/helper/normalize_score.go and plugin/simon.go:75).
+
+Stateless means: the verdict may depend on the pod and the node's
+static definition, not on placements made during the run — the same
+contract the reference's Filter plugins get from the immutable cycle
+snapshot, minus pod-derived state. Stateful custom plugins (like the
+built-in GPU/storage/affinity machinery) need tensor state in the scan
+carry and are built-in only.
+
+The serial oracle honors the same registry, so conformance between the
+two paths holds for custom plugins too.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+NORMALIZE_MODES = ("none", "default", "reverse", "minmax")
+
+
+class SchedulerPlugin:
+    """Base class for out-of-tree plugins."""
+
+    name: str = "Custom"
+    weight: int = 1
+    normalize: str = "none"
+
+    def filter(self, pod: dict, node: dict) -> bool:  # pragma: no cover - interface
+        return True
+
+    def score(self, pod: dict, node: dict) -> int:  # pragma: no cover - interface
+        return 0
+
+
+class PluginRegistry:
+    def __init__(self):
+        self._plugins: Dict[str, SchedulerPlugin] = {}
+
+    def register(self, plugin: SchedulerPlugin):
+        if plugin.normalize not in NORMALIZE_MODES:
+            raise ValueError(
+                f"plugin {plugin.name}: invalid normalize mode {plugin.normalize!r}"
+            )
+        self._plugins[plugin.name] = plugin
+
+    def unregister(self, name: str):
+        self._plugins.pop(name, None)
+
+    def clear(self):
+        self._plugins.clear()
+
+    @property
+    def plugins(self) -> List[SchedulerPlugin]:
+        return list(self._plugins.values())
+
+
+# process-global out-of-tree registry (WithFrameworkOutOfTreeRegistry
+# analogue); simulate()/Applier consult it
+default_registry = PluginRegistry()

@@ -1,0 +1,62 @@
+"""Pod queue-ordering heuristics (pkg/algo).
+
+- affinity_sort / toleration_sort: pods with nodeSelector (resp.
+  tolerations) first (pkg/algo/affinity.go, toleration.go). Stable
+  sorts — the reference's comparators are not strict weak orders under
+  Go's unstable sort.Sort, so we define the evident intent (documented
+  deviation, scheduler/core.py).
+- greed_sort: descending dominant-resource share against total cluster
+  allocatable, pods with a nodeName first (pkg/algo/greed.go:45-91).
+  Dead code in the reference at this revision (`--use-greed` is parsed
+  but never forwarded, SURVEY.md §2.1); here the flag actually applies
+  the ordering.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import List
+
+from ..models import requests as req
+
+
+def affinity_sort(pods: List[dict]) -> List[dict]:
+    return sorted(pods, key=lambda p: (p.get("spec") or {}).get("nodeSelector") is None)
+
+
+def toleration_sort(pods: List[dict]) -> List[dict]:
+    return sorted(pods, key=lambda p: (p.get("spec") or {}).get("tolerations") is None)
+
+
+def _share(alloc: float, total: float) -> float:
+    """algo.Share (greed.go:78-91)."""
+    if total == 0:
+        return 0.0 if alloc == 0 else 1.0
+    return alloc / total
+
+
+def greed_sort(nodes: List[dict], pods: List[dict]) -> List[dict]:
+    """GreedQueue ordering: dominant share of (cpu, memory) vs the
+    cluster total, descending; pods with spec.nodeName first."""
+    total_cpu = 0.0
+    total_mem = 0.0
+    for node in nodes:
+        alloc = req.node_allocatable(node)
+        total_cpu += float(alloc.get(req.CPU, Fraction(0)))
+        total_mem += float(alloc.get(req.MEMORY, Fraction(0)))
+
+    def dominant_share(pod: dict) -> float:
+        requests = req.pod_requests(pod)
+        if not requests:
+            return 0.0
+        cpu = float(requests.get(req.CPU, Fraction(0)))
+        mem = float(requests.get(req.MEMORY, Fraction(0)))
+        return max(_share(cpu, total_cpu), _share(mem, total_mem))
+
+    return sorted(
+        pods,
+        key=lambda p: (
+            not (p.get("spec") or {}).get("nodeName"),
+            -dominant_share(p),
+        ),
+    )

@@ -1,0 +1,96 @@
+"""Cluster snapshot: serialize/restore simulation state.
+
+The reference has no persistence — every run rebuilds the fake cluster
+from YAML (SURVEY.md §5: checkpoint/resume absent; the `simulator-plan`
+ConfigMap constants are vestigial). Here a snapshot is first-class:
+the full post-simulation cluster (nodes with mutated storage/GPU
+annotations + placed pods) round-trips through one JSON file, enabling
+
+- checkpoint/resume: continue deploying more apps onto a prior result
+- defragmentation/what-if studies on a captured cluster state
+- exporting a simulated cluster as the customConfig of a new run
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ..models.decode import ResourceTypes
+from .core import NodeStatus, SimulateResult, Simulator
+
+SNAPSHOT_VERSION = 1
+
+
+def snapshot_to_dict(result: SimulateResult) -> dict:
+    return {
+        "version": SNAPSHOT_VERSION,
+        "nodes": [ns.node for ns in result.node_status],
+        "pods": [p for ns in result.node_status for p in ns.pods],
+        "unscheduled": [
+            {"pod": up.pod, "reason": up.reason} for up in result.unscheduled_pods
+        ],
+    }
+
+
+def save_snapshot(result: SimulateResult, path: str):
+    with open(path, "w") as f:
+        json.dump(snapshot_to_dict(result), f)
+
+
+def load_snapshot(path: str) -> SimulateResult:
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"unsupported snapshot version: {data.get('version')}")
+    by_node = {}
+    statuses = [NodeStatus(node=n, pods=[]) for n in data["nodes"]]
+    for st in statuses:
+        by_node[(st.node.get("metadata") or {}).get("name", "")] = st
+    for pod in data["pods"]:
+        name = (pod.get("spec") or {}).get("nodeName")
+        if name in by_node:
+            by_node[name].pods.append(pod)
+    from .core import UnscheduledPod
+
+    return SimulateResult(
+        unscheduled_pods=[
+            UnscheduledPod(pod=u["pod"], reason=u["reason"]) for u in data.get("unscheduled", [])
+        ],
+        node_status=statuses,
+    )
+
+
+def resume_simulator(result: SimulateResult, engine: str = "tpu") -> Simulator:
+    """Rebuild a live Simulator from a snapshot: nodes re-admitted with
+    their mutated annotations, pods re-placed with their bindings (GPU
+    devices honored via the gpu-index annotation)."""
+    sim = Simulator(engine=engine)
+    cluster = ResourceTypes()
+    cluster.nodes = [ns.node for ns in result.node_status]
+    from .oracle import Oracle
+
+    sim.oracle = Oracle(cluster.nodes)
+    for ns in result.node_status:
+        for pod in ns.pods:
+            sim.oracle.place_existing_pod(pod)
+            sim.cluster_pods.append(pod)
+    return sim
+
+
+def cluster_from_snapshot(result: SimulateResult) -> ResourceTypes:
+    """Snapshot -> ResourceTypes, keeping only non-daemonset running
+    pods, mirroring CreateClusterResourceFromClient's filter
+    (simulator.go:369-441: keeps Running pods without a DaemonSet
+    owner)."""
+    res = ResourceTypes()
+    res.nodes = [ns.node for ns in result.node_status]
+    for ns in result.node_status:
+        for pod in ns.pods:
+            refs = (pod.get("metadata") or {}).get("ownerReferences") or []
+            if any(r.get("kind") == "DaemonSet" for r in refs):
+                continue
+            if ((pod.get("status") or {}).get("phase")) not in (None, "Running"):
+                continue
+            res.pods.append(pod)
+    return res

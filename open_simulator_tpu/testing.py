@@ -1,0 +1,244 @@
+"""Functional-option test fixture builders.
+
+Parity with pkg/test (node.go, pod.go, deployment.go, replicaset.go,
+statefulset.go, daemonset.go, job.go, cronjob.go): `make_fake_*`
+constructors taking option callables, e.g.
+
+    node = make_fake_node("n1", "32", "64Gi",
+                          with_node_labels({"zone": "z1"}),
+                          with_node_taints([...]))
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, List
+
+Option = Callable[[dict], None]
+
+
+def _check_positionals(*values):
+    """Guard against an Option accidentally binding to a positional
+    parameter (e.g. make_fake_pod("p", with_labels({...})) would bind
+    the option to `namespace`)."""
+    for v in values:
+        if callable(v):
+            raise TypeError(
+                "option functions must come after namespace/cpu/memory/replicas; "
+                f"got {v!r} bound to a positional parameter"
+            )
+
+
+
+# ------------------------------------------------------------------- nodes
+
+
+def make_fake_node(name: str, cpu: str, memory: str, *opts: Option) -> dict:
+    """110-pod capacity like MakeFakeNode (pkg/test/node.go:15-40)."""
+    node = {
+        "kind": "Node",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}, "annotations": {}},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory, "pods": "110"},
+            "capacity": {"cpu": cpu, "memory": memory, "pods": "110"},
+        },
+    }
+    for opt in opts:
+        opt(node)
+    return node
+
+
+def with_node_labels(labels: dict) -> Option:
+    def opt(node):
+        node["metadata"].setdefault("labels", {}).update(labels)
+
+    return opt
+
+
+def with_node_taints(taints: List[dict]) -> Option:
+    def opt(node):
+        node.setdefault("spec", {})["taints"] = taints
+
+    return opt
+
+
+def with_node_local_storage(vgs: List[dict], devices: List[dict] = ()) -> Option:
+    def opt(node):
+        node["metadata"].setdefault("annotations", {})["simon/node-local-storage"] = json.dumps(
+            {"vgs": list(vgs), "devices": list(devices)}
+        )
+
+    return opt
+
+
+def with_node_gpu(count: int, total_memory: str, model: str = "V100") -> Option:
+    def opt(node):
+        for section in ("allocatable", "capacity"):
+            node["status"].setdefault(section, {}).update(
+                {
+                    "alibabacloud.com/gpu-count": str(count),
+                    "alibabacloud.com/gpu-mem": total_memory,
+                }
+            )
+        node["metadata"].setdefault("labels", {})["alibabacloud.com/gpu-card-model"] = model
+
+    return opt
+
+
+def with_node_unschedulable() -> Option:
+    def opt(node):
+        node.setdefault("spec", {})["unschedulable"] = True
+
+    return opt
+
+
+# -------------------------------------------------------------------- pods
+
+
+def _pod_template(name, namespace, cpu, memory):
+    return {
+        "metadata": {"name": name, "namespace": namespace, "labels": {}, "annotations": {}},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": f"image-{name}",
+                    "resources": {"requests": {"cpu": cpu, "memory": memory}},
+                }
+            ]
+        },
+    }
+
+
+def make_fake_pod(name: str, namespace: str = "default", cpu: str = "100m", memory: str = "100Mi", *opts: Option) -> dict:
+    _check_positionals(namespace, cpu, memory)
+    pod = {"kind": "Pod", "apiVersion": "v1", **_pod_template(name, namespace, cpu, memory)}
+    for opt in opts:
+        opt(pod)
+    return pod
+
+
+def with_labels(labels: dict) -> Option:
+    def opt(obj):
+        obj["metadata"].setdefault("labels", {}).update(labels)
+
+    return opt
+
+
+def with_annotations(annotations: dict) -> Option:
+    def opt(obj):
+        obj["metadata"].setdefault("annotations", {}).update(annotations)
+
+    return opt
+
+
+def _spec_of(obj: dict) -> dict:
+    if obj.get("kind") == "Pod":
+        return obj["spec"]
+    if obj.get("kind") == "CronJob":
+        return obj["spec"]["jobTemplate"]["spec"]["template"]["spec"]
+    return obj["spec"]["template"]["spec"]
+
+
+def with_tolerations(tolerations: List[dict]) -> Option:
+    def opt(obj):
+        _spec_of(obj)["tolerations"] = tolerations
+
+    return opt
+
+
+def with_node_selector(selector: dict) -> Option:
+    def opt(obj):
+        _spec_of(obj)["nodeSelector"] = selector
+
+    return opt
+
+
+def with_affinity(affinity: dict) -> Option:
+    def opt(obj):
+        _spec_of(obj)["affinity"] = affinity
+
+    return opt
+
+
+def with_node_name(node_name: str) -> Option:
+    def opt(obj):
+        _spec_of(obj)["nodeName"] = node_name
+
+    return opt
+
+
+# --------------------------------------------------------------- workloads
+
+
+def _workload(kind, api, name, namespace, replicas_field, replicas, cpu, memory):
+    tpl = _pod_template(name, namespace, cpu, memory)
+    tpl["metadata"] = {"labels": {"app": name}}
+    obj = {
+        "kind": kind,
+        "apiVersion": api,
+        "metadata": {"name": name, "namespace": namespace, "labels": {"app": name}},
+        "spec": {
+            "selector": {"matchLabels": {"app": name}},
+            "template": tpl,
+        },
+    }
+    if replicas_field:
+        obj["spec"][replicas_field] = replicas
+    return obj
+
+
+def make_fake_deployment(name, namespace="default", replicas=1, cpu="100m", memory="100Mi", *opts: Option) -> dict:
+    _check_positionals(namespace, replicas, cpu, memory)
+    obj = _workload("Deployment", "apps/v1", name, namespace, "replicas", replicas, cpu, memory)
+    for opt in opts:
+        opt(obj)
+    return obj
+
+
+def make_fake_replica_set(name, namespace="default", replicas=1, cpu="100m", memory="100Mi", *opts: Option) -> dict:
+    _check_positionals(namespace, replicas, cpu, memory)
+    obj = _workload("ReplicaSet", "apps/v1", name, namespace, "replicas", replicas, cpu, memory)
+    for opt in opts:
+        opt(obj)
+    return obj
+
+
+def make_fake_stateful_set(name, namespace="default", replicas=1, cpu="100m", memory="100Mi", *opts: Option) -> dict:
+    _check_positionals(namespace, replicas, cpu, memory)
+    obj = _workload("StatefulSet", "apps/v1", name, namespace, "replicas", replicas, cpu, memory)
+    for opt in opts:
+        opt(obj)
+    return obj
+
+
+def make_fake_daemon_set(name, namespace="default", cpu="100m", memory="100Mi", *opts: Option) -> dict:
+    _check_positionals(namespace, cpu, memory)
+    obj = _workload("DaemonSet", "apps/v1", name, namespace, None, None, cpu, memory)
+    for opt in opts:
+        opt(obj)
+    return obj
+
+
+def make_fake_job(name, namespace="default", completions=1, cpu="100m", memory="100Mi", *opts: Option) -> dict:
+    _check_positionals(namespace, completions, cpu, memory)
+    obj = _workload("Job", "batch/v1", name, namespace, "completions", completions, cpu, memory)
+    del obj["spec"]["selector"]
+    for opt in opts:
+        opt(obj)
+    return obj
+
+
+def make_fake_cron_job(name, namespace="default", completions=1, cpu="100m", memory="100Mi", *opts: Option) -> dict:
+    _check_positionals(namespace, completions, cpu, memory)
+    job = make_fake_job(name, namespace, completions, cpu, memory)
+    obj = {
+        "kind": "CronJob",
+        "apiVersion": "batch/v1beta1",
+        "metadata": {"name": name, "namespace": namespace, "labels": {"app": name}},
+        "spec": {"schedule": "* * * * *", "jobTemplate": {"spec": job["spec"]}},
+    }
+    for opt in opts:
+        opt(obj)
+    return obj

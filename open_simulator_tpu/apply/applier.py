@@ -263,7 +263,9 @@ class Applier:
 
         try:
             counts = list(range(0, MAX_NUM_NEW_NODE + 1))
-            res = sweep_node_counts(cluster, apps, new_node, counts)
+            res = sweep_node_counts(
+                cluster, apps, new_node, counts, use_greed=self.use_greed
+            )
         except Exception as e:  # pragma: no cover - diagnostic path
             import logging
 

@@ -36,6 +36,81 @@ import jax.numpy as jnp
 MAX_SCORE = 100
 
 
+class ScanFeatures(NamedTuple):
+    """Which optional subsystems the current batch actually exercises.
+
+    Passed as a static (hashable) jit argument so XLA compiles a scan
+    specialized to the batch: a batch with no GPU pods carries no GPU
+    allocator in its step, a batch with no affinity terms carries no
+    gather/scatter machinery, etc. Every gate is exactness-preserving —
+    the disabled block's contribution is the identity (all-feasible /
+    zero score) whenever the feature is unused, so placements are
+    bit-identical to the ungated scan.
+    """
+
+    gpu: bool
+    storage: bool
+    ipa: bool  # inter-pod (anti-)affinity filters + score
+    hard_spread: bool  # required topologySpreadConstraints
+    soft_spread: bool  # ScheduleAnyway topologySpreadConstraints
+    ports: bool
+    scalars: bool  # extended scalar resources
+    custom: bool  # out-of-tree plugin scores
+    pins: bool  # any pod arrives with spec.nodeName
+    # ((mode, weight), ...) per custom plugin, so each unrolled plugin
+    # emits only its one normalization; None = modes unknown at trace
+    # time, select among all three with jnp.where
+    custom_spec: tuple = None
+
+    @property
+    def terms(self) -> bool:
+        """Whether per-topology target counts (state.tgt) are live."""
+        return self.ipa or self.hard_spread or self.soft_spread
+
+
+ALL_FEATURES = ScanFeatures(*([True] * 9))
+
+
+def features_of(static: "ScanStatic", pinned_node) -> ScanFeatures:
+    """Derive the feature set host-side.
+
+    Inputs are normally concrete arrays; when called from inside a
+    jit/vmap trace (an external caller wrapping run_scan in its own
+    jit), falls back to ALL_FEATURES — the ungated scan, slower but
+    placement-identical.
+    """
+    import numpy as np
+
+    import jax
+
+    if any(
+        isinstance(x, jax.core.Tracer)
+        for x in (static.gpu_mem, static.wants_storage, pinned_node)
+    ):
+        return ALL_FEATURES
+
+    a = np.asarray
+    return ScanFeatures(
+        gpu=bool(a(static.gpu_mem).max(initial=0) > 0),
+        storage=bool(a(static.wants_storage).any()),
+        ipa=bool(
+            (a(static.cls_rows) >= 0).any() or (a(static.cls_group_id) >= 0).any()
+        ),
+        hard_spread=bool((a(static.cls_h_rows) >= 0).any()),
+        soft_spread=bool((a(static.cls_s_rows) >= 0).any()),
+        ports=bool(a(static.want_ports).any()),
+        scalars=static.scalar_alloc.shape[0] > 0,
+        custom=bool((a(static.custom_weight) != 0).any()),
+        pins=bool((a(pinned_node) >= 0).any()),
+        custom_spec=tuple(
+            zip(
+                (int(m) for m in a(static.custom_mode)),
+                (int(w) for w in a(static.custom_weight)),
+            )
+        ),
+    )
+
+
 class ScanStatic(NamedTuple):
     """Arrays closed over by the compiled scan (static per batch)."""
 
@@ -248,7 +323,7 @@ def _local_storage_eval(static: "ScanStatic", state: "ScanState", u):
 HARD_POD_AFFINITY_WEIGHT = 1  # interpodaffinity args default
 
 
-def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid):
+def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, features):
     """InterPodAffinity filter + raw score and PodTopologySpread hard
     filter + soft score for pod class u over all nodes.
 
@@ -258,97 +333,114 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid):
     """
     n = static.topo_val.shape[1]
     big = jnp.iinfo(jnp.int64).max
+    ones_n = jnp.ones((n,), dtype=bool)
 
-    # ---- relevant term rows of this class --------------------------------
-    rows = static.cls_rows[u]  # [R]
-    rvalid = rows >= 0
-    r = jnp.maximum(rows, 0)
-    vals = static.topo_val[r]  # [R, N]
-    has = (vals >= 0) & rvalid[:, None]
-    vv = jnp.maximum(vals, 0)
+    if features.ipa:
+        # ---- relevant term rows of this class ----------------------------
+        rows = static.cls_rows[u]  # [R]
+        rvalid = rows >= 0
+        r = jnp.maximum(rows, 0)
+        vals = static.topo_val[r]  # [R, N]
+        has = (vals >= 0) & rvalid[:, None]
+        vv = jnp.maximum(vals, 0)
 
-    def gather(counts):
-        return jnp.where(has, jnp.take_along_axis(counts[r], vv, axis=1), 0)
+        def gather(counts):
+            return jnp.where(has, jnp.take_along_axis(counts[r], vv, axis=1), 0)
 
-    tgt_at = gather(state.tgt)
-    own_anti_at = gather(state.own_anti_req)
-    own_affreq_at = gather(state.own_aff_req)
-    own_affpref_at = gather(state.own_aff_pref_w)
-    own_antipref_at = gather(state.own_anti_pref_w)
+        tgt_at = gather(state.tgt)
+        own_anti_at = gather(state.own_anti_req)
+        own_affreq_at = gather(state.own_aff_req)
+        own_affpref_at = gather(state.own_aff_pref_w)
+        own_antipref_at = gather(state.own_anti_pref_w)
 
-    m = static.term_match[r, u] & rvalid  # [R]
-    c_anti = jnp.where(rvalid, static.carry_anti_req[r, u], 0)
-    c_paff = jnp.where(rvalid, static.carry_aff_pref_w[r, u], 0)
-    c_panti = jnp.where(rvalid, static.carry_anti_pref_w[r, u], 0)
+        m = static.term_match[r, u] & rvalid  # [R]
+        c_anti = jnp.where(rvalid, static.carry_anti_req[r, u], 0)
+        c_paff = jnp.where(rvalid, static.carry_aff_pref_w[r, u], 0)
+        c_panti = jnp.where(rvalid, static.carry_anti_pref_w[r, u], 0)
 
-    # satisfyExistingPodsAntiAffinity (filtering.go:313-326)
-    fail_exist_anti = jnp.any(m[:, None] & (own_anti_at > 0), axis=0)
-    # satisfyPodAntiAffinity (filtering.go:329-340)
-    fail_own_anti = jnp.any((c_anti > 0)[:, None] & (tgt_at > 0), axis=0)
+        # satisfyExistingPodsAntiAffinity (filtering.go:313-326)
+        fail_exist_anti = jnp.any(m[:, None] & (own_anti_at > 0), axis=0)
+        # satisfyPodAntiAffinity (filtering.go:329-340)
+        fail_own_anti = jnp.any((c_anti > 0)[:, None] & (tgt_at > 0), axis=0)
 
-    # InterPodAffinity raw score (scoring.go processExistingPod)
-    ipa_raw = jnp.sum(
-        (c_paff - c_panti)[:, None] * tgt_at
-        + m[:, None]
-        * (
-            HARD_POD_AFFINITY_WEIGHT * own_affreq_at
-            + own_affpref_at
-            - own_antipref_at
-        ),
-        axis=0,
-    )
-
-    # satisfyPodAffinity (filtering.go:343-371)
-    garc = static.cls_group_rows[u]  # [Gm]
-    gvalid = garc >= 0
-    ga = jnp.maximum(garc, 0)
-    g_term_rows = static.group_rows[ga]
-    gvals = static.topo_val[g_term_rows]  # [Gm, N]
-    has_g = gvals >= 0
-    gc = jnp.where(
-        has_g, jnp.take_along_axis(state.group_counts[ga], jnp.maximum(gvals, 0), axis=1), 0
-    )
-    keys_ok = jnp.all(has_g | ~gvalid[:, None], axis=0)
-    pods_exist = jnp.all((gc > 0) | ~gvalid[:, None], axis=0)
-    total_counts = jnp.sum(jnp.where(gvalid[:, None], state.group_counts[ga], 0))
-    gid = static.cls_group_id[u]
-    self_ok = static.match_all[jnp.maximum(gid, 0), u]
-    bootstrap = (total_counts == 0) & self_ok
-    aff_ok = (gid < 0) | (keys_ok & (pods_exist | bootstrap))
-
-    ipa_ok = aff_ok & ~fail_own_anti & ~fail_exist_anti
-
-    # ---- hard topology spread (filtering.go:276-337) ---------------------
-    # candidate topology VALUES derive from candidate NODES restricted
-    # by the scenario's node_valid mask (capacity sweep correctness)
-    hc = static.cls_h_rows[u]  # [Hm]
-    hvalid = hc >= 0
-    h = jnp.maximum(hc, 0)
-    hrow = static.h_row[h]
-    hvals = static.topo_val[hrow]  # [Hm, N]
-    cand_nodes = static.h_cand_nodes[h] & node_valid[None, :]  # [Hm, N]
-    v_dim = state.tgt.shape[1]
-
-    def cand_row(vals_r, cn_r):
-        return (
-            jnp.zeros((v_dim,), bool).at[jnp.maximum(vals_r, 0)].max(cn_r & (vals_r >= 0))
+        # InterPodAffinity raw score (scoring.go processExistingPod)
+        ipa_raw = jnp.sum(
+            (c_paff - c_panti)[:, None] * tgt_at
+            + m[:, None]
+            * (
+                HARD_POD_AFFINITY_WEIGHT * own_affreq_at
+                + own_affpref_at
+                - own_antipref_at
+            ),
+            axis=0,
         )
 
-    cand = jax.vmap(cand_row)(hvals, cand_nodes)  # [Hm, V]
-    counts_h = state.tgt[hrow]  # [Hm, V]
-    minc = jnp.min(jnp.where(cand, counts_h, big), axis=1)
-    minc = jnp.where(jnp.any(cand, axis=1), minc, 0)
-    pair_in = (
-        jnp.take_along_axis(cand, jnp.maximum(hvals, 0).astype(jnp.int32), axis=1)
-        & (hvals >= 0)
-    )
-    cnt_eff = jnp.where(
-        pair_in, jnp.take_along_axis(counts_h, jnp.maximum(hvals, 0), axis=1), 0
-    )
-    selfm = static.h_self[h, u]
-    skew = cnt_eff + selfm[:, None] - minc[:, None]
-    ok_c = (skew <= static.h_max_skew[h][:, None]) & (hvals >= 0)
-    spread_ok = jnp.all(ok_c | ~hvalid[:, None], axis=0)
+        # satisfyPodAffinity (filtering.go:343-371)
+        garc = static.cls_group_rows[u]  # [Gm]
+        gvalid = garc >= 0
+        ga = jnp.maximum(garc, 0)
+        g_term_rows = static.group_rows[ga]
+        gvals = static.topo_val[g_term_rows]  # [Gm, N]
+        has_g = gvals >= 0
+        gc = jnp.where(
+            has_g,
+            jnp.take_along_axis(state.group_counts[ga], jnp.maximum(gvals, 0), axis=1),
+            0,
+        )
+        keys_ok = jnp.all(has_g | ~gvalid[:, None], axis=0)
+        pods_exist = jnp.all((gc > 0) | ~gvalid[:, None], axis=0)
+        total_counts = jnp.sum(jnp.where(gvalid[:, None], state.group_counts[ga], 0))
+        gid = static.cls_group_id[u]
+        self_ok = static.match_all[jnp.maximum(gid, 0), u]
+        bootstrap = (total_counts == 0) & self_ok
+        aff_ok = (gid < 0) | (keys_ok & (pods_exist | bootstrap))
+
+        ipa_ok = aff_ok & ~fail_own_anti & ~fail_exist_anti
+    else:
+        ipa_ok = ones_n
+        ipa_raw = jnp.zeros((n,), dtype=jnp.int64)
+
+    if features.hard_spread:
+        # ---- hard topology spread (filtering.go:276-337) -----------------
+        # candidate topology VALUES derive from candidate NODES restricted
+        # by the scenario's node_valid mask (capacity sweep correctness)
+        hc = static.cls_h_rows[u]  # [Hm]
+        hvalid = hc >= 0
+        h = jnp.maximum(hc, 0)
+        hrow = static.h_row[h]
+        hvals = static.topo_val[hrow]  # [Hm, N]
+        cand_nodes = static.h_cand_nodes[h] & node_valid[None, :]  # [Hm, N]
+        v_dim = state.tgt.shape[1]
+
+        def cand_row(vals_r, cn_r):
+            return (
+                jnp.zeros((v_dim,), bool)
+                .at[jnp.maximum(vals_r, 0)]
+                .max(cn_r & (vals_r >= 0))
+            )
+
+        cand = jax.vmap(cand_row)(hvals, cand_nodes)  # [Hm, V]
+        counts_h = state.tgt[hrow]  # [Hm, V]
+        minc = jnp.min(jnp.where(cand, counts_h, big), axis=1)
+        minc = jnp.where(jnp.any(cand, axis=1), minc, 0)
+        pair_in = (
+            jnp.take_along_axis(cand, jnp.maximum(hvals, 0).astype(jnp.int32), axis=1)
+            & (hvals >= 0)
+        )
+        cnt_eff = jnp.where(
+            pair_in, jnp.take_along_axis(counts_h, jnp.maximum(hvals, 0), axis=1), 0
+        )
+        selfm = static.h_self[h, u]
+        skew = cnt_eff + selfm[:, None] - minc[:, None]
+        ok_c = (skew <= static.h_max_skew[h][:, None]) & (hvals >= 0)
+        spread_ok = jnp.all(ok_c | ~hvalid[:, None], axis=0)
+    else:
+        spread_ok = ones_n
+
+    if not features.soft_spread:
+        # NormalizeScore's no-constraint branch: MaxNodeScore everywhere
+        max_n = jnp.full((n,), MAX_SCORE, dtype=jnp.int64)
+        return ipa_ok, spread_ok, ipa_raw, lambda feasible_final: max_n
 
     # ---- soft topology spread score (scoring.go) -------------------------
     sc = static.cls_s_rows[u]
@@ -401,47 +493,60 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid):
     return ipa_ok, spread_ok, ipa_raw, soft_score
 
 
-def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit):
+def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit, features):
     """Rank-1 count updates after a commit (AddPod semantics of the
     PreFilterExtensions / next cycle's PreScore recomputation)."""
     node = jnp.maximum(placement, 0)
     inc = commit.astype(jnp.int64)
 
-    rows = static.cls_rows[u]
-    rvalid = rows >= 0
-    r = jnp.maximum(rows, 0)
-    val = static.topo_val[r, node]  # [R]
-    ok = (val >= 0) & rvalid
-    vv = jnp.maximum(val, 0)
-    m = (static.term_match[r, u] & ok).astype(jnp.int64) * inc
+    tgt = state.tgt
+    own_anti = state.own_anti_req
+    own_aff = state.own_aff_req
+    own_paff = state.own_aff_pref_w
+    own_panti = state.own_anti_pref_w
+    group_counts = state.group_counts
+    soft_counts = state.soft_counts
 
-    tgt = state.tgt.at[r, vv].add(m)
-    own_anti = state.own_anti_req.at[r, vv].add(
-        jnp.where(ok, static.carry_anti_req[r, u], 0) * inc
-    )
-    own_aff = state.own_aff_req.at[r, vv].add(
-        jnp.where(ok, static.carry_aff_req[r, u], 0) * inc
-    )
-    own_paff = state.own_aff_pref_w.at[r, vv].add(
-        jnp.where(ok, static.carry_aff_pref_w[r, u], 0) * inc
-    )
-    own_panti = state.own_anti_pref_w.at[r, vv].add(
-        jnp.where(ok, static.carry_anti_pref_w[r, u], 0) * inc
-    )
+    if features.terms:
+        rows = static.cls_rows[u]
+        rvalid = rows >= 0
+        r = jnp.maximum(rows, 0)
+        val = static.topo_val[r, node]  # [R]
+        ok = (val >= 0) & rvalid
+        vv = jnp.maximum(val, 0)
+        m = (static.term_match[r, u] & ok).astype(jnp.int64) * inc
+        # target counts feed IPA filters/score, hard-spread skew checks,
+        # and soft-spread host-level constraint counts
+        tgt = tgt.at[r, vv].add(m)
 
-    # group counts: all A rows
-    a_dim = static.group_rows.shape[0]
-    g_val = static.topo_val[static.group_rows, node]  # [A]
-    g_ok = g_val >= 0
-    g_inc = (static.match_all[static.group_of_row, u] & g_ok).astype(jnp.int64) * inc
-    group_counts = state.group_counts.at[jnp.arange(a_dim), jnp.maximum(g_val, 0)].add(g_inc)
+    if features.ipa:
+        own_anti = own_anti.at[r, vv].add(
+            jnp.where(ok, static.carry_anti_req[r, u], 0) * inc
+        )
+        own_aff = own_aff.at[r, vv].add(
+            jnp.where(ok, static.carry_aff_req[r, u], 0) * inc
+        )
+        own_paff = own_paff.at[r, vv].add(
+            jnp.where(ok, static.carry_aff_pref_w[r, u], 0) * inc
+        )
+        own_panti = own_panti.at[r, vv].add(
+            jnp.where(ok, static.carry_anti_pref_w[r, u], 0) * inc
+        )
 
-    # soft spread counts: all Cs rows, restricted to qualifying nodes
-    cs_dim = static.s_row.shape[0]
-    s_val = static.topo_val[static.s_row, node]  # [Cs]
-    s_ok = (s_val >= 0) & static.s_q[jnp.arange(cs_dim), node]
-    s_inc = (static.term_match[static.s_row, u] & s_ok).astype(jnp.int64) * inc
-    soft_counts = state.soft_counts.at[jnp.arange(cs_dim), jnp.maximum(s_val, 0)].add(s_inc)
+        # group counts: all A rows
+        a_dim = static.group_rows.shape[0]
+        g_val = static.topo_val[static.group_rows, node]  # [A]
+        g_ok = g_val >= 0
+        g_inc = (static.match_all[static.group_of_row, u] & g_ok).astype(jnp.int64) * inc
+        group_counts = group_counts.at[jnp.arange(a_dim), jnp.maximum(g_val, 0)].add(g_inc)
+
+    if features.soft_spread:
+        # soft spread counts: all Cs rows, restricted to qualifying nodes
+        cs_dim = static.s_row.shape[0]
+        s_val = static.topo_val[static.s_row, node]  # [Cs]
+        s_ok = (s_val >= 0) & static.s_q[jnp.arange(cs_dim), node]
+        s_inc = (static.term_match[static.s_row, u] & s_ok).astype(jnp.int64) * inc
+        soft_counts = soft_counts.at[jnp.arange(cs_dim), jnp.maximum(s_val, 0)].add(s_inc)
 
     return tgt, own_anti, own_aff, own_paff, own_panti, group_counts, soft_counts
 
@@ -477,8 +582,7 @@ def _gpu_allocate(avail, dev_valid, per_gpu_mem, count):
 INACTIVE = -2  # pod not present in this scenario (capacity-sweep masking)
 
 
-@partial(jax.jit, static_argnums=())
-def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node):
+def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node, features=None):
     """Schedule every pod in order; returns (placements[P], final state).
 
     placements[p] = node index, or -1 when unschedulable.
@@ -492,10 +596,10 @@ def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node):
         pinned_node,
         jnp.ones((n,), bool),
         jnp.ones((p,), bool),
+        features=features,
     )
 
 
-@partial(jax.jit, static_argnums=())
 def run_scan_masked(
     static: ScanStatic,
     init: ScanState,
@@ -503,13 +607,36 @@ def run_scan_masked(
     pinned_node,
     node_valid,
     pod_active,
+    features=None,
 ):
     """run_scan with scenario masks for the capacity sweep
     (pkg/apply/apply.go:186-239 re-imagined as a batched what-if):
     `node_valid[n]` gates candidate nodes, `pod_active[p]` skips pods
     that do not exist in this scenario (e.g. daemonset pods of disabled
     new nodes). Inactive pods commit nothing and report INACTIVE.
+
+    `features` (a ScanFeatures, static under jit) specializes the
+    compiled scan to the subsystems the batch uses; None derives it from
+    `static`/`pinned_node`, which must then be concrete arrays.
     """
+    if features is None:
+        features = features_of(static, pinned_node)
+    return _run_scan_compiled(
+        features, static, init, class_of_pod, pinned_node, node_valid, pod_active
+    )
+
+
+@partial(jax.jit, static_argnums=0)
+def _run_scan_compiled(
+    features: ScanFeatures,
+    static: ScanStatic,
+    init: ScanState,
+    class_of_pod,
+    pinned_node,
+    node_valid,
+    pod_active,
+):
+    n = static.alloc_mcpu.shape[0]
 
     def step(state: ScanState, inp):
         u, pin, active = inp
@@ -519,30 +646,42 @@ def run_scan_masked(
         fit_cpu = static.alloc_mcpu >= static.req_mcpu[u] + state.used_mcpu
         fit_mem = static.alloc_mem >= static.req_mem[u] + state.used_mem
         fit_eph = static.alloc_eph >= static.req_eph[u] + state.used_eph
-        fit_scalar = jnp.all(
-            static.scalar_alloc >= static.req_scalar[u][:, None] + state.used_scalar,
-            axis=0,
-        )
-        fit_res = fit_cpu & fit_mem & fit_eph & fit_scalar
+        fit_res = fit_cpu & fit_mem & fit_eph
+        if features.scalars:
+            fit_res = fit_res & jnp.all(
+                static.scalar_alloc >= static.req_scalar[u][:, None] + state.used_scalar,
+                axis=0,
+            )
         # zero-request pods skip everything but the pod-count check
         fit = fit_pods & (fit_res | ~static.has_request[u])
+        feasible = feasible & fit
         # NodePorts
-        port_clash = jnp.any(state.ports_used & static.conflict_ports[u][None, :], axis=1)
+        if features.ports:
+            port_clash = jnp.any(
+                state.ports_used & static.conflict_ports[u][None, :], axis=1
+            )
+            feasible = feasible & ~port_clash
         # GPU share
-        avail = static.gpu_per_dev[:, None] - state.gpu_used
-        gpu_found, gpu_take = _gpu_allocate(
-            avail, static.dev_valid, static.gpu_mem[u], static.gpu_cnt[u]
-        )
-        needs_gpu = static.gpu_mem[u] > 0
-        gpu_ok = ~needs_gpu | ((static.gpu_total >= static.gpu_mem[u]) & gpu_found)
+        if features.gpu:
+            avail = static.gpu_per_dev[:, None] - state.gpu_used
+            gpu_found, gpu_take = _gpu_allocate(
+                avail, static.dev_valid, static.gpu_mem[u], static.gpu_cnt[u]
+            )
+            needs_gpu = static.gpu_mem[u] > 0
+            gpu_ok = ~needs_gpu | ((static.gpu_total >= static.gpu_mem[u]) & gpu_found)
+            feasible = feasible & gpu_ok
         # Open-Local
-        local_ok, local_raw, vg_take, ssd_take, hdd_take = _local_storage_eval(
-            static, state, u
-        )
+        if features.storage:
+            local_ok, local_raw, vg_take, ssd_take, hdd_take = _local_storage_eval(
+                static, state, u
+            )
+            feasible = feasible & local_ok
         # InterPodAffinity + PodTopologySpread
-        ipa_ok, spread_ok, ipa_raw, soft_score = _terms_eval(static, state, u, node_valid)
+        ipa_ok, spread_ok, ipa_raw, soft_score = _terms_eval(
+            static, state, u, node_valid, features
+        )
 
-        feasible = feasible & fit & ~port_clash & gpu_ok & local_ok & ipa_ok & spread_ok
+        feasible = feasible & ipa_ok & spread_ok
 
         # ---- scores ----
         cpu_req_total = state.nz_mcpu + static.nz_mcpu[u]
@@ -563,85 +702,132 @@ def run_scan_masked(
         nodeaff = _default_normalize(static.nodeaff_raw[u], feasible, reverse=False)
         tainttol = _default_normalize(static.taint_intol[u], feasible, reverse=True)
         simon = _minmax_normalize(static.simon_raw[u], feasible)
-        local = _minmax_normalize(local_raw, feasible)
         # PodTopologySpread soft score (all MaxNodeScore when the pod has
         # no soft constraints — NormalizeScore maxScore==0 branch)
         spread = soft_score(feasible)
-        # InterPodAffinity NormalizeScore (scoring.go:246-270): bounds
-        # include 0, float divide, int64 truncation
-        ipa_mx = jnp.maximum(jnp.max(jnp.where(feasible, ipa_raw, 0)), 0)
-        ipa_mn = jnp.minimum(jnp.min(jnp.where(feasible, ipa_raw, 0)), 0)
-        ipa_diff = (ipa_mx - ipa_mn).astype(jnp.float64)
-        ipa = jnp.where(
-            ipa_diff > 0,
-            (MAX_SCORE * (ipa_raw - ipa_mn) / jnp.maximum(ipa_diff, 1.0)).astype(jnp.int64),
-            0,
-        )
         total = (
             balanced
             + static.image_score[u]
             + least
             + nodeaff
             + static.avoid_score[u] * 10000
-            + ipa
             + spread * 2
             + tainttol
             + simon  # Simon plugin
             + simon  # Open-Gpu-Share plugin (identical formula)
-            + local  # Open-Local plugin
         )
-        # out-of-tree custom plugins (static K, unrolled)
-        for k_i in range(static.custom_raw.shape[0]):
-            raw_k = static.custom_raw[k_i, u]
-            mode = static.custom_mode[k_i]
-            norm_default = _default_normalize(raw_k, feasible, reverse=False)
-            norm_reverse = _default_normalize(raw_k, feasible, reverse=True)
-            norm_minmax = _minmax_normalize(raw_k, feasible)
-            score_k = jnp.where(
-                mode == 0,
-                raw_k,
-                jnp.where(
-                    mode == 1, norm_default, jnp.where(mode == 2, norm_reverse, norm_minmax)
+        if features.ipa:
+            # InterPodAffinity NormalizeScore (scoring.go:246-270): bounds
+            # include 0, float divide, int64 truncation
+            ipa_mx = jnp.maximum(jnp.max(jnp.where(feasible, ipa_raw, 0)), 0)
+            ipa_mn = jnp.minimum(jnp.min(jnp.where(feasible, ipa_raw, 0)), 0)
+            ipa_diff = (ipa_mx - ipa_mn).astype(jnp.float64)
+            ipa = jnp.where(
+                ipa_diff > 0,
+                (MAX_SCORE * (ipa_raw - ipa_mn) / jnp.maximum(ipa_diff, 1.0)).astype(
+                    jnp.int64
                 ),
+                0,
             )
-            total = total + score_k * static.custom_weight[k_i]
+            total = total + ipa
+        if features.storage:
+            total = total + _minmax_normalize(local_raw, feasible)  # Open-Local plugin
+        if features.custom:
+            # out-of-tree custom plugins (static K, unrolled)
+            for k_i in range(static.custom_raw.shape[0]):
+                raw_k = static.custom_raw[k_i, u]
+                if features.custom_spec is not None:
+                    # modes/weights host-known: emit only the needed path
+                    mode_k, weight_k = features.custom_spec[k_i]
+                    if weight_k == 0:
+                        continue
+                    if mode_k == 0:
+                        score_k = raw_k
+                    elif mode_k == 1:
+                        score_k = _default_normalize(raw_k, feasible, reverse=False)
+                    elif mode_k == 2:
+                        score_k = _default_normalize(raw_k, feasible, reverse=True)
+                    else:
+                        score_k = _minmax_normalize(raw_k, feasible)
+                    total = total + score_k * weight_k
+                    continue
+                mode = static.custom_mode[k_i]
+                norm_default = _default_normalize(raw_k, feasible, reverse=False)
+                norm_reverse = _default_normalize(raw_k, feasible, reverse=True)
+                norm_minmax = _minmax_normalize(raw_k, feasible)
+                score_k = jnp.where(
+                    mode == 0,
+                    raw_k,
+                    jnp.where(
+                        mode == 1,
+                        norm_default,
+                        jnp.where(mode == 2, norm_reverse, norm_minmax),
+                    ),
+                )
+                total = total + score_k * static.custom_weight[k_i]
 
         # ---- select: first max over feasible; pinned overrides ----
         neg = jnp.iinfo(jnp.int64).min
         masked = jnp.where(feasible, total, neg)
         best = jnp.argmax(masked)
         found = jnp.any(feasible)
-        placement = jnp.where(pin >= 0, pin, jnp.where(found, best, -1))
-        # a pod pinned to a masked-out node does not exist in this
-        # scenario; never commit resources outside node_valid
-        pin_ok = node_valid[jnp.maximum(pin, 0)]
-        placement = jnp.where((pin >= 0) & ~pin_ok, INACTIVE, placement)
+        placement = jnp.where(found, best, -1)
+        if features.pins:
+            placement = jnp.where(pin >= 0, pin, placement)
+            # a pod pinned to a masked-out node does not exist in this
+            # scenario; never commit resources outside node_valid
+            pin_ok = node_valid[jnp.maximum(pin, 0)]
+            placement = jnp.where((pin >= 0) & ~pin_ok, INACTIVE, placement)
         placement = jnp.where(active, placement, INACTIVE)
 
         # ---- commit ----
         commit = placement >= 0
         tgt, own_anti, own_aff, own_paff, own_panti, group_counts, soft_counts = (
-            _terms_commit(static, state, u, placement, commit)
+            _terms_commit(static, state, u, placement, commit, features)
         )
         onehot = (
-            jax.nn.one_hot(jnp.maximum(placement, 0), static.alloc_mcpu.shape[0], dtype=jnp.int64)
+            jax.nn.one_hot(jnp.maximum(placement, 0), n, dtype=jnp.int64)
             * commit.astype(jnp.int64)
         )
         new_state = ScanState(
             used_mcpu=state.used_mcpu + onehot * static.req_mcpu[u],
             used_mem=state.used_mem + onehot * static.req_mem[u],
             used_eph=state.used_eph + onehot * static.req_eph[u],
-            used_scalar=state.used_scalar + onehot[None, :] * static.req_scalar[u][:, None],
+            used_scalar=(
+                state.used_scalar + onehot[None, :] * static.req_scalar[u][:, None]
+                if features.scalars
+                else state.used_scalar
+            ),
             nz_mcpu=state.nz_mcpu + onehot * static.nz_mcpu[u],
             nz_mem=state.nz_mem + onehot * static.nz_mem[u],
             pod_cnt=state.pod_cnt + onehot,
-            ports_used=state.ports_used
-            | (onehot.astype(bool)[:, None] & static.want_ports[u][None, :]),
-            gpu_used=state.gpu_used
-            + jnp.where(needs_gpu, onehot[:, None] * gpu_take * static.gpu_mem[u], 0),
-            vg_used=state.vg_used + onehot[:, None] * vg_take,
-            ssd_used=state.ssd_used | (onehot.astype(bool)[:, None] & ssd_take),
-            hdd_used=state.hdd_used | (onehot.astype(bool)[:, None] & hdd_take),
+            ports_used=(
+                state.ports_used
+                | (onehot.astype(bool)[:, None] & static.want_ports[u][None, :])
+                if features.ports
+                else state.ports_used
+            ),
+            gpu_used=(
+                state.gpu_used
+                + jnp.where(needs_gpu, onehot[:, None] * gpu_take * static.gpu_mem[u], 0)
+                if features.gpu
+                else state.gpu_used
+            ),
+            vg_used=(
+                state.vg_used + onehot[:, None] * vg_take
+                if features.storage
+                else state.vg_used
+            ),
+            ssd_used=(
+                state.ssd_used | (onehot.astype(bool)[:, None] & ssd_take)
+                if features.storage
+                else state.ssd_used
+            ),
+            hdd_used=(
+                state.hdd_used | (onehot.astype(bool)[:, None] & hdd_take)
+                if features.storage
+                else state.hdd_used
+            ),
             tgt=tgt,
             own_anti_req=own_anti,
             own_aff_req=own_aff,
@@ -649,7 +835,7 @@ def run_scan_masked(
             own_anti_pref_w=own_panti,
             group_counts=group_counts,
             soft_counts=soft_counts,
-            )
+        )
         return new_state, placement
 
     final_state, placements = jax.lax.scan(

@@ -74,6 +74,7 @@ def sweep_node_counts(
     new_node_spec: Optional[dict],
     counts: List[int],
     mesh=None,
+    use_greed: bool = False,
 ) -> SweepResult:
     """Evaluate `counts` candidate new-node counts in one batched run."""
     import jax
@@ -101,6 +102,13 @@ def sweep_node_counts(
         pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
     for app in apps:
         app_pods = wl.generate_valid_pods_from_app(app.name, app.resource, padded.nodes)
+        if use_greed:
+            # same ordering the authoritative serial run will use
+            # (scheduler/core.py schedule_app), else the hint is
+            # computed for a different pod sequence
+            from ..scheduler.queues import greed_sort
+
+            app_pods = greed_sort(padded.nodes, app_pods)
         pods.extend(_sort_app_pods(app_pods))
 
     n_base = len(padded.nodes) - max_count

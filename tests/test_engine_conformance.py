@@ -329,3 +329,50 @@ def test_affinity_across_apps_sees_existing_pods():
         cluster,
         [AppResource("first", first), AppResource("second", second)],
     )
+
+
+def test_run_scan_callable_under_external_jit():
+    """run_scan with no explicit features must still work when an
+    external caller wraps it in jax.jit (features_of falls back to the
+    ungated ALL_FEATURES scan), and produce the same placements as the
+    specialized direct call."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from open_simulator_tpu.ops import scan as scan_ops
+    from open_simulator_tpu.ops.encode import (
+        encode_batch,
+        encode_cluster,
+        encode_dynamic,
+        to_scan_static,
+        to_scan_state,
+    )
+    from open_simulator_tpu.scheduler.oracle import Oracle
+
+    rng = random.Random(7)
+    nodes = [_random_node(rng, i) for i in range(6)]
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    pods = []
+    from open_simulator_tpu.models import workloads as wl
+
+    res = ResourceTypes()
+    res.deployments = [_random_workload(rng, i) for i in range(3)]
+    pods = wl.generate_valid_pods_from_app("t", res, nodes)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    class_arr = jnp.asarray(batch.class_of_pod)
+    pinned_arr = jnp.asarray(batch.pinned_node)
+
+    direct, _ = scan_ops.run_scan(static, init, class_arr, pinned_arr)
+
+    @jax.jit
+    def wrapped(static, init, class_arr, pinned_arr):
+        placements, _ = scan_ops.run_scan(static, init, class_arr, pinned_arr)
+        return placements
+
+    jitted = wrapped(static, init, class_arr, pinned_arr)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(jitted))

@@ -99,6 +99,68 @@ def cmd_apply(args) -> int:
     return 0
 
 
+def cmd_defrag(args) -> int:
+    import json
+
+    from .parallel.defrag import plan_defrag
+    from .scheduler.snapshot import load_snapshot
+
+    _force_platform()
+    try:
+        snapshot = load_snapshot(args.snapshot)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    protect = None
+    if args.keep_new_nodes:
+        from .models.workloads import LABEL_NEW_NODE
+
+        def protect(node):
+            return LABEL_NEW_NODE in ((node.get("metadata") or {}).get("labels") or {})
+
+    plan = plan_defrag(snapshot, max_drain=args.max_drain, protect=protect)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "drainOrder": plan.ranked_nodes,
+                    "chosenDepth": plan.chosen_depth,
+                    "drainedNodes": plan.drained_nodes,
+                    "unscheduledByDepth": [int(x) for x in plan.unscheduled],
+                    "moves": [
+                        {
+                            "namespace": (m.pod.get("metadata") or {}).get("namespace"),
+                            "pod": (m.pod.get("metadata") or {}).get("name"),
+                            "from": m.from_node,
+                            "to": m.to_node,
+                        }
+                        for m in plan.moves
+                    ],
+                }
+            )
+        )
+        return 0
+    if plan.chosen_depth == 0:
+        print("no node can be fully drained")
+        return 0
+    print(f"drainable nodes ({plan.chosen_depth}): {', '.join(plan.drained_nodes)}")
+    print(f"migrations required: {len(plan.moves)}")
+    from .apply.report import render_table
+
+    rows = [
+        [
+            (m.pod.get("metadata") or {}).get("namespace", ""),
+            (m.pod.get("metadata") or {}).get("name", ""),
+            m.from_node,
+            m.to_node,
+        ]
+        for m in plan.moves
+    ]
+    print(render_table(["Namespace", "Pod", "From", "To"], rows))
+    return 0
+
+
 def _result_json(result) -> str:
     """Structured results (SURVEY.md §5: structured results + optional
     table renderer instead of ASCII-only)."""
@@ -195,6 +257,29 @@ def build_parser() -> argparse.ArgumentParser:
         "--snapshot", default="", help="write the resulting cluster snapshot to this file"
     )
     p_apply.set_defaults(func=cmd_apply)
+
+    p_defrag = sub.add_parser(
+        "defrag",
+        help="pod-migration defragmentation plan from a cluster snapshot",
+    )
+    p_defrag.add_argument(
+        "--snapshot", required=True, help="snapshot file from `simon apply --snapshot`"
+    )
+    p_defrag.add_argument(
+        "--max-drain",
+        type=int,
+        default=None,
+        help="limit the number of nodes considered for draining",
+    )
+    p_defrag.add_argument(
+        "--keep-new-nodes",
+        action="store_true",
+        help="exempt simon-added new nodes from draining",
+    )
+    p_defrag.add_argument(
+        "--format", choices=["table", "json"], default="table", help="result output format"
+    )
+    p_defrag.set_defaults(func=cmd_defrag)
 
     p_version = sub.add_parser("version", help="print version")
     p_version.set_defaults(func=cmd_version)

@@ -1,0 +1,290 @@
+"""Pod-migration / defragmentation sweep.
+
+The reference lists pod migration as a use case (README.md:20) but ships
+no command for it — its primitives are cluster snapshot import
+(pkg/simulator/simulator.go:369-441) and re-simulation. Here
+defragmentation is a first-class batched what-if, the mirror image of
+the capacity sweep (sweep.py):
+
+- nodes are ranked by dominant-resource utilization, least-loaded first
+  (the natural drain order: cheapest nodes to empty)
+- scenario s drains the first s nodes of that ranking: their
+  non-daemonset pods are released for rescheduling, their daemonset
+  pods cease to exist, and the nodes are masked out of the candidate
+  set; every pod still on a kept node is a forced (pinned) placement
+- one vmapped masked scan evaluates all drain depths at once (sharded
+  over a device mesh like the capacity sweep); the largest depth with
+  zero unschedulable pods wins
+- the winning depth is then replayed through the serial oracle, which
+  validates it placement-for-placement (including device-level GPU and
+  VG state the batched search tracks only in aggregate) and yields the
+  exact migration plan
+
+Pod ordering inside a scenario: pods are queued by DESCENDING drain
+rank of their current node, so for every prefix-drain scenario all
+pinned pods commit before any evicted pod schedules — each scenario
+sees the semantics "existing cluster first, then the migration wave",
+with one shared pod order across scenarios (vmap requirement).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..models import requests as req
+from ..scheduler.core import NodeStatus, SimulateResult
+
+
+@dataclass
+class PodMove:
+    pod: dict
+    from_node: str
+    to_node: str
+
+
+@dataclass
+class DefragResult:
+    ranked_nodes: List[str]  # drain order (least utilized first)
+    depths: List[int]  # evaluated drain depths
+    unscheduled: np.ndarray  # [Sc] unschedulable pods per depth
+    chosen_depth: int  # largest feasible depth (0 = nothing drainable)
+    drained_nodes: List[str] = field(default_factory=list)
+    moves: List[PodMove] = field(default_factory=list)
+    result: Optional[SimulateResult] = None  # cluster after the migration
+
+
+def _dominant_share(node: dict, pods: List[dict]) -> float:
+    alloc = req.node_allocatable(node)
+    used_cpu = used_mem = 0
+    for p in pods:
+        r = req.pod_requests(p)
+        used_cpu += r.get("cpu", 0)
+        used_mem += r.get("memory", 0)
+    cpu_cap = alloc.get("cpu", 0)
+    mem_cap = alloc.get("memory", 0)
+    return max(
+        float(used_cpu / cpu_cap) if cpu_cap else 0.0,
+        float(used_mem / mem_cap) if mem_cap else 0.0,
+    )
+
+
+def _is_daemonset_pod(pod: dict) -> bool:
+    refs = (pod.get("metadata") or {}).get("ownerReferences") or []
+    return any(r.get("kind") == "DaemonSet" for r in refs)
+
+
+def _strip_node_name(pod: dict) -> dict:
+    out = copy.deepcopy(pod)
+    spec = out.setdefault("spec", {})
+    spec.pop("nodeName", None)
+    # stale placement state must not leak into re-scheduling
+    status = out.get("status") or {}
+    status.pop("phase", None)
+    return out
+
+
+def rank_nodes_for_drain(
+    statuses: List[NodeStatus], protect: Optional[Callable[[dict], bool]] = None
+) -> List[int]:
+    """Indices of drainable nodes, least dominant-share first (stable on
+    ties by original index). `protect(node)` True exempts a node."""
+    cand = []
+    for i, ns in enumerate(statuses):
+        if protect is not None and protect(ns.node):
+            continue
+        cand.append((_dominant_share(ns.node, ns.pods), i))
+    cand.sort(key=lambda t: (t[0], t[1]))
+    return [i for _, i in cand]
+
+
+def plan_defrag(
+    snapshot: SimulateResult,
+    max_drain: Optional[int] = None,
+    protect: Optional[Callable[[dict], bool]] = None,
+    mesh=None,
+) -> DefragResult:
+    """Find the deepest feasible drain and its migration plan."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import scan as scan_ops
+    from ..ops.encode import (
+        encode_batch,
+        encode_cluster,
+        encode_dynamic,
+        to_scan_static,
+        to_scan_state,
+    )
+    from ..scheduler.oracle import Oracle
+
+    statuses = snapshot.node_status
+    nodes = [ns.node for ns in statuses]
+    ranked = rank_nodes_for_drain(statuses, protect)
+    n = len(nodes)
+    limit = len(ranked) - 1 if len(ranked) == n else len(ranked)
+    limit = max(limit, 0)  # never drain every schedulable node
+    if max_drain is not None:
+        limit = min(limit, max_drain)
+    depths = list(range(0, limit + 1))
+    ranked_names = [nodes[i]["metadata"]["name"] for i in ranked]
+    if limit == 0:
+        return DefragResult(
+            ranked_nodes=ranked_names,
+            depths=depths,
+            unscheduled=np.zeros(1, dtype=np.int64),
+            chosen_depth=0,
+            result=snapshot,
+        )
+
+    # drain rank per node index; undrainable nodes get rank "infinity"
+    rank_of = np.full(n, n + 1, dtype=np.int64)
+    for r, i in enumerate(ranked):
+        rank_of[i] = r
+
+    # pod queue: descending drain rank of the current node
+    entries = []  # (rank, node_idx, pod, is_ds)
+    for i, ns in enumerate(statuses):
+        for pod in ns.pods:
+            entries.append((rank_of[i], i, pod, _is_daemonset_pod(pod)))
+    entries.sort(key=lambda t: -t[0])
+
+    if not entries:
+        # pod-free cluster: every drain depth is trivially feasible
+        moves, result = _replay(snapshot, ranked, limit, entries)
+        return DefragResult(
+            ranked_nodes=ranked_names,
+            depths=depths,
+            unscheduled=np.zeros(len(depths), dtype=np.int64),
+            chosen_depth=limit,
+            drained_nodes=ranked_names[:limit],
+            moves=moves,
+            result=result,
+        )
+
+    oracle = Oracle(nodes)
+    clean_pods = [_strip_node_name(p) for _, _, p, _ in entries]
+    cluster_enc = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster_enc, clean_pods)
+    dyn = encode_dynamic(oracle, cluster_enc)
+    static = to_scan_static(cluster_enc, batch)
+    init = to_scan_state(dyn, batch)
+    class_arr = jnp.asarray(batch.class_of_pod)
+
+    p_cnt = len(entries)
+    sc = len(depths)
+    home = np.array([e[1] for e in entries], dtype=np.int32)
+    pod_rank = np.array([e[0] for e in entries], dtype=np.int64)
+    is_ds = np.array([e[3] for e in entries], dtype=bool)
+
+    node_valid = np.ones((sc, n), dtype=bool)
+    pinned = np.empty((sc, p_cnt), dtype=np.int32)
+    pod_active = np.ones((sc, p_cnt), dtype=bool)
+    for s_i, depth in enumerate(depths):
+        drained_idx = ranked[:depth]
+        node_valid[s_i, drained_idx] = False
+        evicted = pod_rank < depth
+        pinned[s_i] = np.where(evicted, -1, home)
+        pod_active[s_i] = ~(evicted & is_ds)
+
+    features = scan_ops.features_of(static, jnp.asarray(pinned[0]))
+
+    def one_scenario(pin, valid, active):
+        placements, _final = scan_ops.run_scan_masked(
+            static, init, class_arr, pin, valid, active, features=features
+        )
+        return placements, jnp.sum(placements == -1)
+
+    sweep_fn = jax.vmap(one_scenario)
+    pin_j = jnp.asarray(pinned)
+    valid_j = jnp.asarray(node_valid)
+    active_j = jnp.asarray(pod_active)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        n_dev = mesh.devices.size
+        pad = (-sc) % n_dev
+        if pad:
+            pin_j = jnp.concatenate([pin_j, jnp.repeat(pin_j[-1:], pad, 0)])
+            valid_j = jnp.concatenate([valid_j, jnp.repeat(valid_j[-1:], pad, 0)])
+            active_j = jnp.concatenate([active_j, jnp.repeat(active_j[-1:], pad, 0)])
+        sharding = NamedSharding(mesh, P(axis))
+        pin_j = jax.device_put(pin_j, sharding)
+        valid_j = jax.device_put(valid_j, sharding)
+        active_j = jax.device_put(active_j, sharding)
+        placements_all, unsched = jax.jit(
+            sweep_fn, in_shardings=(sharding, sharding, sharding)
+        )(pin_j, valid_j, active_j)
+        unsched = np.asarray(unsched)[:sc]
+    else:
+        placements_all, unsched = jax.jit(sweep_fn)(pin_j, valid_j, active_j)
+        unsched = np.asarray(unsched)
+
+    # deepest feasible drain per the batched search, then serial-oracle
+    # validation (mirrors the applier's sweep-hint + authoritative-run
+    # split); on disagreement fall back to the next shallower depth
+    for depth in sorted((d for d in depths if unsched[d] == 0), reverse=True):
+        validated = _replay(snapshot, ranked, depth, entries)
+        if validated is not None:
+            moves, result = validated
+            return DefragResult(
+                ranked_nodes=ranked_names,
+                depths=depths,
+                unscheduled=unsched,
+                chosen_depth=depth,
+                drained_nodes=ranked_names[:depth],
+                moves=moves,
+                result=result,
+            )
+    return DefragResult(
+        ranked_nodes=ranked_names,
+        depths=depths,
+        unscheduled=unsched,
+        chosen_depth=0,
+        result=snapshot,
+    )
+
+
+def _replay(snapshot, ranked, depth, entries):
+    """Serial-oracle validation of one drain depth. Returns
+    (moves, SimulateResult) or None if any evicted pod fails."""
+    from ..scheduler.oracle import Oracle
+
+    statuses = snapshot.node_status
+    drained = set(ranked[:depth])
+    kept_nodes = [ns.node for i, ns in enumerate(statuses) if i not in drained]
+    oracle = Oracle(kept_nodes)
+
+    evicted = []
+    for _rank, node_idx, pod, is_ds in entries:
+        if node_idx in drained:
+            if not is_ds:
+                evicted.append((node_idx, pod))
+            continue
+        oracle.place_existing_pod(pod)
+
+    moves: List[PodMove] = []
+    for node_idx, pod in evicted:
+        clean = _strip_node_name(pod)
+        target, _reason = oracle.schedule_pod(clean)
+        if target is None:
+            return None
+        moves.append(
+            PodMove(
+                pod=clean,
+                from_node=statuses[node_idx].node["metadata"]["name"],
+                to_node=target,
+            )
+        )
+
+    # a validated plan schedules every evicted pod by construction
+    result = SimulateResult(
+        unscheduled_pods=[],
+        node_status=[NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes],
+    )
+    return moves, result

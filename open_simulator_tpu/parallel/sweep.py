@@ -104,8 +104,9 @@ def sweep_node_counts(
         app_pods = wl.generate_valid_pods_from_app(app.name, app.resource, padded.nodes)
         if use_greed:
             # same ordering the authoritative serial run will use
-            # (scheduler/core.py schedule_app), else the hint is
-            # computed for a different pod sequence
+            # (scheduler/core.py schedule_app): greed_sort ignores
+            # simon new nodes, so the max-count padding here and the
+            # per-count serial cluster sort pods identically
             from ..scheduler.queues import greed_sort
 
             app_pods = greed_sort(padded.nodes, app_pods)

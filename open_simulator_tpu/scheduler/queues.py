@@ -37,10 +37,20 @@ def _share(alloc: float, total: float) -> float:
 
 def greed_sort(nodes: List[dict], pods: List[dict]) -> List[dict]:
     """GreedQueue ordering: dominant share of (cpu, memory) vs the
-    cluster total, descending; pods with spec.nodeName first."""
+    cluster total, descending; pods with spec.nodeName first.
+
+    Capacity totals exclude simon-fabricated new nodes so the ordering
+    is independent of the capacity-planner's current new-node count —
+    the serial escalation run and the batched sweep (which pads to the
+    maximum count) must sort pods identically or the sweep's minimal
+    count is not valid for the serial run that confirms it."""
+    from ..models.workloads import LABEL_NEW_NODE
+
     total_cpu = 0.0
     total_mem = 0.0
     for node in nodes:
+        if LABEL_NEW_NODE in ((node.get("metadata") or {}).get("labels") or {}):
+            continue
         alloc = req.node_allocatable(node)
         total_cpu += float(alloc.get(req.CPU, Fraction(0)))
         total_mem += float(alloc.get(req.MEMORY, Fraction(0)))

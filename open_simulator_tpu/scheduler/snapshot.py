@@ -41,8 +41,12 @@ def save_snapshot(result: SimulateResult, path: str):
 def load_snapshot(path: str) -> SimulateResult:
     with open(path) as f:
         data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"snapshot must be a JSON object, got {type(data).__name__}")
     if data.get("version") != SNAPSHOT_VERSION:
         raise ValueError(f"unsupported snapshot version: {data.get('version')}")
+    if not isinstance(data.get("nodes"), list) or not isinstance(data.get("pods"), list):
+        raise ValueError("snapshot missing 'nodes'/'pods' lists")
     by_node = {}
     statuses = [NodeStatus(node=n, pods=[]) for n in data["nodes"]]
     for st in statuses:

@@ -1,0 +1,152 @@
+"""Pod-migration / defragmentation sweep (parallel/defrag.py).
+
+The reference lists migration as a use case (README.md:20) without a
+command; here it is a first-class batched what-if over drain depths.
+"""
+
+import json
+
+import numpy as np
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.parallel.defrag import plan_defrag, rank_nodes_for_drain
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.testing import make_fake_node, make_fake_pod
+
+
+def _node(name, cpu="8", mem="16Gi"):
+    return make_fake_node(name, cpu=cpu, memory=mem)
+
+
+def _pod(name, node=None, cpu="1", mem="1Gi"):
+    pod = make_fake_pod(name, namespace="d", cpu=cpu, memory=mem)
+    if node:
+        pod["spec"]["nodeName"] = node
+        pod["status"] = {"phase": "Running"}
+    return pod
+
+
+def _snapshot(nodes, pods):
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    cluster.pods = pods
+    return simulate(cluster, [], engine="oracle")
+
+
+def test_rank_prefers_least_utilized():
+    nodes = [_node("a"), _node("b"), _node("c")]
+    pods = [
+        _pod("p0", "a", cpu="6"),
+        _pod("p1", "b", cpu="1"),
+        _pod("p2", "c", cpu="3"),
+    ]
+    snap = _snapshot(nodes, pods)
+    ranked = rank_nodes_for_drain(snap.node_status)
+    names = [snap.node_status[i].node["metadata"]["name"] for i in ranked]
+    assert names == ["b", "c", "a"]
+
+
+def test_defrag_frees_underutilized_node():
+    # three nodes at 25% cpu each: all pods fit on one node, so two of
+    # the three can be drained (but never all three)
+    nodes = [_node("a"), _node("b"), _node("c")]
+    pods = [
+        _pod(f"p{i}", node, cpu="2", mem="2Gi")
+        for i, node in enumerate(["a", "b", "c"])
+    ]
+    snap = _snapshot(nodes, pods)
+    plan = plan_defrag(snap)
+    assert plan.chosen_depth == 2
+    assert len(plan.moves) == 2
+    surviving = {ns.node["metadata"]["name"] for ns in plan.result.node_status}
+    assert len(surviving) == 1
+    for m in plan.moves:
+        assert m.to_node in surviving
+        assert m.from_node not in surviving
+    # every pod survived the migration
+    total = sum(len(ns.pods) for ns in plan.result.node_status)
+    assert total == 3
+
+
+def test_defrag_respects_capacity():
+    # two nodes, each half full: no node can absorb the other
+    nodes = [_node("a", cpu="4"), _node("b", cpu="4")]
+    pods = [_pod("p0", "a", cpu="3"), _pod("p1", "b", cpu="3")]
+    snap = _snapshot(nodes, pods)
+    plan = plan_defrag(snap)
+    assert plan.chosen_depth == 0
+    assert plan.moves == []
+
+
+def test_defrag_daemonset_pods_vanish_with_node():
+    # the daemonset pod on the drained node must NOT be migrated
+    nodes = [_node("a"), _node("b")]
+    ds_pod = _pod("ds-a", "a", cpu="100m")
+    ds_pod["metadata"]["ownerReferences"] = [
+        {"kind": "DaemonSet", "name": "agent", "uid": "x"}
+    ]
+    # b is busier than a, so the drain ranking picks a first
+    pods = [ds_pod, _pod("p0", "a", cpu="1"), _pod("p1", "b", cpu="4")]
+    snap = _snapshot(nodes, pods)
+    plan = plan_defrag(snap)
+    assert plan.chosen_depth == 1
+    assert plan.drained_nodes == ["a"]
+    moved = {(m.pod["metadata"]["name"]) for m in plan.moves}
+    assert moved == {"p0"}
+    # the daemonset pod vanished with its node instead of migrating
+    remaining = {
+        p["metadata"]["name"] for ns in plan.result.node_status for p in ns.pods
+    }
+    assert remaining == {"p0", "p1"}
+
+
+def test_defrag_protect_exempts_nodes():
+    nodes = [_node("keep-0"), _node("x-1"), _node("x-2")]
+    pods = [_pod("p0", "x-1", cpu="1")]
+    snap = _snapshot(nodes, pods)
+
+    plan = plan_defrag(
+        snap, protect=lambda n: n["metadata"]["name"].startswith("keep")
+    )
+    assert "keep-0" not in plan.ranked_nodes
+    assert "keep-0" not in plan.drained_nodes
+
+
+def test_defrag_mesh_sharded():
+    import jax
+    from jax.sharding import Mesh
+
+    nodes = [_node(f"n{i}") for i in range(6)]
+    pods = [_pod(f"p{i}", f"n{i % 6}", cpu="1") for i in range(6)]
+    snap = _snapshot(nodes, pods)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("scenario",))
+    plan = plan_defrag(snap, mesh=mesh)
+    plain = plan_defrag(snap)
+    assert plan.chosen_depth == plain.chosen_depth
+    np.testing.assert_array_equal(plan.unscheduled, plain.unscheduled)
+
+
+def test_defrag_cli_json(tmp_path):
+    from open_simulator_tpu.cli import main
+    from open_simulator_tpu.scheduler.snapshot import save_snapshot
+
+    nodes = [_node("a"), _node("b"), _node("c")]
+    pods = [
+        _pod(f"p{i}", node, cpu="2", mem="2Gi")
+        for i, node in enumerate(["a", "b", "c"])
+    ]
+    snap = _snapshot(nodes, pods)
+    path = tmp_path / "snap.json"
+    save_snapshot(snap, str(path))
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["defrag", "--snapshot", str(path), "--format", "json"])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert out["chosenDepth"] == 2
+    assert len(out["moves"]) == 2
+    assert set(out["drainedNodes"]).isdisjoint({m["to"] for m in out["moves"]})

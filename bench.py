@@ -109,6 +109,21 @@ def build_scenario():
     return nodes, pods
 
 
+def build_affinity_scenario():
+    """SIMON_BENCH=affinity: the 100-StatefulSet anti-affinity +
+    topology-spread stress from BASELINE.md, expanded to pods."""
+    from open_simulator_tpu.models import workloads as wl
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.scheduler.core import _sort_app_pods
+    from open_simulator_tpu.testing import build_affinity_stress
+
+    nodes, stss = build_affinity_stress(n_nodes=2000, n_sts=100, replicas=20, zones=16)
+    res = ResourceTypes()
+    res.stateful_sets = stss
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("stress", res, nodes))
+    return nodes, pods
+
+
 def main():
     if not _tpu_healthy():
         # wedged axon relay: force CPU so the bench still reports
@@ -129,7 +144,11 @@ def main():
     )
     from open_simulator_tpu.scheduler.oracle import Oracle
 
-    nodes, pods = build_scenario()
+    scenario = os.environ.get("SIMON_BENCH", "default")
+    if scenario == "affinity":
+        nodes, pods = build_affinity_scenario()
+    else:
+        nodes, pods = build_scenario()
     oracle = Oracle(nodes)
     cluster = encode_cluster(oracle)
     batch = encode_batch(oracle, cluster, pods)
@@ -152,11 +171,13 @@ def main():
     elapsed = time.perf_counter() - t0
 
     scheduled = int((placements_np >= 0).sum())
-    pods_per_sec = N_PODS / elapsed
+    n_pods, n_nodes = len(pods), len(nodes)
+    pods_per_sec = n_pods / elapsed
     print(
         json.dumps(
             {
-                "metric": f"pods scheduled/sec at {N_NODES} nodes (JAX scan, {scheduled}/{N_PODS} placed)",
+                "metric": f"pods scheduled/sec at {n_nodes} nodes "
+                f"({scenario} scenario, JAX scan, {scheduled}/{n_pods} placed)",
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(pods_per_sec / NORTH_STAR_PODS_PER_SEC, 3),

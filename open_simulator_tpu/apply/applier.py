@@ -3,8 +3,8 @@
 Mirrors pkg/apply/apply.go:
 - Simon CR config parsing (apiVersion simon/v1alpha1, kind Config;
   pkg/api/v1alpha1/types.go) with path validation (apply.go:249-286)
-- cluster from customConfig dir (kubeConfig/live clusters are out of
-  scope for the simulator environment and rejected with a clear error)
+- cluster from a customConfig dir or from a live cluster via kubeConfig
+  (models/kubeclient.py, CreateClusterResourceFromClient semantics)
 - app list: plain YAML dirs or Helm charts (pkg/chart rendering)
 - the capacity loop (apply.go:186-239): instead of interactively asking
   the user for a node count per iteration, all candidate counts up to
@@ -82,12 +82,9 @@ class SimonConfig:
             raise ValueError(
                 "only one of values of both kubeConfig and customConfig must exist"
             )
-        if self.kube_config:
-            raise ValueError(
-                "kubeConfig clusters are not supported in the TPU simulator "
-                "environment; export the cluster to YAML and use customConfig"
-            )
-        if not os.path.exists(self.custom_cluster):
+        if self.kube_config and not os.path.exists(os.path.expanduser(self.kube_config)):
+            raise ValueError(f"invalid path of kubeconfig: {self.kube_config}")
+        if self.custom_cluster and not os.path.exists(self.custom_cluster):
             raise ValueError(f"invalid path of customConfig: {self.custom_cluster}")
         if self.new_node and not os.path.exists(self.new_node):
             raise ValueError(f"invalid path of newNode: {self.new_node}")
@@ -169,6 +166,7 @@ class Applier:
         engine: str = "tpu",
         use_sweep: bool = True,
         use_greed: bool = False,
+        scheduler_config: str = "",
     ):
         config.validate()
         self.config = config
@@ -177,10 +175,22 @@ class Applier:
         self.engine = engine
         self.use_sweep = use_sweep
         self.use_greed = use_greed
+        self.extenders = []
+        if scheduler_config:
+            from ..scheduler.extender import extenders_from_scheduler_config
+
+            self.extenders = extenders_from_scheduler_config(scheduler_config)
+            if self.extenders:
+                # extenders are host RPC per pod: no batched sweep
+                self.use_sweep = False
 
     # -- loading ------------------------------------------------------------
 
     def load_cluster(self) -> ResourceTypes:
+        if self.config.kube_config:
+            from ..models.kubeclient import create_cluster_resource_from_client
+
+            return create_cluster_resource_from_client(self.config.kube_config)
         return cluster_from_config_dir(self.config.custom_cluster)
 
     def load_apps(self) -> List[AppResource]:
@@ -210,38 +220,51 @@ class Applier:
             from ..parallel.sweep import _new_nodes
 
             padded.nodes = list(padded.nodes) + _new_nodes(new_node, count)
-        return simulate(padded, apps, engine=self.engine, use_greed=self.use_greed)
+        return simulate(
+            padded,
+            apps,
+            engine=self.engine,
+            use_greed=self.use_greed,
+            extenders=self.extenders,
+        )
 
     def run(self, select_apps=None) -> ApplyResult:
-        cluster = self.load_cluster()
-        apps = self.load_apps()
-        if select_apps is not None:
-            apps = [a for a in apps if a.name in select_apps]
-        new_node = self.load_new_node()
+        from ..utils.trace import phase
+
+        with phase("apply/load"):
+            cluster = self.load_cluster()
+            apps = self.load_apps()
+            if select_apps is not None:
+                apps = [a for a in apps if a.name in select_apps]
+            new_node = self.load_new_node()
 
         start_count = 0
         if self.use_sweep and new_node is not None:
             # the sweep narrows the search; the authoritative serial run
             # below still validates its pick (incl. the VG cap the sweep
             # cannot see) and escalates further if needed
-            hint = self._sweep_min_count(cluster, apps, new_node)
+            with phase("apply/sweep"):
+                hint = self._sweep_min_count(cluster, apps, new_node)
             if hint is not None:
                 start_count = hint
 
         max_count = 0 if new_node is None else MAX_NUM_NEW_NODE
         result = None
         for count in range(start_count, max_count + 1):
-            result = self._simulate_with_count(cluster, apps, new_node, count)
+            with phase("apply/simulate"):
+                result = self._simulate_with_count(cluster, apps, new_node, count)
             if result.unscheduled_pods:
                 continue
             ok, reason = satisfy_resource_setting(result.node_status)
             if not ok:
                 continue
+            with phase("apply/report"):
+                report_text = report(result.node_status, self.extended_resources)
             return ApplyResult(
                 success=True,
                 new_node_count=count,
                 result=result,
-                report_text=report(result.node_status, self.extended_resources),
+                report_text=report_text,
             )
         if result is not None and result.unscheduled_pods:
             message = (

@@ -8,11 +8,11 @@ Mirrors cmd/simon (cmd/simon/simon.go, cmd/apply/apply.go):
   simon gen-doc
 
 Log level comes from the LogLevel env var (cmd/simon/simon.go:60-80).
---default-scheduler-config is accepted for compatibility but has no
-effect, matching the reference where it is a dead option
-(SURVEY.md §2.1, pkg/apply/apply.go:80-81). --use-greed — also dead in
-the reference — actually applies the GreedQueue ordering here
-(scheduler/queues.py).
+--default-scheduler-config and --use-greed are dead options in the
+reference (stored but never forwarded, pkg/apply/apply.go:80-81); here
+both are functional: the scheduler config's `extenders:` section is
+honored (scheduler/extender.py) and --use-greed applies the GreedQueue
+ordering (scheduler/queues.py).
 
 Run as `python -m open_simulator_tpu.cli ...` or via the `simon`
 console script.
@@ -62,6 +62,7 @@ def cmd_apply(args) -> int:
             engine=args.engine,
             use_sweep=not args.no_sweep,
             use_greed=args.use_greed,
+            scheduler_config=args.default_scheduler_config,
         )
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -78,6 +79,10 @@ def cmd_apply(args) -> int:
             idx = {int(x) for x in raw.split(",")}
             select = [n for i, n in enumerate(names) if i in idx]
     result = applier.run(select_apps=select)
+    if args.trace:
+        from .utils.trace import GLOBAL
+
+        print(GLOBAL.as_json(), file=sys.stderr)
     if args.snapshot and result.result is not None:
         from .scheduler.snapshot import save_snapshot
 
@@ -239,7 +244,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="extended resource reports: gpu,open-local",
     )
     p_apply.add_argument(
-        "--default-scheduler-config", default="", help="accepted for compatibility (unused)"
+        "--default-scheduler-config",
+        default="",
+        help="KubeSchedulerConfiguration file; its `extenders:` section is "
+        "honored (HTTP filter/prioritize/bind callbacks; forces the serial "
+        "engine). Dead option in the reference, functional here.",
     )
     p_apply.add_argument(
         "--use-greed",
@@ -255,6 +264,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_apply.add_argument(
         "--snapshot", default="", help="write the resulting cluster snapshot to this file"
+    )
+    p_apply.add_argument(
+        "--trace",
+        action="store_true",
+        help="print per-phase wall-clock JSON to stderr (set SIMON_PROFILE_DIR "
+        "for a JAX profiler capture of the scan phases)",
     )
     p_apply.set_defaults(func=cmd_apply)
 

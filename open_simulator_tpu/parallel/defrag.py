@@ -126,9 +126,9 @@ def plan_defrag(
     ranked = rank_nodes_for_drain(statuses, protect)
     n = len(nodes)
     limit = len(ranked) - 1 if len(ranked) == n else len(ranked)
-    limit = max(limit, 0)  # never drain every schedulable node
     if max_drain is not None:
         limit = min(limit, max_drain)
+    limit = max(limit, 0)  # never drain every schedulable node
     depths = list(range(0, limit + 1))
     ranked_names = [nodes[i]["metadata"]["name"] for i in ranked]
     if limit == 0:
@@ -196,7 +196,9 @@ def plan_defrag(
         placements, _final = scan_ops.run_scan_masked(
             static, init, class_arr, pin, valid, active, features=features
         )
-        return placements, jnp.sum(placements == -1)
+        # only the count leaves the device; the serial _replay derives
+        # the winning depth's exact placements
+        return jnp.sum(placements == -1)
 
     sweep_fn = jax.vmap(one_scenario)
     pin_j = jnp.asarray(pinned)
@@ -217,13 +219,12 @@ def plan_defrag(
         pin_j = jax.device_put(pin_j, sharding)
         valid_j = jax.device_put(valid_j, sharding)
         active_j = jax.device_put(active_j, sharding)
-        placements_all, unsched = jax.jit(
-            sweep_fn, in_shardings=(sharding, sharding, sharding)
-        )(pin_j, valid_j, active_j)
+        unsched = jax.jit(sweep_fn, in_shardings=(sharding, sharding, sharding))(
+            pin_j, valid_j, active_j
+        )
         unsched = np.asarray(unsched)[:sc]
     else:
-        placements_all, unsched = jax.jit(sweep_fn)(pin_j, valid_j, active_j)
-        unsched = np.asarray(unsched)
+        unsched = np.asarray(jax.jit(sweep_fn)(pin_j, valid_j, active_j))
 
     # deepest feasible drain per the batched search, then serial-oracle
     # validation (mirrors the applier's sweep-hint + authoritative-run

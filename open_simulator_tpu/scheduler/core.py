@@ -69,15 +69,20 @@ class Simulator:
     """In-memory cluster + serial scheduler (the fake apiserver +
     scheduler goroutine of the reference collapse into this object)."""
 
-    def __init__(self, engine: str = "oracle", use_greed: bool = False):
+    def __init__(self, engine: str = "oracle", use_greed: bool = False, extenders=None):
         self.engine_kind = engine
         self.use_greed = use_greed
+        # HTTP extenders are host RPC per pod: they force the serial
+        # oracle path (SURVEY.md §2.3 host-callback escape hatch)
+        self.extenders = list(extenders or [])
+        if self.extenders:
+            self.engine_kind = "oracle"
         self.oracle: Optional[Oracle] = None
         self.cluster_pods: List[dict] = []
 
     # RunCluster (simulator.go:159-164)
     def run_cluster(self, cluster: ResourceTypes) -> SimulateResult:
-        self.oracle = Oracle(cluster.nodes)
+        self.oracle = Oracle(cluster.nodes, extenders=self.extenders)
         pods = wl.pods_excluding_daemon_sets(cluster)
         for ds in cluster.daemon_sets:
             pods.extend(wl.pods_from_daemon_set(ds, cluster.nodes))
@@ -164,9 +169,10 @@ def simulate(
     apps: List[AppResource],
     engine: str = "oracle",
     use_greed: bool = False,
+    extenders=None,
 ) -> SimulateResult:
     """One-shot simulation (core.go:64-103)."""
-    sim = Simulator(engine=engine, use_greed=use_greed)
+    sim = Simulator(engine=engine, use_greed=use_greed, extenders=extenders)
     cluster = cluster.copy()
     failed: List[UnscheduledPod] = []
     result = sim.run_cluster(cluster)

@@ -42,20 +42,24 @@ class TpuEngine:
 
         from ..ops import scan as scan_ops
         from ..ops.encode import to_scan_static, to_scan_state
+        from ..utils.trace import phase, profiled
 
         oracle = self.oracle
-        cluster = encode_cluster(oracle)
-        batch = encode_batch(oracle, cluster, pods)
-        dyn = encode_dynamic(oracle, cluster)
-        static = to_scan_static(cluster, batch)
-        init = to_scan_state(dyn, batch)
-        placements, _ = scan_ops.run_scan(
-            static,
-            init,
-            jnp.asarray(batch.class_of_pod),
-            jnp.asarray(batch.pinned_node),
-        )
-        return np.asarray(placements)
+        with phase("engine/encode"):
+            cluster = encode_cluster(oracle)
+            batch = encode_batch(oracle, cluster, pods)
+            dyn = encode_dynamic(oracle, cluster)
+            static = to_scan_static(cluster, batch)
+            init = to_scan_state(dyn, batch)
+        with profiled("engine/scan"):
+            placements, _ = scan_ops.run_scan(
+                static,
+                init,
+                jnp.asarray(batch.class_of_pod),
+                jnp.asarray(batch.pinned_node),
+            )
+            out = np.asarray(placements)  # blocks on device completion
+        return out
 
     def commit_host(self, pod: dict, node_idx: int):
         """Replay one placement into oracle state (same binding code the

@@ -1,0 +1,241 @@
+"""HTTP scheduler extenders — the host-callback escape hatch.
+
+Mirrors vendor/k8s.io/kubernetes/pkg/scheduler/core/extender.go:
+- Filter (extender.go:273-339): POST {urlPrefix}/{filterVerb} with
+  ExtenderArgs{pod, nodes|nodenames}; the result's node list replaces
+  the feasible set, failedNodes carry per-node reasons; errors fail the
+  pod unless `ignorable`
+- Prioritize (extender.go:343-383): POST returns HostPriorityList;
+  host scores * weight are summed across extenders and scaled by
+  MaxNodeScore/MaxExtenderPriority = 10 into the plugin score sum
+  (generic_scheduler.go:519-556)
+- Bind (extender.go:385-399): a binder extender is delegated the bind
+- IsInterested (extender.go:406-424): only pods requesting a managed
+  resource reach the extender (no managedResources = all pods)
+
+Extenders run on the host (they are arbitrary RPC), so a simulation
+with extenders uses the serial oracle path — the scan cannot carry an
+HTTP round-trip per pod (SURVEY.md §2.3: extender fan-out maps to a
+host-callback escape hatch, not a kernel).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+MAX_NODE_SCORE = 100
+MAX_EXTENDER_PRIORITY = 10
+DEFAULT_TIMEOUT_S = 5.0
+
+
+class ExtenderError(RuntimeError):
+    pass
+
+
+@dataclass
+class ExtenderConfig:
+    """KubeSchedulerConfiguration `extenders:` entry (v1beta1)."""
+
+    url_prefix: str
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False
+    ignorable: bool = False
+    managed_resources: List[str] = field(default_factory=list)
+    http_timeout_s: float = DEFAULT_TIMEOUT_S
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExtenderConfig":
+        return cls(
+            url_prefix=d.get("urlPrefix", ""),
+            filter_verb=d.get("filterVerb", ""),
+            prioritize_verb=d.get("prioritizeVerb", ""),
+            bind_verb=d.get("bindVerb", ""),
+            weight=int(d.get("weight", 1) or 1),
+            node_cache_capable=bool(d.get("nodeCacheCapable", False)),
+            ignorable=bool(d.get("ignorable", False)),
+            managed_resources=[
+                r.get("name", "") for r in d.get("managedResources") or []
+            ],
+            http_timeout_s=float(d.get("httpTimeoutSeconds", DEFAULT_TIMEOUT_S)),
+        )
+
+
+class HTTPExtender:
+    def __init__(self, config: ExtenderConfig):
+        self.config = config
+
+    @property
+    def name(self) -> str:
+        return self.config.url_prefix
+
+    @property
+    def is_binder(self) -> bool:
+        return bool(self.config.bind_verb)
+
+    def is_interested(self, pod: dict) -> bool:
+        if not self.config.managed_resources:
+            return True
+        managed = set(self.config.managed_resources)
+        for c in ((pod.get("spec") or {}).get("containers")) or []:
+            res = c.get("resources") or {}
+            for section in ("requests", "limits"):
+                if managed & set((res.get(section) or {}).keys()):
+                    return True
+        return False
+
+    def _send(self, verb: str, args: dict) -> dict:
+        url = self.config.url_prefix.rstrip("/") + "/" + verb
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(args).encode(),
+            headers={"Content-Type": "application/json", "Accept": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.config.http_timeout_s) as r:
+                return json.load(r)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            raise ExtenderError(f"extender {url}: {e}") from e
+
+    def filter(
+        self, pod: dict, nodes: List[dict]
+    ) -> Tuple[List[dict], Dict[str, str]]:
+        """Returns (feasible nodes, failed {node: reason}). Raises
+        ExtenderError on transport/protocol errors."""
+        if not self.config.filter_verb:
+            return nodes, {}
+        by_name = {((n.get("metadata") or {}).get("name", "")): n for n in nodes}
+        args: dict = {"pod": pod}
+        if self.config.node_cache_capable:
+            args["nodenames"] = list(by_name.keys())
+        else:
+            args["nodes"] = {"items": nodes}
+        result = self._send(self.config.filter_verb, args)
+        if result.get("error"):
+            raise ExtenderError(f"extender {self.name}: {result['error']}")
+        failed = dict(result.get("failedNodes") or {})
+        if self.config.node_cache_capable and result.get("nodenames") is not None:
+            out = []
+            for name in result["nodenames"]:
+                if name not in by_name:
+                    raise ExtenderError(
+                        f"extender {self.name} claims unknown node {name!r}"
+                    )
+                out.append(by_name[name])
+            return out, failed
+        if result.get("nodes") is not None:
+            return list((result["nodes"] or {}).get("items") or []), failed
+        return [], failed
+
+    def prioritize(self, pod: dict, nodes: List[dict]) -> Optional[Dict[str, int]]:
+        """Returns {node_name: raw score} or None on (ignored) error."""
+        if not self.config.prioritize_verb:
+            return {
+                (n.get("metadata") or {}).get("name", ""): 0 for n in nodes
+            }
+        args: dict = {"pod": pod}
+        if self.config.node_cache_capable:
+            args["nodenames"] = [
+                (n.get("metadata") or {}).get("name", "") for n in nodes
+            ]
+        else:
+            args["nodes"] = {"items": nodes}
+        try:
+            result = self._send(self.config.prioritize_verb, args)
+        except ExtenderError:
+            # prioritization errors are ignored (generic_scheduler.go:536)
+            return None
+        return {
+            h.get("host", ""): int(h.get("score", 0)) for h in (result or [])
+        }
+
+    def bind(self, pod: dict, node_name: str) -> None:
+        meta = pod.get("metadata") or {}
+        result = self._send(
+            self.config.bind_verb,
+            {
+                "podName": meta.get("name", ""),
+                "podNamespace": meta.get("namespace", ""),
+                "podUID": meta.get("uid", ""),
+                "node": node_name,
+            },
+        )
+        if result.get("error"):
+            raise ExtenderError(f"extender bind {self.name}: {result['error']}")
+
+
+def filter_with_extenders(
+    extenders: List[HTTPExtender],
+    pod: dict,
+    feasible: List,
+    fail,
+) -> List:
+    """findNodesThatPassExtenders (generic_scheduler.go:345-374) over
+    oracle NodeStates. `fail(reason)` records per-node failure reasons."""
+    for ext in extenders:
+        if not feasible:
+            break
+        if not ext.is_interested(pod):
+            continue
+        nodes = [ns.node for ns in feasible]
+        try:
+            kept_nodes, failed = ext.filter(pod, nodes)
+        except ExtenderError:
+            if ext.config.ignorable:
+                continue
+            raise
+        for _name, msg in sorted(failed.items()):
+            fail(msg)
+        kept_names = {
+            ((n.get("metadata") or {}).get("name", "")) for n in kept_nodes
+        }
+        feasible = [ns for ns in feasible if ns.name in kept_names]
+    return feasible
+
+
+def extender_scores(
+    extenders: List[HTTPExtender], pod: dict, feasible: List
+) -> List[int]:
+    """Combined extender contribution per feasible node, already scaled
+    by MaxNodeScore/MaxExtenderPriority (generic_scheduler.go:552-556)."""
+    combined = {ns.name: 0 for ns in feasible}
+    for ext in extenders:
+        if not ext.is_interested(pod):
+            continue
+        scores = ext.prioritize(pod, [ns.node for ns in feasible])
+        if scores is None:
+            continue
+        for host, score in scores.items():
+            if host in combined:
+                combined[host] += score * ext.config.weight
+    scale = MAX_NODE_SCORE // MAX_EXTENDER_PRIORITY
+    return [combined[ns.name] * scale for ns in feasible]
+
+
+def extenders_from_scheduler_config(path: str) -> List[HTTPExtender]:
+    """Load the `extenders:` section of a KubeSchedulerConfiguration
+    file (the reference forwards these to scheduler.New,
+    pkg/simulator/simulator.go:149). Raises ValueError on malformed
+    input so CLI error handling stays uniform."""
+    import yaml
+
+    with open(path) as f:
+        try:
+            doc = yaml.safe_load(f) or {}
+        except yaml.YAMLError as e:
+            raise ValueError(f"invalid scheduler config {path}: {e}") from e
+    if not isinstance(doc, dict):
+        raise ValueError(f"invalid scheduler config {path}: not a mapping")
+    extenders = doc.get("extenders") or []
+    if not isinstance(extenders, list) or not all(
+        isinstance(e, dict) for e in extenders
+    ):
+        raise ValueError(f"invalid scheduler config {path}: bad extenders section")
+    return [HTTPExtender(ExtenderConfig.from_dict(e)) for e in extenders]

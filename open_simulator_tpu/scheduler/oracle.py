@@ -179,12 +179,15 @@ def _ports_conflict(want: List[Tuple[str, str, int]], used: set) -> bool:
 class Oracle:
     """Serial scheduler over mutable node states."""
 
-    def __init__(self, nodes: List[dict], registry=None):
+    def __init__(self, nodes: List[dict], registry=None, extenders=None):
         if registry is None:
             from .plugins import default_registry
 
             registry = default_registry
         self.registry = registry
+        # HTTP scheduler extenders (extender.py); host-side RPC, so a
+        # simulation using them runs on this serial path only
+        self.extenders = list(extenders or [])
         self.nodes: List[NodeState] = []
         self.node_index: Dict[str, int] = {}
         for n in nodes:
@@ -236,7 +239,18 @@ class Oracle:
 
     def schedule_pod(self, pod: dict) -> Tuple[Optional[str], str]:
         """One scheduleOne cycle. Returns (node_name, reason)."""
-        feasible, reasons = self._find_feasible(pod)
+        from .extender import ExtenderError
+
+        meta = pod.get("metadata") or {}
+        try:
+            feasible, reasons = self._find_feasible(pod)
+        except ExtenderError as e:
+            # a non-ignorable extender failure fails this pod's cycle
+            # (scheduleOne error path), not the whole simulation
+            return None, (
+                f"failed to schedule pod ({meta.get('namespace', 'default')}/"
+                f"{meta.get('name', '')}): {e}"
+            )
         if not feasible:
             return None, self._failure_message(pod, reasons)
         scores = self._prioritize(pod, feasible)
@@ -245,7 +259,15 @@ class Oracle:
         for ns, sc in zip(feasible[1:], scores[1:]):
             if sc > best_score:
                 best, best_score = ns, sc
-        self._reserve_and_bind(pod, best)
+        try:
+            # the binder extender runs before any local mutation, so a
+            # failure here leaves no partial commit
+            self._reserve_and_bind(pod, best)
+        except ExtenderError as e:
+            return None, (
+                f"failed to bind pod ({meta.get('namespace', 'default')}/"
+                f"{meta.get('name', '')}): {e}"
+            )
         return best.name, ""
 
     # -- filters ------------------------------------------------------------
@@ -336,6 +358,10 @@ class Oracle:
             if rejected:
                 continue
             feasible.append(ns)
+        if self.extenders:
+            from .extender import filter_with_extenders
+
+            feasible = filter_with_extenders(self.extenders, pod, feasible, fail)
         return feasible, reasons
 
     def _fits_resources(self, pod_req: dict, ns: NodeState) -> Optional[str]:
@@ -615,6 +641,10 @@ class Oracle:
             elif plugin.normalize == "minmax":
                 raw = self._minmax_normalize(raw)
             add(raw, plugin.weight)
+        if self.extenders:
+            from .extender import extender_scores
+
+            add(extender_scores(self.extenders, pod, feasible), 1)
         return total
 
     @staticmethod
@@ -969,6 +999,13 @@ class Oracle:
     def _reserve_and_bind(self, pod: dict, ns: NodeState):
         meta = pod.setdefault("metadata", {})
         spec = pod.setdefault("spec", {})
+        # a binder extender is delegated the bind (scheduler.go bind();
+        # extender.go:385-399); local state is updated either way so the
+        # simulation keeps tracking the placement
+        for ext in self.extenders:
+            if ext.is_binder and ext.is_interested(pod):
+                ext.bind(pod, ns.name)
+                break
         # Open-Gpu-Share Reserve: allocate device ids, update node
         gpu_mem, gpu_cnt = stor.pod_gpu_request(pod)
         if stor.pod_gpu_memory(pod) > 0 and ns.gpu is not None:

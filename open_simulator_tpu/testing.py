@@ -242,3 +242,90 @@ def make_fake_cron_job(name, namespace="default", completions=1, cpu="100m", mem
     for opt in opts:
         opt(obj)
     return obj
+
+
+def build_affinity_stress(
+    n_nodes: int = 1000,
+    n_sts: int = 100,
+    replicas: int = 8,
+    zones: int = 8,
+    namespace: str = "stress",
+):
+    """The InterPodAffinity-heavy benchmark scenario (BASELINE.md:
+    "100 StatefulSets + topology-spread").
+
+    Returns (nodes, stateful_sets). Every StatefulSet carries
+    - required pod anti-affinity against its own app label on the
+      hostname topology (at most one replica per node),
+    - a DoNotSchedule zone topology-spread constraint (maxSkew 1),
+    - for odd indices, an additional ScheduleAnyway hostname spread
+      (soft score path),
+    - for every third one, preferred pod affinity to the previous
+      StatefulSet's pods on the zone topology (cross-app score terms).
+    """
+    nodes = [
+        make_fake_node(
+            f"sn-{i:05d}",
+            "32",
+            "64Gi",
+            with_node_labels({"zone": f"z{i % zones}"}),
+        )
+        for i in range(n_nodes)
+    ]
+    stss = []
+    for s in range(n_sts):
+        app = f"sts-{s:03d}"
+        selector = {"matchLabels": {"app": app}}
+        affinity = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": selector,
+                        "topologyKey": "kubernetes.io/hostname",
+                    }
+                ]
+            }
+        }
+        if s % 3 == 2:
+            affinity["podAffinity"] = {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 50,
+                        "podAffinityTerm": {
+                            "labelSelector": {
+                                "matchLabels": {"app": f"sts-{s - 1:03d}"}
+                            },
+                            "topologyKey": "zone",
+                        },
+                    }
+                ]
+            }
+        spread = [
+            {
+                "maxSkew": 1,
+                "topologyKey": "zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": selector,
+            }
+        ]
+        if s % 2 == 1:
+            spread.append(
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "kubernetes.io/hostname",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": selector,
+                }
+            )
+        sts = make_fake_stateful_set(
+            app,
+            namespace,
+            replicas,
+            "500m",
+            "1Gi",
+            with_labels({"app": app}),
+            with_affinity(affinity),
+        )
+        sts["spec"]["template"]["spec"]["topologySpreadConstraints"] = spread
+        stss.append(sts)
+    return nodes, stss

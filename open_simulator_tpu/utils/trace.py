@@ -1,0 +1,99 @@
+"""Tracing / profiling hooks.
+
+The reference has none (SURVEY.md §5: no pprof, no OpenTelemetry; only
+vendored scheduler metrics that are never scraped). Here per-phase
+wall-clock is first-class: every scheduling run records named phases
+(encode / compile+scan / decode / replay / report ...) into a
+process-local trace that can be printed as JSON (`simon apply
+--trace`), and an optional JAX profiler capture can wrap any phase for
+TPU-level analysis (`SIMON_PROFILE_DIR=... ` -> TensorBoard trace).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+
+
+@dataclass
+class PhaseRecord:
+    name: str
+    seconds: float
+    count: int = 1
+
+
+@dataclass
+class Trace:
+    phases: Dict[str, PhaseRecord] = field(default_factory=dict)
+    order: List[str] = field(default_factory=list)
+
+    def add(self, name: str, seconds: float):
+        with _lock:
+            rec = self.phases.get(name)
+            if rec is None:
+                self.phases[name] = PhaseRecord(name, seconds)
+                self.order.append(name)
+            else:
+                rec.seconds += seconds
+                rec.count += 1
+
+    def reset(self):
+        with _lock:
+            self.phases.clear()
+            self.order.clear()
+
+    def as_dict(self) -> dict:
+        return {
+            "phases": [
+                {
+                    "name": n,
+                    "seconds": round(self.phases[n].seconds, 6),
+                    "count": self.phases[n].count,
+                }
+                for n in self.order
+            ],
+            "total_seconds": round(sum(p.seconds for p in self.phases.values()), 6),
+        }
+
+    def as_json(self) -> str:
+        return json.dumps(self.as_dict())
+
+
+# process-wide trace; callers that need isolation use Trace() directly
+GLOBAL = Trace()
+
+
+@contextmanager
+def phase(name: str, trace: Optional[Trace] = None):
+    """Record wall-clock of the enclosed block under `name`."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        (trace or GLOBAL).add(name, time.perf_counter() - t0)
+
+
+@contextmanager
+def profiled(name: str, trace: Optional[Trace] = None):
+    """phase() + a JAX profiler capture when SIMON_PROFILE_DIR is set.
+
+    The capture lands in $SIMON_PROFILE_DIR/<name>/ and is viewable in
+    TensorBoard / Perfetto (jax.profiler.trace)."""
+    profile_dir = os.environ.get("SIMON_PROFILE_DIR")
+    if not profile_dir:
+        with phase(name, trace):
+            yield
+        return
+    import jax
+
+    target = os.path.join(profile_dir, name.replace("/", "_"))
+    with phase(name, trace):
+        with jax.profiler.trace(target):
+            yield

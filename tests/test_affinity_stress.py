@@ -1,0 +1,71 @@
+"""The InterPodAffinity/topology-spread-heavy benchmark scenario
+(BASELINE.md configs: "100 StatefulSets + topology-spread"), exercised
+at CI scale: engine-vs-oracle conformance plus invariant checks of the
+constraints themselves."""
+
+from collections import Counter
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.testing import build_affinity_stress
+
+
+def _run(engine, nodes, stss):
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    reset_name_counter()
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    res = ResourceTypes()
+    res.stateful_sets = stss
+    return simulate(cluster, [AppResource("stress", res)], engine=engine)
+
+
+def _placements(result):
+    return {
+        p["metadata"]["name"]: ns.node["metadata"]["name"]
+        for ns in result.node_status
+        for p in ns.pods
+    }
+
+
+def test_affinity_stress_conformance():
+    nodes, stss = build_affinity_stress(n_nodes=16, n_sts=8, replicas=4, zones=4)
+    res_o = _run("oracle", nodes, stss)
+    res_t = _run("tpu", nodes, stss)
+    assert not res_o.unscheduled_pods
+    assert not res_t.unscheduled_pods
+    po, pt = _placements(res_o), _placements(res_t)
+    assert po == pt
+
+
+def test_affinity_stress_constraints_hold():
+    nodes, stss = build_affinity_stress(n_nodes=16, n_sts=8, replicas=4, zones=4)
+    res = _run("oracle", nodes, stss)
+    zone_of = {
+        n["metadata"]["name"]: n["metadata"]["labels"]["zone"] for n in nodes
+    }
+    per_app_node = Counter()
+    per_app_zone = {}
+    for ns in res.node_status:
+        node = ns.node["metadata"]["name"]
+        for p in ns.pods:
+            app = p["metadata"]["labels"]["app"]
+            per_app_node[(app, node)] += 1
+            per_app_zone.setdefault(app, Counter())[zone_of[node]] += 1
+    # required anti-affinity on hostname: one replica per node per app
+    assert all(v == 1 for v in per_app_node.values())
+    # DoNotSchedule zone spread with maxSkew 1
+    for app, zc in per_app_zone.items():
+        counts = [zc.get(f"z{z}", 0) for z in range(4)]
+        assert max(counts) - min(counts) <= 1, (app, counts)
+
+
+def test_affinity_stress_overflow_reports_reasons():
+    # more replicas than nodes: required hostname anti-affinity makes
+    # the surplus unschedulable with a spread/affinity reason
+    nodes, stss = build_affinity_stress(n_nodes=4, n_sts=1, replicas=6, zones=2)
+    res = _run("tpu", nodes, stss)
+    assert len(res.unscheduled_pods) == 2
+    for up in res.unscheduled_pods:
+        assert "affinity" in up.reason or "skew" in up.reason or "spread" in up.reason

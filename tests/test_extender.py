@@ -1,0 +1,231 @@
+"""HTTP scheduler extenders (scheduler/extender.py) against a local
+extender server, mirroring core/extender.go + generic_scheduler.go
+extender call sites."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.scheduler.extender import ExtenderConfig, HTTPExtender
+from open_simulator_tpu.testing import make_fake_node
+
+
+class _ExtenderServer:
+    """Filter: rejects nodes whose name contains 'banned'.
+    Prioritize: scores nodes by trailing index.
+    Bind: records bindings."""
+
+    def __init__(self):
+        self.bindings = []
+        self.calls = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                args = json.loads(self.rfile.read(length))
+                outer.calls.append(self.path)
+                if self.path == "/filter":
+                    nodes = (args.get("nodes") or {}).get("items") or []
+                    kept = [
+                        n
+                        for n in nodes
+                        if "banned" not in n["metadata"]["name"]
+                    ]
+                    failed = {
+                        n["metadata"]["name"]: "node is banned by extender"
+                        for n in nodes
+                        if "banned" in n["metadata"]["name"]
+                    }
+                    body = {"nodes": {"items": kept}, "failedNodes": failed}
+                elif self.path == "/prioritize":
+                    nodes = (args.get("nodes") or {}).get("items") or []
+                    body = [
+                        {
+                            "host": n["metadata"]["name"],
+                            "score": int(n["metadata"]["name"].rsplit("-", 1)[-1]),
+                        }
+                        for n in nodes
+                    ]
+                elif self.path == "/bind":
+                    outer.bindings.append((args["podName"], args["node"]))
+                    body = {}
+                else:
+                    body = {"error": f"unknown verb {self.path}"}
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _cluster(names):
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node(n, "8", "16Gi") for n in names]
+    return cluster
+
+
+def _app(replicas=3):
+    res = ResourceTypes()
+    res.deployments = [
+        {
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "d"},
+            "spec": {
+                "replicas": replicas,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "img",
+                                "resources": {"requests": {"cpu": "1"}},
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ]
+    return [AppResource("web", res)]
+
+
+def test_extender_filter_and_prioritize():
+    srv = _ExtenderServer()
+    try:
+        ext = HTTPExtender(
+            ExtenderConfig(
+                url_prefix=srv.url,
+                filter_verb="filter",
+                prioritize_verb="prioritize",
+                weight=2,
+            )
+        )
+        # node-9 gets the highest extender score and wins despite equal
+        # resource scores; banned nodes never receive pods
+        res = simulate(
+            _cluster(["banned-5", "node-1", "node-9"]),
+            _app(replicas=1),
+            engine="tpu",  # downgraded to oracle because extenders
+            extenders=[ext],
+        )
+    finally:
+        srv.stop()
+    assert not res.unscheduled_pods
+    placed = {
+        ns.node["metadata"]["name"]: len(ns.pods) for ns in res.node_status
+    }
+    assert placed["banned-5"] == 0
+    assert placed["node-9"] == 1
+    assert "/filter" in srv.calls and "/prioritize" in srv.calls
+
+
+def test_extender_failure_reason_reported():
+    srv = _ExtenderServer()
+    try:
+        ext = HTTPExtender(
+            ExtenderConfig(url_prefix=srv.url, filter_verb="filter")
+        )
+        res = simulate(
+            _cluster(["banned-1", "banned-2"]), _app(replicas=1), extenders=[ext]
+        )
+    finally:
+        srv.stop()
+    assert len(res.unscheduled_pods) == 1
+    assert "banned by extender" in res.unscheduled_pods[0].reason
+
+
+def test_extender_binder_delegation():
+    srv = _ExtenderServer()
+    try:
+        ext = HTTPExtender(
+            ExtenderConfig(url_prefix=srv.url, bind_verb="bind")
+        )
+        res = simulate(_cluster(["node-1"]), _app(replicas=2), extenders=[ext])
+    finally:
+        srv.stop()
+    assert not res.unscheduled_pods
+    assert len(srv.bindings) == 2
+    assert all(node == "node-1" for _pod, node in srv.bindings)
+
+
+def test_extender_managed_resources_gate():
+    srv = _ExtenderServer()
+    try:
+        ext = HTTPExtender(
+            ExtenderConfig(
+                url_prefix=srv.url,
+                filter_verb="filter",
+                managed_resources=["example.com/fpga"],
+            )
+        )
+        # pod does not request the managed resource: extender not called,
+        # banned node is usable
+        res = simulate(_cluster(["banned-1"]), _app(replicas=1), extenders=[ext])
+    finally:
+        srv.stop()
+    assert not res.unscheduled_pods
+    assert srv.calls == []
+
+
+def test_extender_down_ignorable_vs_fatal():
+    cfg = ExtenderConfig(
+        url_prefix="http://127.0.0.1:1",  # nothing listens
+        filter_verb="filter",
+        http_timeout_s=0.2,
+    )
+    # non-ignorable: the pod's scheduling cycle fails (not the whole
+    # simulation), mirroring scheduleOne's error path
+    res = simulate(_cluster(["node-1"]), _app(replicas=1), extenders=[HTTPExtender(cfg)])
+    assert len(res.unscheduled_pods) == 1
+    assert "extender" in res.unscheduled_pods[0].reason
+
+    cfg.ignorable = True
+    res = simulate(_cluster(["node-1"]), _app(replicas=1), extenders=[HTTPExtender(cfg)])
+    assert not res.unscheduled_pods
+
+
+def test_extenders_from_scheduler_config(tmp_path):
+    import yaml
+
+    from open_simulator_tpu.scheduler.extender import extenders_from_scheduler_config
+
+    path = tmp_path / "sched.yaml"
+    path.write_text(
+        yaml.safe_dump(
+            {
+                "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+                "kind": "KubeSchedulerConfiguration",
+                "extenders": [
+                    {
+                        "urlPrefix": "http://e1:8888/api",
+                        "filterVerb": "filter",
+                        "weight": 3,
+                        "nodeCacheCapable": True,
+                        "managedResources": [{"name": "example.com/fpga"}],
+                    }
+                ],
+            }
+        )
+    )
+    exts = extenders_from_scheduler_config(str(path))
+    assert len(exts) == 1
+    assert exts[0].config.weight == 3
+    assert exts[0].config.node_cache_capable
+    assert exts[0].config.managed_resources == ["example.com/fpga"]

@@ -1,0 +1,237 @@
+"""Live-cluster import (models/kubeclient.py) against a local fake
+apiserver, mirroring CreateClusterResourceFromClient
+(pkg/simulator/simulator.go:369-441)."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+import yaml
+
+from open_simulator_tpu.models.kubeclient import (
+    KubeClient,
+    KubeConfigError,
+    create_cluster_resource_from_client,
+)
+from open_simulator_tpu.testing import make_fake_node
+
+
+def _pod(name, phase="Running", owner_kind=None, deleting=False):
+    pod = {
+        "metadata": {"name": name, "namespace": "d"},
+        "spec": {"containers": [{"name": "c", "image": "img"}]},
+        "status": {"phase": phase},
+    }
+    if owner_kind:
+        pod["metadata"]["ownerReferences"] = [{"kind": owner_kind, "name": "o"}]
+    if deleting:
+        pod["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    return pod
+
+
+class _FakeApiServer:
+    """Serves the seven LIST endpoints; records auth headers."""
+
+    def __init__(self, pdb_version="v1beta1"):
+        self.seen_auth = []
+        outer = self
+
+        nodes = [make_fake_node("live-0", cpu="8", memory="16Gi")]
+        pods = [
+            _pod("static-ok"),
+            _pod("pending", phase="Pending"),
+            _pod("ds-owned", owner_kind="DaemonSet"),
+            _pod("rs-owned", owner_kind="ReplicaSet"),
+            _pod("terminating", deleting=True),
+        ]
+        self.routes = {
+            "/api/v1/nodes": ("NodeList", "v1", nodes),
+            "/api/v1/pods": ("PodList", "v1", pods),
+            f"/apis/policy/{pdb_version}/poddisruptionbudgets": (
+                "PodDisruptionBudgetList",
+                f"policy/{pdb_version}",
+                [{"metadata": {"name": "pdb-1", "namespace": "d"}, "spec": {}}],
+            ),
+            "/api/v1/services": ("ServiceList", "v1", []),
+            "/apis/storage.k8s.io/v1/storageclasses": (
+                "StorageClassList",
+                "storage.k8s.io/v1",
+                [{"metadata": {"name": "standard"}, "provisioner": "x"}],
+            ),
+            "/api/v1/persistentvolumeclaims": ("PersistentVolumeClaimList", "v1", []),
+            "/apis/apps/v1/daemonsets": ("DaemonSetList", "apps/v1", []),
+        }
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                outer.seen_auth.append(self.headers.get("Authorization"))
+                route = outer.routes.get(self.path)
+                if route is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b"{}")
+                    return
+                kind, api_version, items = route
+                body = json.dumps(
+                    {"kind": kind, "apiVersion": api_version, "items": items}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _write_kubeconfig(tmp_path, server, token="sekret", current="ctx"):
+    cfg = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": current,
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server}}],
+        "users": [{"name": "u", "user": {"token": token}}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_import_filters_pods_and_sets_kinds(tmp_path):
+    srv = _FakeApiServer()
+    try:
+        kc = _write_kubeconfig(tmp_path, srv.url)
+        res = create_cluster_resource_from_client(kc)
+    finally:
+        srv.stop()
+    assert [n["metadata"]["name"] for n in res.nodes] == ["live-0"]
+    # only Running, non-daemonset, non-terminating pods survive
+    assert sorted(p["metadata"]["name"] for p in res.pods) == ["rs-owned", "static-ok"]
+    assert all(p["kind"] == "Pod" for p in res.pods)
+    assert res.nodes[0]["kind"] == "Node"
+    assert [s["metadata"]["name"] for s in res.storage_classes] == ["standard"]
+    assert [p["metadata"]["name"] for p in res.pod_disruption_budgets] == ["pdb-1"]
+    # bearer token sent on every request
+    assert set(srv.seen_auth) == {"Bearer sekret"}
+
+
+def test_import_pdb_v1_fallback(tmp_path):
+    srv = _FakeApiServer(pdb_version="v1")
+    try:
+        kc = _write_kubeconfig(tmp_path, srv.url)
+        res = create_cluster_resource_from_client(kc)
+    finally:
+        srv.stop()
+    assert [p["metadata"]["name"] for p in res.pod_disruption_budgets] == ["pdb-1"]
+
+
+def test_kubeconfig_errors(tmp_path):
+    path = tmp_path / "kc"
+    path.write_text(yaml.safe_dump({"contexts": []}))
+    with pytest.raises(KubeConfigError, match="current-context"):
+        KubeClient(str(path))
+
+    path.write_text(yaml.safe_dump({"current-context": "nope", "contexts": []}))
+    with pytest.raises(KubeConfigError, match="not found"):
+        KubeClient(str(path))
+
+
+def test_kubeconfig_token_file_and_data_certs(tmp_path):
+    tok = tmp_path / "token"
+    tok.write_text("from-file\n")
+    cfg = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [
+            {
+                "name": "c",
+                "cluster": {
+                    "server": "https://example:6443",
+                    "insecure-skip-tls-verify": True,
+                },
+            }
+        ],
+        "users": [{"name": "u", "user": {"tokenFile": str(tok)}}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    client = KubeClient(str(path))
+    assert client.token == "from-file"
+    assert client._ssl_ctx is not None
+    client.close()
+
+
+def test_applier_end_to_end_with_kubeconfig(tmp_path):
+    from open_simulator_tpu.apply.applier import Applier, SimonConfig
+
+    srv = _FakeApiServer()
+    try:
+        kc = _write_kubeconfig(tmp_path, srv.url)
+        apps_dir = tmp_path / "app"
+        apps_dir.mkdir()
+        (apps_dir / "deploy.yaml").write_text(
+            yaml.safe_dump(
+                {
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "metadata": {"name": "web", "namespace": "d"},
+                    "spec": {
+                        "replicas": 2,
+                        "template": {
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "image": "img",
+                                        "resources": {
+                                            "requests": {"cpu": "1", "memory": "1Gi"}
+                                        },
+                                    }
+                                ]
+                            }
+                        },
+                    },
+                }
+            )
+        )
+        cfg_path = tmp_path / "simon.yaml"
+        cfg_path.write_text(
+            yaml.safe_dump(
+                {
+                    "apiVersion": "simon/v1alpha1",
+                    "kind": "Config",
+                    "metadata": {"name": "live"},
+                    "spec": {
+                        "cluster": {"kubeConfig": kc},
+                        "appList": [{"name": "web", "path": str(apps_dir)}],
+                    },
+                }
+            )
+        )
+        applier = Applier(SimonConfig.from_file(str(cfg_path)), engine="oracle")
+        result = applier.run()
+    finally:
+        srv.stop()
+    assert result.success
+    names = {
+        p["metadata"]["name"]
+        for ns in result.result.node_status
+        for p in ns.pods
+    }
+    assert "static-ok" in names
+    assert any(n.startswith("web-") for n in names)

@@ -1,0 +1,57 @@
+"""Per-phase tracing (utils/trace.py) — SURVEY.md §5: the reference has
+no tracing; the TPU build records per-phase wall-clock."""
+
+import json
+
+from open_simulator_tpu.utils.trace import GLOBAL, Trace, phase
+
+
+def test_phase_accumulates():
+    tr = Trace()
+    with phase("a", tr):
+        pass
+    with phase("a", tr):
+        pass
+    with phase("b", tr):
+        pass
+    d = tr.as_dict()
+    assert [p["name"] for p in d["phases"]] == ["a", "b"]
+    assert d["phases"][0]["count"] == 2
+    assert d["total_seconds"] >= 0
+    json.loads(tr.as_json())
+
+
+def test_engine_records_phases():
+    GLOBAL.reset()
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.scheduler.core import AppResource, simulate
+    from open_simulator_tpu.testing import make_fake_node
+
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node(f"n{i}", cpu="8", memory="16Gi") for i in range(3)]
+    res = ResourceTypes()
+    res.deployments = [
+        {
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "d"},
+            "spec": {
+                "replicas": 3,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "img",
+                                "resources": {"requests": {"cpu": "1"}},
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ]
+    out = simulate(cluster, [AppResource("web", res)], engine="tpu")
+    assert not out.unscheduled_pods
+    names = {p["name"] for p in GLOBAL.as_dict()["phases"]}
+    assert {"engine/encode", "engine/scan"} <= names
+    GLOBAL.reset()

@@ -157,6 +157,102 @@ class ApplyResult:
     message: str = ""
 
 
+def replay_scenario(sweep, count: int, placements):
+    """Rebuild host-side oracle state from one scenario's scan
+    placements (the same binding code the serial path uses — the
+    engine-replay contract of scheduler/engine.py), producing the
+    SimulateResult for reports. Returns (result, oracle)."""
+    from ..scheduler.core import NodeStatus, SimulateResult, UnscheduledPod
+    from ..scheduler.oracle import Oracle
+
+    nodes = [ns.node for ns in sweep.oracle.nodes[: sweep.n_base + count]]
+    oracle = Oracle(nodes)
+    failed = []
+    for pod, idx in zip(sweep.pods, placements):
+        idx = int(idx)
+        if idx == -2:  # inactive in this scenario (disabled-node ds pod)
+            continue
+        name = (pod.get("spec") or {}).get("nodeName")
+        if name:
+            if name in oracle.node_index:
+                oracle.place_existing_pod(pod)
+            # else dangling: kept in the tracker, never scheduled
+            # (reference simulator.go:221-229)
+        elif idx < 0:
+            _, reasons = oracle._find_feasible(pod)
+            failed.append(
+                UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reasons))
+            )
+        else:
+            oracle._reserve_and_bind(pod, oracle.nodes[idx])
+    status = [NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes]
+    return SimulateResult(unscheduled_pods=failed, node_status=status), oracle
+
+
+def probe_plan(
+    cluster,
+    apps,
+    new_node,
+    use_greed: bool = False,
+    extended_resources: Optional[List[str]] = None,
+    max_count: int = MAX_NUM_NEW_NODE,
+) -> ApplyResult:
+    """Fast capacity plan: encode the padded cluster once, start at the
+    aggregate-resource lower bound, bisect over candidate counts (each
+    probe = one masked scan), and replay the winning scan's placements
+    into host state for the report — no second full simulation
+    (replaces the reference's per-guess re-simulation loop,
+    pkg/apply/apply.go:186-239)."""
+    from ..parallel.sweep import CapacitySweep
+    from ..utils.trace import phase
+
+    sweep = CapacitySweep(cluster, apps, new_node, max_count, use_greed=use_greed)
+    max_cpu, max_mem, max_vg = _resource_caps()
+
+    def feasible(res) -> bool:
+        # int-truncate like satisfyResourceSetting (apply.go:680-681)
+        return (
+            res.unscheduled == 0
+            and int(res.cpu_util) <= max_cpu
+            and int(res.mem_util) <= max_mem
+            and int(res.vg_util) <= max_vg
+        )
+
+    with phase("apply/lower-bound"):
+        start = sweep.lower_bound(max_cpu, max_mem, max_vg)
+    with phase("apply/probe-search"):
+        best = sweep.find_min_count(feasible, start=start)
+    if best is None:
+        res = sweep.probe(max_count)
+        result, _ = replay_scenario(sweep, max_count, res.placements)
+        message = (
+            f"{len(result.unscheduled_pods)} pod(s) cannot be scheduled "
+            f"even with {max_count} new node(s)"
+            if result.unscheduled_pods
+            else satisfy_resource_setting(result.node_status)[1]
+        )
+        return ApplyResult(
+            success=False, new_node_count=max_count, result=result, message=message
+        )
+    with phase("apply/replay"):
+        result, _ = replay_scenario(sweep, best.count, best.placements)
+    # authoritative host-side check of the caps on real state
+    ok, reason = satisfy_resource_setting(result.node_status)
+    if result.unscheduled_pods or not ok:  # pragma: no cover - defensive
+        raise RuntimeError(
+            "probe replay disagreed with scan: "
+            + (reason or f"{len(result.unscheduled_pods)} unscheduled")
+        )
+    with phase("apply/report"):
+        report_text = report(result.node_status, extended_resources or [])
+    return ApplyResult(
+        success=True,
+        new_node_count=best.count,
+        result=result,
+        report_text=report_text,
+    )
+
+
 class Applier:
     def __init__(
         self,
@@ -229,14 +325,21 @@ class Applier:
         )
 
     def run(self, select_apps=None) -> ApplyResult:
-        from ..utils.trace import phase
+        from ..utils.trace import GLOBAL, phase
 
+        # per-run phase times, not cumulative across runs in one process
+        GLOBAL.reset()
         with phase("apply/load"):
             cluster = self.load_cluster()
             apps = self.load_apps()
             if select_apps is not None:
                 apps = [a for a in apps if a.name in select_apps]
             new_node = self.load_new_node()
+
+        if self.use_sweep and new_node is not None and self.engine == "tpu":
+            fast = self._plan_with_probes(cluster, apps, new_node)
+            if fast is not None:
+                return fast
 
         start_count = 0
         if self.use_sweep and new_node is not None:
@@ -278,6 +381,25 @@ class Applier:
         return ApplyResult(
             success=False, new_node_count=max_count, result=result, message=message
         )
+
+    def _plan_with_probes(self, cluster, apps, new_node) -> Optional[ApplyResult]:
+        """Returns None to fall back to the serial loop (e.g. when the
+        batched path cannot encode the input)."""
+        import logging
+
+        try:
+            return probe_plan(
+                cluster,
+                apps,
+                new_node,
+                use_greed=self.use_greed,
+                extended_resources=self.extended_resources,
+            )
+        except Exception as e:  # pragma: no cover - diagnostic path
+            logging.getLogger(__name__).warning(
+                "batched capacity plan failed, falling back to serial escalation: %s", e
+            )
+            return None
 
     def _sweep_min_count(self, cluster, apps, new_node) -> Optional[int]:
         """One batched sweep over all candidate counts; returns the

@@ -33,6 +33,9 @@ import numpy as np
 from ..models import labels as lbl
 from ..models import requests as req
 from ..models import storage as stor
+from .profiles import freeze as _freeze
+from .profiles import node_profiles as _shared_node_profiles
+from .profiles import uses_match_fields as _uses_match_fields
 from .terms import TermTables, build_term_tables
 from ..scheduler.oracle import (
     GpuState,
@@ -145,7 +148,7 @@ class PodBatch:
     image_score: np.ndarray  # [U, N] i64
 
 
-def _class_key(pod: dict) -> str:
+def _class_key(pod: dict):
     spec = pod.get("spec") or {}
     meta = pod.get("metadata") or {}
     anno = meta.get("annotations") or {}
@@ -177,7 +180,7 @@ def _class_key(pod: dict) -> str:
         "local_storage": anno.get(stor.ANNO_POD_LOCAL_STORAGE),
         "owner_kind": (ctrl or {}).get("kind"),
     }
-    return json.dumps(key, sort_keys=True, default=str)
+    return _freeze(key)
 
 
 def encode_cluster(oracle: Oracle) -> ClusterStatic:
@@ -343,6 +346,53 @@ def _ports_conflict_pair(a: tuple, b: tuple) -> bool:
     return aip == "0.0.0.0" or bip == "0.0.0.0" or aip == bip
 
 
+def _image_scores_by_profile(
+    pod: dict, oracle: Oracle, rep_idx, profile_counts
+) -> np.ndarray:
+    """ImageLocality raw scores per node profile (mirrors
+    Oracle._score_image_locality bit for bit; image spread counts come
+    from profile counts instead of a scan over every node)."""
+    containers = (pod.get("spec") or {}).get("containers") or []
+    nc = len(rep_idx)
+    if not containers:
+        return np.zeros(nc, dtype=np.int64)
+    total_nodes = len(oracle.nodes)
+    wanted = set()
+    norm_names = []
+    for c in containers:
+        name = c.get("image", "")
+        if ":" not in name.rsplit("/", 1)[-1]:
+            name = name + ":latest"
+        wanted.add(name)
+        norm_names.append(name)
+    # per-profile image presence/size
+    rep_images: List[dict] = []
+    for r in rep_idx:
+        images = {}
+        for img in ((oracle.nodes[int(r)].node.get("status") or {}).get("images")) or []:
+            size = int(img.get("sizeBytes", 0))
+            for name in img.get("names") or []:
+                if name in wanted:
+                    images[name] = size
+        rep_images.append(images)
+    spread: Dict[str, int] = {w: 0 for w in wanted}
+    for c_i, images in enumerate(rep_images):
+        for name in images:
+            spread[name] += int(profile_counts[c_i])
+    out = np.zeros(nc, dtype=np.int64)
+    max_threshold = IMG_MAX_CONTAINER_THRESHOLD * len(containers)
+    for c_i, images in enumerate(rep_images):
+        s = 0
+        for name in norm_names:
+            if name in images:
+                s += int(images[name] * (spread[name] / total_nodes))
+        s = min(max(s, IMG_MIN_THRESHOLD), max_threshold)
+        out[c_i] = (
+            MAX_NODE_SCORE * (s - IMG_MIN_THRESHOLD) // (max_threshold - IMG_MIN_THRESHOLD)
+        )
+    return out
+
+
 def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> PodBatch:
     """Build class-deduplicated static tensors for a pod batch."""
     # port vocabulary over batch + existing usage
@@ -415,6 +465,11 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
     avoid_score = np.zeros((u, n), dtype=np.int64)
     image_score = np.zeros((u, n), dtype=np.int64)
 
+    node_class_of, rep_idx = _shared_node_profiles(
+        [ns.node for ns in oracle.nodes], class_pods
+    )
+    profile_counts = np.bincount(node_class_of, minlength=len(rep_idx))
+
     for u_i, pod in enumerate(class_pods):
         spec = pod.get("spec") or {}
         requests = req.pod_requests(pod)
@@ -465,39 +520,74 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
             tolerations,
             {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"},
         )
-        simon_req = {name: float(requests.get(name, Fraction(0))) for name in cluster.simon_resources}
         simon_empty = not requests and not req.pod_limits(pod)
 
-        for n_i, ns in enumerate(oracle.nodes):
+        # label/taint feasibility + static scores, evaluated once per
+        # node profile (per node when the class reads node names)
+        if _uses_match_fields(spec):
+            dom = np.arange(n, dtype=np.int64)
+            inv = None
+        else:
+            dom = rep_idx
+            inv = node_class_of
+        nd = len(dom)
+        ok_d = np.empty(nd, dtype=bool)
+        aff_d = np.empty(nd, dtype=np.int64)
+        intol_d = np.empty(nd, dtype=np.int64)
+        for j in range(nd):
+            ns = oracle.nodes[int(dom[j])]
             node = ns.node
             nspec = node.get("spec") or {}
+            taints = nspec.get("taints") or []
             ok = True
             if nspec.get("unschedulable") and not unsched_tolerated:
                 ok = False
             if ok and unknown_scalar:
                 ok = False
-            if ok and lbl.find_untolerated_taint(nspec.get("taints") or [], tolerations):
+            if ok and lbl.find_untolerated_taint(taints, tolerations):
                 ok = False
             if ok and not lbl.pod_matches_node_selector_and_affinity(spec, node):
                 ok = False
-            static_feasible[u_i, n_i] = ok
-            nodeaff_raw[u_i, n_i] = lbl.preferred_node_affinity_score(spec, node)
-            taint_intol[u_i, n_i] = lbl.count_intolerable_prefer_no_schedule(
-                nspec.get("taints") or [], tolerations
+            ok_d[j] = ok
+            aff_d[j] = lbl.preferred_node_affinity_score(spec, node)
+            intol_d[j] = lbl.count_intolerable_prefer_no_schedule(taints, tolerations)
+        if inv is None:
+            static_feasible[u_i] = ok_d
+            nodeaff_raw[u_i] = aff_d
+            taint_intol[u_i] = intol_d
+            avoid_score[u_i] = _avoid_scores(pod, oracle)
+            image_score[u_i] = _image_scores(pod, oracle)
+        else:
+            static_feasible[u_i] = ok_d[inv]
+            nodeaff_raw[u_i] = aff_d[inv]
+            taint_intol[u_i] = intol_d[inv]
+            rep_states = [oracle.nodes[int(r)] for r in rep_idx]
+            avoid_score[u_i] = np.asarray(
+                Oracle._score_prefer_avoid_pods(oracle, pod, rep_states),
+                dtype=np.int64,
+            )[inv]
+            image_score[u_i] = _image_scores_by_profile(
+                pod, oracle, rep_idx, profile_counts
+            )[inv]
+
+        # Simon raw share (static: pod annotations never enter podReq),
+        # vectorized over the node axis (plugin/simon.go:44-67 semantics)
+        if simon_empty:
+            simon_raw[u_i] = MAX_NODE_SCORE
+        else:
+            pr = np.array(
+                [float(requests.get(name, Fraction(0))) for name in cluster.simon_resources],
+                dtype=np.float64,
             )
-            # Simon raw share (static: pod annotations never enter podReq)
-            if simon_empty:
-                simon_raw[u_i, n_i] = MAX_NODE_SCORE
-            else:
-                res = 0.0
-                for r_i, name in enumerate(cluster.simon_resources):
-                    pr = simon_req[name]
-                    avail = cluster.simon_alloc[r_i, n_i] - pr
-                    share = (0.0 if pr == 0 else 1.0) if avail == 0 else pr / avail
-                    res = max(res, share)
-                simon_raw[u_i, n_i] = int(MAX_NODE_SCORE * res)
-        avoid_score[u_i] = _avoid_scores(pod, oracle)
-        image_score[u_i] = _image_scores(pod, oracle)
+            avail = cluster.simon_alloc - pr[:, None]  # [R, N]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                share = np.where(
+                    avail == 0.0,
+                    (pr != 0.0).astype(np.float64)[:, None],
+                    pr[:, None] / avail,
+                )
+            res = np.maximum(share.max(axis=0), 0.0) if len(pr) else np.zeros(n)
+            simon_raw[u_i] = (MAX_NODE_SCORE * res).astype(np.int64)
 
     # out-of-tree custom plugins: stateless verdicts folded per class
     # (the engine-side analogue of WithFrameworkOutOfTreeRegistry)
@@ -519,7 +609,7 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
                 else:
                     custom_raw[k_i, u_i, n_i] = int(plugin.score(pod, ns.node))
 
-    terms = build_term_tables(oracle, class_pods)
+    terms = build_term_tables(oracle, class_pods, profiles=(node_class_of, rep_idx))
 
     return PodBatch(
         p=len(pods),
@@ -551,6 +641,32 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
         taint_intol=taint_intol,
         avoid_score=avoid_score,
         image_score=image_score,
+    )
+
+
+def features_of_batch(cluster: ClusterStatic, batch: PodBatch):
+    """ScanFeatures from the host-side encodings — same result as
+    scan.features_of(static, pinned) but without device->host transfers
+    (the arrays are still numpy here)."""
+    from .scan import ScanFeatures
+
+    t = batch.terms
+    return ScanFeatures(
+        gpu=bool(batch.gpu_mem.max(initial=0) > 0),
+        storage=bool(batch.wants_storage.any()),
+        ipa=bool((t.cls_rows >= 0).any() or (t.cls_group_id >= 0).any()),
+        hard_spread=bool((t.cls_h_rows >= 0).any()),
+        soft_spread=bool((t.cls_s_rows >= 0).any()),
+        ports=bool(batch.want_ports.any()),
+        scalars=cluster.scalar_alloc.shape[0] > 0,
+        custom=bool((batch.custom_weight != 0).any()),
+        pins=bool((batch.pinned_node >= 0).any()),
+        custom_spec=tuple(
+            zip(
+                (int(m) for m in batch.custom_mode),
+                (int(w) for w in batch.custom_weight),
+            )
+        ),
     )
 
 

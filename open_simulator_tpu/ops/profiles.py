@@ -1,0 +1,119 @@
+"""Node-profile deduplication for host-side encoding.
+
+The reference evaluates every (pod, node) pair on 16 goroutines
+(vendor/.../parallelize/parallelism.go). Here the host encode collapses
+both axes: pods dedup into classes (ops/encode.py) and nodes dedup into
+*profiles* — the tuple of node attributes the batch's static encodings
+actually read (referenced labels, taints, unschedulable, preferAvoid
+annotation, images). All label/taint feasibility and static scoring run
+once per (class, profile) and scatter back to [U, N].
+
+Pod classes whose node affinity uses matchFields read node *names*,
+which profiles exclude — those classes fall back to per-node work
+(daemonset pods pin via matchFields, models/workloads.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = [
+    "freeze",
+    "referenced_label_keys",
+    "node_profile_key",
+    "node_profiles",
+    "uses_match_fields",
+]
+
+
+def freeze(obj):
+    """Recursively convert YAML-shaped data into a hashable tuple tree
+    (dicts sorted by key). ~4x faster than json.dumps for dedup keys."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(freeze(v) for v in obj)
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
+def referenced_label_keys(class_pods: List[dict]):
+    """(value_keys, presence_keys): label keys whose values the batch's
+    selectors/affinity expressions read, and keys where only *presence*
+    matters (spread topology keys — their values feed the term tables
+    per node directly, never through profiles). Restricting node
+    profiles to these lets nodes that differ only in unreferenced
+    labels (e.g. the per-node hostname label) share a profile."""
+    value_keys = set()
+    presence_keys = set()
+    for pod in class_pods:
+        spec = pod.get("spec") or {}
+        value_keys.update((spec.get("nodeSelector") or {}).keys())
+        aff = spec.get("affinity") or {}
+        node_aff = aff.get("nodeAffinity") or {}
+        req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+        terms = list(req.get("nodeSelectorTerms") or [])
+        for wt in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            terms.append(wt.get("preference") or {})
+        for term in terms:
+            for e in term.get("matchExpressions") or []:
+                value_keys.add(e.get("key"))
+        for c in spec.get("topologySpreadConstraints") or []:
+            presence_keys.add(c.get("topologyKey", ""))
+    return frozenset(value_keys), frozenset(presence_keys - value_keys)
+
+
+def node_profile_key(node: dict, value_keys: frozenset, presence_keys: frozenset):
+    """Everything the per-class static encodings read from a node except
+    its name."""
+    meta = node.get("metadata") or {}
+    spec = node.get("spec") or {}
+    status = node.get("status") or {}
+    labels = meta.get("labels") or {}
+    return freeze(
+        [
+            {k: labels[k] for k in value_keys if k in labels},
+            sorted(k for k in presence_keys if k in labels),
+            spec.get("taints"),
+            bool(spec.get("unschedulable")),
+            (meta.get("annotations") or {}).get(
+                "scheduler.alpha.kubernetes.io/preferAvoidPods"
+            ),
+            status.get("images"),
+        ]
+    )
+
+
+def node_profiles(nodes: List[dict], class_pods: List[dict]):
+    """Returns (node_class_of[N] i32, rep_idx[NC] node indices)."""
+    value_keys, presence_keys = referenced_label_keys(class_pods)
+    prof_ids: Dict[object, int] = {}
+    n = len(nodes)
+    node_class_of = np.empty(n, dtype=np.int32)
+    rep_idx: List[int] = []
+    for n_i, node in enumerate(nodes):
+        key = node_profile_key(node, value_keys, presence_keys)
+        cid = prof_ids.get(key)
+        if cid is None:
+            cid = len(rep_idx)
+            prof_ids[key] = cid
+            rep_idx.append(n_i)
+        node_class_of[n_i] = cid
+    return node_class_of, np.asarray(rep_idx, dtype=np.int64)
+
+
+def uses_match_fields(spec: dict) -> bool:
+    """matchFields terms read node names, which the node-profile dedup
+    deliberately excludes."""
+    aff = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    req = aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    for term in req.get("nodeSelectorTerms") or []:
+        if term.get("matchFields"):
+            return True
+    for wt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        if (wt.get("preference") or {}).get("matchFields"):
+            return True
+    return False

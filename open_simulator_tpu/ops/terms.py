@@ -165,10 +165,12 @@ def _spread_constraints(pod: dict, mode: str) -> list:
     return out
 
 
-def build_term_tables(oracle, class_pods: List[dict]) -> TermTables:
+def build_term_tables(oracle, class_pods: List[dict], profiles=None) -> TermTables:
     """Construct the tables from the batch classes + existing pods.
 
     class_pods: one representative pod dict per class.
+    profiles: optional (node_class_of, rep_idx) from ops/profiles.py,
+    to share the node-profile dedup with encode_batch.
     """
     nodes = [ns.node for ns in oracle.nodes]
     n = len(nodes)
@@ -193,22 +195,25 @@ def build_term_tables(oracle, class_pods: List[dict]) -> TermTables:
             c["row"] = b.row(c["selector"], c["ns"], c["key"])
 
     # -- topology values ---------------------------------------------------
-    # (vocabs must be fully populated before sizing V)
+    # one pass per distinct topology key (not per row): nodes are read
+    # once per key, rows sharing the key share the value column
+    node_labels = [((node.get("metadata") or {}).get("labels") or {}) for node in nodes]
+    key_vals: Dict[str, np.ndarray] = {}
     for row in b.rows:
-        for n_i, node in enumerate(nodes):
-            labels = (node.get("metadata") or {}).get("labels") or {}
+        if row.topo_key in key_vals:
+            continue
+        vals = np.full(n, -1, dtype=np.int32)
+        for n_i, labels in enumerate(node_labels):
             if row.topo_key in labels:
-                b.value_id(row.topo_key, labels[row.topo_key], n_i)
+                vals[n_i] = b.value_id(row.topo_key, labels[row.topo_key], n_i)
+        key_vals[row.topo_key] = vals
     t = max(len(b.rows), 1)
     v_vocab = max((len(vv) for vv in b.key_vocab.values()), default=0)
     v = max(v_vocab, n if b.has_hostname else 0, 1)
 
     topo_val = np.full((t, n), -1, dtype=np.int32)
     for t_i, row in enumerate(b.rows):
-        for n_i, node in enumerate(nodes):
-            labels = (node.get("metadata") or {}).get("labels") or {}
-            if row.topo_key in labels:
-                topo_val[t_i, n_i] = b.value_id(row.topo_key, labels[row.topo_key], n_i)
+        topo_val[t_i] = key_vals[row.topo_key]
 
     # -- per-class match/carry --------------------------------------------
     match = np.zeros((t, u), dtype=bool)
@@ -284,6 +289,53 @@ def build_term_tables(oracle, class_pods: List[dict]) -> TermTables:
         idx = np.nonzero(group_of_row == g)[0]
         cls_group_rows[u_i, : len(idx)] = idx
 
+    # -- per-class node masks (profile-deduplicated) ----------------------
+    # selector/affinity match and topo-key presence run once per node
+    # profile and scatter to [N] (ops/profiles.py)
+    from .profiles import node_profiles, uses_match_fields
+
+    if profiles is not None:
+        prof_of, prof_reps = profiles
+    else:
+        prof_of, prof_reps = node_profiles(nodes, class_pods)
+    _match_cache: Dict[int, np.ndarray] = {}
+
+    def _sel_match_mask(u_i: int) -> np.ndarray:
+        """bool[N]: nodes passing the class's nodeSelector + required
+        node affinity (filtering.go:231-247 candidate filtering)."""
+        m = _match_cache.get(u_i)
+        if m is not None:
+            return m
+        spec = class_pods[u_i].get("spec") or {}
+        if uses_match_fields(spec):
+            m = np.fromiter(
+                (lbl.pod_matches_node_selector_and_affinity(spec, node) for node in nodes),
+                bool,
+                n,
+            )
+        else:
+            ok = np.fromiter(
+                (
+                    lbl.pod_matches_node_selector_and_affinity(spec, nodes[int(r)])
+                    for r in prof_reps
+                ),
+                bool,
+                len(prof_reps),
+            )
+            m = ok[prof_of]
+        _match_cache[u_i] = m
+        return m
+
+    def _haskeys_mask(constraints: list) -> np.ndarray:
+        """bool[N]: node has every constraint's topology key."""
+        keys = [c["key"] for c in constraints]
+        ok = np.fromiter(
+            (all(k in node_labels[int(r)] for k in keys) for r in prof_reps),
+            bool,
+            len(prof_reps),
+        )
+        return ok[prof_of]
+
     # -- hard spread constraint instances ---------------------------------
     h_entries: Dict[tuple, int] = {}
     h_list: List[dict] = []
@@ -291,22 +343,15 @@ def build_term_tables(oracle, class_pods: List[dict]) -> TermTables:
     for u_i, constraints in enumerate(cls_hard):
         if not constraints:
             continue
-        pod = class_pods[u_i]
-        spec = pod.get("spec") or {}
         # candidate nodes: pass pod's nodeSelector/affinity AND have
         # every constraint key (filtering.go:231-247)
-        cand_nodes = []
-        for n_i, node in enumerate(nodes):
-            if not lbl.pod_matches_node_selector_and_affinity(spec, node):
-                continue
-            labels = (node.get("metadata") or {}).get("labels") or {}
-            if all(c["key"] in labels for c in constraints):
-                cand_nodes.append(n_i)
+        cand_mask = _sel_match_mask(u_i) & _haskeys_mask(constraints)
+        cand_nodes = np.nonzero(cand_mask)[0]
         for c in constraints:
             key = (
                 c["row"],
                 c["max_skew"],
-                tuple(cand_nodes),
+                cand_mask.tobytes(),
                 _selector_key(c["selector"]),
             )
             if key not in h_entries:
@@ -340,18 +385,11 @@ def build_term_tables(oracle, class_pods: List[dict]) -> TermTables:
     for u_i, constraints in enumerate(cls_soft):
         if not constraints:
             continue
-        pod = class_pods[u_i]
-        spec = pod.get("spec") or {}
         # qualifying nodes for counting (scoring.go processAllNode):
         # nodeSelector/affinity AND all soft keys present
-        q = np.zeros(n, dtype=bool)
-        for n_i, node in enumerate(nodes):
-            labels = (node.get("metadata") or {}).get("labels") or {}
-            if not all(c["key"] in labels for c in constraints):
-                cls_s_haskeys[u_i, n_i] = False
-                continue
-            if lbl.pod_matches_node_selector_and_affinity(spec, node):
-                q[n_i] = True
+        haskeys = _haskeys_mask(constraints)
+        cls_s_haskeys[u_i] = haskeys
+        q = haskeys & _sel_match_mask(u_i)
         for c in constraints:
             key = (c["row"], c["max_skew"], q.tobytes())
             if key not in s_entries:

@@ -44,6 +44,19 @@ class SweepResult:
     placements: np.ndarray  # [Sc, P] node index / -1 / -2(inactive)
     pods: List[dict]
     node_names: List[str]
+    vg_util: Optional[np.ndarray] = None  # [Sc] percent (0 when no VGs)
+
+
+@dataclass
+class ProbeResult:
+    """One capacity scenario, evaluated by a single masked scan."""
+
+    count: int
+    unscheduled: int
+    cpu_util: float
+    mem_util: float
+    vg_util: float
+    placements: np.ndarray  # [P] node index / -1 / -2(inactive)
 
 
 def _new_nodes(spec: dict, count: int) -> List[dict]:
@@ -68,6 +81,332 @@ def _daemonset_target(pod: dict) -> Optional[str]:
     return None
 
 
+class CapacitySweep:
+    """Encode-once / probe-many capacity search.
+
+    The cluster is padded with `max_count` candidate nodes exactly once;
+    every probe is a single masked scan with a different node-validity
+    mask — same shapes, so XLA compiles one executable for every count
+    (the reference re-runs the whole simulation per guess,
+    pkg/apply/apply.go:186-239).
+    """
+
+    def __init__(
+        self,
+        cluster: ResourceTypes,
+        apps: List[AppResource],
+        new_node_spec: Optional[dict],
+        max_count: int,
+        use_greed: bool = False,
+    ):
+        from ..ops.encode import (
+            encode_batch,
+            encode_cluster,
+            encode_dynamic,
+            features_of_batch,
+            to_scan_static,
+            to_scan_state,
+        )
+        from ..utils.trace import phase
+
+        self.max_count = max_count if new_node_spec is not None else 0
+        padded = cluster.copy()
+        padded.nodes = list(padded.nodes) + _new_nodes(new_node_spec, self.max_count)
+
+        # Build oracle at full padding; generate the full pod sequence
+        # the serial path would see (cluster pods, then apps in order).
+        with phase("sweep/expand"):
+            self.oracle = Oracle(padded.nodes)
+            pods: List[dict] = []
+            pods.extend(wl.pods_excluding_daemon_sets(padded))
+            for ds in padded.daemon_sets:
+                pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
+            for app in apps:
+                app_pods = wl.generate_valid_pods_from_app(
+                    app.name, app.resource, padded.nodes
+                )
+                if use_greed:
+                    # same ordering the authoritative serial run will
+                    # use (scheduler/core.py schedule_app): greed_sort
+                    # ignores simon new nodes, so max-count padding and
+                    # the per-count serial cluster sort pods identically
+                    from ..scheduler.queues import greed_sort
+
+                    app_pods = greed_sort(padded.nodes, app_pods)
+                pods.extend(_sort_app_pods(app_pods))
+        self.pods = pods
+        self.n = len(padded.nodes)
+        self.n_base = self.n - self.max_count
+
+        with phase("sweep/encode"):
+            self.cluster_enc = encode_cluster(self.oracle)
+            self.batch = encode_batch(self.oracle, self.cluster_enc, pods)
+            self.dyn = encode_dynamic(self.oracle, self.cluster_enc)
+            self.static = to_scan_static(self.cluster_enc, self.batch)
+            self.init = to_scan_state(self.dyn, self.batch)
+            # derive features host-side: inside a jit/vmap trace
+            # features_of would fall back to the ungated ALL_FEATURES scan
+            self.features = features_of_batch(self.cluster_enc, self.batch)
+
+        # daemonset pods of disabled candidate nodes are inactive in
+        # that scenario (the reference regenerates them per run)
+        self._ds_target = np.full(len(pods), -1, dtype=np.int64)
+        name_to_idx = self.oracle.node_index
+        for p_i, pod in enumerate(pods):
+            target = _daemonset_target(pod)
+            if target is not None and target in name_to_idx:
+                self._ds_target[p_i] = name_to_idx[target]
+        self._probe_jit = None
+
+    # -- masks -------------------------------------------------------------
+
+    def node_valid(self, count: int) -> np.ndarray:
+        valid = np.ones(self.n, dtype=bool)
+        valid[self.n_base + count :] = False
+        return valid
+
+    def pod_active(self, valid: np.ndarray) -> np.ndarray:
+        active = np.ones(len(self.pods), dtype=bool)
+        tgt = self._ds_target
+        has_tgt = tgt >= 0
+        active[has_tgt] = valid[tgt[has_tgt]]
+        return active
+
+    # -- the compiled scenario ---------------------------------------------
+
+    def _scenario(self, valid, active):
+        import jax.numpy as jnp
+
+        from ..ops import scan as scan_ops
+
+        placements, final = scan_ops.run_scan_masked(
+            self.static,
+            self.init,
+            jnp.asarray(self.batch.class_of_pod),
+            jnp.asarray(self.batch.pinned_node),
+            valid,
+            active,
+            features=self.features,
+        )
+        unsched = jnp.sum(placements == -1)
+        denom_cpu = jnp.sum(jnp.where(valid, self.static.alloc_mcpu, 0))
+        denom_mem = jnp.sum(jnp.where(valid, self.static.alloc_mem, 0))
+        cpu_util = (
+            100.0 * jnp.sum(jnp.where(valid, final.used_mcpu, 0)) / jnp.maximum(denom_cpu, 1)
+        )
+        mem_util = (
+            100.0 * jnp.sum(jnp.where(valid, final.used_mem, 0)) / jnp.maximum(denom_mem, 1)
+        )
+        denom_vg = jnp.sum(jnp.where(valid[:, None], self.static.vg_cap, 0))
+        vg_util = (
+            100.0 * jnp.sum(jnp.where(valid[:, None], final.vg_used, 0)) / jnp.maximum(denom_vg, 1)
+        )
+        return placements, unsched, cpu_util, mem_util, vg_util
+
+    def probe(self, count: int) -> ProbeResult:
+        """Evaluate one candidate count (one masked scan)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.trace import phase
+
+        if self._probe_jit is None:
+            self._probe_jit = jax.jit(self._scenario)
+        valid = self.node_valid(count)
+        with phase("sweep/probe"):
+            placements, unsched, cpu, mem, vg = self._probe_jit(
+                jnp.asarray(valid), jnp.asarray(self.pod_active(valid))
+            )
+            placements = np.asarray(placements)
+        return ProbeResult(
+            count=count,
+            unscheduled=int(unsched),
+            cpu_util=float(cpu),
+            mem_util=float(mem),
+            vg_util=float(vg),
+            placements=placements,
+        )
+
+    def probe_many(self, counts: List[int], mesh=None) -> SweepResult:
+        """Evaluate many counts batched (vmap; scenario-sharded over a
+        device mesh when one is given)."""
+        import jax
+        import jax.numpy as jnp
+
+        sc = len(counts)
+        node_valid = np.stack([self.node_valid(c) for c in counts])
+        pod_active = np.stack([self.pod_active(v) for v in node_valid])
+        sweep_fn = jax.vmap(self._scenario)
+        valid_j = jnp.asarray(node_valid)
+        active_j = jnp.asarray(pod_active)
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = mesh.axis_names[0]
+            n_dev = mesh.devices.size
+            pad = (-sc) % n_dev
+            if pad:
+                valid_j = jnp.concatenate([valid_j, jnp.repeat(valid_j[-1:], pad, 0)])
+                active_j = jnp.concatenate([active_j, jnp.repeat(active_j[-1:], pad, 0)])
+            sharding = NamedSharding(mesh, P(axis))
+            valid_j = jax.device_put(valid_j, sharding)
+            active_j = jax.device_put(active_j, sharding)
+            out = jax.jit(sweep_fn, in_shardings=(sharding, sharding))(valid_j, active_j)
+            placements, unsched, cpu_util, mem_util, vg_util = (
+                np.asarray(o)[:sc] for o in out
+            )
+        else:
+            out = jax.jit(sweep_fn)(valid_j, active_j)
+            placements, unsched, cpu_util, mem_util, vg_util = (np.asarray(o) for o in out)
+
+        return SweepResult(
+            counts=list(counts),
+            unscheduled=unsched,
+            cpu_util=cpu_util,
+            mem_util=mem_util,
+            placements=placements,
+            pods=self.pods,
+            node_names=[ns.name for ns in self.oracle.nodes],
+            vg_util=vg_util,
+        )
+
+    # -- resource lower bound ----------------------------------------------
+
+    def lower_bound(self, max_cpu: int = 100, max_mem: int = 100, max_vg: int = 100) -> int:
+        """Smallest count not ruled out by aggregate resource totals and
+        utilization caps. Any count below it either leaves pods
+        unschedulable (sum of requests exceeds sum of allocatable) or
+        violates a cap, so the scheduling search can start here. Purely
+        arithmetic — no scan."""
+        b, c_enc, d = self.batch, self.cluster_enc, self.dyn
+        cls = b.class_of_pod
+        req = {
+            "mcpu": b.req_mcpu[cls].astype(np.int64),
+            "mem": b.req_mem[cls].astype(np.int64),
+            "eph": b.req_eph[cls].astype(np.int64),
+            "pods": np.ones(len(self.pods), dtype=np.int64),
+            "vg": b.lvm_sizes[cls].sum(axis=1).astype(np.int64),
+        }
+        alloc = {
+            "mcpu": c_enc.alloc_mcpu,
+            "mem": c_enc.alloc_mem,
+            "eph": c_enc.alloc_eph,
+            "pods": c_enc.alloc_pods,
+            "vg": c_enc.vg_cap.sum(axis=1),
+        }
+        base_used = {
+            "mcpu": int(d.used_mcpu.sum()),
+            "mem": int(d.used_mem.sum()),
+            "eph": int(d.used_eph.sum()),
+            "pods": int(d.pod_cnt.sum()),
+            "vg": int(d.vg_used.sum()),
+        }
+        for count in range(0, self.max_count + 1):
+            valid = self.node_valid(count)
+            active = self.pod_active(valid)
+            ok = True
+            for r in ("mcpu", "mem", "eph", "pods"):
+                if base_used[r] + int(req[r][active].sum()) > int(alloc[r][valid].sum()):
+                    ok = False
+                    break
+            if ok:
+                for r, cap in (("mcpu", max_cpu), ("mem", max_mem), ("vg", max_vg)):
+                    total_alloc = int(alloc[r][valid].sum())
+                    if total_alloc == 0:
+                        continue
+                    used = base_used[r] + int(req[r][active].sum())
+                    if int(used / total_alloc * 100) > cap:
+                        ok = False
+                        break
+            if ok:
+                return count
+        return self.max_count
+
+    # -- minimal-count search ----------------------------------------------
+
+    def estimate_extra(self, res: ProbeResult) -> int:
+        """How many more candidate nodes the unscheduled pods of this
+        probe need by aggregate request (a Newton-style step for the
+        escalation: usually lands within a node or two of the true
+        minimum even when taints/selectors make the global lower bound
+        loose)."""
+        mask = res.placements == -1
+        if not mask.any() or self.max_count == 0:
+            return 1
+        cls = self.batch.class_of_pod[np.asarray(mask)]
+        b = self.batch
+        new_i = self.n_base  # all candidate nodes share the spec
+        extra = 1
+        for req_v, alloc_v in (
+            (b.req_mcpu[cls], self.cluster_enc.alloc_mcpu[new_i]),
+            (b.req_mem[cls], self.cluster_enc.alloc_mem[new_i]),
+            (b.req_eph[cls], self.cluster_enc.alloc_eph[new_i]),
+            (np.ones(len(cls), dtype=np.int64), self.cluster_enc.alloc_pods[new_i]),
+        ):
+            need = int(req_v.sum())
+            alloc = int(alloc_v)
+            if alloc > 0 and need > 0:
+                extra = max(extra, -(-need // alloc))
+        return extra
+
+    def find_min_count(
+        self,
+        feasible,
+        start: int = 0,
+        on_probe=None,
+    ) -> Optional[ProbeResult]:
+        """Smallest count whose probe satisfies `feasible(ProbeResult)`,
+        exploiting monotonicity (more nodes never schedule fewer pods,
+        asserted by tests/test_capacity.py): probe `start`; on failure
+        escalate by the unscheduled-request estimate (with a doubling
+        backstop), then bisect the bracket. Typically 1 scan when the
+        resource lower bound is tight, O(log max) otherwise."""
+        probes: dict = {}
+
+        def probe(c: int) -> ProbeResult:
+            if c not in probes:
+                probes[c] = self.probe(c)
+                if on_probe is not None:
+                    on_probe(probes[c])
+            return probes[c]
+
+        res = probe(start)
+        if feasible(res):
+            return res
+        # grow bracket: (lo known-infeasible, hi candidate]
+        lo, escalations = start, 0
+        while True:
+            step = max(self.estimate_extra(probe(lo)), 1 << escalations)
+            hi = min(lo + step, self.max_count)
+            res = probe(hi)
+            if feasible(res):
+                break
+            lo = hi
+            if hi == self.max_count:
+                return None  # infeasible even at max
+            escalations += 1
+        # bisect (lo infeasible, hi feasible]; the estimate usually
+        # lands exactly, so confirm hi-1 first — one probe instead of a
+        # full bisection when it is infeasible
+        best = res
+        lo_b, hi_b = lo, best.count
+        if hi_b - lo_b > 1:
+            res = probe(hi_b - 1)
+            if feasible(res):
+                best, hi_b = res, hi_b - 1
+            else:
+                lo_b = hi_b - 1
+        while hi_b - lo_b > 1:
+            mid = (lo_b + hi_b) // 2
+            res = probe(mid)
+            if feasible(res):
+                best, hi_b = res, mid
+            else:
+                lo_b = mid
+        return best
+
+
 def sweep_node_counts(
     cluster: ResourceTypes,
     apps: List[AppResource],
@@ -77,105 +416,6 @@ def sweep_node_counts(
     use_greed: bool = False,
 ) -> SweepResult:
     """Evaluate `counts` candidate new-node counts in one batched run."""
-    import jax
-    import jax.numpy as jnp
-
-    from ..ops import scan as scan_ops
-    from ..ops.encode import (
-        encode_batch,
-        encode_cluster,
-        encode_dynamic,
-        to_scan_static,
-        to_scan_state,
-    )
-
     max_count = max(counts) if new_node_spec is not None else 0
-    padded = cluster.copy()
-    padded.nodes = list(padded.nodes) + _new_nodes(new_node_spec, max_count)
-
-    # Build oracle at full padding; generate the full pod sequence the
-    # serial path would see (cluster pods first, then apps in order).
-    oracle = Oracle(padded.nodes)
-    pods: List[dict] = []
-    pods.extend(wl.pods_excluding_daemon_sets(padded))
-    for ds in padded.daemon_sets:
-        pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
-    for app in apps:
-        app_pods = wl.generate_valid_pods_from_app(app.name, app.resource, padded.nodes)
-        if use_greed:
-            # same ordering the authoritative serial run will use
-            # (scheduler/core.py schedule_app): greed_sort ignores
-            # simon new nodes, so the max-count padding here and the
-            # per-count serial cluster sort pods identically
-            from ..scheduler.queues import greed_sort
-
-            app_pods = greed_sort(padded.nodes, app_pods)
-        pods.extend(_sort_app_pods(app_pods))
-
-    n_base = len(padded.nodes) - max_count
-    n = len(padded.nodes)
-
-    # per-scenario masks
-    sc = len(counts)
-    node_valid = np.ones((sc, n), dtype=bool)
-    for s, c in enumerate(counts):
-        node_valid[s, n_base + c :] = False
-    pod_active = np.ones((sc, len(pods)), dtype=bool)
-    name_to_idx = oracle.node_index
-    for p_i, pod in enumerate(pods):
-        target = _daemonset_target(pod)
-        if target is not None and target in name_to_idx:
-            t = name_to_idx[target]
-            pod_active[:, p_i] = node_valid[:, t]
-
-    cluster_enc = encode_cluster(oracle)
-    batch = encode_batch(oracle, cluster_enc, pods)
-    dyn = encode_dynamic(oracle, cluster_enc)
-    static = to_scan_static(cluster_enc, batch)
-    init = to_scan_state(dyn, batch)
-    class_arr = jnp.asarray(batch.class_of_pod)
-    pinned_arr = jnp.asarray(batch.pinned_node)
-
-    def one_scenario(valid, active):
-        placements, final = scan_ops.run_scan_masked(
-            static, init, class_arr, pinned_arr, valid, active
-        )
-        unsched = jnp.sum(placements == -1)
-        denom_cpu = jnp.sum(jnp.where(valid, static.alloc_mcpu, 0))
-        denom_mem = jnp.sum(jnp.where(valid, static.alloc_mem, 0))
-        cpu_util = 100.0 * jnp.sum(jnp.where(valid, final.used_mcpu, 0)) / jnp.maximum(denom_cpu, 1)
-        mem_util = 100.0 * jnp.sum(jnp.where(valid, final.used_mem, 0)) / jnp.maximum(denom_mem, 1)
-        return placements, unsched, cpu_util, mem_util
-
-    sweep_fn = jax.vmap(one_scenario)
-
-    valid_j = jnp.asarray(node_valid)
-    active_j = jnp.asarray(pod_active)
-
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        axis = mesh.axis_names[0]
-        n_dev = mesh.devices.size
-        pad = (-sc) % n_dev
-        if pad:
-            valid_j = jnp.concatenate([valid_j, jnp.repeat(valid_j[-1:], pad, 0)])
-            active_j = jnp.concatenate([active_j, jnp.repeat(active_j[-1:], pad, 0)])
-        sharding = NamedSharding(mesh, P(axis))
-        valid_j = jax.device_put(valid_j, sharding)
-        active_j = jax.device_put(active_j, sharding)
-        out = jax.jit(sweep_fn, in_shardings=(sharding, sharding))(valid_j, active_j)
-        placements, unsched, cpu_util, mem_util = (np.asarray(o)[:sc] for o in out)
-    else:
-        out = jax.jit(sweep_fn)(valid_j, active_j)
-        placements, unsched, cpu_util, mem_util = (np.asarray(o) for o in out)
-
-    return SweepResult(
-        counts=list(counts),
-        unscheduled=unsched,
-        cpu_util=cpu_util,
-        mem_util=mem_util,
-        placements=placements,
-        pods=pods,
-        node_names=[ns.name for ns in oracle.nodes],
-    )
+    sweep = CapacitySweep(cluster, apps, new_node_spec, max_count, use_greed=use_greed)
+    return sweep.probe_many(counts, mesh=mesh)

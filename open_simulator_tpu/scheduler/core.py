@@ -79,6 +79,7 @@ class Simulator:
             self.engine_kind = "oracle"
         self.oracle: Optional[Oracle] = None
         self.cluster_pods: List[dict] = []
+        self._engine = None  # TpuEngine, created once per cluster
 
     # RunCluster (simulator.go:159-164)
     def run_cluster(self, cluster: ResourceTypes) -> SimulateResult:
@@ -138,7 +139,9 @@ class Simulator:
         self.cluster_pods.extend(dangling)
         if not batch:
             return []
-        engine = TpuEngine(self.oracle)
+        if self._engine is None or self._engine.oracle is not self.oracle:
+            self._engine = TpuEngine(self.oracle)
+        engine = self._engine
         placements = engine.schedule(batch)
         failed: List[UnscheduledPod] = []
         for pod, node_idx in zip(batch, placements):

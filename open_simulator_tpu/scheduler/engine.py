@@ -21,6 +21,7 @@ from ..ops.encode import (
     encode_batch,
     encode_cluster,
     encode_dynamic,
+    features_of_batch,
 )
 from .oracle import Oracle
 
@@ -28,8 +29,21 @@ __all__ = ["TpuEngine"]
 
 
 class TpuEngine:
+    """Holds the oracle plus a per-node-set cache of the cluster
+    encoding: with K apps on an N-node cluster the O(N) ClusterStatic
+    build runs once, not K times (per-batch state — DynamicState, pod
+    statics, port vocab — is still rebuilt per schedule call)."""
+
     def __init__(self, oracle: Oracle):
         self.oracle = oracle
+        self._cluster: ClusterStatic = None
+        self._n_nodes = -1
+
+    def cluster_static(self) -> ClusterStatic:
+        if self._cluster is None or self._n_nodes != len(self.oracle.nodes):
+            self._cluster = encode_cluster(self.oracle)
+            self._n_nodes = len(self.oracle.nodes)
+        return self._cluster
 
     def schedule(self, pods: List[dict]) -> np.ndarray:
         """Returns placements[P]: node index or -1 (unschedulable).
@@ -46,17 +60,19 @@ class TpuEngine:
 
         oracle = self.oracle
         with phase("engine/encode"):
-            cluster = encode_cluster(oracle)
+            cluster = self.cluster_static()
             batch = encode_batch(oracle, cluster, pods)
             dyn = encode_dynamic(oracle, cluster)
             static = to_scan_static(cluster, batch)
             init = to_scan_state(dyn, batch)
+            features = features_of_batch(cluster, batch)
         with profiled("engine/scan"):
             placements, _ = scan_ops.run_scan(
                 static,
                 init,
                 jnp.asarray(batch.class_of_pod),
                 jnp.asarray(batch.pinned_node),
+                features=features,
             )
             out = np.asarray(placements)  # blocks on device completion
         return out

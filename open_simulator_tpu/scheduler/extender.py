@@ -118,6 +118,10 @@ class HTTPExtender:
         else:
             args["nodes"] = {"items": nodes}
         result = self._send(self.config.filter_verb, args)
+        if not isinstance(result, dict):
+            raise ExtenderError(
+                f"extender {self.name}: malformed filter response"
+            )
         if result.get("error"):
             raise ExtenderError(f"extender {self.name}: {result['error']}")
         failed = dict(result.get("failedNodes") or {})
@@ -131,7 +135,14 @@ class HTTPExtender:
                 out.append(by_name[name])
             return out, failed
         if result.get("nodes") is not None:
-            return list((result["nodes"] or {}).get("items") or []), failed
+            out = list((result["nodes"] or {}).get("items") or [])
+            for n in out:
+                name = (n.get("metadata") or {}).get("name", "")
+                if name not in by_name:
+                    raise ExtenderError(
+                        f"extender {self.name} claims unknown node {name!r}"
+                    )
+            return out, failed
         return [], failed
 
     def prioritize(self, pod: dict, nodes: List[dict]) -> Optional[Dict[str, int]]:
@@ -152,8 +163,14 @@ class HTTPExtender:
         except ExtenderError:
             # prioritization errors are ignored (generic_scheduler.go:536)
             return None
+        # A malformed body (non-list, or non-dict entries) is treated the
+        # same as a transport error: ignored, like the reference.
+        if not isinstance(result, list) or not all(
+            isinstance(h, dict) for h in result
+        ):
+            return None
         return {
-            h.get("host", ""): int(h.get("score", 0)) for h in (result or [])
+            h.get("host", ""): int(h.get("score", 0)) for h in result
         }
 
     def bind(self, pod: dict, node_name: str) -> None:

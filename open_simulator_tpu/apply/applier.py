@@ -179,7 +179,7 @@ def replay_scenario(sweep, count: int, placements):
             # else dangling: kept in the tracker, never scheduled
             # (reference simulator.go:221-229)
         elif idx < 0:
-            _, reasons = oracle._find_feasible(pod)
+            _, reasons, _ = oracle._find_feasible(pod)
             failed.append(
                 UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reasons))
             )
@@ -272,6 +272,7 @@ class Applier:
         self.use_sweep = use_sweep
         self.use_greed = use_greed
         self.extenders = []
+        self.last_cluster = None
         if scheduler_config:
             from ..scheduler.extender import extenders_from_scheduler_config
 
@@ -335,6 +336,9 @@ class Applier:
             if select_apps is not None:
                 apps = [a for a in apps if a.name in select_apps]
             new_node = self.load_new_node()
+        # kept for callers that snapshot the result (cli.py: PDBs and
+        # PriorityClasses ride along so a resume behaves identically)
+        self.last_cluster = cluster
 
         if self.use_sweep and new_node is not None and self.engine == "tpu":
             fast = self._plan_with_probes(cluster, apps, new_node)
@@ -387,6 +391,8 @@ class Applier:
         batched path cannot encode the input)."""
         import logging
 
+        from ..parallel.sweep import PrioritySignalError
+
         try:
             return probe_plan(
                 cluster,
@@ -395,6 +401,11 @@ class Applier:
                 use_greed=self.use_greed,
                 extended_resources=self.extended_resources,
             )
+        except PrioritySignalError as e:
+            logging.getLogger(__name__).info(
+                "priority workload: planning with the serial engine (%s)", e
+            )
+            return None
         except Exception as e:  # pragma: no cover - diagnostic path
             logging.getLogger(__name__).warning(
                 "batched capacity plan failed, falling back to serial escalation: %s", e
@@ -406,11 +417,15 @@ class Applier:
         minimal count that schedules everything within the caps."""
         from ..parallel.sweep import sweep_node_counts
 
+        from ..parallel.sweep import PrioritySignalError
+
         try:
             counts = list(range(0, MAX_NUM_NEW_NODE + 1))
             res = sweep_node_counts(
                 cluster, apps, new_node, counts, use_greed=self.use_greed
             )
+        except PrioritySignalError:
+            return None  # serial loop below handles priority/preemption
         except Exception as e:  # pragma: no cover - diagnostic path
             import logging
 

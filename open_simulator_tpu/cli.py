@@ -86,7 +86,9 @@ def cmd_apply(args) -> int:
     if args.snapshot and result.result is not None:
         from .scheduler.snapshot import save_snapshot
 
-        save_snapshot(result.result, args.snapshot)
+        save_snapshot(
+            result.result, args.snapshot, cluster=getattr(applier, "last_cluster", None)
+        )
     if args.format == "json":
         print(_result_json(result))
         return 0 if result.success else 2

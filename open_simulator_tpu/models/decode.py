@@ -34,6 +34,11 @@ class ResourceTypes:
     persistent_volume_claims: List[dict] = field(default_factory=list)
     storage_classes: List[dict] = field(default_factory=list)
     pod_disruption_budgets: List[dict] = field(default_factory=list)
+    # Extension beyond the reference demux (pkg/simulator/utils.go:139-183
+    # has no PriorityClass case): kept so priorityClassName on workloads
+    # can resolve to a numeric priority the way the real apiserver's
+    # admission plugin would (scheduler/preemption.py).
+    priority_classes: List[dict] = field(default_factory=list)
 
     def extend(self, other: "ResourceTypes"):
         for f in self.__dataclass_fields__:
@@ -60,6 +65,7 @@ _KIND_FIELD = {
     "PersistentVolumeClaim": "persistent_volume_claims",
     "StorageClass": "storage_classes",
     "PodDisruptionBudget": "pod_disruption_budgets",
+    "PriorityClass": "priority_classes",
 }
 
 

@@ -259,7 +259,9 @@ def _replay(snapshot, ranked, depth, entries):
     statuses = snapshot.node_status
     drained = set(ranked[:depth])
     kept_nodes = [ns.node for i, ns in enumerate(statuses) if i not in drained]
-    oracle = Oracle(kept_nodes)
+    # a defrag replay must never evict running pods to make a drained
+    # pod fit — moves have to land in genuinely free capacity
+    oracle = Oracle(kept_nodes, enable_preemption=False)
 
     evicted = []
     for _rank, node_idx, pod, is_ds in entries:

@@ -35,6 +35,14 @@ from ..scheduler.core import AppResource, _sort_app_pods
 from ..scheduler.oracle import Oracle
 
 
+class PrioritySignalError(ValueError):
+    """Raised when a batched sweep is asked to plan a priority-bearing
+    workload: the scan cannot model PrioritySort/preemption, and a
+    silent non-preemptive plan would diverge from simulate() on the
+    same input. Callers (apply/applier.py) catch this and fall back to
+    the serial escalation loop, whose simulate() handles priority."""
+
+
 @dataclass
 class SweepResult:
     counts: List[int]
@@ -119,6 +127,12 @@ class CapacitySweep:
             self.oracle = Oracle(padded.nodes)
             pods: List[dict] = []
             pods.extend(wl.pods_excluding_daemon_sets(padded))
+            if cluster.priority_classes:
+                raise PrioritySignalError(
+                    "cluster defines PriorityClass objects; the batched scan "
+                    "has no priority/preemption semantics — use the serial "
+                    "engine (scheduler/core.py falls back automatically)"
+                )
             for ds in padded.daemon_sets:
                 pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
             for app in apps:
@@ -134,6 +148,14 @@ class CapacitySweep:
 
                     app_pods = greed_sort(padded.nodes, app_pods)
                 pods.extend(_sort_app_pods(app_pods))
+            from ..scheduler.preemption import pod_uses_priority
+
+            if any(pod_uses_priority(p) for p in pods):
+                raise PrioritySignalError(
+                    "workload carries priority/priorityClassName; the batched "
+                    "scan has no priority/preemption semantics — use the "
+                    "serial engine (scheduler/core.py falls back automatically)"
+                )
         self.pods = pods
         self.n = len(padded.nodes)
         self.n_base = self.n - self.max_count

@@ -37,12 +37,17 @@ class TpuEngine:
     def __init__(self, oracle: Oracle):
         self.oracle = oracle
         self._cluster: ClusterStatic = None
-        self._n_nodes = -1
+        self._cache_key = None
 
     def cluster_static(self) -> ClusterStatic:
-        if self._cluster is None or self._n_nodes != len(self.oracle.nodes):
+        # keyed on (node count, alloc epoch): GPU-share Reserve mutates
+        # ns.alloc[gpu-count], which is baked into ClusterStatic's
+        # scalar allocatables — a bind in one batch must invalidate the
+        # cache for the next
+        key = (len(self.oracle.nodes), self.oracle.alloc_epoch)
+        if self._cluster is None or self._cache_key != key:
             self._cluster = encode_cluster(self.oracle)
-            self._n_nodes = len(self.oracle.nodes)
+            self._cache_key = key
         return self._cluster
 
     def schedule(self, pods: List[dict]) -> np.ndarray:

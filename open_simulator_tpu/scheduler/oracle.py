@@ -128,6 +128,9 @@ class NodeState:
     storage: Optional[stor.NodeStorage] = None
     # mutable allocatable (gpu-count is updated by the GPU plugin Reserve)
     alloc: Dict[str, Fraction] = field(default_factory=dict)
+    # open-local allocations committed at bind, keyed by (namespace,
+    # name) — recorded so preemption can reverse them exactly
+    local_allocs: Dict[Tuple[str, str], tuple] = field(default_factory=dict)
 
     @property
     def name(self) -> str:
@@ -176,10 +179,27 @@ def _ports_conflict(want: List[Tuple[str, str, int]], used: set) -> bool:
 # ------------------------------------------------------------------- oracle
 
 
+@dataclass
+class PreemptedPod:
+    """One eviction performed by DefaultPreemption."""
+
+    pod: dict
+    node_name: str
+    preemptor: str
+
+
 class Oracle:
     """Serial scheduler over mutable node states."""
 
-    def __init__(self, nodes: List[dict], registry=None, extenders=None):
+    def __init__(
+        self,
+        nodes: List[dict],
+        registry=None,
+        extenders=None,
+        pdbs=None,
+        priority_classes=None,
+        enable_preemption: bool = True,
+    ):
         if registry is None:
             from .plugins import default_registry
 
@@ -188,10 +208,47 @@ class Oracle:
         # HTTP scheduler extenders (extender.py); host-side RPC, so a
         # simulation using them runs on this serial path only
         self.extenders = list(extenders or [])
+        # DefaultPreemption inputs (scheduler/preemption.py)
+        from .preemption import build_priority_resolver
+
+        self.pdbs = list(pdbs or [])
+        self._prio_resolver = build_priority_resolver(priority_classes or [])
+        self.enable_preemption = enable_preemption
+        # priority bookkeeping: commit sequence is the start-time proxy
+        # for MoreImportantPod ties; _min_prio gates the preemption
+        # attempt (a preemptor needs a strictly lower-priority pod to
+        # exist at all, so the all-default-priority case pays nothing)
+        self._seq_counter = 0
+        self.commit_seq: Dict[Tuple[str, str], int] = {}
+        self._min_prio = math.inf
+        self.saw_priority = False
+        self.preempted: List[PreemptedPod] = []
+        # bumped whenever a node's mutable allocatable changes (GPU
+        # Reserve adjusting gpu-count); TpuEngine keys its ClusterStatic
+        # cache on this so stale allocatables never reach the scan
+        self.alloc_epoch = 0
         self.nodes: List[NodeState] = []
         self.node_index: Dict[str, int] = {}
         for n in nodes:
             self.add_node(n)
+
+    # -- priority helpers ---------------------------------------------------
+
+    def pod_priority(self, pod: dict) -> int:
+        return self._prio_resolver.priority(pod)
+
+    def pod_preemption_policy(self, pod: dict) -> str:
+        return self._prio_resolver.preemption_policy(pod)
+
+    def commit_seq_of(self, pod: dict) -> int:
+        meta = pod.get("metadata") or {}
+        return self.commit_seq.get(
+            (meta.get("namespace") or "default", meta.get("name", "")), 0
+        )
+
+    def drain_preempted(self) -> List[PreemptedPod]:
+        out, self.preempted = self.preempted, []
+        return out
 
     # -- cluster mutation ---------------------------------------------------
 
@@ -233,6 +290,7 @@ class Oracle:
             if devs:
                 ns.gpu.commit(devs, gpu_mem)
                 ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
+                self.alloc_epoch += 1
         self._commit(pod, ns)
 
     # -- the scheduling cycle ----------------------------------------------
@@ -242,8 +300,13 @@ class Oracle:
         from .extender import ExtenderError
 
         meta = pod.get("metadata") or {}
+        if not self.saw_priority:
+            from .preemption import pod_uses_priority
+
+            if pod_uses_priority(pod):
+                self.saw_priority = True
         try:
-            feasible, reasons = self._find_feasible(pod)
+            feasible, reasons, codes = self._find_feasible(pod)
         except ExtenderError as e:
             # a non-ignorable extender failure fails this pod's cycle
             # (scheduleOne error path), not the whole simulation
@@ -252,17 +315,14 @@ class Oracle:
                 f"{meta.get('name', '')}): {e}"
             )
         if not feasible:
+            placed = self._post_filter_preempt(pod, codes)
+            if placed is not None:
+                return placed, ""
             return None, self._failure_message(pod, reasons)
-        scores = self._prioritize(pod, feasible)
-        best = feasible[0]
-        best_score = scores[0]
-        for ns, sc in zip(feasible[1:], scores[1:]):
-            if sc > best_score:
-                best, best_score = ns, sc
         try:
             # the binder extender runs before any local mutation, so a
             # failure here leaves no partial commit
-            self._reserve_and_bind(pod, best)
+            best = self._select_and_bind(pod, feasible)
         except ExtenderError as e:
             return None, (
                 f"failed to bind pod ({meta.get('namespace', 'default')}/"
@@ -270,99 +330,204 @@ class Oracle:
             )
         return best.name, ""
 
+    def _select_and_bind(self, pod: dict, feasible: List[NodeState]) -> NodeState:
+        """prioritizeNodes + selectHost (first-max tie rule, see module
+        docstring) + the reserve/bind sequence. Returns the chosen
+        node; may raise ExtenderError from a binder extender."""
+        scores = self._prioritize(pod, feasible)
+        best = feasible[0]
+        best_score = scores[0]
+        for ns, sc in zip(feasible[1:], scores[1:]):
+            if sc > best_score:
+                best, best_score = ns, sc
+        self._reserve_and_bind(pod, best)
+        return best
+
+    def _post_filter_preempt(self, pod: dict, codes: Dict[int, str]) -> Optional[str]:
+        """DefaultPreemption PostFilter (registered by
+        algorithmprovider/registry.go:106-109; logic in
+        scheduler/preemption.py). On success the victims are evicted
+        from their node, recorded in self.preempted (the Simulator
+        re-enqueues them), and the preemptor is scheduled in a fresh
+        retry cycle — the reference requeues the nominated pod and
+        reruns scheduleOne (scheduler.go:320-369); with the victims
+        gone the retry binds.
+        """
+        if not self.enable_preemption:
+            return None
+        prio = self.pod_priority(pod)
+        # a victim must have strictly lower priority than the preemptor;
+        # when nothing committed is lower, skip the whole dry run
+        if not (prio > self._min_prio):
+            return None
+        from .preemption import run_preemption
+
+        result = run_preemption(self, pod, codes)
+        if result is None:
+            return None
+        preemptor = (pod.get("metadata") or {}).get("name", "")
+        ns = self.nodes[result.node_index]
+        for victim in result.victims:
+            self.evict_pod(ns, victim)
+            self.preempted.append(
+                PreemptedPod(pod=victim, node_name=ns.name, preemptor=preemptor)
+            )
+        # retry cycle: with victims evicted the pod fits on the
+        # nominated node (it may score another feasible node higher —
+        # same as the reference's fresh scheduleOne after requeue).
+        # Victims stay evicted even if the retry fails (the reference
+        # likewise never restores PrepareCandidate's deletions); an
+        # extender error here fails this pod's cycle, not the run.
+        from .extender import ExtenderError
+
+        try:
+            feasible, _, _ = self._find_feasible(pod)
+            if not feasible:
+                return None
+            best = self._select_and_bind(pod, feasible)
+        except ExtenderError:
+            return None
+        return best.name
+
     # -- filters ------------------------------------------------------------
 
-    def _find_feasible(self, pod: dict):
-        spec = pod.get("spec") or {}
-        meta = pod.get("metadata") or {}
-        pod_req = req.pod_requests(pod)
-        want_ports = _pod_host_ports(pod)
-        topo_state = self._topology_spread_prefilter(pod)
-        ipa_state = self._interpod_prefilter(pod)
-        lvm_vols, dev_vols = stor.parse_pod_local_volumes(pod)
+    # Per-node failure codes mirror framework.Status codes: a node
+    # rejected "unresolvable" (UnschedulableAndUnresolvable) cannot be
+    # helped by preemption (nodesWherePreemptionMightHelp,
+    # default_preemption.go:259-271). Sources: nodeunschedulable/
+    # nodename/nodeaffinity/tainttoleration filters, PodTopologySpread
+    # missing-topology-key (filtering.go:298), InterPodAffinity required
+    # affinity rules (filtering.go:389).
+
+    def _pod_filter_ctx(self, pod: dict) -> dict:
+        """Pod-level filter inputs that do not depend on cluster state."""
         gpu_mem, gpu_cnt = stor.pod_gpu_request(pod)
-        pod_gpu_mem_total = stor.pod_gpu_memory(pod)
+        lvm_vols, dev_vols = stor.parse_pod_local_volumes(pod)
+        return {
+            "spec": pod.get("spec") or {},
+            "pod_req": req.pod_requests(pod),
+            "want_ports": _pod_host_ports(pod),
+            "lvm_vols": lvm_vols,
+            "dev_vols": dev_vols,
+            "gpu_mem": gpu_mem,
+            "gpu_cnt": gpu_cnt,
+            "gpu_mem_total": stor.pod_gpu_memory(pod),
+        }
+
+    def _prefilter(self, pod: dict) -> dict:
+        """Cluster-state-dependent PreFilter states (recomputed after
+        any mutation — the preemption dry run relies on this instead of
+        the reference's incremental AddPod/RemovePod extensions)."""
+        return {
+            "topo": self._topology_spread_prefilter(pod),
+            "ipa": self._interpod_prefilter(pod),
+        }
+
+    def _check_node(self, pod: dict, ctx: dict, pre: dict, ns: NodeState):
+        """All framework filters against one node. Returns None when the
+        node is feasible, else (reason, code)."""
+        spec = ctx["spec"]
+        node = ns.node
+        nspec = node.get("spec") or {}
+        # NodeUnschedulable
+        if nspec.get("unschedulable") and not lbl.tolerations_tolerate_taint(
+            spec.get("tolerations") or [],
+            {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"},
+        ):
+            return "node(s) were unschedulable", "unresolvable"
+        # NodeName
+        if spec.get("nodeName") and spec["nodeName"] != ns.name:
+            return "node(s) didn't match the requested hostname", "unresolvable"
+        # TaintToleration
+        taint = lbl.find_untolerated_taint(
+            nspec.get("taints") or [], spec.get("tolerations") or []
+        )
+        if taint is not None:
+            return (
+                "node(s) had taint {%s: %s}, that the pod didn't tolerate"
+                % (taint.get("key", ""), taint.get("value", "")),
+                "unresolvable",
+            )
+        # NodeAffinity
+        if not lbl.pod_matches_node_selector_and_affinity(spec, node):
+            return "node(s) didn't match node selector", "unresolvable"
+        # NodePorts
+        if _ports_conflict(ctx["want_ports"], ns.used_ports):
+            return (
+                "node(s) didn't have free ports for the requested pod ports",
+                "unschedulable",
+            )
+        # NodeResourcesFit
+        r = self._fits_resources(ctx["pod_req"], ns)
+        if r:
+            return r, "unschedulable"
+        # PodTopologySpread
+        r = self._topology_spread_filter(pod, pre["topo"], ns)
+        if r:
+            return "node(s) didn't match pod topology spread constraints", r
+        # InterPodAffinity
+        r = self._interpod_filter(pod, pre["ipa"], ns)
+        if r:
+            code = (
+                "unresolvable"
+                if r == "node(s) didn't match pod affinity rules"
+                else "unschedulable"
+            )
+            return r, code
+        # Open-Local
+        r = self._open_local_filter(ctx["lvm_vols"], ctx["dev_vols"], ns)
+        if r:
+            return r, "unschedulable"
+        # Open-Gpu-Share
+        if ctx["gpu_mem_total"] > 0:
+            if ns.gpu is None or ns.gpu.count * ns.gpu.per_device_mem < ctx["gpu_mem_total"]:
+                return "Insufficient GPU memory", "unschedulable"
+            if ns.gpu.allocate_gpu_ids(ctx["gpu_mem"], ctx["gpu_cnt"]) is None:
+                return "No GPU device can fit the pod", "unschedulable"
+        # out-of-tree custom plugins (stateless filter contract)
+        for plugin in self.registry.plugins:
+            if not plugin.filter(pod, ns.node):
+                return f"node(s) didn't pass plugin {plugin.name}", "unschedulable"
+        return None
+
+    def _find_feasible(self, pod: dict):
+        ctx = self._pod_filter_ctx(pod)
+        pre = self._prefilter(pod)
 
         feasible = []
         reasons: Dict[str, int] = {}
+        codes: Dict[int, str] = {}
 
         def fail(reason: str):
             reasons[reason] = reasons.get(reason, 0) + 1
 
         for ns in self.nodes:
-            node = ns.node
-            nspec = node.get("spec") or {}
-            # NodeUnschedulable
-            if nspec.get("unschedulable") and not lbl.tolerations_tolerate_taint(
-                spec.get("tolerations") or [],
-                {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"},
-            ):
-                fail("node(s) were unschedulable")
+            r = self._check_node(pod, ctx, pre, ns)
+            if r is None:
+                feasible.append(ns)
                 continue
-            # NodeName
-            if spec.get("nodeName") and spec["nodeName"] != ns.name:
-                fail("node(s) didn't match the requested hostname")
-                continue
-            # TaintToleration
-            taint = lbl.find_untolerated_taint(
-                nspec.get("taints") or [], spec.get("tolerations") or []
-            )
-            if taint is not None:
-                fail(
-                    "node(s) had taint {%s: %s}, that the pod didn't tolerate"
-                    % (taint.get("key", ""), taint.get("value", ""))
-                )
-                continue
-            # NodeAffinity
-            if not lbl.pod_matches_node_selector_and_affinity(spec, node):
-                fail("node(s) didn't match node selector")
-                continue
-            # NodePorts
-            if _ports_conflict(want_ports, ns.used_ports):
-                fail("node(s) didn't have free ports for the requested pod ports")
-                continue
-            # NodeResourcesFit
-            r = self._fits_resources(pod_req, ns)
-            if r:
-                fail(r)
-                continue
-            # PodTopologySpread
-            if not self._topology_spread_filter(pod, topo_state, ns):
-                fail("node(s) didn't match pod topology spread constraints")
-                continue
-            # InterPodAffinity
-            r = self._interpod_filter(pod, ipa_state, ns)
-            if r:
-                fail(r)
-                continue
-            # Open-Local
-            r = self._open_local_filter(lvm_vols, dev_vols, ns)
-            if r:
-                fail(r)
-                continue
-            # Open-Gpu-Share
-            if pod_gpu_mem_total > 0:
-                if ns.gpu is None or ns.gpu.count * ns.gpu.per_device_mem < pod_gpu_mem_total:
-                    fail("Insufficient GPU memory")
-                    continue
-                if ns.gpu.allocate_gpu_ids(gpu_mem, gpu_cnt) is None:
-                    fail("No GPU device can fit the pod")
-                    continue
-            # out-of-tree custom plugins (stateless filter contract)
-            rejected = False
-            for plugin in self.registry.plugins:
-                if not plugin.filter(pod, ns.node):
-                    fail(f"node(s) didn't pass plugin {plugin.name}")
-                    rejected = True
-                    break
-            if rejected:
-                continue
-            feasible.append(ns)
+            reason, code = r
+            fail(reason)
+            codes[ns.index] = code
         if self.extenders:
             from .extender import filter_with_extenders
 
+            before = {ns.index for ns in feasible}
             feasible = filter_with_extenders(self.extenders, pod, feasible, fail)
-        return feasible, reasons
+            for idx in before - {ns.index for ns in feasible}:
+                codes[idx] = "unschedulable"
+        return feasible, reasons, codes
+
+    def passes_filters_on_node(self, pod: dict, ns: NodeState, ctx=None) -> bool:
+        """PodPassesFiltersOnNode for the preemption dry run: framework
+        filters only (extenders join preemption via ProcessPreemption,
+        not here), with PreFilter state recomputed against current
+        cluster state. `ctx` (state-independent, from _pod_filter_ctx)
+        may be precomputed by the caller and reused across calls."""
+        if ctx is None:
+            ctx = self._pod_filter_ctx(pod)
+        pre = self._prefilter(pod)
+        return self._check_node(pod, ctx, pre, ns) is None
 
     def _fits_resources(self, pod_req: dict, ns: NodeState) -> Optional[str]:
         """fitsRequest (noderesources/fit.go:230-303)."""
@@ -454,9 +619,12 @@ class Oracle:
         min_counts = [min(v.values()) if v else 0 for v in counts]
         return constraints, counts, min_counts
 
-    def _topology_spread_filter(self, pod: dict, state, ns: NodeState) -> bool:
+    def _topology_spread_filter(self, pod: dict, state, ns: NodeState) -> Optional[str]:
+        """Returns None (feasible) or the failure code: a missing
+        topology key is UnschedulableAndUnresolvable (filtering.go:298),
+        a skew violation plain Unschedulable (filtering.go:330)."""
         if state is None:
-            return True
+            return None
         constraints, counts, min_counts = state
         meta = pod.get("metadata") or {}
         pod_labels = meta.get("labels") or {}
@@ -464,13 +632,13 @@ class Oracle:
         for i, c in enumerate(constraints):
             key = c.get("topologyKey", "")
             if key not in nl:
-                return False
+                return "unresolvable"
             self_match = 1 if lbl.match_labels_selector(c.get("labelSelector"), pod_labels) else 0
             match_num = counts[i].get(nl[key], 0)
             skew = match_num + self_match - min_counts[i]
             if skew > int(c.get("maxSkew", 1)):
-                return False
-        return True
+                return "unschedulable"
+        return None
 
     # -- interpod affinity --------------------------------------------------
 
@@ -1016,7 +1184,9 @@ class Oracle:
                     str(d) for d in devs
                 )
                 ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
-        # Open-Local Bind: commit VG/device allocation
+                self.alloc_epoch += 1
+        # Open-Local Bind: commit VG/device allocation (recorded for
+        # exact reversal by preemption eviction)
         lvm_vols, dev_vols = stor.parse_pod_local_volumes(pod)
         if ns.storage is not None and (lvm_vols or dev_vols):
             alloc = self._lvm_fit(lvm_vols, ns.storage) if lvm_vols else []
@@ -1026,10 +1196,16 @@ class Oracle:
             for dev_idx, _size in dalloc or []:
                 ns.storage.devices[dev_idx].is_allocated = True
             stor.set_node_storage(ns.node, ns.storage)
+            ns.local_allocs[self._pod_key(pod)] = (alloc or [], dalloc or [])
         # Simon Bind
         spec["nodeName"] = ns.name
         pod.setdefault("status", {})["phase"] = "Running"
         self._commit(pod, ns)
+
+    @staticmethod
+    def _pod_key(pod: dict) -> Tuple[str, str]:
+        meta = pod.get("metadata") or {}
+        return (meta.get("namespace") or "default", meta.get("name", ""))
 
     def _commit(self, pod: dict, ns: NodeState):
         """NodeInfo.AddPod accounting."""
@@ -1047,6 +1223,111 @@ class Oracle:
         ns.nz_mem += req.pod_nonzero_request(pod, req.MEMORY)
         for port in _pod_host_ports(pod):
             ns.used_ports.add(port)
+        # priority bookkeeping for DefaultPreemption
+        self._seq_counter += 1
+        self.commit_seq[self._pod_key(pod)] = self._seq_counter
+        prio = self.pod_priority(pod)
+        if prio < self._min_prio:
+            self._min_prio = prio
+        if not self.saw_priority:
+            from .preemption import pod_uses_priority
+
+            if pod_uses_priority(pod):
+                self.saw_priority = True
+
+    # -- pod removal (preemption) -------------------------------------------
+
+    def remove_pod_from_node(self, ns: NodeState, pod: dict):
+        """Reverse of _commit + the Reserve/Bind side effects, used by
+        the preemption dry run (selectVictimsOnNode's removePod) and by
+        the real eviction. Returns an undo token for
+        restore_pod_to_node — the token pins the exact GPU device ids
+        and open-local allocation so a restore is bit-identical.
+        """
+        for i, p in enumerate(ns.pods):
+            if p is pod:
+                pos = i
+                break
+        else:
+            raise ValueError("pod not on node")
+        ns.pods.pop(pos)
+        ns.req_mcpu -= req.pod_request_milli_cpu(pod)
+        ns.req_mem -= req.pod_request_int(pod, req.MEMORY)
+        ns.req_eph -= req.pod_request_int(pod, req.EPHEMERAL)
+        for name, v in req.pod_requests(pod).items():
+            if name in (req.CPU, req.MEMORY, req.EPHEMERAL):
+                continue
+            if req.is_scalar_resource(name):
+                iv = -((-v.numerator) // v.denominator)
+                ns.req_scalar[name] = ns.req_scalar.get(name, 0) - iv
+        ns.nz_mcpu -= req.pod_nonzero_request(pod, req.CPU)
+        ns.nz_mem -= req.pod_nonzero_request(pod, req.MEMORY)
+        for port in _pod_host_ports(pod):
+            ns.used_ports.discard(port)
+        # GPU devices (from the gpu-index annotation Reserve wrote)
+        gpu_devs: List[int] = []
+        gpu_mem, _ = stor.pod_gpu_request(pod)
+        if gpu_mem > 0 and ns.gpu is not None:
+            anno = (pod.get("metadata") or {}).get("annotations") or {}
+            idx = anno.get(stor.GPU_INDEX_ANNO)
+            if idx:
+                gpu_devs = [int(d) for d in str(idx).split("-") if str(d).isdigit()]
+                for d in gpu_devs:
+                    ns.gpu.used[d] -= gpu_mem
+                ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
+                self.alloc_epoch += 1
+        # open-local allocation
+        local = ns.local_allocs.pop(self._pod_key(pod), None)
+        if local is not None and ns.storage is not None:
+            alloc, dalloc = local
+            for vg_idx, size in alloc:
+                ns.storage.vgs[vg_idx].requested -= size
+            for dev_idx, _size in dalloc:
+                ns.storage.devices[dev_idx].is_allocated = False
+            stor.set_node_storage(ns.node, ns.storage)
+        return (pos, gpu_devs, gpu_mem, local)
+
+    def restore_pod_to_node(self, ns: NodeState, pod: dict, token):
+        """Exact inverse of remove_pod_from_node."""
+        pos, gpu_devs, gpu_mem, local = token
+        ns.pods.insert(pos, pod)
+        ns.req_mcpu += req.pod_request_milli_cpu(pod)
+        ns.req_mem += req.pod_request_int(pod, req.MEMORY)
+        ns.req_eph += req.pod_request_int(pod, req.EPHEMERAL)
+        for name, v in req.pod_requests(pod).items():
+            if name in (req.CPU, req.MEMORY, req.EPHEMERAL):
+                continue
+            if req.is_scalar_resource(name):
+                iv = -((-v.numerator) // v.denominator)
+                ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
+        ns.nz_mcpu += req.pod_nonzero_request(pod, req.CPU)
+        ns.nz_mem += req.pod_nonzero_request(pod, req.MEMORY)
+        for port in _pod_host_ports(pod):
+            ns.used_ports.add(port)
+        if gpu_devs and ns.gpu is not None:
+            for d in gpu_devs:
+                ns.gpu.used[d] += gpu_mem
+            ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
+            self.alloc_epoch += 1
+        if local is not None and ns.storage is not None:
+            alloc, dalloc = local
+            for vg_idx, size in alloc:
+                ns.storage.vgs[vg_idx].requested += size
+            for dev_idx, _size in dalloc:
+                ns.storage.devices[dev_idx].is_allocated = True
+            stor.set_node_storage(ns.node, ns.storage)
+            ns.local_allocs[self._pod_key(pod)] = (alloc, dalloc)
+
+    def evict_pod(self, ns: NodeState, pod: dict):
+        """Evict a victim for real (PrepareCandidate's DeletePod): the
+        binding state written into the pod dict is stripped so the
+        Simulator can re-enqueue it as a fresh, schedulable pod."""
+        self.remove_pod_from_node(ns, pod)
+        (pod.get("spec") or {}).pop("nodeName", None)
+        pod.pop("status", None)
+        anno = (pod.get("metadata") or {}).get("annotations")
+        if anno:
+            anno.pop(stor.GPU_INDEX_ANNO, None)
 
     # -- misc ---------------------------------------------------------------
 

@@ -1,0 +1,329 @@
+"""Priority & preemption (DefaultPreemption PostFilter + PrioritySort).
+
+Reimplements the kube-scheduler v1.20.5 preemption cycle
+(vendor/.../framework/plugins/defaultpreemption/default_preemption.go):
+
+- pod priority (component-helpers/scheduling/corev1/helpers.go:25) plus
+  an admission-emulation extension: the fake apiserver of the reference
+  has no admission chain, so `priorityClassName` on a pod resolves here
+  against decoded PriorityClass objects and the two builtin classes —
+  exactly what the real priority admission plugin would stamp into
+  `spec.priority`.
+- PodEligibleToPreemptOthers (default_preemption.go:231-255): a
+  `preemptionPolicy: Never` pod never preempts. The terminating-pods
+  check is vacuous (no graceful deletion in the simulator).
+- nodesWherePreemptionMightHelp (default_preemption.go:259-271): nodes
+  rejected with UnschedulableAndUnresolvable (node selector/affinity,
+  taints, nodeName, unschedulable node, missing topology key, required
+  pod-affinity rules — see oracle.Code) are excluded.
+- selectVictimsOnNode (default_preemption.go:578-673): remove all
+  lower-priority pods; if the preemptor then fits, reprieve as many as
+  possible — PDB-violating victims first, then non-violating, both in
+  MoreImportantPod order (priority desc, earlier start first; start
+  time is the oracle's commit sequence — simulated pods carry no
+  status.startTime).
+- filterPodsWithPDBViolation (default_preemption.go:736-781): budget =
+  `status.disruptionsAllowed` (defaults to 0, matching the reference
+  under a fake client where no disruption controller ever fills the
+  status in).
+- pickOneNodeForPreemption (default_preemption.go:443-561): the 6
+  tie-break criteria, with the final "sort of randomly" step pinned to
+  first-in-node-order (same documented determinism deviation as
+  selectHost, scheduler/oracle.py).
+
+Deviations (documented, deliberate):
+- Candidate search is exhaustive and deterministic: the reference
+  dry-runs a random-offset sample of ~10% of nodes
+  (default_preemption.go:169-184, getOffsetAndNumCandidates) and its
+  parallel candidate list is unordered; we evaluate every potential
+  node. More candidates never yields a worse pick.
+- The dry run reverses GPU-share device and open-local VG/device state
+  too. The reference's dry-run NodeInfo clone only adjusts resource
+  accounting, so its gpu/local-storage plugin caches go stale during
+  preemption — a bug we do not reproduce.
+- Victims are actually removable here: the Simulator re-enqueues them
+  (their controller would recreate them in a real cluster), whereas
+  the reference deletes them from the fake cluster and the preemptor
+  is still reported failed by the serial handshake. See
+  scheduler/core.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..models import labels as lbl
+
+# Builtin PriorityClasses (pkg/apis/scheduling/types.go upstream).
+BUILTIN_PRIORITY_CLASSES = {
+    "system-cluster-critical": 2000000000,
+    "system-node-critical": 2000001000,
+}
+
+
+@dataclass
+class PriorityAdmission:
+    """Admission emulation for the priority plugin: what the real
+    apiserver's Priority admission controller would stamp into
+    spec.priority / spec.preemptionPolicy from PriorityClass objects.
+    Honors value, globalDefault, and per-class preemptionPolicy."""
+
+    values: Dict[str, int] = field(default_factory=dict)
+    policies: Dict[str, str] = field(default_factory=dict)
+    global_default: int = 0
+
+    def priority(self, pod: dict) -> int:
+        """PodPriority (corev1/helpers.go:25) with admission defaults."""
+        spec = pod.get("spec") or {}
+        if spec.get("priority") is not None:
+            return int(spec["priority"])
+        name = spec.get("priorityClassName")
+        if name and name in self.values:
+            return self.values[name]
+        return self.global_default
+
+    def preemption_policy(self, pod: dict) -> str:
+        spec = pod.get("spec") or {}
+        if spec.get("preemptionPolicy") is not None:
+            return str(spec["preemptionPolicy"])
+        name = spec.get("priorityClassName")
+        if name and name in self.policies:
+            return self.policies[name]
+        return "PreemptLowerPriority"
+
+
+def build_priority_resolver(priority_classes: List[dict]) -> PriorityAdmission:
+    """PriorityAdmission from decoded PriorityClass objects plus the
+    builtins (builtin names are rejected by the real apiserver, so user
+    classes never shadow them)."""
+    adm = PriorityAdmission(values=dict(BUILTIN_PRIORITY_CLASSES))
+    for pc in priority_classes or []:
+        name = (pc.get("metadata") or {}).get("name")
+        if not name:
+            continue
+        adm.values[name] = int(pc.get("value", 0))
+        if pc.get("preemptionPolicy"):
+            adm.policies[name] = str(pc["preemptionPolicy"])
+        if pc.get("globalDefault"):
+            adm.global_default = int(pc.get("value", 0))
+    return adm
+
+
+def pod_priority(pod: dict, resolver: Optional[PriorityAdmission] = None) -> int:
+    if resolver is None:
+        resolver = PriorityAdmission()
+    return resolver.priority(pod)
+
+
+def pod_uses_priority(pod: dict) -> bool:
+    """True when the pod carries any priority signal at all — the
+    Simulator uses this to fall back from the TPU scan to the serial
+    oracle (scan parity for preemption is not implemented; VERDICT r1)."""
+    spec = pod.get("spec") or {}
+    return spec.get("priority") is not None or bool(spec.get("priorityClassName"))
+
+
+@dataclass
+class Candidate:
+    """One preemption candidate node (default_preemption.go Candidate):
+    victims ordered by MoreImportantPod (priority desc)."""
+
+    node_index: int
+    node_name: str
+    victims: List[dict]
+    num_pdb_violations: int
+
+
+@dataclass
+class PreemptionResult:
+    node_name: str
+    node_index: int
+    victims: List[dict] = field(default_factory=list)
+
+
+def filter_pods_with_pdb_violation(
+    pods: List[dict], pdbs: List[dict]
+) -> Tuple[List[dict], List[dict]]:
+    """filterPodsWithPDBViolation (default_preemption.go:736-781).
+    Stable: preserves the order of `pods` within each group."""
+    allowed = [
+        int(((pdb.get("status") or {}).get("disruptionsAllowed")) or 0) for pdb in pdbs
+    ]
+    violating, non_violating = [], []
+    for pod in pods:
+        meta = pod.get("metadata") or {}
+        pod_labels = meta.get("labels") or {}
+        pod_ns = meta.get("namespace") or "default"
+        violated = False
+        if pod_labels:
+            for i, pdb in enumerate(pdbs):
+                pdb_ns = ((pdb.get("metadata") or {}).get("namespace")) or "default"
+                if pdb_ns != pod_ns:
+                    continue
+                selector = (pdb.get("spec") or {}).get("selector")
+                # nil/empty selector matches nothing (the metav1
+                # LabelSelectorAsSelector empty-selector rule there)
+                if not selector or not (
+                    selector.get("matchLabels") or selector.get("matchExpressions")
+                ):
+                    continue
+                if not lbl.match_labels_selector(selector, pod_labels):
+                    continue
+                disrupted = ((pdb.get("status") or {}).get("disruptedPods")) or {}
+                if meta.get("name") in disrupted:
+                    continue
+                allowed[i] -= 1
+                if allowed[i] < 0:
+                    violated = True
+        (violating if violated else non_violating).append(pod)
+    return violating, non_violating
+
+
+def pick_one_node(candidates: List[Candidate], oracle) -> Optional[Candidate]:
+    """pickOneNodeForPreemption (default_preemption.go:443-561)."""
+    if not candidates:
+        return None
+    if len(candidates) == 1:
+        return candidates[0]
+
+    def start_seq(pod: dict) -> int:
+        return oracle.commit_seq_of(pod)
+
+    # 1. minimum PDB violations
+    best = min(c.num_pdb_violations for c in candidates)
+    pool = [c for c in candidates if c.num_pdb_violations == best]
+    if len(pool) == 1:
+        return pool[0]
+    # 2. minimum highest-priority victim (victims sorted desc by priority)
+    best = min(oracle.pod_priority(c.victims[0]) for c in pool)
+    pool = [c for c in pool if oracle.pod_priority(c.victims[0]) == best]
+    if len(pool) == 1:
+        return pool[0]
+    # 3. minimum sum of victim priorities
+    best = min(sum(oracle.pod_priority(p) for p in c.victims) for c in pool)
+    pool = [
+        c for c in pool if sum(oracle.pod_priority(p) for p in c.victims) == best
+    ]
+    if len(pool) == 1:
+        return pool[0]
+    # 4. minimum number of victims
+    best = min(len(c.victims) for c in pool)
+    pool = [c for c in pool if len(c.victims) == best]
+    if len(pool) == 1:
+        return pool[0]
+    # 5. latest earliest-start-time of the victims (proxy: commit seq —
+    #    higher seq = started later)
+    best = max(min(start_seq(p) for p in c.victims) for c in pool)
+    pool = [c for c in pool if min(start_seq(p) for p in c.victims) == best]
+    # 6. first in node order (reference: "sort of randomly")
+    return min(pool, key=lambda c: c.node_index)
+
+
+def select_victims_on_node(oracle, pod: dict, ns, pdbs: List[dict], ctx=None):
+    """selectVictimsOnNode (default_preemption.go:578-673) against live
+    oracle state: victims are removed, reprieves re-added, and on exit
+    the node is restored exactly (undo tokens carry the GPU device ids
+    and open-local allocations of each removed pod).
+
+    Returns (victims, num_pdb_violations) or None when preemption on
+    this node cannot help.
+    """
+    preemptor_prio = oracle.pod_priority(pod)
+    potential = [p for p in ns.pods if oracle.pod_priority(p) < preemptor_prio]
+    if not potential:
+        return None
+    undo = {}
+    removed: List[dict] = []
+
+    def key(p):
+        m = p.get("metadata") or {}
+        return (m.get("namespace") or "default", m.get("name", ""))
+
+    def remove(p):
+        undo[key(p)] = oracle.remove_pod_from_node(ns, p)
+        removed.append(p)
+
+    def restore_all():
+        for p in reversed(removed):
+            oracle.restore_pod_to_node(ns, p, undo[key(p)])
+
+    for p in list(potential):
+        remove(p)
+    try:
+        if not oracle.passes_filters_on_node(pod, ns, ctx=ctx):
+            return None
+        # MoreImportantPod order: priority desc, earlier start first
+        potential.sort(
+            key=lambda p: (-oracle.pod_priority(p), oracle.commit_seq_of(p))
+        )
+        violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
+        victims: List[dict] = []
+        num_violating = 0
+
+        def reprieve(p) -> bool:
+            oracle.restore_pod_to_node(ns, p, undo[key(p)])
+            removed.remove(p)
+            if oracle.passes_filters_on_node(pod, ns, ctx=ctx):
+                return True
+            undo[key(p)] = oracle.remove_pod_from_node(ns, p)
+            removed.append(p)
+            victims.append(p)
+            return False
+
+        for p in violating:
+            if not reprieve(p):
+                num_violating += 1
+        for p in non_violating:
+            reprieve(p)
+        return victims, num_violating
+    finally:
+        restore_all()
+
+
+def run_preemption(oracle, pod: dict, codes: Dict[int, str]) -> Optional[PreemptionResult]:
+    """The preempt() pipeline (default_preemption.go:118-163) minus
+    extender ProcessPreemption (no configured extender of the reference
+    example set supports preemption).
+
+    `codes` is the per-node-index failure code map from the failed
+    scheduling cycle ("unschedulable" | "unresolvable")."""
+    # PodEligibleToPreemptOthers — policy comes from spec.preemptionPolicy
+    # or, absent that, the pod's PriorityClass (admission emulation)
+    if oracle.pod_preemption_policy(pod) == "Never":
+        return None
+    pdbs = oracle.pdbs
+    # the pod-level filter context is cluster-state independent; compute
+    # it once for the whole dry run instead of per passes_filters call
+    ctx = oracle._pod_filter_ctx(pod)
+    candidates: List[Candidate] = []
+    for ns in oracle.nodes:
+        # nodesWherePreemptionMightHelp: filters marked the node
+        # UnschedulableAndUnresolvable -> removing pods cannot help
+        if codes.get(ns.index) == "unresolvable":
+            continue
+        got = select_victims_on_node(oracle, pod, ns, pdbs, ctx=ctx)
+        if got is None:
+            continue
+        victims, num_violating = got
+        # every victim reprieved -> the cycle's failure on this node
+        # came from state the dry run does not model (an extender
+        # filter); evicting nothing cannot help, and the vendored
+        # pickOneNodeForPreemption would index victims[0] (a latent
+        # upstream panic, default_preemption.go:475). Drop it.
+        if not victims:
+            continue
+        candidates.append(
+            Candidate(
+                node_index=ns.index,
+                node_name=ns.name,
+                victims=victims,
+                num_pdb_violations=num_violating,
+            )
+        )
+    best = pick_one_node(candidates, oracle)
+    if best is None:
+        return None
+    return PreemptionResult(
+        node_name=best.node_name, node_index=best.node_index, victims=best.victims
+    )

@@ -22,8 +22,8 @@ from .core import NodeStatus, SimulateResult, Simulator
 SNAPSHOT_VERSION = 1
 
 
-def snapshot_to_dict(result: SimulateResult) -> dict:
-    return {
+def snapshot_to_dict(result: SimulateResult, cluster: ResourceTypes = None) -> dict:
+    out = {
         "version": SNAPSHOT_VERSION,
         "nodes": [ns.node for ns in result.node_status],
         "pods": [p for ns in result.node_status for p in ns.pods],
@@ -31,11 +31,18 @@ def snapshot_to_dict(result: SimulateResult) -> dict:
             {"pod": up.pod, "reason": up.reason} for up in result.unscheduled_pods
         ],
     }
+    # cluster-scoped scheduling config (PDBs feed DefaultPreemption,
+    # PriorityClasses the admission emulation) so a resumed simulator
+    # agrees with a fresh simulate() on identical state
+    if cluster is not None:
+        out["podDisruptionBudgets"] = list(cluster.pod_disruption_budgets)
+        out["priorityClasses"] = list(cluster.priority_classes)
+    return out
 
 
-def save_snapshot(result: SimulateResult, path: str):
+def save_snapshot(result: SimulateResult, path: str, cluster: ResourceTypes = None):
     with open(path, "w") as f:
-        json.dump(snapshot_to_dict(result), f)
+        json.dump(snapshot_to_dict(result, cluster), f)
 
 
 def load_snapshot(path: str) -> SimulateResult:
@@ -57,24 +64,44 @@ def load_snapshot(path: str) -> SimulateResult:
             by_node[name].pods.append(pod)
     from .core import UnscheduledPod
 
-    return SimulateResult(
+    result = SimulateResult(
         unscheduled_pods=[
             UnscheduledPod(pod=u["pod"], reason=u["reason"]) for u in data.get("unscheduled", [])
         ],
         node_status=statuses,
     )
+    # carried alongside (not part of the scheduling result proper);
+    # resume_simulator picks these up
+    result.snapshot_extras = {
+        "pdbs": data.get("podDisruptionBudgets") or [],
+        "priority_classes": data.get("priorityClasses") or [],
+    }
+    return result
 
 
-def resume_simulator(result: SimulateResult, engine: str = "tpu") -> Simulator:
+def resume_simulator(
+    result: SimulateResult,
+    engine: str = "tpu",
+    pdbs=None,
+    priority_classes=None,
+) -> Simulator:
     """Rebuild a live Simulator from a snapshot: nodes re-admitted with
     their mutated annotations, pods re-placed with their bindings (GPU
-    devices honored via the gpu-index annotation)."""
+    devices honored via the gpu-index annotation). PDBs and
+    PriorityClasses default to what load_snapshot carried
+    (snapshot_extras) so preemption on the resumed simulator matches a
+    fresh simulate()."""
+    extras = getattr(result, "snapshot_extras", {}) or {}
+    if pdbs is None:
+        pdbs = extras.get("pdbs") or []
+    if priority_classes is None:
+        priority_classes = extras.get("priority_classes") or []
     sim = Simulator(engine=engine)
     cluster = ResourceTypes()
     cluster.nodes = [ns.node for ns in result.node_status]
     from .oracle import Oracle
 
-    sim.oracle = Oracle(cluster.nodes)
+    sim.oracle = Oracle(cluster.nodes, pdbs=pdbs, priority_classes=priority_classes)
     for ns in result.node_status:
         for pod in ns.pods:
             sim.oracle.place_existing_pod(pod)

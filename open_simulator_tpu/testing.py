@@ -162,6 +162,30 @@ def with_affinity(affinity: dict) -> Option:
     return opt
 
 
+def with_priority(value: int) -> Option:
+    """spec.priority — what the admission chain would stamp from a
+    priorityClassName (scheduler/preemption.py)."""
+
+    def opt(obj):
+        _spec_of(obj)["priority"] = value
+
+    return opt
+
+
+def with_priority_class(name: str) -> Option:
+    def opt(obj):
+        _spec_of(obj)["priorityClassName"] = name
+
+    return opt
+
+
+def with_preemption_policy(policy: str) -> Option:
+    def opt(obj):
+        _spec_of(obj)["preemptionPolicy"] = policy
+
+    return opt
+
+
 def with_node_name(node_name: str) -> Option:
     def opt(obj):
         _spec_of(obj)["nodeName"] = node_name

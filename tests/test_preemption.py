@@ -1,0 +1,317 @@
+"""Priority & preemption (DefaultPreemption + PrioritySort).
+
+Covers scheduler/preemption.py + the oracle PostFilter hook against the
+reference semantics of vendor/.../defaultpreemption/default_preemption.go
+and queuesort/priority_sort.go.
+"""
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.testing import (
+    make_fake_node,
+    make_fake_pod,
+    with_labels,
+    with_node_selector,
+    with_node_labels,
+    with_preemption_policy,
+    with_priority,
+    with_priority_class,
+)
+
+
+def _cluster(nodes, pods=(), pdbs=(), priority_classes=()):
+    return ResourceTypes(
+        nodes=list(nodes),
+        pods=list(pods),
+        pod_disruption_budgets=list(pdbs),
+        priority_classes=list(priority_classes),
+    )
+
+
+def _app(name, pods):
+    return AppResource(name=name, resource=ResourceTypes(pods=list(pods)))
+
+
+def _placement(result):
+    """pod name -> node name over the final cluster state."""
+    out = {}
+    for st in result.node_status:
+        for p in st.pods:
+            out[p["metadata"]["name"]] = st.node["metadata"]["name"]
+    return out
+
+
+# ---------------------------------------------------------------- ordering
+
+
+def test_priority_sort_orders_app_pods():
+    # one node that fits exactly one pod: the high-priority pod must be
+    # scheduled first even though it is listed last
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    pods = [
+        make_fake_pod("low", "default", "800m", "1Gi", with_priority(1)),
+        make_fake_pod("high", "default", "800m", "1Gi", with_priority(100)),
+    ]
+    # disable preemption effects by giving `low` nothing to preempt:
+    # it simply fails after `high` takes the node
+    result = simulate(_cluster(nodes), [_app("a", pods)])
+    assert _placement(result).get("high") == "node-1"
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["low"]
+    assert not result.preemptions
+
+
+# -------------------------------------------------------------- preemption
+
+
+def test_basic_preemption_evicts_lower_priority():
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi", with_priority(0))
+    preemptor = make_fake_pod("pre", "default", "800m", "1Gi", with_priority(100))
+    result = simulate(_cluster(nodes, pods=[victim]), [_app("a", [preemptor])])
+    assert _placement(result).get("pre") == "node-1"
+    assert len(result.preemptions) == 1
+    ev = result.preemptions[0]
+    assert ev.victim["metadata"]["name"] == "victim"
+    assert ev.node_name == "node-1"
+    assert ev.preemptor == "pre"
+    # the re-enqueued victim has nowhere to go
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["victim"]
+
+
+def test_victim_reschedules_elsewhere():
+    nodes = [
+        make_fake_node("node-1", "1", "4Gi", with_node_labels({"disk": "ssd"})),
+        make_fake_node("node-2", "1", "4Gi"),
+    ]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi")
+    # the preemptor can only run on node-1 (nodeSelector), where victim sits
+    preemptor = make_fake_pod(
+        "pre", "default", "800m", "1Gi", with_priority(10), with_node_selector({"disk": "ssd"})
+    )
+    cluster = _cluster(nodes)
+    cluster.pods.append(dict(victim, spec=dict(victim["spec"], nodeName="node-1")))
+    result = simulate(cluster, [_app("a", [preemptor])])
+    placed = _placement(result)
+    assert placed.get("pre") == "node-1"
+    assert placed.get("victim") == "node-2"
+    assert result.all_scheduled
+    assert len(result.preemptions) == 1
+
+
+def test_preemption_policy_never():
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi")
+    preemptor = make_fake_pod(
+        "pre", "default", "800m", "1Gi", with_priority(100), with_preemption_policy("Never")
+    )
+    result = simulate(_cluster(nodes, pods=[victim]), [_app("a", [preemptor])])
+    assert not result.preemptions
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["pre"]
+
+
+def test_no_preemption_among_equal_priorities():
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    a = make_fake_pod("a", "default", "800m", "1Gi", with_priority(5))
+    b = make_fake_pod("b", "default", "800m", "1Gi", with_priority(5))
+    result = simulate(_cluster(nodes, pods=[a]), [_app("x", [b])])
+    assert not result.preemptions
+    assert len(result.unscheduled_pods) == 1
+
+
+# ------------------------------------------------------------ PDB awareness
+
+
+def test_pdb_prefers_non_violating_node():
+    nodes = [
+        make_fake_node("node-1", "1", "4Gi"),
+        make_fake_node("node-2", "1", "4Gi"),
+    ]
+    protected = make_fake_pod(
+        "web-0", "default", "800m", "1Gi", with_labels({"app": "web"})
+    )
+    unprotected = make_fake_pod(
+        "batch-0", "default", "800m", "1Gi", with_labels({"app": "batch"})
+    )
+    pdb = {
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": "web-pdb", "namespace": "default"},
+        "spec": {"minAvailable": 1, "selector": {"matchLabels": {"app": "web"}}},
+        # no status -> disruptionsAllowed defaults to 0 (fake client:
+        # no disruption controller ever fills it in)
+    }
+    cluster = _cluster(nodes, pdbs=[pdb])
+    cluster.pods.append(dict(protected, spec=dict(protected["spec"], nodeName="node-1")))
+    cluster.pods.append(
+        dict(unprotected, spec=dict(unprotected["spec"], nodeName="node-2"))
+    )
+    preemptor = make_fake_pod("pre", "default", "800m", "1Gi", with_priority(10))
+    result = simulate(cluster, [_app("a", [preemptor])])
+    assert len(result.preemptions) == 1
+    # node-2's victim violates no PDB -> preferred candidate
+    assert result.preemptions[0].victim["metadata"]["name"] == "batch-0"
+    assert _placement(result).get("pre") == "node-2"
+
+
+def test_picks_minimum_highest_victim_priority():
+    nodes = [
+        make_fake_node("node-1", "1", "4Gi"),
+        make_fake_node("node-2", "1", "4Gi"),
+    ]
+    hi_victim = make_fake_pod("v-hi", "default", "800m", "1Gi", with_priority(5))
+    lo_victim = make_fake_pod("v-lo", "default", "800m", "1Gi", with_priority(3))
+    cluster = _cluster(nodes)
+    cluster.pods.append(dict(hi_victim, spec=dict(hi_victim["spec"], nodeName="node-1")))
+    cluster.pods.append(dict(lo_victim, spec=dict(lo_victim["spec"], nodeName="node-2")))
+    preemptor = make_fake_pod("pre", "default", "800m", "1Gi", with_priority(10))
+    result = simulate(cluster, [_app("a", [preemptor])])
+    assert len(result.preemptions) == 1
+    assert result.preemptions[0].victim["metadata"]["name"] == "v-lo"
+
+
+def test_reprieve_keeps_higher_priority_victim():
+    # node fits 2 of the 3 pods; evicting only the lowest-priority
+    # victim is enough, the higher one is reprieved
+    nodes = [make_fake_node("node-1", "2", "8Gi")]
+    v_hi = make_fake_pod("v-hi", "default", "800m", "1Gi", with_priority(5))
+    v_lo = make_fake_pod("v-lo", "default", "800m", "1Gi", with_priority(1))
+    cluster = _cluster(nodes)
+    cluster.pods.append(dict(v_hi, spec=dict(v_hi["spec"], nodeName="node-1")))
+    cluster.pods.append(dict(v_lo, spec=dict(v_lo["spec"], nodeName="node-1")))
+    preemptor = make_fake_pod("pre", "default", "800m", "1Gi", with_priority(10))
+    result = simulate(cluster, [_app("a", [preemptor])])
+    assert [ev.victim["metadata"]["name"] for ev in result.preemptions] == ["v-lo"]
+    placed = _placement(result)
+    assert placed.get("pre") == "node-1"
+    assert placed.get("v-hi") == "node-1"
+
+
+# ------------------------------------------------- eligibility of the nodes
+
+
+def test_unresolvable_nodes_not_considered():
+    # the preemptor's nodeSelector rejects node-1 -> evicting its pods
+    # cannot help (nodesWherePreemptionMightHelp)
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi")
+    preemptor = make_fake_pod(
+        "pre", "default", "100m", "1Gi", with_priority(10), with_node_selector({"x": "y"})
+    )
+    result = simulate(_cluster(nodes, pods=[victim]), [_app("a", [preemptor])])
+    assert not result.preemptions
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["pre"]
+
+
+# -------------------------------------------------------- priority classes
+
+
+def test_priority_class_resolution():
+    pc = {
+        "kind": "PriorityClass",
+        "apiVersion": "scheduling.k8s.io/v1",
+        "metadata": {"name": "important"},
+        "value": 1000,
+    }
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi")
+    preemptor = make_fake_pod(
+        "pre", "default", "800m", "1Gi", with_priority_class("important")
+    )
+    result = simulate(
+        _cluster(nodes, pods=[victim], priority_classes=[pc]), [_app("a", [preemptor])]
+    )
+    assert _placement(result).get("pre") == "node-1"
+    assert len(result.preemptions) == 1
+
+
+def test_global_default_priority_class():
+    # a globalDefault class raises the priority of pods with no
+    # priority fields: the "victim" outranks the explicit priority-5
+    # preemptor, so nothing is preempted
+    pc = {
+        "kind": "PriorityClass",
+        "metadata": {"name": "default-high"},
+        "value": 1000,
+        "globalDefault": True,
+    }
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    resident = make_fake_pod("resident", "default", "800m", "1Gi")
+    pod = make_fake_pod("pre", "default", "800m", "1Gi", with_priority(5))
+    result = simulate(
+        _cluster(nodes, pods=[resident], priority_classes=[pc]), [_app("a", [pod])]
+    )
+    assert not result.preemptions
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["pre"]
+
+
+def test_priority_class_preemption_policy_never():
+    pc = {
+        "kind": "PriorityClass",
+        "metadata": {"name": "polite"},
+        "value": 1000,
+        "preemptionPolicy": "Never",
+    }
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi")
+    preemptor = make_fake_pod(
+        "pre", "default", "800m", "1Gi", with_priority_class("polite")
+    )
+    result = simulate(
+        _cluster(nodes, pods=[victim], priority_classes=[pc]), [_app("a", [preemptor])]
+    )
+    assert not result.preemptions
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["pre"]
+
+
+def test_builtin_priority_classes():
+    nodes = [make_fake_node("node-1", "1", "4Gi")]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi")
+    preemptor = make_fake_pod(
+        "pre", "default", "800m", "1Gi", with_priority_class("system-cluster-critical")
+    )
+    result = simulate(_cluster(nodes, pods=[victim]), [_app("a", [preemptor])])
+    assert _placement(result).get("pre") == "node-1"
+
+
+# ------------------------------------------------------------- engine path
+
+
+def test_tpu_engine_falls_back_to_oracle_on_priority():
+    nodes = [make_fake_node("node-1", "1", "4Gi"), make_fake_node("node-2", "1", "4Gi")]
+    victim = make_fake_pod("victim", "default", "800m", "1Gi")
+    preemptor = make_fake_pod(
+        "pre", "default", "800m", "1Gi", with_priority(10), with_node_selector({"x": "y"})
+    )
+    nodes[0]["metadata"].setdefault("labels", {})["x"] = "y"
+    cluster = _cluster(nodes)
+    cluster.pods.append(dict(victim, spec=dict(victim["spec"], nodeName="node-1")))
+    for engine in ("oracle", "tpu"):
+        result = simulate(cluster, [_app("a", [preemptor])], engine=engine)
+        placed = _placement(result)
+        assert placed.get("pre") == "node-1", engine
+        assert placed.get("victim") == "node-2", engine
+        assert len(result.preemptions) == 1, engine
+
+
+def test_cascading_preemption_terminates():
+    # pre(20) evicts mid(10); mid then evicts low(0) on the other node
+    nodes = [
+        make_fake_node("node-1", "1", "4Gi", with_node_labels({"grp": "a"})),
+        make_fake_node("node-2", "1", "4Gi"),
+    ]
+    low = make_fake_pod("low", "default", "800m", "1Gi", with_priority(0))
+    mid = make_fake_pod(
+        "mid", "default", "800m", "1Gi", with_priority(10), with_node_selector({})
+    )
+    mid["spec"].pop("nodeSelector", None)
+    pre = make_fake_pod(
+        "pre", "default", "800m", "1Gi", with_priority(20), with_node_selector({"grp": "a"})
+    )
+    cluster = _cluster(nodes)
+    cluster.pods.append(dict(mid, spec=dict(mid["spec"], nodeName="node-1")))
+    cluster.pods.append(dict(low, spec=dict(low["spec"], nodeName="node-2")))
+    result = simulate(cluster, [_app("a", [pre])])
+    placed = _placement(result)
+    assert placed.get("pre") == "node-1"
+    assert placed.get("mid") == "node-2"
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["low"]
+    assert len(result.preemptions) == 2

@@ -127,12 +127,6 @@ class CapacitySweep:
             self.oracle = Oracle(padded.nodes)
             pods: List[dict] = []
             pods.extend(wl.pods_excluding_daemon_sets(padded))
-            if cluster.priority_classes:
-                raise PrioritySignalError(
-                    "cluster defines PriorityClass objects; the batched scan "
-                    "has no priority/preemption semantics — use the serial "
-                    "engine (scheduler/core.py falls back automatically)"
-                )
             for ds in padded.daemon_sets:
                 pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
             for app in apps:
@@ -148,9 +142,13 @@ class CapacitySweep:
 
                     app_pods = greed_sort(padded.nodes, app_pods)
                 pods.extend(_sort_app_pods(app_pods))
-            from ..scheduler.preemption import pod_uses_priority
+            from ..scheduler.preemption import (
+                build_priority_resolver,
+                pod_uses_priority,
+            )
 
-            if any(pod_uses_priority(p) for p in pods):
+            resolver = build_priority_resolver(cluster.priority_classes)
+            if any(pod_uses_priority(p, resolver) for p in pods):
                 raise PrioritySignalError(
                     "workload carries priority/priorityClassName; the batched "
                     "scan has no priority/preemption semantics — use the "

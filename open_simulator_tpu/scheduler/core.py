@@ -120,7 +120,20 @@ class Simulator:
         # affinity/toleration-sorted order, so a stable sort keeps it.
         # (In the reference this Less never reorders anything: the
         # serial handshake keeps at most one pod in the active queue.)
-        pods = sorted(pods, key=lambda p: -self.oracle.pod_priority(p))
+        # Applied only when a priority signal exists, so the no-priority
+        # case keeps the reference's exact list order; nodeName-bound
+        # pods commit first — their capacity is occupied regardless of
+        # queue order, and sorting a pending pod ahead of them would
+        # let it bind into capacity they already hold.
+        from .preemption import pod_uses_priority
+
+        if self.oracle.saw_priority or any(
+            pod_uses_priority(p, self.oracle._prio_resolver) for p in pods
+        ):
+            bound = [p for p in pods if (p.get("spec") or {}).get("nodeName")]
+            pending = [p for p in pods if not (p.get("spec") or {}).get("nodeName")]
+            pending.sort(key=lambda p: -self.oracle.pod_priority(p))
+            pods = bound + pending
         return self._schedule_pods(pods)
 
     def _schedule_pods(self, pods: List[dict]) -> SimulateResult:
@@ -133,7 +146,7 @@ class Simulator:
         use_tpu = (
             self.engine_kind == "tpu"
             and not self.oracle.saw_priority
-            and not any(pod_uses_priority(p) for p in pods)
+            and not any(pod_uses_priority(p, self.oracle._prio_resolver) for p in pods)
         )
         if use_tpu:
             failed = self._schedule_pods_tpu(pods)

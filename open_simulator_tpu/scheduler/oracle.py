@@ -287,6 +287,12 @@ class Oracle:
                 devs = [int(d) for d in str(idx).split("-") if str(d).isdigit()]
             else:
                 devs = ns.gpu.allocate_gpu_ids(gpu_mem, gpu_cnt or 1)
+                if devs:
+                    # stamp the allocation so eviction (remove_pod_from_node)
+                    # can release exactly these devices
+                    pod.setdefault("metadata", {}).setdefault("annotations", {})[
+                        stor.GPU_INDEX_ANNO
+                    ] = "-".join(str(d) for d in devs)
             if devs:
                 ns.gpu.commit(devs, gpu_mem)
                 ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
@@ -303,7 +309,7 @@ class Oracle:
         if not self.saw_priority:
             from .preemption import pod_uses_priority
 
-            if pod_uses_priority(pod):
+            if pod_uses_priority(pod, self._prio_resolver):
                 self.saw_priority = True
         try:
             feasible, reasons, codes = self._find_feasible(pod)
@@ -1232,7 +1238,7 @@ class Oracle:
         if not self.saw_priority:
             from .preemption import pod_uses_priority
 
-            if pod_uses_priority(pod):
+            if pod_uses_priority(pod, self._prio_resolver):
                 self.saw_priority = True
 
     # -- pod removal (preemption) -------------------------------------------

@@ -1,0 +1,349 @@
+"""Direct tests of the offline Helm renderer (models/chart.py).
+
+The reference renders charts with the real helm engine
+(pkg/chart/chart.go:54-118); these tests pin our Go-template subset on
+(a) the reference's yoda chart (the flagship simon-config.yaml app) and
+(b) synthetic charts exercising each template feature the engine
+claims: range, with, include/_helpers.tpl named templates, $-variables,
+pipelines, and subchart dependencies with condition gating.
+"""
+
+import os
+import textwrap
+
+import pytest
+import yaml
+
+from open_simulator_tpu.models.chart import (
+    ChartError,
+    process_chart,
+    render_template,
+)
+
+YODA = "/root/reference/example/application/charts/yoda"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(YODA), reason="reference example charts not mounted"
+)
+
+
+def write_chart(root, name, files, chart_yaml=None, values=None):
+    path = os.path.join(str(root), name)
+    os.makedirs(os.path.join(path, "templates"), exist_ok=True)
+    with open(os.path.join(path, "Chart.yaml"), "w") as f:
+        yaml.safe_dump(chart_yaml or {"name": name, "version": "0.1.0"}, f)
+    if values is not None:
+        with open(os.path.join(path, "values.yaml"), "w") as f:
+            yaml.safe_dump(values, f)
+    for rel, text in files.items():
+        fpath = os.path.join(path, "templates", rel)
+        os.makedirs(os.path.dirname(fpath), exist_ok=True)
+        with open(fpath, "w") as f:
+            f.write(textwrap.dedent(text))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# yoda: the chart the reference's acceptance scenario installs
+# ---------------------------------------------------------------------------
+
+
+@needs_reference
+def test_yoda_renders_all_manifests():
+    manifests = [yaml.safe_load(m) for m in process_chart("yoda", YODA)]
+    kinds = [m["kind"] for m in manifests]
+    # 5 storage classes + service + daemonset + 4 deployment-ish +
+    # statefulset + jobs/cronjob (storage-class.yaml holds five docs)
+    assert kinds.count("StorageClass") == 5
+    assert "DaemonSet" in kinds and "Service" in kinds and "CronJob" in kinds
+    # InstallOrder: StorageClass before Service before DaemonSet before
+    # Deployment/StatefulSet/Job/CronJob
+    assert kinds.index("Service") > kinds.index("StorageClass")
+    assert kinds.index("DaemonSet") > kinds.index("Service")
+    assert kinds.index("CronJob") == len(kinds) - 1
+
+
+@needs_reference
+def test_yoda_snapshot_values():
+    """Spot-pin rendered content: values substitution, int coercion of
+    the NodePort, release name, and the SingleMasterMode conditional."""
+    manifests = [yaml.safe_load(m) for m in process_chart("yoda", YODA)]
+    values = yaml.safe_load(open(os.path.join(YODA, "values.yaml")))
+
+    svc = next(m for m in manifests if m["kind"] == "Service")
+    port = svc["spec"]["ports"][0]
+    assert port["nodePort"] == int(values["globalconfig"]["YodaSchedulerNodePort"])
+
+    # SingleMasterMode=false in values.yaml selects the else branch
+    # (replicas: 2) in resizer/snapshotter/provisioner
+    assert values["globalconfig"]["SingleMasterMode"] is False
+    resizer = next(
+        m for m in manifests if "resizer" in m["metadata"]["name"]
+    )
+    assert resizer["spec"]["replicas"] == 2
+    image = resizer["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert image.startswith(values["globalconfig"]["RegistryURL"])
+
+    ds = next(m for m in manifests if m["kind"] == "DaemonSet")
+    assert ds["metadata"]["namespace"] == values["yoda_namespace"]
+
+
+# ---------------------------------------------------------------------------
+# template language features
+# ---------------------------------------------------------------------------
+
+
+def test_range_over_list_and_dict():
+    ctx = {"Values": {"ports": [80, 443], "labels": {"b": "2", "a": "1"}}}
+    out = render_template(
+        "{{- range .Values.ports }}\np{{ . }}{{- end }}", ctx
+    )
+    assert out == "\np80\np443"
+    # maps iterate in sorted key order (Go template semantics)
+    out = render_template(
+        "{{- range $k, $v := .Values.labels }}{{ $k }}={{ $v }};{{- end }}", ctx
+    )
+    assert out == "a=1;b=2;"
+
+
+def test_range_else_and_index_var():
+    out = render_template(
+        "{{- range $i, $x := .Values.xs }}{{ $i }}:{{ $x }} {{ end }}",
+        {"Values": {"xs": ["a", "b"]}},
+    )
+    assert out.strip() == "0:a 1:b"
+    out = render_template(
+        "{{- range .Values.none }}x{{ else }}empty{{ end }}", {"Values": {}}
+    )
+    assert out == "empty"
+
+
+def test_with_rebinds_dot_and_dollar_stays_root():
+    ctx = {"Values": {"img": {"repo": "r", "tag": "t"}, "top": "T"}}
+    out = render_template(
+        "{{- with .Values.img }}{{ .repo }}:{{ .tag }}@{{ $.Values.top }}{{- end }}",
+        ctx,
+    )
+    assert out == "r:t@T"
+    out = render_template("{{ with .Values.missing }}x{{ else }}fallback{{ end }}", ctx)
+    assert out == "fallback"
+
+
+def test_variables_scope_and_assignment():
+    out = render_template(
+        "{{- $x := 1 }}{{- if true }}{{- $x = 2 }}{{- end }}{{ $x }}", {}
+    )
+    assert out.strip() == "2"  # = mutates the outer variable
+    out = render_template(
+        "{{- $x := 1 }}{{- if true }}{{- $x := 9 }}{{- end }}{{ $x }}", {}
+    )
+    assert out.strip() == "1"  # := shadows inside the block
+
+
+def test_pipelines_and_functions():
+    ctx = {"Values": {"name": "my-app", "n": 3}}
+    assert render_template("{{ .Values.name | upper | quote }}", ctx) == '"MY-APP"'
+    assert render_template("{{ .Values.missing | default \"d\" }}", ctx) == "d"
+    assert render_template("{{ printf \"%s-%d\" .Values.name .Values.n }}", ctx) == "my-app-3"
+    assert render_template("{{ .Values.name | trunc 2 }}", ctx) == "my"
+    assert render_template("{{ .Values.name | trimSuffix \"-app\" }}", ctx) == "my"
+    assert render_template("{{ add 1 2 3 }}", ctx) == "6"
+    assert render_template("{{ ternary \"a\" \"b\" true }}", ctx) == "a"
+    assert (
+        render_template("{{ if and true (eq .Values.n 3) }}y{{ end }}", ctx) == "y"
+    )
+
+
+def test_nindent_toyaml():
+    ctx = {"Values": {"res": {"limits": {"cpu": "1"}}}}
+    out = render_template(
+        "resources:{{ .Values.res | toYaml | nindent 2 }}", ctx
+    )
+    assert out == "resources:\n  limits:\n    cpu: '1'"
+
+
+def test_include_from_helpers_tpl(tmp_path):
+    path = write_chart(
+        tmp_path,
+        "incl",
+        {
+            "_helpers.tpl": """\
+            {{- define "incl.fullname" -}}
+            {{ .Release.Name }}-{{ .Chart.name }}
+            {{- end }}
+            """,
+            "cm.yaml": """\
+            apiVersion: v1
+            kind: ConfigMap
+            metadata:
+              name: {{ include "incl.fullname" . }}
+              labels:
+                viaTemplate: {{ template "incl.fullname" . }}
+            """,
+        },
+    )
+    (doc,) = [yaml.safe_load(m) for m in process_chart("rel", path)]
+    assert doc["metadata"]["name"] == "rel-incl"
+    assert doc["metadata"]["labels"]["viaTemplate"] == "rel-incl"
+
+
+def test_include_with_dict_context_and_nindent(tmp_path):
+    path = write_chart(
+        tmp_path,
+        "dict",
+        {
+            "_helpers.tpl": """\
+            {{- define "dict.labels" -}}
+            app: {{ .app }}
+            rel: {{ .rel }}
+            {{- end }}
+            """,
+            "cm.yaml": """\
+            kind: ConfigMap
+            metadata:
+              name: x
+              labels:
+                {{- include "dict.labels" (dict "app" .Chart.name "rel" .Release.Name) | nindent 4 }}
+            """,
+        },
+    )
+    (doc,) = [yaml.safe_load(m) for m in process_chart("r1", path)]
+    assert doc["metadata"]["labels"] == {"app": "dict", "rel": "r1"}
+
+
+def test_subchart_condition_and_value_scoping(tmp_path):
+    parent = write_chart(
+        tmp_path,
+        "parent",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: parent-{{ .Values.who }}\n"},
+        chart_yaml={
+            "name": "parent",
+            "version": "1.0.0",
+            "dependencies": [
+                {"name": "childa", "condition": "childa.enabled"},
+                {"name": "childb", "condition": "childb.enabled"},
+            ],
+        },
+        values={
+            "who": "p",
+            "global": {"zone": "z1"},
+            "childa": {"enabled": True, "who": "override"},
+            "childb": {"enabled": False},
+        },
+    )
+    write_chart(
+        os.path.join(parent, "charts"),
+        "childa",
+        {
+            "cm.yaml": "kind: ConfigMap\nmetadata:\n"
+            "  name: a-{{ .Values.who }}-{{ .Values.global.zone }}\n"
+        },
+        values={"who": "default"},
+    )
+    write_chart(
+        os.path.join(parent, "charts"),
+        "childb",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: b\n"},
+    )
+    docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
+    names = sorted(d["metadata"]["name"] for d in docs)
+    # childb disabled by condition; childa sees parent override + global
+    assert names == ["a-override-z1", "parent-p"]
+
+
+def test_required_raises_and_notes_skipped(tmp_path):
+    path = write_chart(
+        tmp_path,
+        "req",
+        {
+            "cm.yaml": "kind: ConfigMap\nmetadata:\n"
+            '  name: {{ required "who is required" .Values.who }}\n',
+            "NOTES.txt": "{{ fail \"NOTES must never render\" }}",
+        },
+    )
+    with pytest.raises(ChartError, match="who is required"):
+        process_chart("rel", path)
+    docs = process_chart("rel", path, extra_values={"who": "ok"})
+    assert len(docs) == 1 and yaml.safe_load(docs[0])["metadata"]["name"] == "ok"
+
+
+def test_install_order_sorting(tmp_path):
+    path = write_chart(
+        tmp_path,
+        "order",
+        {
+            "z.yaml": "kind: Deployment\nmetadata:\n  name: d\n",
+            "a.yaml": "kind: Service\nmetadata:\n  name: s\n---\n"
+            "kind: Namespace\nmetadata:\n  name: n\n",
+        },
+    )
+    kinds = [yaml.safe_load(m)["kind"] for m in process_chart("rel", path)]
+    assert kinds == ["Namespace", "Service", "Deployment"]
+
+
+def test_capabilities_and_api_versions():
+    out = render_template(
+        "{{ if .Capabilities.APIVersions.Has \"apps/v1\" }}v{{ .Capabilities.KubeVersion.Minor }}{{ end }}",
+        {"Capabilities": __import__(
+            "open_simulator_tpu.models.chart", fromlist=["default_capabilities"]
+        ).default_capabilities()},
+    )
+    assert out == "v20"
+
+
+def test_tpl_renders_string_values():
+    ctx = {"Values": {"tmpl": "hello {{ .Values.name }}", "name": "w"}}
+    assert render_template("{{ tpl .Values.tmpl . }}", ctx) == "hello w"
+
+
+def test_index_function():
+    ctx = {"Values": {"images": ["a", "b"], "anno": {"k.with.dots": "v"}}}
+    assert render_template("{{ index .Values.images 1 }}", ctx) == "b"
+    assert render_template('{{ index .Values.anno "k.with.dots" }}', ctx) == "v"
+    assert render_template("{{ index .Values.images 9 }}", ctx) == ""
+
+
+def test_subchart_alias_condition_and_values(tmp_path):
+    """An aliased dependency is gated and value-scoped by its alias,
+    even though charts/ holds it under the chart name."""
+    parent = write_chart(
+        tmp_path,
+        "parent",
+        {},
+        chart_yaml={
+            "name": "parent",
+            "version": "1.0.0",
+            "dependencies": [
+                {"name": "redis", "alias": "cache", "condition": "cache.enabled"}
+            ],
+        },
+        values={"cache": {"enabled": True, "who": "aliased"}},
+    )
+    write_chart(
+        os.path.join(parent, "charts"),
+        "redis",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: r-{{ .Values.who }}\n"},
+        values={"who": "default"},
+    )
+    docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
+    assert [d["metadata"]["name"] for d in docs] == ["r-aliased"]
+    # disabled via the alias path -> not rendered
+    import shutil
+
+    parent2 = write_chart(
+        tmp_path,
+        "parent2",
+        {},
+        chart_yaml={
+            "name": "parent2",
+            "version": "1.0.0",
+            "dependencies": [
+                {"name": "redis", "alias": "cache", "condition": "cache.enabled"}
+            ],
+        },
+        values={"cache": {"enabled": False}},
+    )
+    shutil.copytree(
+        os.path.join(parent, "charts", "redis"), os.path.join(parent2, "charts", "redis")
+    )
+    assert process_chart("rel", parent2) == []

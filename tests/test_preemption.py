@@ -315,3 +315,99 @@ def test_cascading_preemption_terminates():
     assert placed.get("mid") == "node-2"
     assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["low"]
     assert len(result.preemptions) == 2
+
+
+# ------------------------------------------------- round-2 regression fixes
+
+
+def test_explicit_priority_zero_keeps_tpu_fast_path():
+    """A live-cluster import stamps spec.priority: 0 on every pod; that
+    must NOT disable the TPU scan (pod_uses_priority treats effective
+    priority 0 as no signal)."""
+    from open_simulator_tpu.scheduler.preemption import pod_uses_priority
+
+    assert not pod_uses_priority({"spec": {"priority": 0}})
+    assert not pod_uses_priority({"spec": {}})
+    assert pod_uses_priority({"spec": {"priority": 7}})
+    assert pod_uses_priority({"spec": {"priority": -1}})
+    # builtin classes resolve to ~2e9 — that is a signal
+    assert pod_uses_priority({"spec": {"priorityClassName": "system-cluster-critical"}})
+
+    nodes = [make_fake_node("n1", "4", "8Gi")]
+    pods = [
+        make_fake_pod("a", "default", "100m", "100Mi", with_priority(0)),
+        make_fake_pod("b", "default", "100m", "100Mi", with_priority(0)),
+    ]
+    result = simulate(_cluster(nodes), [_app("app", pods)], engine="tpu")
+    assert not result.unscheduled_pods
+
+
+def test_bound_pods_commit_before_priority_sorted_pending():
+    """A high-priority pending pod must not bind into capacity already
+    held by a nodeName-bound pod listed after it."""
+    nodes = [make_fake_node("n1", "1", "4Gi")]
+    bound = make_fake_pod("bound", "default", "800m", "1Gi", with_priority(0))
+    bound["spec"]["nodeName"] = "n1"
+    pending = make_fake_pod("pending", "default", "800m", "1Gi", with_priority(100))
+    result = simulate(_cluster(nodes), [_app("app", [pending, bound])])
+    # Before the fix, `pending` (sorted first) bound into n1's capacity
+    # and `bound` was force-committed on top: both on n1, over-committed,
+    # no preemption. Correct: bound commits first, pending preempts it.
+    assert _placement(result).get("pending") == "n1"
+    assert [e.victim["metadata"]["name"] for e in result.preemptions] == ["bound"]
+    assert [u.pod["metadata"]["name"] for u in result.unscheduled_pods] == ["bound"]
+    # n1 holds exactly one 800m pod — never both
+    ns = next(s for s in result.node_status if s.node["metadata"]["name"] == "n1")
+    assert len(ns.pods) == 1
+
+
+def test_pick_one_node_earliest_start_over_highest_priority_victims():
+    """Tie-break 5 (GetEarliestPodStartTime) considers only each node's
+    highest-priority victims, not all victims."""
+    from open_simulator_tpu.scheduler.preemption import Candidate, pick_one_node
+
+    prio = {"hx": 10, "old-low": 0, "hy": 10}
+    seq = {"hx": 100, "old-low": 1, "hy": 50}
+
+    class FakeOracle:
+        def pod_priority(self, pod):
+            return prio[pod["metadata"]["name"]]
+
+        def commit_seq_of(self, pod):
+            return seq[pod["metadata"]["name"]]
+
+    def pod(name):
+        return {"metadata": {"name": name}}
+
+    # node X: high-prio victim started LATER (seq 100) but also hosts an
+    # ancient low-prio victim (seq 1). node Y: high-prio victim seq 50.
+    # Upstream: compare only the highest-priority victims -> X (100) wins.
+    x = Candidate(node_index=0, node_name="x", victims=[pod("hx"), pod("old-low")], num_pdb_violations=0)
+    y = Candidate(node_index=1, node_name="y", victims=[pod("hy")], num_pdb_violations=0)
+    # equalize criteria 3 (sum) and 4 (count): give y a low-prio victim too
+    prio["young-low"] = 0
+    seq["young-low"] = 99
+    y.victims.append(pod("young-low"))
+    assert pick_one_node([x, y], FakeOracle()).node_name == "x"
+
+
+def test_evicting_unannotated_gpu_pod_releases_devices():
+    """place_existing_pod allocates devices for a bound GPU pod without
+    a gpu-index annotation; eviction must release exactly those devices
+    (round-2 fix: the allocation is stamped onto the pod)."""
+    from open_simulator_tpu.models import storage as stor
+    from open_simulator_tpu.scheduler.oracle import Oracle
+    from open_simulator_tpu.testing import with_node_gpu
+
+    node = make_fake_node("g1", "8", "16Gi", with_node_gpu(2, "32"))
+    oracle = Oracle([node])
+    pod = make_fake_pod("gpod", "default", "100m", "100Mi")
+    pod["spec"]["nodeName"] = "g1"
+    pod["metadata"].setdefault("annotations", {})[stor.GPU_MEM_ANNO] = "8"
+    oracle.place_existing_pod(pod)
+    ns = oracle.nodes[0]
+    assert sum(ns.gpu.used) == 8
+    # the allocation is now visible on the pod
+    assert pod["metadata"]["annotations"].get(stor.GPU_INDEX_ANNO)
+    oracle.remove_pod_from_node(ns, pod)
+    assert sum(ns.gpu.used) == 0

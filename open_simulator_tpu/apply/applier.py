@@ -8,9 +8,10 @@ Mirrors pkg/apply/apply.go:
 - app list: plain YAML dirs or Helm charts (pkg/chart rendering)
 - the capacity loop (apply.go:186-239): instead of interactively asking
   the user for a node count per iteration, all candidate counts up to
-  MaxNumNewNode are evaluated in ONE batched TPU sweep
-  (parallel/sweep.py); `interactive=True` keeps the reference's
-  ask-per-step shell on top of the precomputed sweep
+  MaxNumNewNode are evaluated via bisection probes over ONE encoded
+  padded cluster (parallel/sweep.py). The reference's ask-per-step
+  shell lives in apply/interactive.py (`simon apply -i`), driving the
+  same probe machinery one user guess at a time
 - utilization caps from MaxCPU/MaxMemory/MaxVG env vars
   (satisfyResourceSetting, apply.go:611-697)
 """

@@ -56,7 +56,15 @@ def _pod_req_summary(pod: dict):
     return mcpu, mem
 
 
-def report(node_statuses, extended_resources: Optional[List[str]] = None) -> str:
+def report(
+    node_statuses,
+    extended_resources: Optional[List[str]] = None,
+    select_nodes=None,
+) -> str:
+    """Render the result tables. `select_nodes` (a set of node names, or
+    None for all) filters the Pod Info table only — the reference's
+    interactive node multi-select (reportNodeInfo, apply.go:510-530)
+    narrows the pod table while the cluster tables stay complete."""
     extended_resources = extended_resources or []
     out = ["Node Info"]
     out.append(_node_table(node_statuses, extended_resources))
@@ -71,7 +79,16 @@ def report(node_statuses, extended_resources: Optional[List[str]] = None) -> str
             out.append(_gpu_table(node_statuses))
     out.append("")
     out.append("Pod Info")
-    out.append(_pod_table(node_statuses, extended_resources))
+    pod_statuses = (
+        node_statuses
+        if select_nodes is None
+        else [
+            ns
+            for ns in node_statuses
+            if ((ns.node.get("metadata") or {}).get("name")) in select_nodes
+        ]
+    )
+    out.append(_pod_table(pod_statuses, extended_resources))
     return "\n".join(out)
 
 

@@ -68,17 +68,15 @@ def cmd_apply(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
-    select = None
     if args.interactive:
-        names = [a.name for a in config.app_list]
-        print("Apps in config:")
-        for i, n in enumerate(names):
-            print(f"  [{i}] {n}")
-        raw = input("Confirm your apps (comma-separated indices, empty = all): ").strip()
-        if raw:
-            idx = {int(x) for x in raw.split(",")}
-            select = [n for i, n in enumerate(names) if i in idx]
-    result = applier.run(select_apps=select)
+        # the reference's survey shell: app multi-select, then a
+        # per-iteration {show reasons | add node(s) | exit} loop, then
+        # node multi-select before the report (apply.go:157-239, 510-530)
+        from .apply.interactive import run_interactive
+
+        result = run_interactive(applier)
+    else:
+        result = applier.run()
     if args.trace:
         from .utils.trace import GLOBAL
 

@@ -1,0 +1,136 @@
+"""Scripted-stdin tests of the interactive shell (apply/interactive.py)
+— the reference's survey loop (pkg/apply/apply.go:157-239, 510-530)."""
+
+import io
+import os
+
+import yaml
+
+from open_simulator_tpu.apply.applier import Applier, SimonConfig
+from open_simulator_tpu.apply.interactive import Shell, run_interactive
+from open_simulator_tpu.testing import make_fake_node
+
+
+def _write_yaml(path, obj):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(obj, f)
+
+
+def _deployment(name, replicas, cpu):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {"requests": {"cpu": cpu}},
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+def _setup(tmp_path):
+    """1-cpu cluster node; app needs 2 cpu total; 2-cpu newnode spec."""
+    cluster = os.path.join(str(tmp_path), "cluster")
+    appdir = os.path.join(str(tmp_path), "app")
+    newnode = os.path.join(str(tmp_path), "newnode")
+    _write_yaml(os.path.join(cluster, "node.yaml"), make_fake_node("node-1", "1", "4Gi"))
+    _write_yaml(os.path.join(appdir, "deploy.yaml"), _deployment("web", 4, "500m"))
+    _write_yaml(
+        os.path.join(newnode, "node.yaml"), make_fake_node("template", "2", "8Gi")
+    )
+    from open_simulator_tpu.apply.applier import AppInfo
+
+    config = SimonConfig(
+        custom_cluster=cluster,
+        app_list=[AppInfo(name="web", path=appdir)],
+        new_node=newnode,
+    )
+    return config
+
+
+def _run(config, script, **applier_kw):
+    fin = io.StringIO("\n".join(script) + "\n")
+    fout = io.StringIO()
+    applier = Applier(config, interactive=True, **applier_kw)
+    result = run_interactive(applier, shell=Shell(fin=fin, fout=fout))
+    return result, fout.getvalue()
+
+
+def test_show_reasons_add_nodes_then_success(tmp_path):
+    config = _setup(tmp_path)
+    script = [
+        "",  # app multi-select: all
+        "0",  # unschedulable menu: show error events
+        "1",  # menu again: add node(s)
+        "2",  # input node number
+        "",  # node multi-select before report: all
+    ]
+    result, out = _run(config, script)
+    assert result.success
+    assert result.new_node_count == 2
+    assert "there are still" in out and "can not be scheduled when add 0 nodes" in out
+    # show-reasons listing printed namespace/name: reason lines
+    assert "default/web-" in out
+    assert "Insufficient cpu" in out
+    # the report ran after node multi-select
+    assert "select nodes that you want to report:" in out
+    assert "Node Info" in result.report_text
+    assert "simon-00" in result.report_text
+
+
+def test_exit_with_unscheduled_pods(tmp_path):
+    config = _setup(tmp_path)
+    script = [
+        "",  # app multi-select: all
+        "2",  # exit
+    ]
+    result, out = _run(config, script)
+    assert not result.success
+    assert "exited by user" in result.message
+    assert result.new_node_count == 0
+    assert result.result is not None and result.result.unscheduled_pods
+
+
+def test_select_by_name_and_node_report_filter(tmp_path):
+    """Apps can be picked by name; the node multi-select narrows the
+    Pod Info table while Node Info keeps every node."""
+    config = _setup(tmp_path)
+    script = [
+        "web",  # select the one app by name
+        "1",  # add node(s)
+        "1",  # input node number: 2 pods fit node-1, 2 fit the new node
+        "0",  # node multi-select: only node-1 in the pod table
+    ]
+    result, out = _run(config, script)
+    assert result.success
+    assert result.new_node_count == 1
+    # pod table narrowed to node-1, but Node Info still lists all nodes
+    assert "simon-00" in result.report_text.split("Pod Info")[0]
+    assert "simon-00" not in result.report_text.split("Pod Info")[1]
+
+
+def test_serial_evaluator_used_for_priority_workloads(tmp_path):
+    """A priority-bearing workload cannot ride the batched sweep; the
+    interactive loop falls back to serial simulate per guess."""
+    config = _setup(tmp_path)
+    appdir = config.app_list[0].path
+    doc = yaml.safe_load(open(os.path.join(appdir, "deploy.yaml")))
+    doc["spec"]["template"]["spec"]["priority"] = 10
+    _write_yaml(os.path.join(appdir, "deploy.yaml"), doc)
+    script = ["", "1", "2", ""]
+    result, out = _run(config, script)
+    assert result.success
+    assert result.new_node_count == 2

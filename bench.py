@@ -174,17 +174,23 @@ def build_capacity_scenario():
             "spec": {"replicas": replicas, "template": {"spec": spec}},
         }
 
-    # 100k pods, ~165k cpu requested vs 160k allocatable — and 435 of
-    # the base nodes are tainted (usable only by the tolerant class), so
-    # the aggregate lower bound is deliberately loose and the planner
-    # has to bisect: the plan needs tens of 96-cpu nodes
+    # 100k pods, ~160k cpu requested vs 160k allocatable — and 435 of
+    # the base nodes are tainted, usable only by the tolerant class.
+    # Spreading scores put only ~1/23 of the tolerant pods there, so
+    # ~5k tainted cpu is stranded and the effective supply is ~155k:
+    # the planner must bisect to tens of 96-cpu nodes. Class order
+    # matters too: the toleration queue sort schedules `tolerant` first
+    # and the rest in list order, so `small` (250m granule) lands last
+    # and back-fills the cpu fragments the coarse classes strand — the
+    # plan is driven by the aggregate shortfall, not by fragmentation
+    # (which no node count under MaxNumNewNode could fix).
     rep = CAP_PODS // 5
     resources = ResourceTypes()
     resources.deployments = [
-        deploy("small", rep, "250m", "512Mi"),
-        deploy("medium", rep, "1", "2Gi"),
+        deploy("memheavy", rep, "750m", "8Gi"),
         deploy("large", rep, "4", "8Gi"),
-        deploy("memheavy", rep, "1", "8Gi"),
+        deploy("medium", rep, "1", "2Gi"),
+        deploy("small", rep, "250m", "512Mi"),
         deploy("tolerant", rep, "2", "4Gi", tolerant=True),
     ]
     cluster = ResourceTypes()

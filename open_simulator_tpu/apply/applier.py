@@ -158,11 +158,20 @@ class ApplyResult:
     message: str = ""
 
 
+MAX_DETAILED_REASONS = 50
+
+
 def replay_scenario(sweep, count: int, placements):
     """Rebuild host-side oracle state from one scenario's scan
     placements (the same binding code the serial path uses — the
     engine-replay contract of scheduler/engine.py), producing the
-    SimulateResult for reports. Returns (result, oracle)."""
+    SimulateResult for reports. Returns (result, oracle).
+
+    Exact per-node failure reasons cost a full serial filter pass per
+    failed pod (O(nodes) Python), so only the first MAX_DETAILED_REASONS
+    failures get them; the rest carry a summary reason. A 100k-pod probe
+    with thousands of failures must not take hours to explain itself —
+    the caller that needs every reason runs the serial engine."""
     from ..scheduler.core import NodeStatus, SimulateResult, UnscheduledPod
     from ..scheduler.oracle import Oracle
 
@@ -180,10 +189,17 @@ def replay_scenario(sweep, count: int, placements):
             # else dangling: kept in the tracker, never scheduled
             # (reference simulator.go:221-229)
         elif idx < 0:
-            _, reasons, _ = oracle._find_feasible(pod)
-            failed.append(
-                UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reasons))
-            )
+            if len(failed) < MAX_DETAILED_REASONS:
+                _, reasons, _ = oracle._find_feasible(pod)
+                reason = Oracle._failure_message(pod, reasons)
+            else:
+                meta = pod.get("metadata") or {}
+                reason = (
+                    f"failed to schedule pod ({meta.get('namespace', 'default')}/"
+                    f"{meta.get('name', '')}): Unschedulable: "
+                    f"0/{len(nodes)} nodes are available"
+                )
+            failed.append(UnscheduledPod(pod=pod, reason=reason))
         else:
             oracle._reserve_and_bind(pod, oracle.nodes[idx])
     status = [NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes]
@@ -197,6 +213,7 @@ def probe_plan(
     use_greed: bool = False,
     extended_resources: Optional[List[str]] = None,
     max_count: int = MAX_NUM_NEW_NODE,
+    score_weights=None,
 ) -> ApplyResult:
     """Fast capacity plan: encode the padded cluster once, start at the
     aggregate-resource lower bound, bisect over candidate counts (each
@@ -207,7 +224,14 @@ def probe_plan(
     from ..parallel.sweep import CapacitySweep
     from ..utils.trace import phase
 
-    sweep = CapacitySweep(cluster, apps, new_node, max_count, use_greed=use_greed)
+    sweep = CapacitySweep(
+        cluster,
+        apps,
+        new_node,
+        max_count,
+        use_greed=use_greed,
+        score_weights=score_weights,
+    )
     max_cpu, max_mem, max_vg = _resource_caps()
 
     def feasible(res) -> bool:
@@ -273,11 +297,16 @@ class Applier:
         self.use_sweep = use_sweep
         self.use_greed = use_greed
         self.extenders = []
+        self.score_weights = None  # None = default profile weights
         self.last_cluster = None
         if scheduler_config:
-            from ..scheduler.extender import extenders_from_scheduler_config
+            # full KubeSchedulerConfiguration: extenders + score-plugin
+            # enable/disable/weights + percentageOfNodesToScore checks
+            from ..scheduler.schedconfig import load_scheduler_config
 
-            self.extenders = extenders_from_scheduler_config(scheduler_config)
+            cfg = load_scheduler_config(scheduler_config)
+            self.extenders = cfg.extenders
+            self.score_weights = cfg.score_weights
             if self.extenders:
                 # extenders are host RPC per pod: no batched sweep
                 self.use_sweep = False
@@ -324,6 +353,7 @@ class Applier:
             engine=self.engine,
             use_greed=self.use_greed,
             extenders=self.extenders,
+            score_weights=self.score_weights,
         )
 
     def run(self, select_apps=None) -> ApplyResult:
@@ -401,6 +431,7 @@ class Applier:
                 new_node,
                 use_greed=self.use_greed,
                 extended_resources=self.extended_resources,
+                score_weights=self.score_weights,
             )
         except PrioritySignalError as e:
             logging.getLogger(__name__).info(
@@ -423,7 +454,12 @@ class Applier:
         try:
             counts = list(range(0, MAX_NUM_NEW_NODE + 1))
             res = sweep_node_counts(
-                cluster, apps, new_node, counts, use_greed=self.use_greed
+                cluster,
+                apps,
+                new_node,
+                counts,
+                use_greed=self.use_greed,
+                score_weights=self.score_weights,
             )
         except PrioritySignalError:
             return None  # serial loop below handles priority/preemption

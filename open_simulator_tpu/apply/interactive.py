@@ -141,7 +141,12 @@ def _make_evaluator(applier, cluster, apps, new_node):
         try:
             return _ProbeEvaluator(
                 CapacitySweep(
-                    cluster, apps, new_node, MAX_NUM_NEW_NODE, use_greed=applier.use_greed
+                    cluster,
+                    apps,
+                    new_node,
+                    MAX_NUM_NEW_NODE,
+                    use_greed=applier.use_greed,
+                    score_weights=applier.score_weights,
                 )
             )
         except Exception:

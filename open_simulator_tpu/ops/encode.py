@@ -644,14 +644,16 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
     )
 
 
-def features_of_batch(cluster: ClusterStatic, batch: PodBatch):
+def features_of_batch(cluster: ClusterStatic, batch: PodBatch, weights=None):
     """ScanFeatures from the host-side encodings — same result as
     scan.features_of(static, pinned) but without device->host transfers
-    (the arrays are still numpy here)."""
+    (the arrays are still numpy here). `weights` is an optional
+    schedconfig.ScoreWeights overlay (static per compile)."""
     from .scan import ScanFeatures
 
     t = batch.terms
     return ScanFeatures(
+        weights=weights,
         gpu=bool(batch.gpu_mem.max(initial=0) > 0),
         storage=bool(batch.wants_storage.any()),
         ipa=bool((t.cls_rows >= 0).any() or (t.cls_group_id >= 0).any()),

@@ -33,6 +33,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..scheduler.schedconfig import DEFAULT_SCORE_WEIGHTS as _DEFAULT_WEIGHTS
+
 MAX_SCORE = 100
 
 
@@ -61,6 +63,11 @@ class ScanFeatures(NamedTuple):
     # emits only its one normalization; None = modes unknown at trace
     # time, select among all three with jnp.where
     custom_spec: tuple = None
+    # in-tree + simulator score-plugin weights from an optional
+    # KubeSchedulerConfiguration (scheduler/schedconfig.py). Static, so
+    # XLA constant-folds zero-weight plugins out of the step entirely;
+    # None = the default profile weights.
+    weights: tuple = None
 
     @property
     def terms(self) -> bool:
@@ -71,7 +78,7 @@ class ScanFeatures(NamedTuple):
 ALL_FEATURES = ScanFeatures(*([True] * 9))
 
 
-def features_of(static: "ScanStatic", pinned_node) -> ScanFeatures:
+def features_of(static: "ScanStatic", pinned_node, weights=None) -> ScanFeatures:
     """Derive the feature set host-side.
 
     Inputs are normally concrete arrays; when called from inside a
@@ -87,10 +94,11 @@ def features_of(static: "ScanStatic", pinned_node) -> ScanFeatures:
         isinstance(x, jax.core.Tracer)
         for x in (static.gpu_mem, static.wants_storage, pinned_node)
     ):
-        return ALL_FEATURES
+        return ALL_FEATURES._replace(weights=weights)
 
     a = np.asarray
     return ScanFeatures(
+        weights=weights,
         gpu=bool(a(static.gpu_mem).max(initial=0) > 0),
         storage=bool(a(static.wants_storage).any()),
         ipa=bool(
@@ -684,39 +692,50 @@ def _run_scan_compiled(
         feasible = feasible & ipa_ok & spread_ok
 
         # ---- scores ----
+        # Weights are static (a KubeSchedulerConfiguration overlay,
+        # scheduler/schedconfig.py); zero-weight plugins are skipped at
+        # trace time so XLA never sees them.
+        w = features.weights if features.weights is not None else _DEFAULT_WEIGHTS
+        total = jnp.zeros(n, dtype=jnp.int64)
         cpu_req_total = state.nz_mcpu + static.nz_mcpu[u]
         mem_req_total = state.nz_mem + static.nz_mem[u]
-        least = (
-            _least_requested(cpu_req_total, static.alloc_mcpu)
-            + _least_requested(mem_req_total, static.alloc_mem)
-        ) // 2
-        cpu_frac = cpu_req_total / jnp.maximum(static.alloc_mcpu, 1)
-        cpu_frac = jnp.where(static.alloc_mcpu > 0, cpu_frac, 1.0)
-        mem_frac = mem_req_total / jnp.maximum(static.alloc_mem, 1)
-        mem_frac = jnp.where(static.alloc_mem > 0, mem_frac, 1.0)
-        balanced = jnp.where(
-            (cpu_frac >= 1) | (mem_frac >= 1),
-            0,
-            ((1 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE).astype(jnp.int64),
-        )
-        nodeaff = _default_normalize(static.nodeaff_raw[u], feasible, reverse=False)
-        tainttol = _default_normalize(static.taint_intol[u], feasible, reverse=True)
-        simon = _minmax_normalize(static.simon_raw[u], feasible)
-        # PodTopologySpread soft score (all MaxNodeScore when the pod has
-        # no soft constraints — NormalizeScore maxScore==0 branch)
-        spread = soft_score(feasible)
-        total = (
-            balanced
-            + static.image_score[u]
-            + least
-            + nodeaff
-            + static.avoid_score[u] * 10000
-            + spread * 2
-            + tainttol
-            + simon  # Simon plugin
-            + simon  # Open-Gpu-Share plugin (identical formula)
-        )
-        if features.ipa:
+        if w.least:
+            least = (
+                _least_requested(cpu_req_total, static.alloc_mcpu)
+                + _least_requested(mem_req_total, static.alloc_mem)
+            ) // 2
+            total = total + least * w.least
+        if w.balanced:
+            cpu_frac = cpu_req_total / jnp.maximum(static.alloc_mcpu, 1)
+            cpu_frac = jnp.where(static.alloc_mcpu > 0, cpu_frac, 1.0)
+            mem_frac = mem_req_total / jnp.maximum(static.alloc_mem, 1)
+            mem_frac = jnp.where(static.alloc_mem > 0, mem_frac, 1.0)
+            balanced = jnp.where(
+                (cpu_frac >= 1) | (mem_frac >= 1),
+                0,
+                ((1 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE).astype(jnp.int64),
+            )
+            total = total + balanced * w.balanced
+        if w.nodeaff:
+            nodeaff = _default_normalize(static.nodeaff_raw[u], feasible, reverse=False)
+            total = total + nodeaff * w.nodeaff
+        if w.tainttol:
+            tainttol = _default_normalize(static.taint_intol[u], feasible, reverse=True)
+            total = total + tainttol * w.tainttol
+        if w.simon or w.gpushare:
+            # Simon and Open-Gpu-Share share one formula (simon.go:44-67)
+            simon = _minmax_normalize(static.simon_raw[u], feasible)
+            total = total + simon * (w.simon + w.gpushare)
+        if w.spread:
+            # PodTopologySpread soft score (all MaxNodeScore when the pod
+            # has no soft constraints — NormalizeScore maxScore==0 branch)
+            spread = soft_score(feasible)
+            total = total + spread * w.spread
+        if w.image:
+            total = total + static.image_score[u] * w.image
+        if w.avoid:
+            total = total + static.avoid_score[u] * w.avoid
+        if features.ipa and w.ipa:
             # InterPodAffinity NormalizeScore (scoring.go:246-270): bounds
             # include 0, float divide, int64 truncation
             ipa_mx = jnp.maximum(jnp.max(jnp.where(feasible, ipa_raw, 0)), 0)
@@ -729,9 +748,10 @@ def _run_scan_compiled(
                 ),
                 0,
             )
-            total = total + ipa
-        if features.storage:
-            total = total + _minmax_normalize(local_raw, feasible)  # Open-Local plugin
+            total = total + ipa * w.ipa
+        if features.storage and w.openlocal:
+            # Open-Local plugin
+            total = total + _minmax_normalize(local_raw, feasible) * w.openlocal
         if features.custom:
             # out-of-tree custom plugins (static K, unrolled)
             for k_i in range(static.custom_raw.shape[0]):

@@ -106,6 +106,7 @@ class CapacitySweep:
         new_node_spec: Optional[dict],
         max_count: int,
         use_greed: bool = False,
+        score_weights=None,
     ):
         from ..ops.encode import (
             encode_batch,
@@ -166,7 +167,9 @@ class CapacitySweep:
             self.init = to_scan_state(self.dyn, self.batch)
             # derive features host-side: inside a jit/vmap trace
             # features_of would fall back to the ungated ALL_FEATURES scan
-            self.features = features_of_batch(self.cluster_enc, self.batch)
+            self.features = features_of_batch(
+                self.cluster_enc, self.batch, weights=score_weights
+            )
 
         # daemonset pods of disabled candidate nodes are inactive in
         # that scenario (the reference regenerates them per run)
@@ -434,8 +437,16 @@ def sweep_node_counts(
     counts: List[int],
     mesh=None,
     use_greed: bool = False,
+    score_weights=None,
 ) -> SweepResult:
     """Evaluate `counts` candidate new-node counts in one batched run."""
     max_count = max(counts) if new_node_spec is not None else 0
-    sweep = CapacitySweep(cluster, apps, new_node_spec, max_count, use_greed=use_greed)
+    sweep = CapacitySweep(
+        cluster,
+        apps,
+        new_node_spec,
+        max_count,
+        use_greed=use_greed,
+        score_weights=score_weights,
+    )
     return sweep.probe_many(counts, mesh=mesh)

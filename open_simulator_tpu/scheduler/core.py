@@ -80,9 +80,18 @@ class Simulator:
     """In-memory cluster + serial scheduler (the fake apiserver +
     scheduler goroutine of the reference collapse into this object)."""
 
-    def __init__(self, engine: str = "oracle", use_greed: bool = False, extenders=None):
+    def __init__(
+        self,
+        engine: str = "oracle",
+        use_greed: bool = False,
+        extenders=None,
+        score_weights=None,
+    ):
         self.engine_kind = engine
         self.use_greed = use_greed
+        # KubeSchedulerConfiguration score-plugin weights
+        # (scheduler/schedconfig.py); None = default profile
+        self.score_weights = score_weights
         # HTTP extenders are host RPC per pod: they force the serial
         # oracle path (SURVEY.md §2.3 host-callback escape hatch)
         self.extenders = list(extenders or [])
@@ -100,6 +109,7 @@ class Simulator:
             extenders=self.extenders,
             pdbs=cluster.pod_disruption_budgets,
             priority_classes=cluster.priority_classes,
+            score_weights=self.score_weights,
         )
         pods = wl.pods_excluding_daemon_sets(cluster)
         for ds in cluster.daemon_sets:
@@ -246,9 +256,15 @@ def simulate(
     engine: str = "oracle",
     use_greed: bool = False,
     extenders=None,
+    score_weights=None,
 ) -> SimulateResult:
     """One-shot simulation (core.go:64-103)."""
-    sim = Simulator(engine=engine, use_greed=use_greed, extenders=extenders)
+    sim = Simulator(
+        engine=engine,
+        use_greed=use_greed,
+        extenders=extenders,
+        score_weights=score_weights,
+    )
     cluster = cluster.copy()
     failed: List[UnscheduledPod] = []
     preemptions: List[PreemptionEvent] = []

@@ -70,7 +70,9 @@ class TpuEngine:
             dyn = encode_dynamic(oracle, cluster)
             static = to_scan_static(cluster, batch)
             init = to_scan_state(dyn, batch)
-            features = features_of_batch(cluster, batch)
+            features = features_of_batch(
+                cluster, batch, weights=getattr(oracle, "score_weights", None)
+            )
         with profiled("engine/scan"):
             placements, _ = scan_ops.run_scan(
                 static,

@@ -236,6 +236,17 @@ def extender_scores(
     return [combined[ns.name] * scale for ns in feasible]
 
 
+def extenders_from_config_doc(doc: dict) -> List[HTTPExtender]:
+    """Build extenders from an already-parsed KubeSchedulerConfiguration
+    document. Raises ValueError on a malformed `extenders:` section."""
+    extenders = doc.get("extenders") or []
+    if not isinstance(extenders, list) or not all(
+        isinstance(e, dict) for e in extenders
+    ):
+        raise ValueError("invalid scheduler config: bad extenders section")
+    return [HTTPExtender(ExtenderConfig.from_dict(e)) for e in extenders]
+
+
 def extenders_from_scheduler_config(path: str) -> List[HTTPExtender]:
     """Load the `extenders:` section of a KubeSchedulerConfiguration
     file (the reference forwards these to scheduler.New,
@@ -250,9 +261,7 @@ def extenders_from_scheduler_config(path: str) -> List[HTTPExtender]:
             raise ValueError(f"invalid scheduler config {path}: {e}") from e
     if not isinstance(doc, dict):
         raise ValueError(f"invalid scheduler config {path}: not a mapping")
-    extenders = doc.get("extenders") or []
-    if not isinstance(extenders, list) or not all(
-        isinstance(e, dict) for e in extenders
-    ):
-        raise ValueError(f"invalid scheduler config {path}: bad extenders section")
-    return [HTTPExtender(ExtenderConfig.from_dict(e)) for e in extenders]
+    try:
+        return extenders_from_config_doc(doc)
+    except ValueError as e:
+        raise ValueError(f"invalid scheduler config {path}: {e}") from e

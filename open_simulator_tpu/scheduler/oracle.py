@@ -199,12 +199,20 @@ class Oracle:
         pdbs=None,
         priority_classes=None,
         enable_preemption: bool = True,
+        score_weights=None,
     ):
         if registry is None:
             from .plugins import default_registry
 
             registry = default_registry
         self.registry = registry
+        # score-plugin weights from an optional KubeSchedulerConfiguration
+        # (schedconfig.py); None = the default profile
+        from .schedconfig import DEFAULT_SCORE_WEIGHTS
+
+        self.score_weights = (
+            score_weights if score_weights is not None else DEFAULT_SCORE_WEIGHTS
+        )
         # HTTP scheduler extenders (extender.py); host-side RPC, so a
         # simulation using them runs on this serial path only
         self.extenders = list(extenders or [])
@@ -795,17 +803,29 @@ class Oracle:
             for i, s in enumerate(scores):
                 total[i] += s * weight
 
-        add(self._score_balanced_allocation(pod, feasible), 1)
-        add(self._score_image_locality(pod, feasible), 1)
-        add(self._score_interpod_affinity(pod, feasible), 1)
-        add(self._score_least_allocated(pod, feasible), 1)
-        add(self._score_node_affinity(pod, feasible), 1)
-        add(self._score_prefer_avoid_pods(pod, feasible), 10000)
-        add(self._score_topology_spread(pod, feasible), 2)
-        add(self._score_taint_toleration(pod, feasible), 1)
-        add(self._score_simon(pod, feasible), 1)
-        add(self._score_open_local(pod, feasible), 1)
-        add(self._score_gpu_share(pod, feasible), 1)
+        w = self.score_weights
+        if w.balanced:
+            add(self._score_balanced_allocation(pod, feasible), w.balanced)
+        if w.image:
+            add(self._score_image_locality(pod, feasible), w.image)
+        if w.ipa:
+            add(self._score_interpod_affinity(pod, feasible), w.ipa)
+        if w.least:
+            add(self._score_least_allocated(pod, feasible), w.least)
+        if w.nodeaff:
+            add(self._score_node_affinity(pod, feasible), w.nodeaff)
+        if w.avoid:
+            add(self._score_prefer_avoid_pods(pod, feasible), w.avoid)
+        if w.spread:
+            add(self._score_topology_spread(pod, feasible), w.spread)
+        if w.tainttol:
+            add(self._score_taint_toleration(pod, feasible), w.tainttol)
+        if w.simon:
+            add(self._score_simon(pod, feasible), w.simon)
+        if w.openlocal:
+            add(self._score_open_local(pod, feasible), w.openlocal)
+        if w.gpushare:
+            add(self._score_gpu_share(pod, feasible), w.gpushare)
         for plugin in self.registry.plugins:
             raw = [int(plugin.score(pod, ns.node)) for ns in feasible]
             if plugin.normalize == "default":

@@ -1,0 +1,161 @@
+"""KubeSchedulerConfiguration handling (--default-scheduler-config).
+
+The reference assembles a v1beta1 KubeSchedulerConfiguration in
+GetAndSetSchedulerConfig (pkg/simulator/utils.go:212-289): defaults +
+the three simulator plugins injected into the Score/Filter/Reserve/Bind
+sets, DefaultBinder disabled, PercentageOfNodesToScore forced to 100.
+A user-supplied config file feeds the same options machinery
+(InitKubeSchedulerConfiguration, utils.go:185-203) — though in the
+reference the CLI flag is dead (never forwarded to Simulate; SURVEY.md
+§2.1). Here the seam is live:
+
+- `extenders:` spawn HTTP extenders (scheduler/extender.py)
+- `profiles[0].plugins.score` enable/disable + per-plugin weights
+  overlay the simulator's default score set (defaults below mirror
+  algorithmprovider/registry.go:118-131 plus the three injected
+  plugins at weight 1)
+- `percentageOfNodesToScore` is validated like v1beta1 (0-100) and
+  then pinned to 100 exactly as utils.go:278 does — values other than
+  100 are rejected loudly instead of silently un-pinned, because every
+  engine here scores all nodes
+- filter/reserve/bind plugin sets stay fixed: the simulator owns them
+  (utils.go:241-277 rebuilds them unconditionally), so only score
+  customization is honored; pluginConfig args are not consumed by any
+  in-tree plugin the simulator registers
+
+Score weights flow into both engines: the serial oracle reads the
+mapping directly (oracle._prioritize) and the scan receives them as
+static compile-time constants (ops/scan.py ScoreWeights) so XLA
+constant-folds disabled plugins out of the step entirely.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional
+
+import yaml
+
+
+class ScoreWeights(NamedTuple):
+    """Static (hashable) per-plugin score weights, in-tree + simulator
+    plugins. Defaults mirror algorithmprovider/registry.go:118-131 and
+    the weight-1 injected plugins (utils.go:230-240)."""
+
+    balanced: int = 1  # NodeResourcesBalancedAllocation
+    image: int = 1  # ImageLocality
+    least: int = 1  # NodeResourcesLeastAllocated
+    nodeaff: int = 1  # NodeAffinity
+    avoid: int = 10000  # NodePreferAvoidPods
+    spread: int = 2  # PodTopologySpread
+    tainttol: int = 1  # TaintToleration
+    ipa: int = 1  # InterPodAffinity
+    simon: int = 1  # Simon
+    gpushare: int = 1  # Open-Gpu-Share
+    openlocal: int = 1  # Open-Local
+
+
+DEFAULT_SCORE_WEIGHTS = ScoreWeights()
+
+# KubeSchedulerConfiguration plugin name -> ScoreWeights field
+PLUGIN_FIELDS: Dict[str, str] = {
+    "NodeResourcesBalancedAllocation": "balanced",
+    "ImageLocality": "image",
+    "NodeResourcesLeastAllocated": "least",
+    "NodeAffinity": "nodeaff",
+    "NodePreferAvoidPods": "avoid",
+    "PodTopologySpread": "spread",
+    "TaintToleration": "tainttol",
+    "InterPodAffinity": "ipa",
+    "Simon": "simon",
+    "Open-Gpu-Share": "gpushare",
+    "Open-Local": "openlocal",
+}
+
+
+@dataclass
+class SchedulerConfig:
+    score_weights: ScoreWeights = DEFAULT_SCORE_WEIGHTS
+    percentage_of_nodes_to_score: int = 100
+    extenders: List = field(default_factory=list)
+    unknown_score_plugins: List[str] = field(default_factory=list)
+
+
+def _apply_score_set(plugins_score: dict, base: ScoreWeights):
+    """Upstream plugin-set merge semantics (apis/config/v1beta1 +
+    runtime/framework.go pluginsNeeded): `disabled` names (or "*") are
+    removed from the default set, then `enabled` entries are appended
+    with their weight (absent weight -> the plugin's default)."""
+    weights = base._asdict()
+    unknown: List[str] = []
+    for entry in plugins_score.get("disabled") or []:
+        name = (entry or {}).get("name", "")
+        if name == "*":
+            weights = {k: 0 for k in weights}
+        elif name in PLUGIN_FIELDS:
+            weights[PLUGIN_FIELDS[name]] = 0
+        else:
+            unknown.append(name)
+    for entry in plugins_score.get("enabled") or []:
+        name = (entry or {}).get("name", "")
+        if name in PLUGIN_FIELDS:
+            f = PLUGIN_FIELDS[name]
+            w = entry.get("weight")
+            weights[f] = (
+                int(w)
+                if w is not None
+                else getattr(DEFAULT_SCORE_WEIGHTS, f)
+            )
+        else:
+            unknown.append(name)
+    return ScoreWeights(**weights), unknown
+
+
+def parse_scheduler_config(doc: dict) -> SchedulerConfig:
+    """Parse an already-loaded KubeSchedulerConfiguration document."""
+    if not isinstance(doc, dict) or doc.get("kind") not in (
+        "KubeSchedulerConfiguration",
+        None,
+    ):
+        raise ValueError("not a KubeSchedulerConfiguration document")
+    cfg = SchedulerConfig()
+
+    pct = doc.get("percentageOfNodesToScore")
+    if pct is not None:
+        pct = int(pct)
+        # v1beta1 validation range; the simulator then forces 100
+        # (utils.go:278) — reject anything else loudly
+        if pct < 0 or pct > 100:
+            raise ValueError(
+                f"percentageOfNodesToScore {pct} is not in the range [0, 100]"
+            )
+        if pct not in (0, 100):  # 0 means "use default", which is forced to 100
+            raise ValueError(
+                "the simulator scores 100% of nodes "
+                f"(utils.go:278); percentageOfNodesToScore {pct} is not supported"
+            )
+    profiles = doc.get("profiles") or []
+    if profiles:
+        profile = profiles[0] or {}
+        sched_name = profile.get("schedulerName")
+        if sched_name not in (None, "default-scheduler"):
+            raise ValueError(
+                f"profile schedulerName {sched_name!r} is not the default "
+                "scheduler; the simulator runs a single default profile "
+                "(utils.go:226)"
+            )
+        score = (profile.get("plugins") or {}).get("score") or {}
+        cfg.score_weights, cfg.unknown_score_plugins = _apply_score_set(
+            score, cfg.score_weights
+        )
+
+    from .extender import extenders_from_config_doc
+
+    cfg.extenders = extenders_from_config_doc(doc)
+    return cfg
+
+
+def load_scheduler_config(path: str) -> SchedulerConfig:
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    return parse_scheduler_config(doc)

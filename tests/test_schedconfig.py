@@ -1,0 +1,238 @@
+"""KubeSchedulerConfiguration profile handling (scheduler/schedconfig.py)
+— plugin enable/disable + score weights honored identically by both
+engines (GetAndSetSchedulerConfig, pkg/simulator/utils.go:212-289)."""
+
+import os
+
+import pytest
+import yaml
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.scheduler.schedconfig import (
+    DEFAULT_SCORE_WEIGHTS,
+    ScoreWeights,
+    load_scheduler_config,
+    parse_scheduler_config,
+)
+from open_simulator_tpu.testing import make_fake_node, make_fake_pod
+
+
+def _cluster(nodes):
+    return ResourceTypes(nodes=list(nodes))
+
+
+def _app(pods):
+    return AppResource(name="a", resource=ResourceTypes(pods=list(pods)))
+
+
+def _placement(result):
+    out = {}
+    for st in result.node_status:
+        for p in st.pods:
+            out[p["metadata"]["name"]] = st.node["metadata"]["name"]
+    return out
+
+
+# ------------------------------------------------------------------ parsing
+
+
+def test_parse_defaults():
+    cfg = parse_scheduler_config({"kind": "KubeSchedulerConfiguration"})
+    assert cfg.score_weights == DEFAULT_SCORE_WEIGHTS
+    assert cfg.extenders == []
+
+
+def test_parse_disable_and_weight_override():
+    cfg = parse_scheduler_config(
+        {
+            "kind": "KubeSchedulerConfiguration",
+            "profiles": [
+                {
+                    "plugins": {
+                        "score": {
+                            "disabled": [{"name": "NodeResourcesLeastAllocated"}],
+                            "enabled": [{"name": "TaintToleration", "weight": 5}],
+                        }
+                    }
+                }
+            ],
+        }
+    )
+    assert cfg.score_weights.least == 0
+    assert cfg.score_weights.tainttol == 5
+    # untouched plugins keep defaults
+    assert cfg.score_weights.balanced == 1
+    assert cfg.score_weights.avoid == 10000
+
+
+def test_parse_star_disables_all():
+    cfg = parse_scheduler_config(
+        {
+            "profiles": [
+                {
+                    "plugins": {
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [{"name": "Simon"}],
+                        }
+                    }
+                }
+            ],
+        }
+    )
+    assert cfg.score_weights == ScoreWeights(
+        balanced=0, image=0, least=0, nodeaff=0, avoid=0, spread=0,
+        tainttol=0, ipa=0, simon=1, gpushare=0, openlocal=0,
+    )
+
+
+def test_percentage_of_nodes_to_score_validation():
+    with pytest.raises(ValueError, match="not in the range"):
+        parse_scheduler_config({"percentageOfNodesToScore": 150})
+    with pytest.raises(ValueError, match="100% of nodes"):
+        parse_scheduler_config({"percentageOfNodesToScore": 50})
+    parse_scheduler_config({"percentageOfNodesToScore": 100})  # ok
+
+
+def test_non_default_profile_rejected():
+    with pytest.raises(ValueError, match="default"):
+        parse_scheduler_config(
+            {"profiles": [{"schedulerName": "my-scheduler"}]}
+        )
+
+
+def test_load_file_with_extenders(tmp_path):
+    path = os.path.join(str(tmp_path), "sched.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump(
+            {
+                "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+                "kind": "KubeSchedulerConfiguration",
+                "profiles": [
+                    {
+                        "plugins": {
+                            "score": {"disabled": [{"name": "ImageLocality"}]}
+                        }
+                    }
+                ],
+            },
+            f,
+        )
+    cfg = load_scheduler_config(path)
+    assert cfg.score_weights.image == 0
+
+
+# ------------------------------------------- behavior: both engines agree
+
+
+def _two_node_setup():
+    """node-1 is less loaded (LeastAllocated prefers it); node-2 is
+    balanced-better via a zone of existing usage. A config that disables
+    LeastAllocated + boosts BalancedAllocation flips the placement."""
+    nodes = [
+        make_fake_node("node-1", "16", "16Gi"),
+        make_fake_node("node-2", "16", "64Gi"),
+    ]
+    # an anchor pod bound to node-2 creates asymmetric utilization
+    anchor = make_fake_pod("anchor", "default", "8", "8Gi")
+    anchor["spec"]["nodeName"] = "node-2"
+    probe = make_fake_pod("probe", "default", "2", "12Gi")
+    return nodes, anchor, probe
+
+
+def _run(engine, weights):
+    nodes, anchor, probe = _two_node_setup()
+    cluster = ResourceTypes(nodes=nodes, pods=[anchor])
+    res = simulate(cluster, [_app([probe])], engine=engine, score_weights=weights)
+    assert not res.unscheduled_pods
+    return _placement(res)["probe"]
+
+
+def test_disabling_score_plugin_changes_placement_identically():
+    default_oracle = _run("oracle", None)
+    default_tpu = _run("tpu", None)
+    assert default_oracle == default_tpu
+
+    # disable everything except BalancedAllocation at a high weight:
+    # pick the node where cpu/mem fractions align best
+    custom = ScoreWeights(
+        balanced=10, image=0, least=0, nodeaff=0, avoid=0, spread=0,
+        tainttol=0, ipa=0, simon=0, gpushare=0, openlocal=0,
+    )
+    custom_oracle = _run("oracle", custom)
+    custom_tpu = _run("tpu", custom)
+    # both engines agree under the custom profile...
+    assert custom_oracle == custom_tpu
+    # ...and the profile actually changed the decision
+    assert custom_oracle != default_oracle
+
+
+def test_weight_boost_changes_placement_identically():
+    """Boosting TaintToleration dominance: node-2 carries a
+    PreferNoSchedule taint, default profile still picks it for
+    LeastAllocated reasons at weight 1 vs boosted profile avoids it."""
+    nodes = [
+        make_fake_node("node-1", "8", "32Gi"),
+        make_fake_node("node-2", "64", "256Gi"),
+    ]
+    nodes[1].setdefault("spec", {})["taints"] = [
+        {"key": "soft", "value": "x", "effect": "PreferNoSchedule"}
+    ]
+    probe = make_fake_pod("probe", "default", "1", "1Gi")
+
+    def run(engine, weights):
+        res = simulate(
+            ResourceTypes(nodes=[dict(n) for n in nodes]),
+            [_app([dict(probe)])],
+            engine=engine,
+            score_weights=weights,
+        )
+        assert not res.unscheduled_pods
+        return _placement(res)["probe"]
+
+    assert run("oracle", None) == run("tpu", None)
+    # Simon's best-fit score (+100 x2 for the fuller node-1) would mask
+    # the taint signal; with it out of the way, TaintToleration decides:
+    base = DEFAULT_SCORE_WEIGHTS._replace(simon=0, gpushare=0)
+    boosted = base._replace(tainttol=100)
+    assert run("oracle", boosted) == run("tpu", boosted) == "node-1"
+    # and with it disabled, LeastAllocated capacity dominance wins
+    disabled = base._replace(tainttol=0)
+    assert run("oracle", disabled) == run("tpu", disabled) == "node-2"
+
+
+def test_applier_accepts_scheduler_config(tmp_path):
+    """--default-scheduler-config end-to-end through the Applier."""
+    from open_simulator_tpu.apply.applier import Applier, AppInfo, SimonConfig
+
+    cluster_dir = os.path.join(str(tmp_path), "cluster")
+    os.makedirs(cluster_dir)
+    with open(os.path.join(cluster_dir, "node.yaml"), "w") as f:
+        yaml.safe_dump(make_fake_node("n1", "4", "8Gi"), f)
+    appdir = os.path.join(str(tmp_path), "app")
+    os.makedirs(appdir)
+    with open(os.path.join(appdir, "pod.yaml"), "w") as f:
+        pod = make_fake_pod("p1", "default", "1", "1Gi")
+        pod["kind"] = "Pod"
+        yaml.safe_dump(pod, f)
+    sched = os.path.join(str(tmp_path), "sched.yaml")
+    with open(sched, "w") as f:
+        yaml.safe_dump(
+            {
+                "kind": "KubeSchedulerConfiguration",
+                "profiles": [
+                    {"plugins": {"score": {"disabled": [{"name": "ImageLocality"}]}}}
+                ],
+            },
+            f,
+        )
+    applier = Applier(
+        SimonConfig(
+            custom_cluster=cluster_dir, app_list=[AppInfo(name="a", path=appdir)]
+        ),
+        scheduler_config=sched,
+    )
+    assert applier.score_weights.image == 0
+    result = applier.run()
+    assert result.success

@@ -590,7 +590,14 @@ def _gpu_allocate(avail, dev_valid, per_gpu_mem, count):
 INACTIVE = -2  # pod not present in this scenario (capacity-sweep masking)
 
 
-def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node, features=None):
+def run_scan(
+    static: ScanStatic,
+    init: ScanState,
+    class_of_pod,
+    pinned_node,
+    features=None,
+    weights=None,
+):
     """Schedule every pod in order; returns (placements[P], final state).
 
     placements[p] = node index, or -1 when unschedulable.
@@ -605,6 +612,7 @@ def run_scan(static: ScanStatic, init: ScanState, class_of_pod, pinned_node, fea
         jnp.ones((n,), bool),
         jnp.ones((p,), bool),
         features=features,
+        weights=weights,
     )
 
 
@@ -616,6 +624,7 @@ def run_scan_masked(
     node_valid,
     pod_active,
     features=None,
+    weights=None,
 ):
     """run_scan with scenario masks for the capacity sweep
     (pkg/apply/apply.go:186-239 re-imagined as a batched what-if):
@@ -626,9 +635,11 @@ def run_scan_masked(
     `features` (a ScanFeatures, static under jit) specializes the
     compiled scan to the subsystems the batch uses; None derives it from
     `static`/`pinned_node`, which must then be concrete arrays.
+    `weights` (custom score weights) only applies when `features` is
+    derived here; explicit `features` already carry theirs.
     """
     if features is None:
-        features = features_of(static, pinned_node)
+        features = features_of(static, pinned_node, weights=weights)
     return _run_scan_compiled(
         features, static, init, class_of_pod, pinned_node, node_valid, pod_active
     )

@@ -243,25 +243,5 @@ def extenders_from_config_doc(doc: dict) -> List[HTTPExtender]:
     if not isinstance(extenders, list) or not all(
         isinstance(e, dict) for e in extenders
     ):
-        raise ValueError("invalid scheduler config: bad extenders section")
+        raise ValueError("bad extenders section")
     return [HTTPExtender(ExtenderConfig.from_dict(e)) for e in extenders]
-
-
-def extenders_from_scheduler_config(path: str) -> List[HTTPExtender]:
-    """Load the `extenders:` section of a KubeSchedulerConfiguration
-    file (the reference forwards these to scheduler.New,
-    pkg/simulator/simulator.go:149). Raises ValueError on malformed
-    input so CLI error handling stays uniform."""
-    import yaml
-
-    with open(path) as f:
-        try:
-            doc = yaml.safe_load(f) or {}
-        except yaml.YAMLError as e:
-            raise ValueError(f"invalid scheduler config {path}: {e}") from e
-    if not isinstance(doc, dict):
-        raise ValueError(f"invalid scheduler config {path}: not a mapping")
-    try:
-        return extenders_from_config_doc(doc)
-    except ValueError as e:
-        raise ValueError(f"invalid scheduler config {path}: {e}") from e

@@ -76,18 +76,18 @@ PLUGIN_FIELDS: Dict[str, str] = {
 @dataclass
 class SchedulerConfig:
     score_weights: ScoreWeights = DEFAULT_SCORE_WEIGHTS
-    percentage_of_nodes_to_score: int = 100
     extenders: List = field(default_factory=list)
-    unknown_score_plugins: List[str] = field(default_factory=list)
 
 
-def _apply_score_set(plugins_score: dict, base: ScoreWeights):
+def _apply_score_set(plugins_score: dict, base: ScoreWeights) -> ScoreWeights:
     """Upstream plugin-set merge semantics (apis/config/v1beta1 +
     runtime/framework.go pluginsNeeded): `disabled` names (or "*") are
     removed from the default set, then `enabled` entries are appended
-    with their weight (absent weight -> the plugin's default)."""
+    with their weight (absent weight -> the plugin's default). Unknown
+    plugin names and non-positive weights are rejected, matching
+    kube-scheduler's startup failure on an unregistered plugin or a
+    weight <= 0."""
     weights = base._asdict()
-    unknown: List[str] = []
     for entry in plugins_score.get("disabled") or []:
         name = (entry or {}).get("name", "")
         if name == "*":
@@ -95,20 +95,22 @@ def _apply_score_set(plugins_score: dict, base: ScoreWeights):
         elif name in PLUGIN_FIELDS:
             weights[PLUGIN_FIELDS[name]] = 0
         else:
-            unknown.append(name)
+            raise ValueError(f"unknown score plugin {name!r} in disabled set")
     for entry in plugins_score.get("enabled") or []:
         name = (entry or {}).get("name", "")
-        if name in PLUGIN_FIELDS:
-            f = PLUGIN_FIELDS[name]
-            w = entry.get("weight")
-            weights[f] = (
-                int(w)
-                if w is not None
-                else getattr(DEFAULT_SCORE_WEIGHTS, f)
+        if name not in PLUGIN_FIELDS:
+            raise ValueError(f"unknown score plugin {name!r} in enabled set")
+        f = PLUGIN_FIELDS[name]
+        w = entry.get("weight")
+        if w is None:
+            weights[f] = getattr(DEFAULT_SCORE_WEIGHTS, f)
+        elif int(w) <= 0:
+            raise ValueError(
+                f"score plugin {name!r} weight {w} is not positive"
             )
         else:
-            unknown.append(name)
-    return ScoreWeights(**weights), unknown
+            weights[f] = int(w)
+    return ScoreWeights(**weights)
 
 
 def parse_scheduler_config(doc: dict) -> SchedulerConfig:
@@ -135,6 +137,11 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
                 f"(utils.go:278); percentageOfNodesToScore {pct} is not supported"
             )
     profiles = doc.get("profiles") or []
+    if len(profiles) > 1:
+        raise ValueError(
+            f"{len(profiles)} profiles given; the simulator runs a single "
+            "default profile (utils.go:226)"
+        )
     if profiles:
         profile = profiles[0] or {}
         sched_name = profile.get("schedulerName")
@@ -145,9 +152,7 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
                 "(utils.go:226)"
             )
         score = (profile.get("plugins") or {}).get("score") or {}
-        cfg.score_weights, cfg.unknown_score_plugins = _apply_score_set(
-            score, cfg.score_weights
-        )
+        cfg.score_weights = _apply_score_set(score, cfg.score_weights)
 
     from .extender import extenders_from_config_doc
 
@@ -156,6 +161,18 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
 
 
 def load_scheduler_config(path: str) -> SchedulerConfig:
+    """Load and parse a KubeSchedulerConfiguration file. All failure
+    modes (unreadable file, YAML syntax error, invalid content) raise
+    ValueError/OSError carrying the path, so the CLI's uniform
+    `error: ...` + exit-1 handling applies."""
     with open(path) as f:
-        doc = yaml.safe_load(f) or {}
-    return parse_scheduler_config(doc)
+        try:
+            doc = yaml.safe_load(f) or {}
+        except yaml.YAMLError as e:
+            raise ValueError(f"invalid scheduler config {path}: {e}") from e
+    if not isinstance(doc, dict):
+        raise ValueError(f"invalid scheduler config {path}: not a mapping")
+    try:
+        return parse_scheduler_config(doc)
+    except ValueError as e:
+        raise ValueError(f"invalid scheduler config {path}: {e}") from e

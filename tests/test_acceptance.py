@@ -1,0 +1,301 @@
+"""The reference's flagship acceptance scenario, pinned in CI.
+
+Ports pkg/simulator/core_test.go (TestSimulate, core_test.go:32-362)
+and its `checkResult` invariants (core_test.go:364-591) onto the full
+`example/simon-config.yaml` run: demo_1 cluster + yoda Helm chart +
+simple + complicate + open_local + more_pods apps + newnode capacity
+plan, through BOTH engines (batched TPU probe plan and the serial
+oracle), asserting:
+
+- the plan succeeds with the pinned newNodeCount (18 — the number the
+  reference produces for this scenario),
+- every workload's declared replica count is placed, verified by an
+  owner-annotation walk (deployment -> ReplicaSet intermediate handled
+  like core_test.go:519-577),
+- daemonset expectations are recomputed *independently* in this file
+  from the raw YAML (nodeSelector + required node affinity + taint
+  toleration), mirroring core_test.go:463-480's NodeShouldRunPod
+  recomputation rather than trusting the library's expansion.
+
+Skipped when the reference tree is not mounted.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+import yaml
+
+from open_simulator_tpu.apply.applier import Applier, SimonConfig
+from open_simulator_tpu.models import workloads as wl
+from open_simulator_tpu.models.chart import process_chart
+from open_simulator_tpu.models.decode import decode_yaml_content
+
+REF = Path("/root/reference/example")
+PINNED_NEW_NODE_COUNT = 18
+
+pytestmark = pytest.mark.skipif(
+    not REF.exists(), reason="reference example tree not mounted"
+)
+
+
+def _config() -> SimonConfig:
+    return SimonConfig(
+        custom_cluster=str(REF / "cluster/demo_1"),
+        app_list=[
+            type("A", (), {})()  # placeholder, replaced below
+        ],
+        new_node=str(REF / "newnode/demo_1"),
+    )
+
+
+def _apps():
+    from open_simulator_tpu.apply.applier import AppInfo
+
+    return [
+        AppInfo("yoda", str(REF / "application/charts/yoda"), chart=True),
+        AppInfo("simple", str(REF / "application/simple")),
+        AppInfo("complicated", str(REF / "application/complicate")),
+        AppInfo("open_local", str(REF / "application/open_local")),
+        AppInfo("more_pods", str(REF / "application/more_pods")),
+    ]
+
+
+@pytest.fixture(scope="module", params=["tpu", "oracle"])
+def plan(request):
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    reset_name_counter()
+    cfg = _config()
+    cfg.app_list = _apps()
+    applier = Applier(cfg, engine=request.param)
+    result = applier.run()
+    return request.param, result
+
+
+def test_plan_succeeds_with_pinned_node_count(plan):
+    engine, result = plan
+    assert result.success, f"[{engine}] {result.message}"
+    assert result.new_node_count == PINNED_NEW_NODE_COUNT, engine
+    assert result.result is not None and result.result.unscheduled_pods == []
+
+
+# -- expected workload counts from the raw YAML (not the library) ----------
+
+
+def _iter_app_docs():
+    """(app_name, doc) for every workload document each app declares."""
+    for app in _apps():
+        if app.chart:
+            texts = process_chart(app.name, app.path)
+        else:
+            texts = [
+                Path(app.path, f).read_text()
+                for f in sorted(os.listdir(app.path))
+                if f.endswith((".yaml", ".yml"))
+            ]
+        for text in texts:
+            for doc in yaml.safe_load_all(text):
+                if isinstance(doc, dict) and doc.get("kind"):
+                    yield app.name, doc
+
+
+def _expected_counts():
+    """{(app, kind, namespace, name): replicas} for non-daemonset
+    workloads, straight from spec.replicas/completions defaults."""
+    out = {}
+    for app, doc in _iter_app_docs():
+        kind = doc["kind"]
+        meta = doc.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        spec = doc.get("spec") or {}
+        if kind in ("Deployment", "ReplicaSet", "ReplicationController", "StatefulSet"):
+            n = spec.get("replicas", 1)
+        elif kind == "Job":
+            n = spec.get("completions") or 1
+        elif kind == "CronJob":
+            jspec = (spec.get("jobTemplate") or {}).get("spec") or {}
+            n = jspec.get("completions") or 1
+        elif kind == "Pod":
+            n = 1
+        else:
+            continue  # Node / Service / ConfigMap / DaemonSet (below)
+        out[(app, kind, ns, name)] = n
+    return out
+
+
+def _placed_by_workload(result):
+    """Owner-annotation walk over placed pods (core_test.go:519-577):
+    deployment pods carry their ReplicaSet intermediate as owner, so
+    ReplicaSet owners named <deploy>-<hash> are folded back onto the
+    Deployment; cronjob pods carry their Job the same way."""
+    counts = {}
+    pod_names = set()
+    for ns_status in result.node_status:
+        for pod in ns_status.pods:
+            meta = pod["metadata"]
+            pod_names.add((meta.get("namespace", "default"), meta["name"]))
+            labels = meta.get("labels") or {}
+            app = labels.get(wl.LABEL_APP_NAME)
+            if app is None:
+                continue  # pre-existing cluster pod
+            anno = meta.get("annotations") or {}
+            # bare pods carry no workload annotations (reference
+            # MakeValidPodByPod adds none, utils.go:400-407)
+            kind = anno.get(wl.ANNO_WORKLOAD_KIND) or "Pod"
+            name = anno.get(wl.ANNO_WORKLOAD_NAME) or meta["name"]
+            # like the reference, the annotation carries the workload's
+            # raw namespace, which is "" for ns-less YAML; fold back to
+            # the pod's defaulted namespace
+            ns = anno.get(wl.ANNO_WORKLOAD_NAMESPACE) or meta.get("namespace", "default")
+            counts[(app, kind, ns, name)] = counts.get((app, kind, ns, name), 0) + 1
+    return counts, pod_names
+
+
+def _fold_owner(counts, expected):
+    """Fold generated intermediates (RS under a Deployment, Job under a
+    CronJob) onto the declaring workload."""
+    folded = {}
+    for (app, kind, ns, name), n in counts.items():
+        key = (app, kind, ns, name)
+        if key not in expected:
+            for (eapp, ekind, ens, ename), _ in expected.items():
+                if (
+                    eapp == app
+                    and ens == ns
+                    and ekind in ("Deployment", "CronJob")
+                    and kind in ("ReplicaSet", "Job")
+                    and name.startswith(ename + "-")
+                ):
+                    key = (eapp, ekind, ens, ename)
+                    break
+        folded[key] = folded.get(key, 0) + n
+    return folded
+
+
+def test_every_workload_replica_count_placed(plan):
+    engine, result = plan
+    expected = _expected_counts()
+    counts, _ = _placed_by_workload(result.result)
+    folded = _fold_owner(counts, expected)
+    for key, want in expected.items():
+        assert folded.get(key, 0) == want, f"[{engine}] {key}: {folded.get(key)} != {want}"
+
+
+def test_statefulset_ordinals_present(plan):
+    engine, result = plan
+    _, pod_names = _placed_by_workload(result.result)
+    for app, doc in _iter_app_docs():
+        if doc["kind"] != "StatefulSet":
+            continue
+        meta = doc["metadata"]
+        ns = meta.get("namespace", "default")
+        for i in range((doc.get("spec") or {}).get("replicas", 1)):
+            assert (ns, f"{meta['name']}-{i}") in pod_names, (
+                f"[{engine}] missing ordinal {meta['name']}-{i}"
+            )
+
+
+# -- independent daemonset recomputation (core_test.go:463-480) ------------
+
+
+def _node_matches_selector(node, selector):
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in (selector or {}).items())
+
+
+def _node_matches_required_affinity(node, affinity):
+    terms = (
+        ((affinity or {}).get("nodeAffinity") or {})
+        .get("requiredDuringSchedulingIgnoredDuringExecution", {})
+        .get("nodeSelectorTerms")
+    )
+    if not terms:
+        return True
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    for term in terms:
+        ok = True
+        for expr in term.get("matchExpressions") or []:
+            key, op = expr.get("key"), expr.get("operator")
+            vals = expr.get("values") or []
+            if op == "In":
+                ok = labels.get(key) in vals
+            elif op == "NotIn":
+                ok = key not in labels or labels[key] not in vals
+            elif op == "Exists":
+                ok = key in labels
+            elif op == "DoesNotExist":
+                ok = key not in labels
+            else:
+                ok = False
+            if not ok:
+                break
+        if ok:
+            return True
+    return False
+
+
+def _tolerates(taints, tolerations):
+    for taint in taints or []:
+        if taint.get("effect") == "PreferNoSchedule":
+            continue
+        covered = False
+        for tol in tolerations or []:
+            op = tol.get("operator", "Equal")
+            if tol.get("key") and tol["key"] != taint.get("key"):
+                continue
+            if tol.get("effect") and tol["effect"] != taint.get("effect"):
+                continue
+            if op == "Equal" and tol.get("key") and tol.get("value") != taint.get("value"):
+                continue
+            covered = True
+            break
+        if not covered:
+            return False
+    return True
+
+
+def test_daemonset_counts_recomputed_independently(plan):
+    engine, result = plan
+    final_nodes = [ns.node for ns in result.result.node_status]
+    counts, _ = _placed_by_workload(result.result)
+    for app, doc in _iter_app_docs():
+        if doc["kind"] != "DaemonSet":
+            continue
+        meta = doc["metadata"]
+        ns = meta.get("namespace", "default")
+        tmpl_spec = ((doc.get("spec") or {}).get("template") or {}).get("spec") or {}
+        eligible = [
+            n
+            for n in final_nodes
+            if not ((n.get("spec") or {}).get("unschedulable"))
+            and _node_matches_selector(n, tmpl_spec.get("nodeSelector"))
+            and _node_matches_required_affinity(n, tmpl_spec.get("affinity"))
+            and _tolerates(
+                (n.get("spec") or {}).get("taints"), tmpl_spec.get("tolerations")
+            )
+        ]
+        got = counts.get((app, "DaemonSet", ns, meta["name"]), 0)
+        assert got == len(eligible), (
+            f"[{engine}] daemonset {ns}/{meta['name']}: placed {got}, "
+            f"independently eligible {len(eligible)}"
+        )
+
+
+def test_new_nodes_carry_new_node_label(plan):
+    engine, result = plan
+    new_nodes = [
+        ns.node
+        for ns in result.result.node_status
+        if wl.LABEL_NEW_NODE in ((ns.node["metadata"].get("labels")) or {})
+    ]
+    assert len(new_nodes) == PINNED_NEW_NODE_COUNT, engine
+
+
+def test_yoda_chart_workloads_placed(plan):
+    """The Helm-rendered chart's pods made it through the pipeline."""
+    engine, result = plan
+    counts, _ = _placed_by_workload(result.result)
+    yoda = {k: v for k, v in counts.items() if k[0] == "yoda"}
+    assert sum(yoda.values()) > 0, engine

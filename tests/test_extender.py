@@ -204,7 +204,7 @@ def test_extender_down_ignorable_vs_fatal():
 def test_extenders_from_scheduler_config(tmp_path):
     import yaml
 
-    from open_simulator_tpu.scheduler.extender import extenders_from_scheduler_config
+    from open_simulator_tpu.scheduler.schedconfig import load_scheduler_config
 
     path = tmp_path / "sched.yaml"
     path.write_text(
@@ -224,7 +224,7 @@ def test_extenders_from_scheduler_config(tmp_path):
             }
         )
     )
-    exts = extenders_from_scheduler_config(str(path))
+    exts = load_scheduler_config(str(path)).extenders
     assert len(exts) == 1
     assert exts[0].config.weight == 3
     assert exts[0].config.node_cache_capable

@@ -236,3 +236,91 @@ def test_applier_accepts_scheduler_config(tmp_path):
     assert applier.score_weights.image == 0
     result = applier.run()
     assert result.success
+
+
+def test_unknown_score_plugin_rejected():
+    """kube-scheduler fails startup on an unregistered plugin name; a
+    typo must not silently leave the intended plugin enabled."""
+    with pytest.raises(ValueError, match="unknown score plugin"):
+        parse_scheduler_config(
+            {
+                "kind": "KubeSchedulerConfiguration",
+                "profiles": [
+                    {
+                        "plugins": {
+                            "score": {
+                                "disabled": [{"name": "NodeResourceLeastAllocated"}]
+                            }
+                        }
+                    }
+                ],
+            }
+        )
+    with pytest.raises(ValueError, match="unknown score plugin"):
+        parse_scheduler_config(
+            {
+                "kind": "KubeSchedulerConfiguration",
+                "profiles": [
+                    {"plugins": {"score": {"enabled": [{"name": "NoSuchPlugin"}]}}}
+                ],
+            }
+        )
+
+
+def test_non_positive_weight_rejected():
+    """The framework rejects weight <= 0 at startup
+    (runtime/framework.go NewFramework weight validation)."""
+    for w in (0, -5):
+        with pytest.raises(ValueError, match="not positive"):
+            parse_scheduler_config(
+                {
+                    "kind": "KubeSchedulerConfiguration",
+                    "profiles": [
+                        {
+                            "plugins": {
+                                "score": {
+                                    "enabled": [
+                                        {
+                                            "name": "NodeResourcesLeastAllocated",
+                                            "weight": w,
+                                        }
+                                    ]
+                                }
+                            }
+                        }
+                    ],
+                }
+            )
+
+
+def test_multiple_profiles_rejected():
+    with pytest.raises(ValueError, match="single"):
+        parse_scheduler_config(
+            {
+                "kind": "KubeSchedulerConfiguration",
+                "profiles": [
+                    {"schedulerName": "default-scheduler"},
+                    {"schedulerName": "gpu-scheduler"},
+                ],
+            }
+        )
+
+
+def test_load_yaml_error_is_value_error_with_path(tmp_path):
+    """A YAML syntax error must surface as ValueError carrying the
+    path (the CLI catches OSError/ValueError for a clean exit 1)."""
+    from open_simulator_tpu.scheduler.schedconfig import load_scheduler_config
+
+    path = tmp_path / "bad.yaml"
+    path.write_text("profiles: [unclosed\n  - {")
+    with pytest.raises(ValueError, match=str(path)):
+        load_scheduler_config(str(path))
+
+
+def test_load_invalid_content_mentions_path(tmp_path):
+    from open_simulator_tpu.scheduler.schedconfig import load_scheduler_config
+
+    path = tmp_path / "sched.yaml"
+    path.write_text("kind: KubeSchedulerConfiguration\npercentageOfNodesToScore: 101\n")
+    with pytest.raises(ValueError, match=str(path)):
+        load_scheduler_config(str(path))

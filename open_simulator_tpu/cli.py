@@ -51,6 +51,7 @@ def _force_platform():
 
 def cmd_apply(args) -> int:
     from .apply.applier import Applier, SimonConfig
+    from .models.validation import InputError
 
     _force_platform()
     try:
@@ -68,15 +69,23 @@ def cmd_apply(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
-    if args.interactive:
-        # the reference's survey shell: app multi-select, then a
-        # per-iteration {show reasons | add node(s) | exit} loop, then
-        # node multi-select before the report (apply.go:157-239, 510-530)
-        from .apply.interactive import run_interactive
+    try:
+        if args.interactive:
+            # the reference's survey shell: app multi-select, then a
+            # per-iteration {show reasons | add node(s) | exit} loop, then
+            # node multi-select before the report (apply.go:157-239, 510-530)
+            from .apply.interactive import run_interactive
 
-        result = run_interactive(applier)
-    else:
-        result = applier.run()
+            result = run_interactive(applier)
+        else:
+            result = applier.run()
+    except (OSError, InputError) as e:
+        # malformed input discovered while loading/expanding (e.g. a
+        # pod failing k8s validation) exits cleanly like the
+        # reference's log.Fatalf path; internal errors (e.g. a JAX
+        # shape bug, which also raises ValueError) stay loud
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     if args.trace:
         from .utils.trace import GLOBAL
 

@@ -1,0 +1,452 @@
+"""k8s API validation subset.
+
+The reference's MakeValidPod/MakeValidNodeByNode run the *real*
+kubernetes validation library over every generated object
+(pkg/utils/utils.go:519-532 ValidatePod -> validation.ValidatePodCreate;
+utils.go:657-671 ValidateNode -> validation.ValidateNode). This module
+ports the subset of those invariants the simulator depends on — object
+names, label syntax, resource-quantity well-formedness, selector
+operator arity, toleration/taint consistency, enum fields — with the
+upstream message strings (public apimachinery/validation constants), so
+malformed input is rejected loudly with the same words a real apiserver
+would use.
+
+Errors aggregate in field-path order and are wrapped as
+`invalid pod: ...` / `invalid node: ...` exactly like utils.go:530/668.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from ..utils.quantity import parse_quantity
+
+# -- apimachinery/pkg/util/validation string formats -----------------------
+
+_DNS1123_LABEL_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+DNS1123_LABEL_MSG = (
+    "a lowercase RFC 1123 label must consist of lower case alphanumeric "
+    "characters or '-', and must start and end with an alphanumeric "
+    "character (e.g. 'my-name',  or '123-abc', regex used for validation "
+    "is '[a-z0-9]([-a-z0-9]*[a-z0-9])?')"
+)
+DNS1123_LABEL_MAX = 63
+
+_DNS1123_SUBDOMAIN_RE = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$"
+)
+DNS1123_SUBDOMAIN_MSG = (
+    "a lowercase RFC 1123 subdomain must consist of lower case alphanumeric "
+    "characters, '-' or '.', and must start and end with an alphanumeric "
+    "character (e.g. 'example.com', regex used for validation is "
+    r"'[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*')"
+)
+DNS1123_SUBDOMAIN_MAX = 253
+
+_QUALIFIED_NAME_RE = re.compile(r"^([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]$")
+QUALIFIED_NAME_MSG = (
+    "name part must consist of alphanumeric characters, '-', '_' or '.', "
+    "and must start and end with an alphanumeric character (e.g. 'MyName',  "
+    "or 'my.name',  or '123-abc', regex used for validation is "
+    "'([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]')"
+)
+QUALIFIED_NAME_MAX = 63
+
+_LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+LABEL_VALUE_MSG = (
+    "a valid label must be an empty string or consist of alphanumeric "
+    "characters, '-', '_' or '.', and must start and end with an "
+    "alphanumeric character (e.g. 'MyValue',  or 'my_value',  or '12345', "
+    "regex used for validation is "
+    "'(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?')"
+)
+
+
+class InputError(ValueError):
+    """Malformed user input (vs an internal error): the CLI catches
+    this for a clean `error: ...` + exit 1, while real bugs stay loud."""
+
+
+def _max_len_error(length: int) -> str:
+    return f"must be no more than {length} bytes"
+
+
+def _to_int(value) -> Optional[int]:
+    """int() that returns None for non-numeric input instead of
+    raising, so malformed numerics aggregate as field errors."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def _is_dns1123_label(value: str) -> List[str]:
+    errs = []
+    if len(value) > DNS1123_LABEL_MAX:
+        errs.append(_max_len_error(DNS1123_LABEL_MAX))
+    if not _DNS1123_LABEL_RE.match(value):
+        errs.append(DNS1123_LABEL_MSG)
+    return errs
+
+
+def _is_dns1123_subdomain(value: str) -> List[str]:
+    errs = []
+    if len(value) > DNS1123_SUBDOMAIN_MAX:
+        errs.append(_max_len_error(DNS1123_SUBDOMAIN_MAX))
+    if not _DNS1123_SUBDOMAIN_RE.match(value):
+        errs.append(DNS1123_SUBDOMAIN_MSG)
+    return errs
+
+
+def _is_qualified_name(value: str) -> List[str]:
+    """apimachinery IsQualifiedName: [prefix/]name with a DNS-1123
+    subdomain prefix and a 63-char name part."""
+    errs = []
+    parts = value.split("/")
+    if len(parts) == 1:
+        name = parts[0]
+    elif len(parts) == 2:
+        prefix, name = parts
+        if not prefix:
+            errs.append("prefix part must be non-empty")
+        else:
+            errs.extend(
+                "prefix part " + m for m in _is_dns1123_subdomain(prefix)
+            )
+    else:
+        errs.append(
+            "a qualified name "
+            + QUALIFIED_NAME_MSG
+            + " with an optional DNS subdomain prefix and '/' (e.g. "
+            "'example.com/MyName')"
+        )
+        return errs
+    if not name:
+        errs.append("name part must be non-empty")
+    elif len(name) > QUALIFIED_NAME_MAX:
+        errs.append("name part " + _max_len_error(QUALIFIED_NAME_MAX))
+    if name and not _QUALIFIED_NAME_RE.match(name):
+        errs.append(QUALIFIED_NAME_MSG)
+    return errs
+
+
+def _is_label_value(value: str) -> List[str]:
+    errs = []
+    if len(value) > QUALIFIED_NAME_MAX:
+        errs.append(_max_len_error(QUALIFIED_NAME_MAX))
+    if not _LABEL_VALUE_RE.match(value):
+        errs.append(LABEL_VALUE_MSG)
+    return errs
+
+
+# -- field.Error rendering (k8s.io/apimachinery field pkg) -----------------
+
+
+class _ErrorList(list):
+    def invalid(self, path: str, value, detail: str):
+        self.append(f'{path}: Invalid value: "{value}": {detail}')
+
+    def required(self, path: str, detail: str = ""):
+        self.append(f"{path}: Required value" + (f": {detail}" if detail else ""))
+
+    def unsupported(self, path: str, value, supported: List[str]):
+        sup = ", ".join(f'"{s}"' for s in supported)
+        self.append(
+            f'{path}: Unsupported value: "{value}": supported values: {sup}'
+        )
+
+    def duplicate(self, path: str, value):
+        self.append(f'{path}: Duplicate value: "{value}"')
+
+
+def _validate_object_meta(meta: dict, path: str, errs: _ErrorList):
+    name = meta.get("name") or ""
+    generate_name = meta.get("generateName") or ""
+    if not name and not generate_name:
+        errs.required(f"{path}.name", "name or generateName is required")
+    elif name:
+        for m in _is_dns1123_subdomain(name):
+            errs.invalid(f"{path}.name", name, m)
+    ns = meta.get("namespace")
+    if ns:
+        for m in _is_dns1123_label(ns):
+            errs.invalid(f"{path}.namespace", ns, m)
+    for key, value in (meta.get("labels") or {}).items():
+        for m in _is_qualified_name(str(key)):
+            errs.invalid(f"{path}.labels", key, m)
+        for m in _is_label_value(str(value)):
+            errs.invalid(f"{path}.labels", value, m)
+    for key in meta.get("annotations") or {}:
+        for m in _is_qualified_name(str(key)):
+            errs.invalid(f"{path}.annotations", key, m)
+
+
+def _validate_quantity(raw, path: str, errs: _ErrorList) -> Optional[int]:
+    try:
+        value = parse_quantity(raw)
+    except (ValueError, TypeError):
+        errs.invalid(
+            path,
+            raw,
+            "quantities must match the regular expression "
+            "'^([+-]?[0-9.]+)([eEinumkKMGTP]*[-+]?[0-9]*)$'",
+        )
+        return None
+    if value < 0:
+        errs.invalid(path, raw, "must be greater than or equal to 0")
+        return None
+    return value
+
+
+def _validate_resources(resources: dict, path: str, errs: _ErrorList):
+    requests = (resources or {}).get("requests") or {}
+    limits = (resources or {}).get("limits") or {}
+    parsed_limits = {}
+    for rname, raw in limits.items():
+        parsed_limits[rname] = _validate_quantity(raw, f"{path}.limits", errs)
+    for rname, raw in requests.items():
+        req = _validate_quantity(raw, f"{path}.requests", errs)
+        lim = parsed_limits.get(rname)
+        if req is not None and lim is not None and req > lim:
+            errs.invalid(
+                f"{path}.requests",
+                raw,
+                f"must be less than or equal to {rname} limit",
+            )
+
+
+_SELECTOR_OPERATORS = ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]
+
+
+def _validate_node_selector_term(term: dict, path: str, errs: _ErrorList):
+    for i, expr in enumerate(term.get("matchExpressions") or []):
+        epath = f"{path}.matchExpressions[{i}]"
+        key = expr.get("key") or ""
+        for m in _is_qualified_name(key):
+            errs.invalid(f"{epath}.key", key, m)
+        op = expr.get("operator") or ""
+        values = expr.get("values") or []
+        if op in ("In", "NotIn"):
+            if not values:
+                errs.required(
+                    f"{epath}.values",
+                    "must be specified when `operator` is 'In' or 'NotIn'",
+                )
+        elif op in ("Exists", "DoesNotExist"):
+            if values:
+                errs.append(
+                    f"{epath}.values: Forbidden: may not be specified when "
+                    "`operator` is 'Exists' or 'DoesNotExist'"
+                )
+        elif op in ("Gt", "Lt"):
+            if len(values) != 1:
+                errs.required(
+                    f"{epath}.values",
+                    "must be specified single value when `operator` is 'Lt' or 'Gt'",
+                )
+        else:
+            errs.invalid(f"{epath}.operator", op, "not a valid selector operator")
+
+
+_TAINT_EFFECTS = ["NoSchedule", "PreferNoSchedule", "NoExecute"]
+
+
+def _validate_tolerations(tolerations: list, path: str, errs: _ErrorList):
+    for i, tol in enumerate(tolerations or []):
+        tpath = f"{path}[{i}]"
+        key = tol.get("key") or ""
+        op = tol.get("operator") or ""
+        if key:
+            for m in _is_qualified_name(key):
+                errs.invalid(f"{tpath}.key", key, m)
+        elif op and op != "Exists":
+            errs.invalid(
+                f"{tpath}.operator",
+                op,
+                "operator must be Exists when `key` is empty, which means "
+                '"match all values and all keys"',
+            )
+        if op == "Exists" and tol.get("value"):
+            errs.invalid(
+                f"{tpath}.operator",
+                tol["value"],
+                "value must be empty when `operator` is 'Exists'",
+            )
+        if op not in ("", "Equal", "Exists"):
+            errs.unsupported(f"{tpath}.operator", op, ["Equal", "Exists"])
+        effect = tol.get("effect") or ""
+        if effect and effect not in _TAINT_EFFECTS:
+            errs.unsupported(f"{tpath}.effect", effect, _TAINT_EFFECTS)
+
+
+def _validate_containers(containers: list, path: str, errs: _ErrorList):
+    seen_names = set()
+    for i, c in enumerate(containers or []):
+        cpath = f"{path}[{i}]"
+        name = c.get("name") or ""
+        if not name:
+            errs.required(f"{cpath}.name")
+        else:
+            for m in _is_dns1123_label(name):
+                errs.invalid(f"{cpath}.name", name, m)
+            if name in seen_names:
+                errs.duplicate(f"{cpath}.name", name)
+            seen_names.add(name)
+        if not c.get("image"):
+            errs.required(f"{cpath}.image")
+        _validate_resources(c.get("resources") or {}, f"{cpath}.resources", errs)
+        for j, port in enumerate(c.get("ports") or []):
+            ppath = f"{cpath}.ports[{j}]"
+            cp = port.get("containerPort")
+            if cp is None:
+                errs.required(f"{ppath}.containerPort")
+            elif _to_int(cp) is None or not (0 < _to_int(cp) < 65536):
+                errs.invalid(
+                    f"{ppath}.containerPort",
+                    cp,
+                    "must be between 1 and 65535, inclusive",
+                )
+            hp = port.get("hostPort")
+            if hp is not None and (
+                _to_int(hp) is None or not (0 < _to_int(hp) < 65536)
+            ):
+                errs.invalid(
+                    f"{ppath}.hostPort", hp, "must be between 1 and 65535, inclusive"
+                )
+            proto = port.get("protocol", "TCP")
+            if proto not in ("TCP", "UDP", "SCTP"):
+                errs.unsupported(f"{ppath}.protocol", proto, ["TCP", "UDP", "SCTP"])
+
+
+def pod_validation_errors(pod: dict) -> List[str]:
+    """The ValidatePodCreate subset, as field.Error strings."""
+    errs = _ErrorList()
+    meta = pod.get("metadata") or {}
+    _validate_object_meta(meta, "metadata", errs)
+    spec = pod.get("spec") or {}
+    containers = spec.get("containers") or []
+    if not containers:
+        errs.required("spec.containers")
+    _validate_containers(containers, "spec.containers", errs)
+    _validate_containers(
+        spec.get("initContainers") or [], "spec.initContainers", errs
+    )
+    for key, value in (spec.get("nodeSelector") or {}).items():
+        for m in _is_qualified_name(str(key)):
+            errs.invalid("spec.nodeSelector", key, m)
+        for m in _is_label_value(str(value)):
+            errs.invalid("spec.nodeSelector", value, m)
+    node_affinity = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    required = node_affinity.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if required:
+        base = (
+            "spec.affinity.nodeAffinity."
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        )
+        terms = required.get("nodeSelectorTerms")
+        if not terms:
+            errs.required(
+                f"{base}.nodeSelectorTerms", "must have at least one node selector term"
+            )
+        for i, term in enumerate(terms or []):
+            _validate_node_selector_term(
+                term or {}, f"{base}.nodeSelectorTerms[{i}]", errs
+            )
+    for i, pref in enumerate(
+        node_affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+    ):
+        base = (
+            "spec.affinity.nodeAffinity."
+            f"preferredDuringSchedulingIgnoredDuringExecution[{i}]"
+        )
+        weight = _to_int(pref.get("weight"))
+        if weight is None or not (1 <= weight <= 100):
+            errs.invalid(
+                f"{base}.weight", pref.get("weight"), "must be in the range 1-100"
+            )
+        _validate_node_selector_term(
+            pref.get("preference") or {}, f"{base}.preference", errs
+        )
+    _validate_tolerations(spec.get("tolerations"), "spec.tolerations", errs)
+    rp = spec.get("restartPolicy")
+    if rp and rp not in ("Always", "OnFailure", "Never"):
+        errs.unsupported("spec.restartPolicy", rp, ["Always", "OnFailure", "Never"])
+    dp = spec.get("dnsPolicy")
+    if dp and dp not in ("ClusterFirstWithHostNet", "ClusterFirst", "Default", "None"):
+        errs.unsupported(
+            "spec.dnsPolicy",
+            dp,
+            ["ClusterFirstWithHostNet", "ClusterFirst", "Default", "None"],
+        )
+    ads = spec.get("activeDeadlineSeconds")
+    if ads is not None and (_to_int(ads) is None or _to_int(ads) < 1):
+        errs.invalid(
+            "spec.activeDeadlineSeconds", ads, "must be between 1 and 2147483647, inclusive"
+        )
+    return list(errs)
+
+
+def node_validation_errors(node: dict) -> List[str]:
+    """The ValidateNode subset, as field.Error strings."""
+    errs = _ErrorList()
+    meta = node.get("metadata") or {}
+    _validate_object_meta(meta, "metadata", errs)
+    seen = set()
+    for i, taint in enumerate(((node.get("spec") or {}).get("taints")) or []):
+        tpath = f"spec.taints[{i}]"
+        key = taint.get("key") or ""
+        if not key:
+            errs.required(f"{tpath}.key")
+        else:
+            for m in _is_qualified_name(key):
+                errs.invalid(f"{tpath}.key", key, m)
+        value = taint.get("value") or ""
+        for m in _is_label_value(value):
+            errs.invalid(f"{tpath}.value", value, m)
+        effect = taint.get("effect") or ""
+        if not effect:
+            errs.required(f"{tpath}.effect")
+        elif effect not in _TAINT_EFFECTS:
+            errs.unsupported(f"{tpath}.effect", effect, _TAINT_EFFECTS)
+        if (key, effect) in seen:
+            errs.append(
+                f"{tpath}: Duplicate value: taints must be unique by key "
+                "and effect pair"
+            )
+        seen.add((key, effect))
+    status = node.get("status") or {}
+    for section in ("capacity", "allocatable"):
+        for rname, raw in (status.get(section) or {}).items():
+            _validate_quantity(raw, f"status.{section}", errs)
+    return list(errs)
+
+
+def validate_pod(pod: dict):
+    """ValidatePod (utils.go:519-532): raise with the aggregated
+    field errors joined like the reference."""
+    errs = pod_validation_errors(pod)
+    if errs:
+        raise InputError("invalid pod: " + "\n".join(errs))
+
+
+def validate_pod_name(pod: dict):
+    """Name-only fast path for replica clones of an already-validated
+    workload template (the only per-clone field is the generated name)."""
+    errs = _ErrorList()
+    meta = pod.get("metadata") or {}
+    name = meta.get("name") or ""
+    if not name:
+        errs.required("metadata.name", "name or generateName is required")
+    else:
+        for m in _is_dns1123_subdomain(name):
+            errs.invalid("metadata.name", name, m)
+    if errs:
+        raise InputError("invalid pod: " + "\n".join(errs))
+
+
+def validate_node(node: dict):
+    """ValidateNode (utils.go:657-671)."""
+    errs = node_validation_errors(node)
+    if errs:
+        raise InputError("invalid node: " + "\n".join(errs))

@@ -636,8 +636,14 @@ def run_scan_masked(
     compiled scan to the subsystems the batch uses; None derives it from
     `static`/`pinned_node`, which must then be concrete arrays.
     `weights` (custom score weights) only applies when `features` is
-    derived here; explicit `features` already carry theirs.
+    derived here; explicit `features` already carry theirs, so passing
+    both is a caller bug.
     """
+    if features is not None and weights is not None:
+        raise ValueError(
+            "pass weights inside features (features_of_batch(..., weights=)) "
+            "or alone, not both"
+        )
     if features is None:
         features = features_of(static, pinned_node, weights=weights)
     return _run_scan_compiled(

@@ -84,9 +84,10 @@ def _apply_score_set(plugins_score: dict, base: ScoreWeights) -> ScoreWeights:
     runtime/framework.go pluginsNeeded): `disabled` names (or "*") are
     removed from the default set, then `enabled` entries are appended
     with their weight (absent weight -> the plugin's default). Unknown
-    plugin names and non-positive weights are rejected, matching
-    kube-scheduler's startup failure on an unregistered plugin or a
-    weight <= 0."""
+    *enabled* plugin names and non-positive weights are rejected,
+    matching kube-scheduler's startup failure on an unregistered
+    enabled plugin or a weight <= 0; unknown disabled names are
+    ignored, as upstream only resolves enabled plugins."""
     weights = base._asdict()
     for entry in plugins_score.get("disabled") or []:
         name = (entry or {}).get("name", "")
@@ -94,8 +95,10 @@ def _apply_score_set(plugins_score: dict, base: ScoreWeights) -> ScoreWeights:
             weights = {k: 0 for k in weights}
         elif name in PLUGIN_FIELDS:
             weights[PLUGIN_FIELDS[name]] = 0
-        else:
-            raise ValueError(f"unknown score plugin {name!r} in disabled set")
+        # unknown names in the disabled set are ignored, like upstream
+        # updatePluginList (only *enabled* plugins are resolved against
+        # the registry) — a production config disabling a plugin this
+        # simulator doesn't model must stay valid
     for entry in plugins_score.get("enabled") or []:
         name = (entry or {}).get("name", "")
         if name not in PLUGIN_FIELDS:

@@ -238,24 +238,20 @@ def test_applier_accepts_scheduler_config(tmp_path):
     assert result.success
 
 
-def test_unknown_score_plugin_rejected():
-    """kube-scheduler fails startup on an unregistered plugin name; a
-    typo must not silently leave the intended plugin enabled."""
-    with pytest.raises(ValueError, match="unknown score plugin"):
-        parse_scheduler_config(
-            {
-                "kind": "KubeSchedulerConfiguration",
-                "profiles": [
-                    {
-                        "plugins": {
-                            "score": {
-                                "disabled": [{"name": "NodeResourceLeastAllocated"}]
-                            }
-                        }
-                    }
-                ],
-            }
-        )
+def test_unknown_enabled_plugin_rejected_unknown_disabled_ignored():
+    """kube-scheduler fails startup on an unregistered *enabled*
+    plugin (NewFramework resolves it against the registry) but accepts
+    unknown names in the disabled set (updatePluginList just filters),
+    e.g. a production config disabling SelectorSpread."""
+    cfg = parse_scheduler_config(
+        {
+            "kind": "KubeSchedulerConfiguration",
+            "profiles": [
+                {"plugins": {"score": {"disabled": [{"name": "SelectorSpread"}]}}}
+            ],
+        }
+    )
+    assert cfg.score_weights == DEFAULT_SCORE_WEIGHTS
     with pytest.raises(ValueError, match="unknown score plugin"):
         parse_scheduler_config(
             {

@@ -1,0 +1,246 @@
+"""k8s validation parity (models/validation.py).
+
+The reference runs the real kubernetes validation library over every
+generated pod/node (pkg/utils/utils.go:519-532 ValidatePod,
+657-671 ValidateNode); these tests pin the ported subset and its
+upstream message strings.
+"""
+
+import pytest
+
+from open_simulator_tpu.models import workloads as wl
+from open_simulator_tpu.models.validation import (
+    node_validation_errors,
+    pod_validation_errors,
+    validate_node,
+    validate_pod,
+)
+
+
+def _pod(**spec_over):
+    spec = {
+        "containers": [
+            {
+                "name": "c",
+                "image": "busybox",
+                "resources": {"requests": {"cpu": "250m", "memory": "512Mi"}},
+            }
+        ],
+    }
+    spec.update(spec_over)
+    return {
+        "metadata": {"name": "p-1", "namespace": "default", "labels": {"app": "x"}},
+        "spec": spec,
+    }
+
+
+def test_valid_pod_passes():
+    assert pod_validation_errors(_pod()) == []
+
+
+def test_bad_pod_name_rfc1123():
+    pod = _pod()
+    pod["metadata"]["name"] = "Bad_Name"
+    errs = pod_validation_errors(pod)
+    assert any("metadata.name" in e and "RFC 1123 subdomain" in e for e in errs)
+
+
+def test_missing_containers_required():
+    pod = _pod()
+    pod["spec"]["containers"] = []
+    assert any("spec.containers: Required value" in e for e in pod_validation_errors(pod))
+
+
+def test_bad_quantity_message():
+    pod = _pod()
+    pod["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "abc"
+    errs = pod_validation_errors(pod)
+    assert any(
+        "resources.requests" in e and "quantities must match the regular expression" in e
+        for e in errs
+    )
+
+
+def test_negative_quantity_rejected():
+    pod = _pod()
+    pod["spec"]["containers"][0]["resources"]["requests"]["memory"] = "-1Gi"
+    errs = pod_validation_errors(pod)
+    assert any("must be greater than or equal to 0" in e for e in errs)
+
+
+def test_request_exceeding_limit_rejected():
+    pod = _pod()
+    pod["spec"]["containers"][0]["resources"] = {
+        "requests": {"cpu": "2"},
+        "limits": {"cpu": "1"},
+    }
+    errs = pod_validation_errors(pod)
+    assert any("must be less than or equal to cpu limit" in e for e in errs)
+
+
+def test_bad_label_key_and_value():
+    pod = _pod()
+    pod["metadata"]["labels"] = {"-bad-key": "ok", "good": "bad value with spaces"}
+    errs = pod_validation_errors(pod)
+    assert any("metadata.labels" in e for e in errs)
+    assert len(errs) == 2
+
+
+def test_selector_operator_arity():
+    pod = _pod(
+        affinity={
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {
+                            "matchExpressions": [
+                                {"key": "zone", "operator": "In", "values": []},
+                                {"key": "gpu", "operator": "Exists", "values": ["x"]},
+                                {"key": "os", "operator": "Bogus"},
+                            ]
+                        }
+                    ]
+                }
+            }
+        }
+    )
+    errs = pod_validation_errors(pod)
+    assert any("'In' or 'NotIn'" in e for e in errs)
+    assert any("Forbidden" in e and "'Exists' or 'DoesNotExist'" in e for e in errs)
+    assert any("not a valid selector operator" in e for e in errs)
+
+
+def test_toleration_exists_with_value_rejected():
+    pod = _pod(tolerations=[{"key": "k", "operator": "Exists", "value": "v"}])
+    errs = pod_validation_errors(pod)
+    assert any("value must be empty when `operator` is 'Exists'" in e for e in errs)
+
+
+def test_bad_restart_policy_unsupported_value():
+    pod = _pod(restartPolicy="Sometimes")
+    errs = pod_validation_errors(pod)
+    assert any(
+        'spec.restartPolicy: Unsupported value: "Sometimes"' in e for e in errs
+    )
+
+
+def test_container_port_range():
+    pod = _pod()
+    pod["spec"]["containers"][0]["ports"] = [{"containerPort": 99999}]
+    errs = pod_validation_errors(pod)
+    assert any("must be between 1 and 65535, inclusive" in e for e in errs)
+
+
+def test_validate_pod_raises_wrapped():
+    pod = _pod()
+    pod["metadata"]["name"] = ""
+    with pytest.raises(ValueError, match="invalid pod: "):
+        validate_pod(pod)
+
+
+# ------------------------------------------------------------------- nodes
+
+
+def _node():
+    return {
+        "metadata": {"name": "node-1", "labels": {"zone": "z1"}},
+        "status": {"allocatable": {"cpu": "16", "memory": "64Gi", "pods": "110"}},
+    }
+
+
+def test_valid_node_passes():
+    assert node_validation_errors(_node()) == []
+
+
+def test_taint_missing_effect_required():
+    node = _node()
+    node["spec"] = {"taints": [{"key": "dedicated", "value": "infra"}]}
+    errs = node_validation_errors(node)
+    assert any("spec.taints[0].effect: Required value" in e for e in errs)
+
+
+def test_taint_bad_effect_unsupported():
+    node = _node()
+    node["spec"] = {"taints": [{"key": "k", "effect": "Sometimes"}]}
+    errs = node_validation_errors(node)
+    assert any("NoSchedule" in e and "Unsupported value" in e for e in errs)
+
+
+def test_duplicate_taints_rejected():
+    node = _node()
+    node["spec"] = {
+        "taints": [
+            {"key": "k", "value": "a", "effect": "NoSchedule"},
+            {"key": "k", "value": "b", "effect": "NoSchedule"},
+        ]
+    }
+    errs = node_validation_errors(node)
+    assert any("unique by key and effect pair" in e for e in errs)
+
+
+def test_bad_allocatable_quantity():
+    node = _node()
+    node["status"]["allocatable"]["cpu"] = "lots"
+    errs = node_validation_errors(node)
+    assert any("status.allocatable" in e for e in errs)
+
+
+def test_validate_node_raises_wrapped():
+    node = _node()
+    node["metadata"]["name"] = "UPPER"
+    with pytest.raises(ValueError, match="invalid node: "):
+        validate_node(node)
+
+
+# ------------------------------------------------------- pipeline wiring
+
+
+def test_make_valid_pod_rejects_malformed():
+    with pytest.raises(ValueError, match="invalid pod"):
+        wl.make_valid_pod(
+            {"metadata": {"name": "Bad_Name"}, "spec": {"containers": [
+                {"name": "c", "image": "i"}
+            ]}}
+        )
+
+
+def test_make_valid_node_rejects_malformed():
+    with pytest.raises(ValueError, match="invalid node"):
+        wl.make_valid_node({"spec": {"taints": [{"key": "k"}]}}, "node-x")
+
+
+def test_expand_template_validates_template_once_but_names_always():
+    """Replica clones share the template; each clone's generated name
+    is still validated."""
+    deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": "d", "namespace": "default"},
+        "spec": {
+            "replicas": 3,
+            "template": {
+                "spec": {
+                    "containers": [{"name": "c", "image": "img"}],
+                }
+            },
+        },
+    }
+    pods = wl.pods_from_deployment(deploy)
+    assert len(pods) == 3
+    bad = {
+        "kind": "Deployment",
+        "metadata": {"name": "d2", "namespace": "default"},
+        "spec": {"replicas": 2, "template": {"spec": {"containers": []}}},
+    }
+    with pytest.raises(ValueError, match="spec.containers: Required value"):
+        wl.pods_from_deployment(bad)
+
+
+def test_non_numeric_port_aggregates_as_field_error():
+    """A named port (common mistake) must produce a field error, not a
+    raw int() ValueError that aborts validation."""
+    pod = _pod()
+    pod["spec"]["containers"][0]["ports"] = [{"containerPort": "http"}]
+    pod["metadata"]["name"] = "Bad_Name"  # both errors must survive
+    errs = pod_validation_errors(pod)
+    assert any("containerPort" in e and "Invalid value" in e for e in errs)
+    assert any("metadata.name" in e for e in errs)

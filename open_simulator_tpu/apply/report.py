@@ -20,20 +20,23 @@ from ..utils.quantity import format_quantity_bin
 
 def render_table(headers: List[str], rows: List[List[str]]) -> str:
     widths = [len(h) for h in headers]
-    for row in rows:
+    str_rows = [[str(c) for c in row] for row in rows]
+    for row in str_rows:
         for i, cell in enumerate(row):
-            widths[i] = max(widths[i], len(str(cell)))
+            if len(cell) > widths[i]:
+                widths[i] = len(cell)
 
     def line(ch="-", junction="+"):
         return junction + junction.join(ch * (w + 2) for w in widths) + junction
 
     def fmt_row(cells):
-        return "| " + " | ".join(str(c).ljust(w) for c, w in zip(cells, widths)) + " |"
+        return "| " + " | ".join(c.ljust(w) for c, w in zip(cells, widths)) + " |"
 
-    out = [line(), fmt_row(headers), line("=")]
-    for row in rows:
+    sep = line()  # identical between every row: render once, not per row
+    out = [sep, fmt_row(headers), line("=")]
+    for row in str_rows:
         out.append(fmt_row(row))
-        out.append(line())
+        out.append(sep)
     return "\n".join(out)
 
 
@@ -48,12 +51,8 @@ def _pct(numer: float, denom: float) -> int:
 
 
 def _pod_req_summary(pod: dict):
-    requests = req.pod_requests(pod)
-    mcpu = requests.get(req.CPU, Fraction(0)) * 1000
-    mcpu = mcpu.numerator // mcpu.denominator
-    mem = requests.get(req.MEMORY, Fraction(0))
-    mem = mem.numerator // mem.denominator
-    return mcpu, mem
+    s = req.pod_request_summary(pod)
+    return s.floor_mcpu, s.floor_mem
 
 
 def report(
@@ -216,6 +215,10 @@ def _pod_table(node_statuses, extended_resources) -> str:
         headers.append("GPU Mem Requests")
     headers.append("APP Name")
     rows = []
+    # identical (request, allocatable) pairs repeat across thousands of
+    # pods at scale — format each combination once
+    cpu_cell: dict = {}
+    mem_cell: dict = {}
     for status in node_statuses:
         node = status.node
         node_name = (node.get("metadata") or {}).get("name", "")
@@ -223,12 +226,22 @@ def _pod_table(node_statuses, extended_resources) -> str:
         alloc_mem = req.node_alloc_int(node, req.MEMORY)
         for pod in status.pods:
             mcpu, mem = _pod_req_summary(pod)
+            ck = (mcpu, alloc_mcpu)
+            cell_c = cpu_cell.get(ck)
+            if cell_c is None:
+                cell_c = cpu_cell[ck] = f"{_fmt_cpu(mcpu)}({_pct(mcpu, alloc_mcpu)}%)"
+            mk = (mem, alloc_mem)
+            cell_m = mem_cell.get(mk)
+            if cell_m is None:
+                cell_m = mem_cell[mk] = (
+                    f"{format_quantity_bin(mem)}({_pct(mem, alloc_mem)}%)"
+                )
             meta = pod.get("metadata") or {}
             row = [
                 node_name,
                 f"{meta.get('namespace', 'default')}/{meta.get('name', '')}",
-                f"{_fmt_cpu(mcpu)}({_pct(mcpu, alloc_mcpu)}%)",
-                f"{format_quantity_bin(mem)}({_pct(mem, alloc_mem)}%)",
+                cell_c,
+                cell_m,
             ]
             if local:
                 lvm, dev = stor.parse_pod_local_volumes(pod)

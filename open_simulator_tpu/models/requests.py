@@ -132,6 +132,66 @@ def pod_nonzero_request(pod: dict, resource: str) -> int:
     return total
 
 
+class RequestSummary:
+    """Precomputed per-pod request numbers for the hot accounting paths
+    (oracle commit/remove, report aggregation). `mcpu/mem/eph` use the
+    scheduler's ceil semantics (NodeInfo accounting); `floor_mcpu/
+    floor_mem` use the floor semantics of PodRequestsAndLimits-based
+    report code."""
+
+    __slots__ = (
+        "mcpu", "mem", "eph", "scalars", "nz_mcpu", "nz_mem",
+        "floor_mcpu", "floor_mem",
+    )
+
+    def __init__(self, pod: dict):
+        reqs = pod_requests(pod)
+        cpu = reqs.get(CPU, Fraction(0))
+        mem = reqs.get(MEMORY, Fraction(0))
+        eph = reqs.get(EPHEMERAL, Fraction(0))
+        mcpu1000 = cpu * 1000
+        self.mcpu = -((-mcpu1000.numerator) // mcpu1000.denominator)
+        self.mem = -((-mem.numerator) // mem.denominator)
+        self.eph = -((-eph.numerator) // eph.denominator)
+        self.floor_mcpu = mcpu1000.numerator // mcpu1000.denominator
+        self.floor_mem = mem.numerator // mem.denominator
+        scalars = []
+        for name, v in reqs.items():
+            if name in (CPU, MEMORY, EPHEMERAL):
+                continue
+            if is_scalar_resource(name):
+                scalars.append((name, -((-v.numerator) // v.denominator)))
+        self.scalars = tuple(scalars)
+        self.nz_mcpu = pod_nonzero_request(pod, CPU)
+        self.nz_mem = pod_nonzero_request(pod, MEMORY)
+
+
+# identity-keyed memo: replica clones of one workload template share
+# their containers/initContainers/overhead objects (workloads.py
+# _expand_template), so one computation serves the whole workload. The
+# cached entry holds strong refs to the key objects, so their ids
+# cannot be reused while the entry lives; specs are read-only after
+# expansion (the sharing contract in _expand_template).
+_SUMMARY_CACHE: dict = {}
+_SUMMARY_CACHE_MAX = 8192
+
+
+def pod_request_summary(pod: dict) -> RequestSummary:
+    spec = pod.get("spec") or {}
+    c = spec.get("containers")
+    ic = spec.get("initContainers")
+    ov = spec.get("overhead")
+    key = (id(c), id(ic), id(ov))
+    hit = _SUMMARY_CACHE.get(key)
+    if hit is not None and hit[0] is c and hit[1] is ic and hit[2] is ov:
+        return hit[3]
+    summary = RequestSummary(pod)
+    if len(_SUMMARY_CACHE) >= _SUMMARY_CACHE_MAX:
+        _SUMMARY_CACHE.clear()
+    _SUMMARY_CACHE[key] = (c, ic, ov, summary)
+    return summary
+
+
 def node_allocatable(node: dict) -> dict:
     """Node allocatable as {resource: Fraction base units}."""
     status = node.get("status") or {}

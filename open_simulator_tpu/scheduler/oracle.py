@@ -1236,17 +1236,14 @@ class Oracle:
     def _commit(self, pod: dict, ns: NodeState):
         """NodeInfo.AddPod accounting."""
         ns.pods.append(pod)
-        ns.req_mcpu += req.pod_request_milli_cpu(pod)
-        ns.req_mem += req.pod_request_int(pod, req.MEMORY)
-        ns.req_eph += req.pod_request_int(pod, req.EPHEMERAL)
-        for name, v in req.pod_requests(pod).items():
-            if name in (req.CPU, req.MEMORY, req.EPHEMERAL):
-                continue
-            if req.is_scalar_resource(name):
-                iv = -((-v.numerator) // v.denominator)
-                ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
-        ns.nz_mcpu += req.pod_nonzero_request(pod, req.CPU)
-        ns.nz_mem += req.pod_nonzero_request(pod, req.MEMORY)
+        s = req.pod_request_summary(pod)
+        ns.req_mcpu += s.mcpu
+        ns.req_mem += s.mem
+        ns.req_eph += s.eph
+        for name, iv in s.scalars:
+            ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
+        ns.nz_mcpu += s.nz_mcpu
+        ns.nz_mem += s.nz_mem
         for port in _pod_host_ports(pod):
             ns.used_ports.add(port)
         # priority bookkeeping for DefaultPreemption
@@ -1277,17 +1274,14 @@ class Oracle:
         else:
             raise ValueError("pod not on node")
         ns.pods.pop(pos)
-        ns.req_mcpu -= req.pod_request_milli_cpu(pod)
-        ns.req_mem -= req.pod_request_int(pod, req.MEMORY)
-        ns.req_eph -= req.pod_request_int(pod, req.EPHEMERAL)
-        for name, v in req.pod_requests(pod).items():
-            if name in (req.CPU, req.MEMORY, req.EPHEMERAL):
-                continue
-            if req.is_scalar_resource(name):
-                iv = -((-v.numerator) // v.denominator)
-                ns.req_scalar[name] = ns.req_scalar.get(name, 0) - iv
-        ns.nz_mcpu -= req.pod_nonzero_request(pod, req.CPU)
-        ns.nz_mem -= req.pod_nonzero_request(pod, req.MEMORY)
+        s = req.pod_request_summary(pod)
+        ns.req_mcpu -= s.mcpu
+        ns.req_mem -= s.mem
+        ns.req_eph -= s.eph
+        for name, iv in s.scalars:
+            ns.req_scalar[name] = ns.req_scalar.get(name, 0) - iv
+        ns.nz_mcpu -= s.nz_mcpu
+        ns.nz_mem -= s.nz_mem
         for port in _pod_host_ports(pod):
             ns.used_ports.discard(port)
         # GPU devices (from the gpu-index annotation Reserve wrote)
@@ -1317,17 +1311,14 @@ class Oracle:
         """Exact inverse of remove_pod_from_node."""
         pos, gpu_devs, gpu_mem, local = token
         ns.pods.insert(pos, pod)
-        ns.req_mcpu += req.pod_request_milli_cpu(pod)
-        ns.req_mem += req.pod_request_int(pod, req.MEMORY)
-        ns.req_eph += req.pod_request_int(pod, req.EPHEMERAL)
-        for name, v in req.pod_requests(pod).items():
-            if name in (req.CPU, req.MEMORY, req.EPHEMERAL):
-                continue
-            if req.is_scalar_resource(name):
-                iv = -((-v.numerator) // v.denominator)
-                ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
-        ns.nz_mcpu += req.pod_nonzero_request(pod, req.CPU)
-        ns.nz_mem += req.pod_nonzero_request(pod, req.MEMORY)
+        s = req.pod_request_summary(pod)
+        ns.req_mcpu += s.mcpu
+        ns.req_mem += s.mem
+        ns.req_eph += s.eph
+        for name, iv in s.scalars:
+            ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
+        ns.nz_mcpu += s.nz_mcpu
+        ns.nz_mem += s.nz_mem
         for port in _pod_host_ports(pod):
             ns.used_ports.add(port)
         if gpu_devs and ns.gpu is not None:

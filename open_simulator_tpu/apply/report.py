@@ -9,7 +9,6 @@ ASCII table writer (the reference uses olekukonko/tablewriter).
 from __future__ import annotations
 
 import json
-from fractions import Fraction
 from typing import List, Optional
 
 from ..models import requests as req

@@ -1,0 +1,519 @@
+"""Fused Pallas TPU kernel for the sequential-commit scheduling scan.
+
+The XLA `lax.scan` step (ops/scan.py) lowers to ~15-20 small kernels
+per pod; at N=10k nodes each is latency-bound (~2-3us), so a 100k-pod
+capacity probe costs ~3-4 s on a v5e chip. This module runs the ENTIRE
+scan inside ONE `pl.pallas_call`: a `fori_loop` over pods with all
+cluster state resident in VMEM as (R, 128) int32 tiles — per-step cost
+collapses to pure VPU arithmetic with zero kernel-launch overhead.
+
+Scope (automatic fallback to the XLA scan otherwise):
+- no GPU-share / open-local / ports / inter-pod-affinity / topology-
+  spread / custom-plugin / scalar-resource / nodeName-pin machinery
+  (features gates, same contract as ScanFeatures),
+- all quantities must fit exactness-preserving int32 encodings:
+  memory/ephemeral values are divided by their collective GCD
+  (floor-division identities keep every score and fit comparison
+  bit-identical to the int64 XLA path), with magnitude guards.
+
+Semantics replicated from ops/scan.py (which is conformance-tested
+against the serial oracle):
+- NodeResourcesFit (noderesources/fit.go:230-303) incl. the
+  zero-request pod-count-only fast path,
+- LeastAllocated / BalancedAllocation / NodeAffinity / TaintToleration
+  / Simon / ImageLocality / NodePreferAvoidPods scores with their
+  normalizes (normalize_score.go:26-53, simon.go:75-100),
+- first-max tie rule over feasible nodes (documented deviation shared
+  with the XLA engine, scan.py:19-21),
+- capacity-sweep masking: node_valid gates candidates, inactive pods
+  commit nothing and report INACTIVE.
+
+BalancedAllocation is computed in f32 here (the XLA path uses the
+default float width); its inputs are <=24-bit scaled integers so the
+fractions are exact in f32 and only the final (1-|d|)*100 truncation
+could differ — conformance tests (tests/test_pallas_scan.py) pin
+agreement with the XLA path on randomized scenarios.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+LANES = 128
+SUBLANES = 8
+NEG = -(2**31) + 1
+BIG = 2**31 - 1
+MAX_SCORE = 100
+INACTIVE = -2
+
+# magnitude guards: every intermediate must stay inside int32
+_MAX_SCALED = (2**31 - 1) // (MAX_SCORE + 1)
+
+
+class PallasPlan(NamedTuple):
+    """Host-side (numpy) arrays prepared for the kernel, all padded to
+    (R, 128) node tiles / int32."""
+
+    n: int  # true node count
+    r: int  # padded rows (multiple of 8)
+    u: int  # class count
+    # [R, C] node vectors
+    alloc_mcpu: np.ndarray
+    alloc_mem_s: np.ndarray  # fit-scaled
+    alloc_eph_s: np.ndarray
+    alloc_pods: np.ndarray
+    alloc_nzmem_s: np.ndarray  # nz-scaled (balanced/least denominator)
+    # [U, R, C] class tables
+    static_feasible: np.ndarray
+    simon_raw: np.ndarray
+    nodeaff_raw: np.ndarray
+    taint_intol: np.ndarray
+    base_score: np.ndarray  # prefolded image*w_image + avoid*w_avoid
+    # [U, 8] class scalars: req_mcpu, req_mem_s, req_eph_s, nz_mcpu,
+    # nz_mem_s, has_request, 0, 0
+    class_scalars: np.ndarray
+    # init state [R, C] i32 x6
+    init_used_mcpu: np.ndarray
+    init_used_mem_s: np.ndarray
+    init_used_eph_s: np.ndarray
+    init_nz_mcpu: np.ndarray
+    init_nz_mem_s: np.ndarray
+    init_pod_cnt: np.ndarray
+    # scales to recover true units
+    s_mem: int
+    s_eph: int
+    s_nzmem: int
+    # weights (least, balanced, simon+gpushare, nodeaff, tainttol)
+    w: tuple
+    has_nodeaff: bool
+    has_taint: bool
+
+
+def _pad_nodes(vec: np.ndarray, r: int, fill=0) -> np.ndarray:
+    out = np.full(r * LANES, fill, dtype=np.int32)
+    out[: vec.shape[0]] = vec
+    return out.reshape(r, LANES)
+
+
+def _pad_class_table(tab: np.ndarray, r: int, fill=0) -> np.ndarray:
+    u, n = tab.shape
+    out = np.full((u, r * LANES), fill, dtype=np.int32)
+    out[:, :n] = tab
+    return out.reshape(u, r, LANES)
+
+
+def _gcd_scale(*arrays) -> int:
+    vals = np.concatenate([np.asarray(a, dtype=np.int64).ravel() for a in arrays])
+    vals = vals[vals > 0]
+    if vals.size == 0:
+        return 1
+    return int(np.gcd.reduce(vals))
+
+
+def build_plan(cluster, batch, dyn, features, weights=None) -> Optional[PallasPlan]:
+    """Build a kernel plan from the (numpy) ClusterStatic + PodBatch +
+    DynamicState, or None when the batch is outside the fast path's
+    scope."""
+    if (
+        features.gpu
+        or features.storage
+        or features.ipa
+        or features.hard_spread
+        or features.soft_spread
+        or features.ports
+        or features.scalars
+        or features.custom
+        or features.pins
+    ):
+        return None
+
+    from ..scheduler.schedconfig import DEFAULT_SCORE_WEIGHTS, ScoreWeights
+
+    w = ScoreWeights(*weights) if weights is not None else DEFAULT_SCORE_WEIGHTS
+    # plugins the kernel does not model must be disabled or irrelevant
+    # (ipa/spread/openlocal have no terms here by the gates above)
+
+    a = np.asarray
+    alloc_mcpu = a(cluster.alloc_mcpu, dtype=np.int64)
+    alloc_mem = a(cluster.alloc_mem, dtype=np.int64)
+    alloc_eph = a(cluster.alloc_eph, dtype=np.int64)
+    alloc_pods = a(cluster.alloc_pods, dtype=np.int64)
+    req_mcpu = a(batch.req_mcpu, dtype=np.int64)
+    req_mem = a(batch.req_mem, dtype=np.int64)
+    req_eph = a(batch.req_eph, dtype=np.int64)
+    nz_mcpu = a(batch.nz_mcpu, dtype=np.int64)
+    nz_mem = a(batch.nz_mem, dtype=np.int64)
+    init_used_mcpu = a(dyn.used_mcpu, dtype=np.int64)
+    init_used_mem = a(dyn.used_mem, dtype=np.int64)
+    init_used_eph = a(dyn.used_eph, dtype=np.int64)
+    init_nz_mcpu = a(dyn.nz_mcpu, dtype=np.int64)
+    init_nz_mem = a(dyn.nz_mem, dtype=np.int64)
+    init_pod_cnt = a(dyn.pod_cnt, dtype=np.int64)
+
+    s_mem = _gcd_scale(alloc_mem, req_mem, init_used_mem)
+    s_eph = _gcd_scale(alloc_eph, req_eph, init_used_eph)
+    s_nzmem = _gcd_scale(alloc_mem, nz_mem, init_nz_mem)
+
+    simon_raw = a(batch.simon_raw, dtype=np.int64)
+    nodeaff_raw = a(batch.nodeaff_raw, dtype=np.int64)
+    taint_intol = a(batch.taint_intol, dtype=np.int64)
+    image_score = a(batch.image_score, dtype=np.int64)
+    avoid_score = a(batch.avoid_score, dtype=np.int64)
+    base_score = image_score * int(w.image) + avoid_score * int(w.avoid)
+
+    # int32 exactness guards
+    checks = [
+        alloc_mcpu.max(initial=0) <= _MAX_SCALED,
+        (alloc_mem // s_mem).max(initial=0) <= _MAX_SCALED,
+        (alloc_eph // s_eph).max(initial=0) <= _MAX_SCALED,
+        (alloc_mem // s_nzmem).max(initial=0) <= _MAX_SCALED,
+        alloc_pods.max(initial=0) <= _MAX_SCALED,
+        simon_raw.max(initial=0) <= _MAX_SCALED,
+        simon_raw.min(initial=0) >= 0,
+        nodeaff_raw.max(initial=0) <= _MAX_SCALED,
+        nodeaff_raw.min(initial=0) >= 0,
+        taint_intol.max(initial=0) <= _MAX_SCALED,
+        taint_intol.min(initial=0) >= 0,
+        np.abs(base_score).max(initial=0) <= 2**24,
+        # balanced runs in f32: its scaled inputs must be f32-exact
+        (alloc_mem // s_nzmem).max(initial=0) < 2**24,
+        alloc_mcpu.max(initial=0) < 2**24,
+    ]
+    if not all(bool(c) for c in checks):
+        return None
+
+    n = alloc_mcpu.shape[0]
+    u = req_mcpu.shape[0]
+    r = -(-n // LANES)
+    r = -(-r // SUBLANES) * SUBLANES  # row count multiple of 8
+
+    class_scalars = np.zeros((u, 8), dtype=np.int32)
+    class_scalars[:, 0] = req_mcpu
+    class_scalars[:, 1] = req_mem // s_mem
+    class_scalars[:, 2] = req_eph // s_eph
+    class_scalars[:, 3] = nz_mcpu
+    class_scalars[:, 4] = nz_mem // s_nzmem
+    class_scalars[:, 5] = a(batch.has_request).astype(np.int32)
+
+    return PallasPlan(
+        n=n,
+        r=r,
+        u=u,
+        alloc_mcpu=_pad_nodes(alloc_mcpu, r),
+        alloc_mem_s=_pad_nodes(alloc_mem // s_mem, r),
+        alloc_eph_s=_pad_nodes(alloc_eph // s_eph, r),
+        alloc_pods=_pad_nodes(alloc_pods, r),
+        alloc_nzmem_s=_pad_nodes(alloc_mem // s_nzmem, r),
+        static_feasible=_pad_class_table(
+            a(batch.static_feasible).astype(np.int32), r
+        ),
+        simon_raw=_pad_class_table(simon_raw, r),
+        nodeaff_raw=_pad_class_table(nodeaff_raw, r),
+        taint_intol=_pad_class_table(taint_intol, r),
+        base_score=_pad_class_table(base_score, r),
+        class_scalars=class_scalars,
+        init_used_mcpu=_pad_nodes(init_used_mcpu, r),
+        init_used_mem_s=_pad_nodes(init_used_mem // s_mem, r),
+        init_used_eph_s=_pad_nodes(init_used_eph // s_eph, r),
+        init_nz_mcpu=_pad_nodes(init_nz_mcpu, r),
+        init_nz_mem_s=_pad_nodes(init_nz_mem // s_nzmem, r),
+        init_pod_cnt=_pad_nodes(init_pod_cnt, r),
+        s_mem=s_mem,
+        s_eph=s_eph,
+        s_nzmem=s_nzmem,
+        w=(int(w.least), int(w.balanced), int(w.simon) + int(w.gpushare),
+           int(w.nodeaff), int(w.tainttol)),
+        has_nodeaff=bool(nodeaff_raw.any()),
+        has_taint=bool(taint_intol.any()),
+    )
+
+
+def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    w_least, w_bal, w_simon, w_na, w_tt = w
+
+    def kernel(
+        pod_scal_ref,  # (8, Pr, 128) i32: class, rc, rm, re, nzc, nzm,
+        #                has_req, unused — pod p at [:, p//128, p%128]
+        active_ref,  # (Pr, 128) i32
+        valid_ref,  # (R, C) i32
+        alloc_c_ref,
+        alloc_m_ref,
+        alloc_e_ref,
+        alloc_p_ref,
+        alloc_nzm_ref,
+        feas_ref,  # (U, R, C)
+        simon_ref,
+        na_ref,
+        tt_ref,
+        base_ref,
+        ic_ref,  # init-state inputs, copied into the state outputs at
+        im_ref,  # kernel start (output aliasing does NOT initialize
+        ie_ref,  # aliased outputs on TPU — unread inputs are elided)
+        inzc_ref,
+        inzm_ref,
+        ipc_ref,
+        place_ref,  # out (Pr, 128) i32, same packing
+        st_c_ref,  # out state, accumulated in VMEM
+        st_m_ref,
+        st_e_ref,
+        st_nzc_ref,
+        st_nzm_ref,
+        st_p_ref,
+    ):
+        shape = valid_ref.shape
+        rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        idx_mat = rows * LANES + cols
+        pshape = place_ref.shape
+        pod_idx = (
+            jax.lax.broadcasted_iota(jnp.int32, pshape, 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, pshape, 1)
+        )
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
+        valid = valid_ref[:] != 0
+        alloc_c = alloc_c_ref[:]
+        alloc_m = alloc_m_ref[:]
+        alloc_e = alloc_e_ref[:]
+        alloc_p = alloc_p_ref[:]
+        alloc_nzm = alloc_nzm_ref[:]
+        alloc_c_f = alloc_c.astype(jnp.float32)
+        alloc_nzm_f = alloc_nzm.astype(jnp.float32)
+
+        st_c_ref[:] = ic_ref[:]
+        st_m_ref[:] = im_ref[:]
+        st_e_ref[:] = ie_ref[:]
+        st_nzc_ref[:] = inzc_ref[:]
+        st_nzm_ref[:] = inzm_ref[:]
+        st_p_ref[:] = ipc_ref[:]
+
+        def step(p, _):
+            # dynamic lane-dim loads are unsupported on TPU: read the
+            # pod's 128-lane row and extract via a masked reduce
+            pr = p // LANES
+            pc = p % LANES
+            lane = lane_iota == pc
+
+            def pod_scalar(s):
+                row = pod_scal_ref[s, pl.ds(pr, 1), :]
+                return jnp.sum(jnp.where(lane, row, 0))
+
+            u = pod_scalar(0)
+            rc = pod_scalar(1)
+            rm = pod_scalar(2)
+            re = pod_scalar(3)
+            nzc = pod_scalar(4)
+            nzm = pod_scalar(5)
+            has_req = pod_scalar(6)
+            active = jnp.sum(jnp.where(lane, active_ref[pl.ds(pr, 1), :], 0))
+
+            used_c = st_c_ref[:]
+            used_m = st_m_ref[:]
+            used_e = st_e_ref[:]
+            st_nzc = st_nzc_ref[:]
+            st_nzm = st_nzm_ref[:]
+            pod_cnt = st_p_ref[:]
+
+            fit = (
+                (used_c + rc <= alloc_c)
+                & (used_m + rm <= alloc_m)
+                & (used_e + re <= alloc_e)
+            )
+            feas = (
+                (feas_ref[u] != 0)
+                & valid
+                & (pod_cnt + 1 <= alloc_p)
+                & (fit | (has_req == 0))
+            )
+
+            # LeastAllocated (least_allocated.go:108-117)
+            totc = st_nzc + nzc
+            totm = st_nzm + nzm
+            ok_c = (alloc_c > 0) & (totc <= alloc_c)
+            ok_m = (alloc_nzm > 0) & (totm <= alloc_nzm)
+            least_c = jnp.where(
+                ok_c, (alloc_c - totc) * MAX_SCORE // jnp.maximum(alloc_c, 1), 0
+            )
+            least_m = jnp.where(
+                ok_m, (alloc_nzm - totm) * MAX_SCORE // jnp.maximum(alloc_nzm, 1), 0
+            )
+            total = base_ref[u] + ((least_c + least_m) // 2) * w_least
+
+            if w_bal:
+                # BalancedAllocation: fractions are exact in f32 (inputs
+                # < 2^24); only the final truncation is float
+                cpu_frac = totc.astype(jnp.float32) / jnp.maximum(alloc_c_f, 1.0)
+                cpu_frac = jnp.where(alloc_c > 0, cpu_frac, 1.0)
+                mem_frac = totm.astype(jnp.float32) / jnp.maximum(alloc_nzm_f, 1.0)
+                mem_frac = jnp.where(alloc_nzm > 0, mem_frac, 1.0)
+                balanced = jnp.where(
+                    (cpu_frac >= 1.0) | (mem_frac >= 1.0),
+                    0,
+                    ((1.0 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE).astype(
+                        jnp.int32
+                    ),
+                )
+                total = total + balanced * w_bal
+
+            if w_simon:
+                raw = simon_ref[u]
+                hi = jnp.max(jnp.where(feas, raw, NEG))
+                lo = jnp.min(jnp.where(feas, raw, BIG))
+                rng = hi - lo
+                sim = jnp.where(
+                    rng > 0, (raw - lo) * MAX_SCORE // jnp.maximum(rng, 1), 0
+                )
+                total = total + sim * w_simon
+
+            if w_na and has_nodeaff:
+                raw = na_ref[u]
+                mx = jnp.max(jnp.where(feas, raw, 0))
+                na = jnp.where(mx > 0, MAX_SCORE * raw // jnp.maximum(mx, 1), 0)
+                total = total + na * w_na
+
+            if w_tt and has_taint:
+                raw = tt_ref[u]
+                mx = jnp.max(jnp.where(feas, raw, 0))
+                base = jnp.where(mx > 0, MAX_SCORE * raw // jnp.maximum(mx, 1), 0)
+                tt = jnp.where(mx > 0, MAX_SCORE - base, MAX_SCORE)
+                total = total + tt * w_tt
+
+            masked = jnp.where(feas, total, NEG)
+            m = jnp.max(masked)
+            found = m > NEG
+            cand = jnp.where(feas & (masked == m), idx_mat, BIG)
+            best = jnp.min(cand)
+
+            place = jnp.where(
+                active != 0, jnp.where(found, best, -1), INACTIVE
+            )
+            # dynamic lane-dim stores are unsupported on TPU: write via
+            # a pod-position mask over the whole packed block
+            place_ref[:] = jnp.where(pod_idx == p, place, place_ref[:])
+
+            do = found & (active != 0)
+            sel = (idx_mat == best) & do
+            st_c_ref[:] = used_c + jnp.where(sel, rc, 0)
+            st_m_ref[:] = used_m + jnp.where(sel, rm, 0)
+            st_e_ref[:] = used_e + jnp.where(sel, re, 0)
+            st_nzc_ref[:] = st_nzc + jnp.where(sel, nzc, 0)
+            st_nzm_ref[:] = st_nzm + jnp.where(sel, nzm, 0)
+            st_p_ref[:] = pod_cnt + jnp.where(sel, 1, 0)
+            return 0
+
+        jax.lax.fori_loop(0, p_total, step, 0)
+
+    return kernel
+
+
+class _Compiled(NamedTuple):
+    fn: object
+
+
+_COMPILED_CACHE: dict = {}
+
+
+def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
+                    interpret=None):
+    """Run the fused scan. Returns (placements[P] np.int32, final used
+    dict in TRUE units for utilization reporting). `interpret` forces
+    the Pallas interpreter (None = auto: interpret off-TPU)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p_total = int(np.asarray(class_of_pod).shape[0])
+    # dense (Pr, 128) packing: a (P, 1) VMEM array would be lane-padded
+    # 128x by the (8, 128) tile layout (51 MB at 100k pods)
+    pr_rows = max(-(-p_total // LANES), 1)
+    pr_rows = -(-pr_rows // SUBLANES) * SUBLANES
+    p_pad = pr_rows * LANES
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    key = (p_total, plan.r, plan.u, plan.w, plan.has_nodeaff, plan.has_taint,
+           interpret)
+    cached = _COMPILED_CACHE.get(key)
+    if cached is None:
+        kernel = _make_kernel(p_total, plan.w, plan.has_nodeaff, plan.has_taint)
+        rc = (plan.r, LANES)
+
+        @jax.jit
+        def call(pod_scal, active_2d, valid, ac, am, ae, ap, anzm,
+                 feas, simon, na, tt, base,
+                 ic, im, ie, inzc, inzm, ipc):
+            def vm():
+                return pl.BlockSpec(memory_space=pltpu.VMEM)
+            outs = pl.pallas_call(
+                kernel,
+                out_shape=(
+                    jax.ShapeDtypeStruct((pr_rows, LANES), jnp.int32),
+                    jax.ShapeDtypeStruct(rc, jnp.int32),
+                    jax.ShapeDtypeStruct(rc, jnp.int32),
+                    jax.ShapeDtypeStruct(rc, jnp.int32),
+                    jax.ShapeDtypeStruct(rc, jnp.int32),
+                    jax.ShapeDtypeStruct(rc, jnp.int32),
+                    jax.ShapeDtypeStruct(rc, jnp.int32),
+                ),
+                in_specs=[vm() for _ in range(19)],
+                out_specs=tuple(vm() for _ in range(7)),
+                interpret=interpret,
+            )(
+                pod_scal, active_2d, valid, ac, am, ae, ap, anzm,
+                feas, simon, na, tt, base,
+                ic, im, ie, inzc, inzm, ipc,
+            )
+            return outs
+
+        cached = _Compiled(fn=call)
+        _COMPILED_CACHE[key] = cached
+
+    def pack(vec):
+        out = np.zeros(p_pad, dtype=np.int32)
+        out[:p_total] = vec
+        return out.reshape(pr_rows, LANES)
+
+    cls = np.asarray(class_of_pod, dtype=np.int32)
+    # per-pod scalar rows: class + class-derived request scalars,
+    # gathered host-side so the kernel never lane-indexes a class table
+    pod_scal = np.zeros((8, pr_rows, LANES), dtype=np.int32)
+    pod_scal[0] = pack(cls)
+    for s in range(6):
+        pod_scal[1 + s] = pack(plan.class_scalars[cls, s])
+    active_2d = pack(np.asarray(pod_active).astype(np.int32))
+    valid = _pad_nodes(np.asarray(node_valid).astype(np.int32), plan.r)
+
+    # the engine enables x64 globally (ops/__init__.py) for the XLA
+    # scan's int64 semantics, but this kernel is int32 by construction
+    # and Mosaic's convert rules recurse on x64-promoted loop indices —
+    # trace and run with x64 off
+    with jax.enable_x64(False):
+        outs = cached.fn(
+            pod_scal, active_2d, valid,
+            plan.alloc_mcpu, plan.alloc_mem_s, plan.alloc_eph_s, plan.alloc_pods,
+            plan.alloc_nzmem_s,
+            plan.static_feasible, plan.simon_raw, plan.nodeaff_raw,
+            plan.taint_intol, plan.base_score,
+            plan.init_used_mcpu, plan.init_used_mem_s, plan.init_used_eph_s,
+            plan.init_nz_mcpu, plan.init_nz_mem_s, plan.init_pod_cnt,
+        )
+        outs = [np.asarray(o) for o in outs]
+    place = np.asarray(outs[0]).reshape(-1)[:p_total]
+    # map padded slots: any placement index beyond n means "no node"
+    place = np.where((place >= 0) & (place >= plan.n), -1, place)
+    final = {
+        "used_mcpu": np.asarray(outs[1]).reshape(-1)[: plan.n].astype(np.int64),
+        "used_mem": np.asarray(outs[2]).reshape(-1)[: plan.n].astype(np.int64)
+        * plan.s_mem,
+        "nz_mcpu": np.asarray(outs[4]).reshape(-1)[: plan.n].astype(np.int64),
+        "nz_mem": np.asarray(outs[5]).reshape(-1)[: plan.n].astype(np.int64)
+        * plan.s_nzmem,
+        "pod_cnt": np.asarray(outs[6]).reshape(-1)[: plan.n].astype(np.int64),
+    }
+    return place, final

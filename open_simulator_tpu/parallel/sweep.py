@@ -180,6 +180,14 @@ class CapacitySweep:
             if target is not None and target in name_to_idx:
                 self._ds_target[p_i] = name_to_idx[target]
         self._probe_jit = None
+        # fused single-kernel fast path (ops/pallas_scan.py); None when
+        # the batch uses machinery outside its scope
+        from ..ops import pallas_scan
+
+        self._pallas_plan = pallas_scan.build_plan(
+            self.cluster_enc, self.batch, self.dyn, self.features,
+            weights=self.features.weights,
+        )
 
     # -- masks -------------------------------------------------------------
 
@@ -233,9 +241,39 @@ class CapacitySweep:
 
         from ..utils.trace import phase
 
+        valid = self.node_valid(count)
+        if self._pallas_plan is not None:
+            from ..ops import pallas_scan
+
+            with phase("sweep/probe"):
+                placements, final = pallas_scan.run_scan_pallas(
+                    self._pallas_plan,
+                    self.batch.class_of_pod,
+                    self.pod_active(valid),
+                    valid,
+                )
+                # same utilization arithmetic as _scenario, on the host
+                v = valid[: self.n]
+                alloc_c = np.asarray(self.cluster_enc.alloc_mcpu)
+                alloc_m = np.asarray(self.cluster_enc.alloc_mem)
+                denom_c = max(int(alloc_c[v].sum()), 1)
+                denom_m = max(int(alloc_m[v].sum()), 1)
+                cpu_util = 100.0 * float(final["used_mcpu"][v].sum()) / denom_c
+                mem_util = 100.0 * float(final["used_mem"][v].sum()) / denom_m
+                vg_cap = np.asarray(self.cluster_enc.vg_cap)
+                vg_used = np.asarray(self.dyn.vg_used)
+                denom_vg = max(int(vg_cap[v].sum()), 1)
+                vg_util = 100.0 * float(vg_used[v].sum()) / denom_vg
+            return ProbeResult(
+                count=count,
+                unscheduled=int((placements == -1).sum()),
+                cpu_util=cpu_util,
+                mem_util=mem_util,
+                vg_util=vg_util,
+                placements=placements,
+            )
         if self._probe_jit is None:
             self._probe_jit = jax.jit(self._scenario)
-        valid = self.node_valid(count)
         with phase("sweep/probe"):
             placements, unsched, cpu, mem, vg = self._probe_jit(
                 jnp.asarray(valid), jnp.asarray(self.pod_active(valid))

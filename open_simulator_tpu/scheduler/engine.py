@@ -68,11 +68,28 @@ class TpuEngine:
             cluster = self.cluster_static()
             batch = encode_batch(oracle, cluster, pods)
             dyn = encode_dynamic(oracle, cluster)
-            static = to_scan_static(cluster, batch)
-            init = to_scan_state(dyn, batch)
             features = features_of_batch(
                 cluster, batch, weights=getattr(oracle, "score_weights", None)
             )
+            from ..ops import pallas_scan
+
+            plan = pallas_scan.build_plan(
+                cluster, batch, dyn, features, weights=features.weights
+            )
+            if plan is None:
+                static = to_scan_static(cluster, batch)
+                init = to_scan_state(dyn, batch)
+        if plan is not None:
+            # fused single-kernel fast path; bit-identical placements
+            # (tests/test_pallas_scan.py)
+            with profiled("engine/scan"):
+                out, _final = pallas_scan.run_scan_pallas(
+                    plan,
+                    batch.class_of_pod,
+                    np.ones(len(pods), bool),
+                    np.ones(cluster.n, bool),
+                )
+            return out
         with profiled("engine/scan"):
             placements, _ = scan_ops.run_scan(
                 static,

@@ -1,0 +1,155 @@
+"""Conformance: the fused Pallas scan (ops/pallas_scan.py) must place
+pods identically to the XLA lax.scan engine (ops/scan.py), which is
+itself conformance-tested against the serial oracle. Runs in Pallas
+interpret mode on the CPU test mesh."""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.models.workloads import reset_name_counter
+from open_simulator_tpu.ops import pallas_scan, scan as scan_ops
+from open_simulator_tpu.ops.encode import (
+    encode_batch,
+    encode_cluster,
+    encode_dynamic,
+    features_of_batch,
+    to_scan_static,
+    to_scan_state,
+)
+from open_simulator_tpu.scheduler.oracle import Oracle
+from open_simulator_tpu.testing import (
+    make_fake_node,
+    make_fake_pod,
+    with_node_labels,
+    with_node_selector,
+    with_node_taints,
+    with_tolerations,
+)
+
+
+def _run_both(nodes, pods, node_valid=None, pod_active=None):
+    import jax.numpy as jnp
+
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    features = features_of_batch(cluster, batch)
+
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features)
+    assert plan is not None, "scenario unexpectedly outside the fast path"
+
+    n = len(nodes)
+    p = len(pods)
+    nv = np.ones(n, bool) if node_valid is None else node_valid
+    pa = np.ones(p, bool) if pod_active is None else pod_active
+
+    xla_placements, _ = scan_ops.run_scan_masked(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        jnp.asarray(nv),
+        jnp.asarray(pa),
+        features=features,
+    )
+    pl_placements, final = pallas_scan.run_scan_pallas(
+        plan, batch.class_of_pod, pa, nv
+    )
+    return np.asarray(xla_placements), pl_placements, final
+
+
+def _nodes(k=8, seed=0):
+    rng = np.random.RandomState(seed)
+    nodes = []
+    for i in range(k):
+        cpu = int(rng.choice([4, 8, 16, 32]))
+        opts = [with_node_labels({"zone": f"z{i % 3}"})]
+        if i % 3 == 0:
+            opts.append(
+                with_node_taints(
+                    [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+                )
+            )
+        nodes.append(make_fake_node(f"node-{i}", f"{cpu}", f"{cpu * 4}Gi", *opts))
+    return nodes
+
+
+def _pods(count=40, seed=1):
+    rng = np.random.RandomState(seed)
+    pods = []
+    for i in range(count):
+        cpu = ["250m", "500m", "1", "2"][rng.randint(4)]
+        mem = ["256Mi", "512Mi", "1Gi", "2Gi"][rng.randint(4)]
+        opts = []
+        if rng.rand() < 0.3:
+            opts.append(with_node_selector({"zone": f"z{rng.randint(3)}"}))
+        if rng.rand() < 0.3:
+            opts.append(
+                with_tolerations([{"key": "dedicated", "operator": "Exists"}])
+            )
+        pods.append(make_fake_pod(f"p-{i}", "default", cpu, mem, *opts))
+    return pods
+
+
+def test_matches_xla_basic():
+    reset_name_counter()
+    xla, pal, _ = _run_both(_nodes(), _pods())
+    np.testing.assert_array_equal(xla, pal)
+
+
+@pytest.mark.parametrize("seed", [2, 3, 4, 5, 6])
+def test_matches_xla_randomized(seed):
+    reset_name_counter()
+    xla, pal, _ = _run_both(_nodes(seed=seed), _pods(60, seed=seed + 10))
+    np.testing.assert_array_equal(xla, pal)
+
+
+def test_matches_xla_overload():
+    """More pods than fit: -1 placements must agree too."""
+    reset_name_counter()
+    nodes = _nodes(4)
+    pods = _pods(120, seed=7)
+    xla, pal, _ = _run_both(nodes, pods)
+    np.testing.assert_array_equal(xla, pal)
+    assert (pal == -1).any()
+
+
+def test_masked_scenario_inactive_pods():
+    reset_name_counter()
+    nodes = _nodes(8)
+    pods = _pods(50, seed=8)
+    nv = np.ones(8, bool)
+    nv[5:] = False
+    pa = np.ones(50, bool)
+    pa[::7] = False
+    xla, pal, _ = _run_both(nodes, pods, node_valid=nv, pod_active=pa)
+    np.testing.assert_array_equal(xla, pal)
+    assert (pal[::7] == pallas_scan.INACTIVE).all()
+    assert not ((pal >= 5) & (pal >= 0)).any()
+
+
+def test_final_state_matches_placements():
+    reset_name_counter()
+    nodes = _nodes(6, seed=9)
+    pods = _pods(30, seed=9)
+    xla, pal, final = _run_both(nodes, pods)
+    np.testing.assert_array_equal(xla, pal)
+    counts = np.bincount(pal[pal >= 0], minlength=6)
+    np.testing.assert_array_equal(counts, final["pod_cnt"][:6])
+
+
+def test_build_plan_rejects_out_of_scope():
+    """A GPU pod batch must fall back to the XLA path."""
+    reset_name_counter()
+    nodes = [make_fake_node("g-0", "8", "32Gi")]
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    pods = _pods(5, seed=11)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features._replace(gpu=True))
+    assert plan is None

@@ -204,10 +204,13 @@ def _scan_rate(nodes, pods, label: str) -> dict:
     """Compile once, then time one full scan incl. the forced
     device->host transfer (on the axon TPU backend block_until_ready
     can return before execution finishes, which once inflated this
-    number 4 orders of magnitude)."""
+    number 4 orders of magnitude). Uses the same engine fast path
+    production uses: the fused Pallas kernel when the batch is in
+    scope, the XLA scan otherwise."""
     import jax.numpy as jnp
     import numpy as np
 
+    from open_simulator_tpu.ops import pallas_scan
     from open_simulator_tpu.ops import scan as scan_ops
     from open_simulator_tpu.ops.encode import (
         encode_batch,
@@ -223,19 +226,40 @@ def _scan_rate(nodes, pods, label: str) -> dict:
     cluster = encode_cluster(oracle)
     batch = encode_batch(oracle, cluster, pods)
     dyn = encode_dynamic(oracle, cluster)
-    static = to_scan_static(cluster, batch)
-    init = to_scan_state(dyn, batch)
     features = features_of_batch(cluster, batch)
-    class_arr = jnp.asarray(batch.class_of_pod)
-    pinned_arr = jnp.asarray(batch.pinned_node)
 
-    placements, _ = scan_ops.run_scan(static, init, class_arr, pinned_arr, features=features)
-    np.asarray(placements)  # compile + warm
+    plan = (
+        pallas_scan.build_plan(cluster, batch, dyn, features)
+        if pallas_scan.should_use()
+        else None
+    )
+    if plan is not None:
+        ones_p = np.ones(len(pods), bool)
+        ones_n = np.ones(cluster.n, bool)
+        pallas_scan.run_scan_pallas(plan, batch.class_of_pod, ones_p, ones_n)
+        t0 = time.perf_counter()
+        placements_np, _ = pallas_scan.run_scan_pallas(
+            plan, batch.class_of_pod, ones_p, ones_n
+        )
+        elapsed = time.perf_counter() - t0
+        label += "/pallas"
+    else:
+        static = to_scan_static(cluster, batch)
+        init = to_scan_state(dyn, batch)
+        class_arr = jnp.asarray(batch.class_of_pod)
+        pinned_arr = jnp.asarray(batch.pinned_node)
 
-    t0 = time.perf_counter()
-    placements, _ = scan_ops.run_scan(static, init, class_arr, pinned_arr, features=features)
-    placements_np = np.asarray(placements)
-    elapsed = time.perf_counter() - t0
+        placements, _ = scan_ops.run_scan(
+            static, init, class_arr, pinned_arr, features=features
+        )
+        np.asarray(placements)  # compile + warm
+
+        t0 = time.perf_counter()
+        placements, _ = scan_ops.run_scan(
+            static, init, class_arr, pinned_arr, features=features
+        )
+        placements_np = np.asarray(placements)
+        elapsed = time.perf_counter() - t0
 
     return {
         "label": label,

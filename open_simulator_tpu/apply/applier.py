@@ -172,17 +172,40 @@ def replay_scenario(sweep, count: int, placements):
     failures get them; the rest carry a summary reason. A 100k-pod probe
     with thousands of failures must not take hours to explain itself —
     the caller that needs every reason runs the serial engine."""
+    import numpy as np
+
     from ..scheduler.core import NodeStatus, SimulateResult, UnscheduledPod
     from ..scheduler.oracle import Oracle
 
     nodes = [ns.node for ns in sweep.oracle.nodes[: sweep.n_base + count]]
     oracle = Oracle(nodes)
+    # classes with no GPU/storage side effects take a minimal bind
+    # (nodeName + phase + NodeInfo accounting) — the general
+    # _reserve_and_bind re-checks GPU/storage/extenders per pod, which
+    # is most of the replay wall-clock at 100k pods
+    batch = sweep.batch
+    simple_class = (
+        (np.asarray(batch.gpu_mem) <= 0) & ~np.asarray(batch.wants_storage)
+        if not sweep.oracle.extenders
+        else np.zeros(batch.u, bool)
+    )
+    class_of_pod = np.asarray(batch.class_of_pod)
+    had_node_name = sweep.had_node_name
     failed = []
-    for pod, idx in zip(sweep.pods, placements):
+    for p_i, (pod, idx) in enumerate(zip(sweep.pods, placements)):
         idx = int(idx)
         if idx == -2:  # inactive in this scenario (disabled-node ds pod)
             continue
-        name = (pod.get("spec") or {}).get("nodeName")
+        # original pins only: a previous replay may have written
+        # nodeName/phase into this shared pod dict — clear those so
+        # failure reasons (_find_feasible's NodeName filter) and the
+        # reported pod see the pre-bind state
+        if not had_node_name[p_i]:
+            (pod.get("spec") or {}).pop("nodeName", None)
+            (pod.get("status") or {}).pop("phase", None)
+            name = None
+        else:
+            name = (pod.get("spec") or {}).get("nodeName")
         if name:
             if name in oracle.node_index:
                 oracle.place_existing_pod(pod)
@@ -200,6 +223,11 @@ def replay_scenario(sweep, count: int, placements):
                     f"0/{len(nodes)} nodes are available"
                 )
             failed.append(UnscheduledPod(pod=pod, reason=reason))
+        elif simple_class[class_of_pod[p_i]]:
+            ns = oracle.nodes[idx]
+            pod["spec"]["nodeName"] = ns.name
+            pod.setdefault("status", {})["phase"] = "Running"
+            oracle._commit(pod, ns)
         else:
             oracle._reserve_and_bind(pod, oracle.nodes[idx])
     status = [NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes]

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from fractions import Fraction
 
+from ..utils.memo import IdentityMemo
 from ..utils.quantity import parse_quantity
 
 CPU = "cpu"
@@ -166,30 +167,23 @@ class RequestSummary:
         self.nz_mem = pod_nonzero_request(pod, MEMORY)
 
 
-# identity-keyed memo: replica clones of one workload template share
-# their containers/initContainers/overhead objects (workloads.py
-# _expand_template), so one computation serves the whole workload. The
-# cached entry holds strong refs to the key objects, so their ids
-# cannot be reused while the entry lives; specs are read-only after
-# expansion (the sharing contract in _expand_template).
-_SUMMARY_CACHE: dict = {}
-_SUMMARY_CACHE_MAX = 8192
+# replica clones of one workload template share their containers /
+# initContainers / overhead objects (workloads.py _expand_template), so
+# one summary serves the whole workload (see utils/memo.py contract)
+_SUMMARY_MEMO = IdentityMemo()
 
 
 def pod_request_summary(pod: dict) -> RequestSummary:
     spec = pod.get("spec") or {}
-    c = spec.get("containers")
-    ic = spec.get("initContainers")
-    ov = spec.get("overhead")
-    key = (id(c), id(ic), id(ov))
-    hit = _SUMMARY_CACHE.get(key)
-    if hit is not None and hit[0] is c and hit[1] is ic and hit[2] is ov:
-        return hit[3]
-    summary = RequestSummary(pod)
-    if len(_SUMMARY_CACHE) >= _SUMMARY_CACHE_MAX:
-        _SUMMARY_CACHE.clear()
-    _SUMMARY_CACHE[key] = (c, ic, ov, summary)
-    return summary
+    sources = (spec.get("containers"), spec.get("initContainers"), spec.get("overhead"))
+    return _SUMMARY_MEMO.get(sources, lambda: RequestSummary(pod))
+
+
+# report tables and replay re-read allocatables once per pod row, which
+# is 100k+ quantity parses at bench scale; allocatable dicts are not
+# mutated after load (the GPU plugin adjusts NodeState.alloc, not the
+# raw node object)
+_ALLOC_MEMO = IdentityMemo()
 
 
 def node_allocatable(node: dict) -> dict:
@@ -197,8 +191,14 @@ def node_allocatable(node: dict) -> dict:
     status = node.get("status") or {}
     alloc = status.get("allocatable")
     if alloc is None:
-        alloc = status.get("capacity") or {}
-    return {name: parse_quantity(q) for name, q in alloc.items()}
+        alloc = status.get("capacity")
+    if not alloc:
+        # don't memoize a throwaway `{}` key — its fresh id would miss
+        # every time and churn the cache
+        return {}
+    return _ALLOC_MEMO.get(
+        (alloc,), lambda: {name: parse_quantity(q) for name, q in alloc.items()}
+    )
 
 
 def node_alloc_milli_cpu(node: dict) -> int:

@@ -270,11 +270,6 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool):
         rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
         idx_mat = rows * LANES + cols
-        pshape = place_ref.shape
-        pod_idx = (
-            jax.lax.broadcasted_iota(jnp.int32, pshape, 0) * LANES
-            + jax.lax.broadcasted_iota(jnp.int32, pshape, 1)
-        )
         lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
 
         valid = valid_ref[:] != 0
@@ -393,9 +388,10 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool):
             place = jnp.where(
                 active != 0, jnp.where(found, best, -1), INACTIVE
             )
-            # dynamic lane-dim stores are unsupported on TPU: write via
-            # a pod-position mask over the whole packed block
-            place_ref[:] = jnp.where(pod_idx == p, place, place_ref[:])
+            # dynamic lane-dim stores are unsupported on TPU: rewrite
+            # only the pod's 128-lane row, lane-selected via the mask
+            prow = place_ref[pl.ds(pr, 1), :]
+            place_ref[pl.ds(pr, 1), :] = jnp.where(lane, place, prow)
 
             do = found & (active != 0)
             sel = (idx_mat == best) & do
@@ -417,6 +413,20 @@ class _Compiled(NamedTuple):
 
 
 _COMPILED_CACHE: dict = {}
+
+# None = auto (use the kernel only on a real TPU backend — the Pallas
+# interpreter would crawl at bench scale on CPU); tests set True to
+# exercise the integration paths under interpret mode
+FORCE_ENABLE: Optional[bool] = None
+
+
+def should_use() -> bool:
+    """Whether eligible callers should run the fused kernel."""
+    if FORCE_ENABLE is not None:
+        return FORCE_ENABLE
+    import jax
+
+    return jax.default_backend() == "tpu"
 
 
 def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,

@@ -171,6 +171,13 @@ class CapacitySweep:
                 self.cluster_enc, self.batch, weights=score_weights
             )
 
+        # which pods arrived with spec.nodeName, recorded BEFORE any
+        # replay binds pods (replay_scenario writes nodeName into these
+        # shared pod dicts; a later replay must not mistake a previous
+        # replay's binding for an original pin)
+        self.had_node_name = np.array(
+            [bool((p.get("spec") or {}).get("nodeName")) for p in pods], dtype=bool
+        )
         # daemonset pods of disabled candidate nodes are inactive in
         # that scenario (the reference regenerates them per run)
         self._ds_target = np.full(len(pods), -1, dtype=np.int64)
@@ -181,12 +188,17 @@ class CapacitySweep:
                 self._ds_target[p_i] = name_to_idx[target]
         self._probe_jit = None
         # fused single-kernel fast path (ops/pallas_scan.py); None when
-        # the batch uses machinery outside its scope
+        # the batch uses machinery outside its scope or the backend is
+        # not a real TPU (the interpreter would crawl at bench scale)
         from ..ops import pallas_scan
 
-        self._pallas_plan = pallas_scan.build_plan(
-            self.cluster_enc, self.batch, self.dyn, self.features,
-            weights=self.features.weights,
+        self._pallas_plan = (
+            pallas_scan.build_plan(
+                self.cluster_enc, self.batch, self.dyn, self.features,
+                weights=self.features.weights,
+            )
+            if pallas_scan.should_use()
+            else None
         )
 
     # -- masks -------------------------------------------------------------

@@ -73,8 +73,12 @@ class TpuEngine:
             )
             from ..ops import pallas_scan
 
-            plan = pallas_scan.build_plan(
-                cluster, batch, dyn, features, weights=features.weights
+            plan = (
+                pallas_scan.build_plan(
+                    cluster, batch, dyn, features, weights=features.weights
+                )
+                if pallas_scan.should_use()
+                else None
             )
             if plan is None:
                 static = to_scan_static(cluster, batch)

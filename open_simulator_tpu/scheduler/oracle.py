@@ -42,6 +42,7 @@ from ..models import labels as lbl
 from ..models import requests as req
 from ..models import storage as stor
 from ..models.workloads import DEFAULT_SCHEDULER_NAME
+from ..utils.memo import IdentityMemo
 
 MAX_NODE_SCORE = 100
 MIN_NODE_SCORE = 0
@@ -149,9 +150,23 @@ class NodeState:
         return v.numerator // v.denominator
 
 
+# replica clones share their containers list, so the port scan runs
+# once per template instead of once per pod on the commit path
+# (utils/memo.py contract); hostNetwork rides in the source tuple via
+# its interned bool singleton
+_PORTS_MEMO = IdentityMemo()
+
+
 def _pod_host_ports(pod: dict) -> List[Tuple[str, str, int]]:
     spec = pod.get("spec") or {}
     host_net = bool(spec.get("hostNetwork"))
+    return _PORTS_MEMO.get(
+        (spec.get("containers"), host_net),
+        lambda: _scan_host_ports(spec, host_net),
+    )
+
+
+def _scan_host_ports(spec: dict, host_net: bool) -> List[Tuple[str, str, int]]:
     out = []
     for c in spec.get("containers") or []:
         for p in c.get("ports") or []:
@@ -261,13 +276,24 @@ class Oracle:
     # -- cluster mutation ---------------------------------------------------
 
     def add_node(self, node: dict):
-        import copy as _copy
-
-        # deep-copy: binding writes annotations (storage, gpu) into the
-        # node; the caller's ResourceTypes must stay reusable across runs
-        node = _copy.deepcopy(node)
+        # binding mutates ONLY node metadata annotations (storage VG
+        # state via set_node_storage; gpu goes through ns.alloc) and
+        # labels are report-read — clone exactly those layers and share
+        # spec/status read-only. A full deepcopy of 10k nodes cost ~1 s
+        # per Oracle at bench scale for the same isolation.
+        meta = node.get("metadata") or {}
+        node = {
+            **node,
+            "metadata": {
+                **meta,
+                "labels": dict(meta.get("labels") or {}),
+                "annotations": dict(meta.get("annotations") or {}),
+            },
+        }
         ns = NodeState(node=node, index=len(self.nodes))
-        ns.alloc = req.node_allocatable(node)
+        # copy: GPU accounting writes ns.alloc[gpu-count], and
+        # node_allocatable's result is a shared identity-keyed memo
+        ns.alloc = dict(req.node_allocatable(node))
         gpu_count = stor.node_gpu_count(node)
         if gpu_count > 0:
             ns.gpu = GpuState(count=gpu_count, per_device_mem=stor.node_gpu_per_device_memory(node))

@@ -1,0 +1,43 @@
+"""Identity-keyed memoization for read-only shared sub-objects.
+
+Replica clones of one workload template share their containers /
+tolerations / affinity / allocatable objects (workloads.py
+`_expand_template`), so expensive derivations (quantity parsing, deep
+freezes, port scans) can run once per template instead of once per pod.
+
+Contract: keys are `id()` tuples of the source objects; each cache
+entry holds STRONG references to those objects, so their ids cannot be
+reused while the entry lives, and a hit re-checks identity before
+trusting the key. Sources must be read-only after first use (the
+sharing contract established in `_expand_template`). The cache clears
+wholesale when full — entries are cheap to recompute and the working
+set per run is far below the cap.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+_DEFAULT_MAX = 8192
+
+
+class IdentityMemo:
+    """Memoize ``compute(*sources)`` keyed by the identity of sources."""
+
+    def __init__(self, max_entries: int = _DEFAULT_MAX):
+        self._cache: dict = {}
+        self._max = max_entries
+
+    def get(self, sources: Tuple, compute: Callable):
+        key = tuple(id(s) for s in sources)
+        hit = self._cache.get(key)
+        if hit is not None and all(a is b for a, b in zip(hit[0], sources)):
+            return hit[1]
+        value = compute()
+        if len(self._cache) >= self._max:
+            self._cache.clear()
+        self._cache[key] = (sources, value)
+        return value
+
+    def clear(self):
+        self._cache.clear()

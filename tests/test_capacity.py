@@ -160,6 +160,36 @@ def test_find_min_count_bisects_past_loose_bound():
     assert probes[0] == 8 and len(probes) <= 6
 
 
+def test_replay_after_replay_reports_clean_failures():
+    """A second replay at a lower count must not inherit the nodeName/
+    phase bindings the first replay wrote into the shared pod dicts:
+    failures must carry the real resource reason, not a NodeName-filter
+    mismatch against a stale binding (interactive mode replays many
+    counts over one sweep)."""
+    from open_simulator_tpu.apply.applier import replay_scenario
+    from open_simulator_tpu.parallel.sweep import CapacitySweep
+
+    cluster = ResourceTypes()
+    cluster.nodes = [_node("base-0")]
+    resources = ResourceTypes()
+    resources.deployments = [_deploy("web", 6)]
+    apps = [AppResource("cap", resources)]
+    sweep = CapacitySweep(cluster, apps, _node("template"), max_count=4)
+
+    ok = sweep.probe(2)
+    result_hi, _ = replay_scenario(sweep, 2, ok.placements)
+    assert not result_hi.unscheduled_pods
+
+    bad = sweep.probe(0)
+    result_lo, _ = replay_scenario(sweep, 0, bad.placements)
+    assert result_lo.unscheduled_pods
+    for up in result_lo.unscheduled_pods:
+        assert "didn't match the requested hostname" not in up.reason
+        assert "Insufficient" in up.reason or "nodes are available" in up.reason
+        assert not (up.pod.get("spec") or {}).get("nodeName")
+        assert (up.pod.get("status") or {}).get("phase") != "Running"
+
+
 def test_applier_probe_plan_matches_serial(tmp_path):
     """The probe fast path must produce the same count and placements
     as the serial escalation loop."""

@@ -153,3 +153,65 @@ def test_build_plan_rejects_out_of_scope():
     features = features_of_batch(cluster, batch)
     plan = pallas_scan.build_plan(cluster, batch, dyn, features._replace(gpu=True))
     assert plan is None
+
+
+def test_engine_and_sweep_integration_forced(monkeypatch):
+    """CPU backends skip the kernel by default (should_use); force it
+    so CI exercises the engine + capacity-sweep integration paths."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.ops import pallas_scan as ps
+    from open_simulator_tpu.parallel.sweep import CapacitySweep
+    from open_simulator_tpu.scheduler.core import AppResource, simulate
+    from open_simulator_tpu.testing import make_fake_deployment
+
+    monkeypatch.setattr(ps, "FORCE_ENABLE", True)
+    reset_name_counter()
+    cluster = ResourceTypes()
+    cluster.nodes = _nodes(6, seed=12)
+    res = ResourceTypes()
+    res.deployments = [make_fake_deployment("web", "default", 10, "500m", "512Mi")]
+    apps = [AppResource("app", res)]
+
+    reset_name_counter()
+    tpu_res = simulate(cluster, apps, engine="tpu")
+    reset_name_counter()
+    oracle_res = simulate(cluster, apps, engine="oracle")
+
+    def placements(sim_result):
+        out = {}
+        for ns in sim_result.node_status:
+            for pod in ns.pods:
+                out[pod["metadata"]["name"]] = ns.node["metadata"]["name"]
+        return out
+
+    assert placements(tpu_res) == placements(oracle_res)
+
+    reset_name_counter()
+    sweep = CapacitySweep(cluster, apps, _nodes(1, seed=13)[0], 4)
+    assert sweep._pallas_plan is not None
+    r = sweep.probe(0)
+    assert r.unscheduled == 0
+
+
+def test_sweep_skips_kernel_off_tpu(monkeypatch):
+    """With FORCE_ENABLE unset, a CPU backend must not build a plan."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        import pytest
+
+        pytest.skip("auto mode legitimately builds the plan on a real TPU")
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.ops import pallas_scan as ps
+    from open_simulator_tpu.parallel.sweep import CapacitySweep
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.testing import make_fake_deployment
+
+    monkeypatch.setattr(ps, "FORCE_ENABLE", None)
+    reset_name_counter()
+    cluster = ResourceTypes()
+    cluster.nodes = _nodes(4, seed=14)
+    res = ResourceTypes()
+    res.deployments = [make_fake_deployment("web", "default", 4)]
+    sweep = CapacitySweep(cluster, [AppResource("a", res)], None, 0)
+    assert sweep._pallas_plan is None

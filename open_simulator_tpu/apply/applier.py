@@ -249,6 +249,30 @@ def probe_plan(
     into host state for the report — no second full simulation
     (replaces the reference's per-guess re-simulation loop,
     pkg/apply/apply.go:186-239)."""
+    import gc
+
+    # the plan allocates millions of short-lived dicts (pod expansion,
+    # replay, report rows) but frees almost nothing mid-run — cyclic-GC
+    # passes are pure overhead and wall-clock jitter at 100k pods.
+    # Pause collection for the duration; one collect at the end.
+    gc_was_enabled = gc.isenabled()
+    if gc_was_enabled:
+        gc.disable()
+    try:
+        return _probe_plan_inner(
+            cluster, apps, new_node, use_greed, extended_resources,
+            max_count, score_weights,
+        )
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+            gc.collect()
+
+
+def _probe_plan_inner(
+    cluster, apps, new_node, use_greed, extended_resources,
+    max_count, score_weights,
+):
     from ..parallel.sweep import CapacitySweep
     from ..utils.trace import phase
 

@@ -103,12 +103,14 @@ def _node_table(node_statuses, extended_resources) -> str:
         alloc_mem = req.node_alloc_int(node, req.MEMORY)
         used_mcpu = used_mem = 0
         gpu_req = 0
+        summary = req.pod_request_summary
         for pod in status.pods:
-            mcpu, mem = _pod_req_summary(pod)
-            used_mcpu += mcpu
-            used_mem += mem
-            g_mem, g_cnt = stor.pod_gpu_request(pod)
-            gpu_req += g_mem * g_cnt
+            s = summary(pod)
+            used_mcpu += s.floor_mcpu
+            used_mem += s.floor_mem
+            if gpu:  # column only rendered for the gpu table
+                g_mem, g_cnt = stor.pod_gpu_request(pod)
+                gpu_req += g_mem * g_cnt
         labels = (node.get("metadata") or {}).get("labels") or {}
         row = [
             (node.get("metadata") or {}).get("name", ""),
@@ -215,32 +217,32 @@ def _pod_table(node_statuses, extended_resources) -> str:
     headers.append("APP Name")
     rows = []
     # identical (request, allocatable) pairs repeat across thousands of
-    # pods at scale — format each combination once
-    cpu_cell: dict = {}
-    mem_cell: dict = {}
+    # pods at scale — format each value combination once (value-keyed,
+    # so snapshot-loaded pods with per-pod summary objects still hit)
+    cell_pair: dict = {}
+    summary = req.pod_request_summary
+    append = rows.append
     for status in node_statuses:
         node = status.node
         node_name = (node.get("metadata") or {}).get("name", "")
         alloc_mcpu = req.node_alloc_milli_cpu(node)
         alloc_mem = req.node_alloc_int(node, req.MEMORY)
         for pod in status.pods:
-            mcpu, mem = _pod_req_summary(pod)
-            ck = (mcpu, alloc_mcpu)
-            cell_c = cpu_cell.get(ck)
-            if cell_c is None:
-                cell_c = cpu_cell[ck] = f"{_fmt_cpu(mcpu)}({_pct(mcpu, alloc_mcpu)}%)"
-            mk = (mem, alloc_mem)
-            cell_m = mem_cell.get(mk)
-            if cell_m is None:
-                cell_m = mem_cell[mk] = (
-                    f"{format_quantity_bin(mem)}({_pct(mem, alloc_mem)}%)"
+            s = summary(pod)
+            mcpu, mem = s.floor_mcpu, s.floor_mem
+            ck = (mcpu, mem, alloc_mcpu, alloc_mem)
+            cells = cell_pair.get(ck)
+            if cells is None:
+                cells = cell_pair[ck] = (
+                    f"{_fmt_cpu(mcpu)}({_pct(mcpu, alloc_mcpu)}%)",
+                    f"{format_quantity_bin(mem)}({_pct(mem, alloc_mem)}%)",
                 )
             meta = pod.get("metadata") or {}
             row = [
                 node_name,
                 f"{meta.get('namespace', 'default')}/{meta.get('name', '')}",
-                cell_c,
-                cell_m,
+                cells[0],
+                cells[1],
             ]
             if local:
                 lvm, dev = stor.parse_pod_local_volumes(pod)
@@ -251,5 +253,5 @@ def _pod_table(node_statuses, extended_resources) -> str:
                 idx = (meta.get("annotations") or {}).get(stor.GPU_INDEX_ANNO, "")
                 row.append(f"{format_quantity_bin(g_mem)}x{g_cnt}@{idx}" if g_mem else "")
             row.append((meta.get("labels") or {}).get(wl.LABEL_APP_NAME, ""))
-            rows.append(row)
+            append(row)
     return render_table(headers, rows)

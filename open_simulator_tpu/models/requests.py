@@ -182,8 +182,10 @@ def pod_request_summary(pod: dict) -> RequestSummary:
 # report tables and replay re-read allocatables once per pod row, which
 # is 100k+ quantity parses at bench scale; allocatable dicts are not
 # mutated after load (the GPU plugin adjusts NodeState.alloc, not the
-# raw node object)
-_ALLOC_MEMO = IdentityMemo()
+# raw node object). Sized above the node axis: one entry per NODE lives
+# here (unlike the per-template memos), and a cap below the node count
+# would wholesale-clear mid-run, re-parsing every allocatable each pass.
+_ALLOC_MEMO = IdentityMemo(max_entries=1 << 17)
 
 
 def node_allocatable(node: dict) -> dict:

@@ -155,6 +155,12 @@ class CapacitySweep:
                     "scan has no priority/preemption semantics — use the "
                     "serial engine (scheduler/core.py falls back automatically)"
                 )
+            if self.oracle.registry.has_permit:
+                raise PrioritySignalError(
+                    "a registered plugin defines permit(); a post-hoc reject "
+                    "would invalidate later batched placements — use the "
+                    "serial engine (scheduler/core.py falls back automatically)"
+                )
         self.pods = pods
         self.n = len(padded.nodes)
         self.n_base = self.n - self.max_count

@@ -157,6 +157,9 @@ class Simulator:
             self.engine_kind == "tpu"
             and not self.oracle.saw_priority
             and not any(pod_uses_priority(p, self.oracle._prio_resolver) for p in pods)
+            # a permit reject on the selected node would invalidate
+            # every later placement the batched scan committed
+            and not self.oracle.registry.has_permit
         )
         if use_tpu:
             failed = self._schedule_pods_tpu(pods)

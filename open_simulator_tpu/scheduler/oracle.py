@@ -362,26 +362,38 @@ class Oracle:
         try:
             # the binder extender runs before any local mutation, so a
             # failure here leaves no partial commit
-            best = self._select_and_bind(pod, feasible)
+            best, rejecter = self._select_and_bind(pod, feasible)
         except ExtenderError as e:
             return None, (
                 f"failed to bind pod ({meta.get('namespace', 'default')}/"
                 f"{meta.get('name', '')}): {e}"
             )
+        if rejecter is not None:
+            # Permit reject fails the cycle outright (scheduler.go:
+            # 536-553) — no retry on other nodes
+            return None, (
+                f"failed to schedule pod ({meta.get('namespace', 'default')}/"
+                f"{meta.get('name', '')}): rejected by permit plugin "
+                f'"{rejecter}"'
+            )
         return best.name, ""
 
-    def _select_and_bind(self, pod: dict, feasible: List[NodeState]) -> NodeState:
+    def _select_and_bind(self, pod: dict, feasible: List[NodeState]):
         """prioritizeNodes + selectHost (first-max tie rule, see module
-        docstring) + the reserve/bind sequence. Returns the chosen
-        node; may raise ExtenderError from a binder extender."""
+        docstring) + Permit + the reserve/bind sequence. Returns
+        (node, None) on success or (None, plugin_name) on a permit
+        reject; may raise ExtenderError from a binder extender."""
         scores = self._prioritize(pod, feasible)
         best = feasible[0]
         best_score = scores[0]
         for ns, sc in zip(feasible[1:], scores[1:]):
             if sc > best_score:
                 best, best_score = ns, sc
+        for plugin in self.registry.plugins:
+            if not plugin.permit(pod, best.node):
+                return None, plugin.name
         self._reserve_and_bind(pod, best)
-        return best
+        return best, None
 
     def _post_filter_preempt(self, pod: dict, codes: Dict[int, str]) -> Optional[str]:
         """DefaultPreemption PostFilter (registered by
@@ -424,8 +436,10 @@ class Oracle:
             feasible, _, _ = self._find_feasible(pod)
             if not feasible:
                 return None
-            best = self._select_and_bind(pod, feasible)
+            best, rejecter = self._select_and_bind(pod, feasible)
         except ExtenderError:
+            return None
+        if rejecter is not None:
             return None
         return best.name
 

@@ -50,6 +50,22 @@ class SchedulerPlugin:
     def score(self, pod: dict, node: dict) -> int:  # pragma: no cover - interface
         return 0
 
+    def permit(self, pod: dict, node: dict) -> bool:  # pragma: no cover - interface
+        """Permit extension point (framework interface.go:470-489,
+        RunPermitPlugins at scheduler.go:536-553): a last allow/reject
+        gate on the SELECTED node. Rejecting fails the pod's cycle
+        outright — unlike `filter`, the scheduler does not retry other
+        nodes. The reference runs Permit after Reserve and unreserves
+        on reject; the oracle runs it just before its combined
+        reserve+bind step, which leaves identical net state (plugins
+        here see only the raw pod/node dicts, never reserved state).
+        `wait` verdicts are meaningless in a simulator (there is no
+        clock) and are not modeled. A batch with a permit-defining
+        plugin routes to the serial engine: a post-hoc reject would
+        invalidate every later placement the batched scan made against
+        the committed state."""
+        return True
+
 
 class PluginRegistry:
     def __init__(self):
@@ -71,6 +87,15 @@ class PluginRegistry:
     @property
     def plugins(self) -> List[SchedulerPlugin]:
         return list(self._plugins.values())
+
+    @property
+    def has_permit(self) -> bool:
+        """Whether any registered plugin overrides `permit` (forces the
+        serial engine — see SchedulerPlugin.permit)."""
+        return any(
+            type(p).permit is not SchedulerPlugin.permit
+            for p in self._plugins.values()
+        )
 
 
 # process-global out-of-tree registry (WithFrameworkOutOfTreeRegistry

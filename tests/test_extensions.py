@@ -77,6 +77,56 @@ def test_custom_score_plugin_conformance():
     assert "n3" in set(placements(ro).values())
 
 
+class RejectOnHighIndex(SchedulerPlugin):
+    """Permit plugin: rejects any pod SELECTED onto n3 — unlike a
+    filter, the pod must fail outright rather than try other nodes
+    (RunPermitPlugins semantics, scheduler.go:536-553)."""
+
+    name = "No-N3"
+    weight = 100000
+    normalize = "default"
+
+    def score(self, pod, node):
+        # steer selection onto n3 so permit actually fires
+        return 100 if node["metadata"]["name"] == "n3" else 0
+
+    def permit(self, pod, node):
+        return node["metadata"]["name"] != "n3"
+
+
+def test_permit_reject_fails_pod_without_retry():
+    default_registry.register(RejectOnHighIndex())
+    # both engines: the tpu engine must auto-fall back to serial
+    for engine in ("oracle", "tpu"):
+        res = simulate(_cluster(), [_app(replicas=3)], engine=engine)
+        # every pod selects n3 (dominant score) and is rejected there
+        assert len(res.unscheduled_pods) == 3, engine
+        for up in res.unscheduled_pods:
+            assert 'rejected by permit plugin "No-N3"' in up.reason, engine
+        for ns in res.node_status:
+            assert not ns.pods, engine
+
+
+def test_permit_allow_is_transparent():
+    class AllowAll(SchedulerPlugin):
+        name = "Allow-All"
+
+        def permit(self, pod, node):
+            return True
+
+    default_registry.register(AllowAll())
+    res = simulate(_cluster(), [_app()], engine="tpu")
+    assert not res.unscheduled_pods
+    # permit-defining plugins force the serial engine inside the sweep
+    from open_simulator_tpu.parallel.sweep import (
+        CapacitySweep,
+        PrioritySignalError,
+    )
+
+    with pytest.raises(PrioritySignalError, match="permit"):
+        CapacitySweep(_cluster(), [_app()], tb.make_fake_node("t", "8", "16Gi"), 2)
+
+
 def test_greed_sort_orders_big_pods_first():
     from open_simulator_tpu.scheduler.queues import greed_sort
 

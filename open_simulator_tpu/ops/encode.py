@@ -759,7 +759,6 @@ def to_scan_static(cluster: ClusterStatic, batch: PodBatch):
         carry_aff_pref_w=jnp.asarray(batch.terms.carry_aff_pref_w),
         carry_anti_pref_w=jnp.asarray(batch.terms.carry_anti_pref_w),
         cls_rows=jnp.asarray(batch.terms.cls_rows),
-        group_rows=jnp.asarray(batch.terms.group_rows),
         group_of_row=jnp.asarray(batch.terms.group_of_row),
         match_all=jnp.asarray(batch.terms.match_all),
         cls_group_rows=jnp.asarray(batch.terms.cls_group_rows),
@@ -775,10 +774,46 @@ def to_scan_static(cluster: ClusterStatic, batch: PodBatch):
         s_q=jnp.asarray(batch.terms.s_q),
         cls_s_rows=jnp.asarray(batch.terms.cls_s_rows),
         cls_s_haskeys=jnp.asarray(batch.terms.cls_s_haskeys),
+        g_topo_val=jnp.asarray(batch.terms.topo_val[batch.terms.group_rows]),
+        s_topo_val=jnp.asarray(batch.terms.topo_val[batch.terms.s_row]),
+        s_val_onehot=jnp.asarray(_soft_value_onehot(batch.terms)),
         custom_raw=jnp.asarray(batch.custom_raw),
         custom_mode=jnp.asarray(batch.custom_mode),
         custom_weight=jnp.asarray(batch.custom_weight),
     )
+
+
+def _soft_value_onehot(t) -> np.ndarray:
+    """[Cs, Vs, N] static value one-hot for the soft-spread distinct-
+    domain count (scan.py soft_score). Hostname rows stay all-zero —
+    their domain count is the eligible-node count (s_is_host branch) —
+    so Vs is bounded by the small non-hostname vocab, not N."""
+    s_tv = t.topo_val[t.s_row]  # [Cs, N]
+    if not (t.cls_s_rows >= 0).any():
+        # no real soft constraint: Cs=1 is pure padding whose s_row
+        # points at row 0 — without this gate a hostname row 0 would
+        # blow Vs up to N (an O(N^2) one-hot nobody reads)
+        return np.zeros((s_tv.shape[0], 1, s_tv.shape[1]), dtype=bool)
+    nonhost = ~t.s_is_host
+    vs = 1
+    if nonhost.any():
+        mx = int(s_tv[nonhost].max(initial=-1))
+        vs = max(mx + 1, 1)
+    out = np.zeros((s_tv.shape[0], vs, s_tv.shape[1]), dtype=bool)
+    for c_i in range(s_tv.shape[0]):
+        if not nonhost[c_i]:
+            continue
+        vals = s_tv[c_i]
+        mask = vals >= 0
+        out[c_i, vals[mask], np.nonzero(mask)[0]] = True
+    return out
+
+
+def _value_to_node_space(init_v: np.ndarray, topo: np.ndarray) -> np.ndarray:
+    """[R, V] value-space counts -> [R, N] node-space (count at each
+    node's own value; 0 where the key is missing)."""
+    g = np.take_along_axis(init_v, np.maximum(topo, 0).astype(np.int64), axis=1)
+    return np.where(topo >= 0, g, 0)
 
 
 def to_scan_state(dyn: DynamicState, batch: PodBatch):
@@ -786,6 +821,8 @@ def to_scan_state(dyn: DynamicState, batch: PodBatch):
 
     from . import scan as scan_ops
 
+    t = batch.terms
+    tv = t.topo_val
     return scan_ops.ScanState(
         used_mcpu=jnp.asarray(dyn.used_mcpu),
         used_mem=jnp.asarray(dyn.used_mem),
@@ -799,13 +836,18 @@ def to_scan_state(dyn: DynamicState, batch: PodBatch):
         vg_used=jnp.asarray(dyn.vg_used),
         ssd_used=jnp.asarray(dyn.ssd_used),
         hdd_used=jnp.asarray(dyn.hdd_used),
-        tgt=jnp.asarray(batch.terms.init_tgt),
-        own_anti_req=jnp.asarray(batch.terms.init_own_anti_req),
-        own_aff_req=jnp.asarray(batch.terms.init_own_aff_req),
-        own_aff_pref_w=jnp.asarray(batch.terms.init_own_aff_pref_w),
-        own_anti_pref_w=jnp.asarray(batch.terms.init_own_anti_pref_w),
-        group_counts=jnp.asarray(batch.terms.init_group_counts),
-        soft_counts=jnp.asarray(batch.terms.init_soft_counts),
+        tgt=jnp.asarray(_value_to_node_space(t.init_tgt, tv)),
+        own_anti_req=jnp.asarray(_value_to_node_space(t.init_own_anti_req, tv)),
+        own_aff_req=jnp.asarray(_value_to_node_space(t.init_own_aff_req, tv)),
+        own_aff_pref_w=jnp.asarray(_value_to_node_space(t.init_own_aff_pref_w, tv)),
+        own_anti_pref_w=jnp.asarray(_value_to_node_space(t.init_own_anti_pref_w, tv)),
+        group_counts=jnp.asarray(
+            _value_to_node_space(t.init_group_counts, tv[t.group_rows])
+        ),
+        group_total=jnp.asarray(t.init_group_counts.sum(axis=1)),
+        soft_counts=jnp.asarray(
+            _value_to_node_space(t.init_soft_counts, tv[t.s_row])
+        ),
     )
 
 

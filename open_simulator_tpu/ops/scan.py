@@ -170,7 +170,6 @@ class ScanStatic(NamedTuple):
     carry_aff_pref_w: jnp.ndarray  # [T, U]
     carry_anti_pref_w: jnp.ndarray  # [T, U]
     cls_rows: jnp.ndarray  # [U, Rmax]
-    group_rows: jnp.ndarray  # [A]
     group_of_row: jnp.ndarray  # [A]
     match_all: jnp.ndarray  # [Gn, U]
     cls_group_rows: jnp.ndarray  # [U, Gmax]
@@ -186,6 +185,15 @@ class ScanStatic(NamedTuple):
     s_q: jnp.ndarray  # [Cs, N]
     cls_s_rows: jnp.ndarray  # [U, Smax]
     cls_s_haskeys: jnp.ndarray  # [U, N]
+    # node-space term helpers (see ScanState: counts live on the node
+    # axis, so per-step updates are masked broadcasts, not scatters)
+    g_topo_val: jnp.ndarray  # [A, N] i32 = topo_val[group_rows]
+    s_topo_val: jnp.ndarray  # [Cs, N] i32 = topo_val[s_row]
+    # value one-hot for the soft-spread distinct-domain count; hostname
+    # rows are all-zero (their domain count is just the eligible-node
+    # count, s_is_host branch) so Vs stays at the small non-hostname
+    # vocab instead of N
+    s_val_onehot: jnp.ndarray  # [Cs, Vs, N] bool
     custom_raw: jnp.ndarray  # [K, U, N]
     custom_mode: jnp.ndarray  # [K]
     custom_weight: jnp.ndarray  # [K]
@@ -204,14 +212,21 @@ class ScanState(NamedTuple):
     vg_used: jnp.ndarray  # [N, V]
     ssd_used: jnp.ndarray  # [N, Ds] bool
     hdd_used: jnp.ndarray  # [N, Dh] bool
-    # affinity/spread counts over (term row, topology value)
-    tgt: jnp.ndarray  # [T, V] pods matching row selector at value
-    own_anti_req: jnp.ndarray  # [T, V] carried required anti-affinity
-    own_aff_req: jnp.ndarray  # [T, V] carried required affinity
-    own_aff_pref_w: jnp.ndarray  # [T, V] carried preferred-affinity weight
-    own_anti_pref_w: jnp.ndarray  # [T, V] carried preferred-anti weight
-    group_counts: jnp.ndarray  # [A, V] all-terms-match counts per group row
-    soft_counts: jnp.ndarray  # [Cs, V] qualifying-node-restricted counts
+    # affinity/spread counts in NODE space: entry [row, n] is the count
+    # at node n's topology value (topo_val[row, n]); nodes sharing a
+    # value share the count, nodes missing the key hold 0. This keeps
+    # per-step reads as plain row indexing and per-step updates as
+    # masked broadcasts over (topo_val == placed value) — value-space
+    # [T, V] scatters/gathers lower to per-element ops on TPU and were
+    # ~10x the cost of the whole rest of the step.
+    tgt: jnp.ndarray  # [T, N] pods matching row selector at n's value
+    own_anti_req: jnp.ndarray  # [T, N] carried required anti-affinity
+    own_aff_req: jnp.ndarray  # [T, N] carried required affinity
+    own_aff_pref_w: jnp.ndarray  # [T, N] carried preferred-affinity weight
+    own_anti_pref_w: jnp.ndarray  # [T, N] carried preferred-anti weight
+    group_counts: jnp.ndarray  # [A, N] all-terms-match counts per group row
+    group_total: jnp.ndarray  # [A] total matching pods per group row
+    soft_counts: jnp.ndarray  # [Cs, N] qualifying-node-restricted counts
 
 
 def _default_normalize(raw, feasible, reverse: bool):
@@ -350,10 +365,11 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
         r = jnp.maximum(rows, 0)
         vals = static.topo_val[r]  # [R, N]
         has = (vals >= 0) & rvalid[:, None]
-        vv = jnp.maximum(vals, 0)
 
-        def gather(counts):
-            return jnp.where(has, jnp.take_along_axis(counts[r], vv, axis=1), 0)
+        # state is node-space (ScanState docstring): counts at each
+        # node's own value are plain row reads, no value gather
+        def gather(counts_n):
+            return jnp.where(has, counts_n[r], 0)
 
         tgt_at = gather(state.tgt)
         own_anti_at = gather(state.own_anti_req)
@@ -387,17 +403,12 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
         garc = static.cls_group_rows[u]  # [Gm]
         gvalid = garc >= 0
         ga = jnp.maximum(garc, 0)
-        g_term_rows = static.group_rows[ga]
-        gvals = static.topo_val[g_term_rows]  # [Gm, N]
+        gvals = static.g_topo_val[ga]  # [Gm, N]
         has_g = gvals >= 0
-        gc = jnp.where(
-            has_g,
-            jnp.take_along_axis(state.group_counts[ga], jnp.maximum(gvals, 0), axis=1),
-            0,
-        )
+        gc = jnp.where(has_g, state.group_counts[ga], 0)
         keys_ok = jnp.all(has_g | ~gvalid[:, None], axis=0)
         pods_exist = jnp.all((gc > 0) | ~gvalid[:, None], axis=0)
-        total_counts = jnp.sum(jnp.where(gvalid[:, None], state.group_counts[ga], 0))
+        total_counts = jnp.sum(jnp.where(gvalid, state.group_total[ga], 0))
         gid = static.cls_group_id[u]
         self_ok = static.match_all[jnp.maximum(gid, 0), u]
         bootstrap = (total_counts == 0) & self_ok
@@ -411,33 +422,26 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
     if features.hard_spread:
         # ---- hard topology spread (filtering.go:276-337) -----------------
         # candidate topology VALUES derive from candidate NODES restricted
-        # by the scenario's node_valid mask (capacity sweep correctness)
+        # by the scenario's node_valid mask (capacity sweep correctness).
+        # Node-space counts make the per-value min a plain min over
+        # candidate nodes (duplicate values cannot change a min), and
+        # each node's own-value count a direct read. Membership of a
+        # node's value in the candidate-value set reduces to candidate
+        # membership of the node itself: any node where spread_ok is
+        # consumed passes the pod's selector/affinity and carries the
+        # key, so it IS a candidate (h_cand_nodes construction,
+        # ops/terms.py).
         hc = static.cls_h_rows[u]  # [Hm]
         hvalid = hc >= 0
         h = jnp.maximum(hc, 0)
         hrow = static.h_row[h]
         hvals = static.topo_val[hrow]  # [Hm, N]
         cand_nodes = static.h_cand_nodes[h] & node_valid[None, :]  # [Hm, N]
-        v_dim = state.tgt.shape[1]
-
-        def cand_row(vals_r, cn_r):
-            return (
-                jnp.zeros((v_dim,), bool)
-                .at[jnp.maximum(vals_r, 0)]
-                .max(cn_r & (vals_r >= 0))
-            )
-
-        cand = jax.vmap(cand_row)(hvals, cand_nodes)  # [Hm, V]
-        counts_h = state.tgt[hrow]  # [Hm, V]
-        minc = jnp.min(jnp.where(cand, counts_h, big), axis=1)
-        minc = jnp.where(jnp.any(cand, axis=1), minc, 0)
-        pair_in = (
-            jnp.take_along_axis(cand, jnp.maximum(hvals, 0).astype(jnp.int32), axis=1)
-            & (hvals >= 0)
-        )
-        cnt_eff = jnp.where(
-            pair_in, jnp.take_along_axis(counts_h, jnp.maximum(hvals, 0), axis=1), 0
-        )
+        counts_h = state.tgt[hrow]  # [Hm, N] node-space
+        minc = jnp.min(jnp.where(cand_nodes, counts_h, big), axis=1)
+        minc = jnp.where(jnp.any(cand_nodes, axis=1), minc, 0)
+        pair_in = cand_nodes & (hvals >= 0)
+        cnt_eff = jnp.where(pair_in, counts_h, 0)
         selfm = static.h_self[h, u]
         skew = cnt_eff + selfm[:, None] - minc[:, None]
         ok_c = (skew <= static.h_max_skew[h][:, None]) & (hvals >= 0)
@@ -462,21 +466,19 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
         has_keys = static.cls_s_haskeys[u]  # [N]
         eligible = feasible_final & has_keys
         is_host = static.s_is_host[s]
-        v_dim = state.tgt.shape[1]
 
-        def present_row(vals_r):
-            return (
-                jnp.zeros((v_dim,), bool)
-                .at[jnp.maximum(vals_r, 0)]
-                .max(eligible & (vals_r >= 0))
-            )
-
-        present = jax.vmap(present_row)(svals)  # [Sm, V]
+        # distinct eligible topology domains: for non-hostname rows the
+        # static value one-hot [Vs, N] turns "any eligible node with
+        # value v" into an elementwise AND + reduce (Vs = small vocab);
+        # hostname rows count eligible nodes directly (value == node)
+        onehot = static.s_val_onehot[s]  # [Sm, Vs, N]
+        present = jnp.any(onehot & eligible[None, None, :], axis=2)  # [Sm, Vs]
         sz_nonhost = jnp.sum(present, axis=1)
         sz = jnp.where(is_host, jnp.sum(eligible), sz_nonhost)
         weight = jnp.log(sz.astype(jnp.float64) + 2.0)
-        cnt_soft = jnp.take_along_axis(state.soft_counts[s], jnp.maximum(svals, 0), axis=1)
-        cnt_host = jnp.take_along_axis(state.tgt[srow], jnp.maximum(svals, 0), axis=1)
+        # node-space counts: each node already reads its own value
+        cnt_soft = state.soft_counts[s]
+        cnt_host = state.tgt[srow]
         cnt = jnp.where(is_host[:, None], cnt_host, cnt_soft) * (svals >= 0)
         score_f = jnp.sum(
             jnp.where(
@@ -503,7 +505,15 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
 
 def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit, features):
     """Rank-1 count updates after a commit (AddPod semantics of the
-    PreFilterExtensions / next cycle's PreScore recomputation)."""
+    PreFilterExtensions / next cycle's PreScore recomputation).
+
+    Node-space form: incrementing the count at the placed value means
+    incrementing every node sharing that value — a masked broadcast
+    `(topo_val == placed value) * inc` over the full [T, N] table
+    (value-space scatters lower to per-element stores on TPU). Rows not
+    touched by this class carry a zero increment: term_match / carry_* /
+    match_all columns are zero exactly where the old cls_rows-restricted
+    scatters never wrote."""
     node = jnp.maximum(placement, 0)
     inc = commit.astype(jnp.int64)
 
@@ -513,50 +523,46 @@ def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit
     own_paff = state.own_aff_pref_w
     own_panti = state.own_anti_pref_w
     group_counts = state.group_counts
+    group_total = state.group_total
     soft_counts = state.soft_counts
 
     if features.terms:
-        rows = static.cls_rows[u]
-        rvalid = rows >= 0
-        r = jnp.maximum(rows, 0)
-        val = static.topo_val[r, node]  # [R]
-        ok = (val >= 0) & rvalid
-        vv = jnp.maximum(val, 0)
-        m = (static.term_match[r, u] & ok).astype(jnp.int64) * inc
+        val_at = static.topo_val[:, node]  # [T] placed node's values
+        eq = (static.topo_val == val_at[:, None]) & (val_at >= 0)[:, None]
+        eqi = eq.astype(jnp.int64)
         # target counts feed IPA filters/score, hard-spread skew checks,
         # and soft-spread host-level constraint counts
-        tgt = tgt.at[r, vv].add(m)
+        tgt = tgt + (static.term_match[:, u].astype(jnp.int64) * inc)[:, None] * eqi
 
     if features.ipa:
-        own_anti = own_anti.at[r, vv].add(
-            jnp.where(ok, static.carry_anti_req[r, u], 0) * inc
-        )
-        own_aff = own_aff.at[r, vv].add(
-            jnp.where(ok, static.carry_aff_req[r, u], 0) * inc
-        )
-        own_paff = own_paff.at[r, vv].add(
-            jnp.where(ok, static.carry_aff_pref_w[r, u], 0) * inc
-        )
-        own_panti = own_panti.at[r, vv].add(
-            jnp.where(ok, static.carry_anti_pref_w[r, u], 0) * inc
-        )
+        own_anti = own_anti + (static.carry_anti_req[:, u] * inc)[:, None] * eqi
+        own_aff = own_aff + (static.carry_aff_req[:, u] * inc)[:, None] * eqi
+        own_paff = own_paff + (static.carry_aff_pref_w[:, u] * inc)[:, None] * eqi
+        own_panti = own_panti + (static.carry_anti_pref_w[:, u] * inc)[:, None] * eqi
 
         # group counts: all A rows
-        a_dim = static.group_rows.shape[0]
-        g_val = static.topo_val[static.group_rows, node]  # [A]
+        g_val = static.g_topo_val[:, node]  # [A]
         g_ok = g_val >= 0
-        g_inc = (static.match_all[static.group_of_row, u] & g_ok).astype(jnp.int64) * inc
-        group_counts = group_counts.at[jnp.arange(a_dim), jnp.maximum(g_val, 0)].add(g_inc)
+        g_eq = (static.g_topo_val == g_val[:, None]) & g_ok[:, None]
+        g_match = jnp.take(static.match_all[:, u], static.group_of_row)  # [A]
+        g_inc = (g_match & g_ok).astype(jnp.int64) * inc
+        group_counts = group_counts + g_inc[:, None] * g_eq.astype(jnp.int64)
+        group_total = group_total + g_inc
 
     if features.soft_spread:
-        # soft spread counts: all Cs rows, restricted to qualifying nodes
-        cs_dim = static.s_row.shape[0]
-        s_val = static.topo_val[static.s_row, node]  # [Cs]
-        s_ok = (s_val >= 0) & static.s_q[jnp.arange(cs_dim), node]
-        s_inc = (static.term_match[static.s_row, u] & s_ok).astype(jnp.int64) * inc
-        soft_counts = soft_counts.at[jnp.arange(cs_dim), jnp.maximum(s_val, 0)].add(s_inc)
+        # soft spread counts: all Cs rows, restricted to qualifying
+        # PLACED nodes (s_q gates who counts, not who reads)
+        s_val = static.s_topo_val[:, node]  # [Cs]
+        s_ok = (s_val >= 0) & static.s_q[:, node]
+        s_eq = (static.s_topo_val == s_val[:, None]) & s_ok[:, None]
+        s_match = jnp.take(static.term_match[:, u], static.s_row)  # [Cs]
+        s_inc = (s_match & s_ok).astype(jnp.int64) * inc
+        soft_counts = soft_counts + s_inc[:, None] * s_eq.astype(jnp.int64)
 
-    return tgt, own_anti, own_aff, own_paff, own_panti, group_counts, soft_counts
+    return (
+        tgt, own_anti, own_aff, own_paff, own_panti,
+        group_counts, group_total, soft_counts,
+    )
 
 
 def _gpu_allocate(avail, dev_valid, per_gpu_mem, count):
@@ -819,9 +825,10 @@ def _run_scan_compiled(
 
         # ---- commit ----
         commit = placement >= 0
-        tgt, own_anti, own_aff, own_paff, own_panti, group_counts, soft_counts = (
-            _terms_commit(static, state, u, placement, commit, features)
-        )
+        (
+            tgt, own_anti, own_aff, own_paff, own_panti,
+            group_counts, group_total, soft_counts,
+        ) = _terms_commit(static, state, u, placement, commit, features)
         onehot = (
             jax.nn.one_hot(jnp.maximum(placement, 0), n, dtype=jnp.int64)
             * commit.astype(jnp.int64)
@@ -871,6 +878,7 @@ def _run_scan_compiled(
             own_aff_pref_w=own_paff,
             own_anti_pref_w=own_panti,
             group_counts=group_counts,
+            group_total=group_total,
             soft_counts=soft_counts,
         )
         return new_state, placement

@@ -12,10 +12,12 @@ The order-dependent plugins carry state that previous placements feed:
 
 All of them reduce to counts over (term row, topology value) where a
 "term row" is a deduplicated (label selector, namespace set, topology
-key) triple. The scan carries six count matrices `[T, V]` plus a
-per-node count `[T, N]` and updates them with rank-1 scatters on every
-commit; per-pod-class index lists keep the per-step gather cost at
-O(rows-relevant-to-class x N) instead of O(T x N).
+key) triple. This module builds the tables in VALUE space `[T, V]`
+(natural for the host-side init accounting); the scan carries them in
+NODE space `[T, N]` — count at each node's own value, converted in
+encode.to_scan_state — so per-step reads are row indexing and commits
+are masked broadcasts (value-space gathers/scatters lower to
+per-element ops on TPU and dominated the step cost).
 
 Topology-value space: per-key vocab over node labels; rows whose key is
 kubernetes.io/hostname use the node index itself as the value id, so V

@@ -11,8 +11,12 @@ Scenarios (SIMON_BENCH env):
 - `default`: raw scan throughput, 20k pods over 10k nodes.
 - `affinity`: the 100-StatefulSet anti-affinity + topology-spread
   stress (term-table machinery).
-- `all`: capacity headline with the other two embedded in the metric
-  string.
+- `gpushare`: per-device GPU-memory fragmentation scoring at 1k 8-GPU
+  nodes (simon-gpushare-config.yaml at scale).
+- `defrag`: pod-migration defragmentation sweep on a cluster snapshot.
+- `whatif`: minimal-count capacity plan over 8 candidate newnode specs.
+- `all`: capacity headline with the others embedded in the metric
+  string (one scenario per BASELINE.json config).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -136,6 +140,179 @@ def build_affinity_scenario():
     res.stateful_sets = stss
     pods = _sort_app_pods(wl.generate_valid_pods_from_app("stress", res, nodes))
     return nodes, pods
+
+
+def build_gpushare_scenario(n_nodes=1000, n_pods=10000):
+    """SIMON_BENCH=gpushare: the simon-gpushare-config.yaml concept at
+    scale — per-device GPU-memory fragmentation scoring (tightest-fit
+    single-GPU, two-pointer multi-GPU; open-gpu-share
+    gpunodeinfo.go:232-291). V100-style nodes: 8 devices x 32Gi."""
+    gi = 1 << 30
+    nodes = []
+    for i in range(n_nodes):
+        nodes.append(
+            {
+                "kind": "Node",
+                "metadata": {
+                    "name": f"gpu-node-{i:04d}",
+                    "labels": {"kubernetes.io/hostname": f"gpu-node-{i:04d}"},
+                    "annotations": {},
+                },
+                # gpu-count/gpu-mem live in CAPACITY (the open-gpu-share
+                # codec reads capacity; example gpushare nodes carry both)
+                "status": {
+                    "allocatable": {"cpu": "64", "memory": "256Gi", "pods": "110"},
+                    "capacity": {
+                        "cpu": "64",
+                        "memory": "256Gi",
+                        "pods": "110",
+                        "alibabacloud.com/gpu-count": "8",
+                        "alibabacloud.com/gpu-mem": str(8 * 32 * gi),
+                    },
+                },
+            }
+        )
+    # fragmentation mix: 4/8/16/32 Gi single-GPU shares + 2-GPU jobs
+    shapes = [
+        (4 * gi, 1),
+        (8 * gi, 1),
+        (16 * gi, 1),
+        (32 * gi, 1),
+        (16 * gi, 2),
+    ]
+    pods = []
+    for p in range(n_pods):
+        mem, cnt = shapes[p % len(shapes)]
+        pods.append(
+            {
+                "metadata": {
+                    "name": f"gpu-pod-{p:05d}",
+                    "namespace": "bench",
+                    "labels": {},
+                    "annotations": {
+                        "alibabacloud.com/gpu-mem": str(mem),
+                        "alibabacloud.com/gpu-count": str(cnt),
+                    },
+                },
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img-gpu",
+                            "resources": {"requests": {"cpu": "4", "memory": "16Gi"}},
+                        }
+                    ],
+                    "schedulerName": "default-scheduler",
+                },
+            }
+        )
+    return nodes, pods
+
+
+def run_defrag(n_nodes=1000, n_pods=6000) -> dict:
+    """SIMON_BENCH=defrag: pod-migration defragmentation sweep on a
+    cluster snapshot (BASELINE config #4) — rank under-utilized nodes,
+    batch-evaluate all drain depths, replay the deepest feasible drain."""
+    from open_simulator_tpu.parallel.defrag import plan_defrag
+    from open_simulator_tpu.scheduler.core import NodeStatus, SimulateResult
+
+    nodes = [
+        _make_node(f"node-{i:05d}", 32, 128, {"zone": f"z{i % 16}"})
+        for i in range(n_nodes)
+    ]
+    _, pods = build_scenario()
+    pods = [p for p in pods if "nodeSelector" not in p["spec"]][:n_pods]
+    # synthetic placed snapshot at ~20% fill over ALL nodes, so every
+    # drained node forces real migrations
+    statuses = [NodeStatus(node=n, pods=[]) for n in nodes]
+    for i, pod in enumerate(pods[:n_pods]):
+        ns = statuses[i % n_nodes]
+        pod = dict(pod)
+        pod["spec"] = dict(pod["spec"])
+        pod["spec"]["nodeName"] = ns.node["metadata"]["name"]
+        pod.setdefault("status", {})["phase"] = "Running"
+        ns.pods.append(pod)
+    snapshot = SimulateResult(unscheduled_pods=[], node_status=statuses)
+    plan_defrag(snapshot, max_drain=16)  # warm/compile
+    t0 = time.perf_counter()
+    res = plan_defrag(snapshot, max_drain=16)
+    elapsed = time.perf_counter() - t0
+    return {
+        "elapsed_s": elapsed,
+        "drained": res.chosen_depth,
+        "moves": len(res.moves),
+        "nodes": n_nodes,
+        "pods": n_pods,
+    }
+
+
+def run_whatif(n_base=500, n_pods=5000) -> dict:
+    """SIMON_BENCH=whatif: what-if capacity sweep over 8 candidate
+    newnode specs (BASELINE config #5): per spec, find the minimal
+    feasible new-node count; report total wall-clock for all 8."""
+    from open_simulator_tpu.apply.applier import probe_plan
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.models.workloads import reset_name_counter
+    from open_simulator_tpu.scheduler.core import AppResource
+
+    nodes = []
+    for i in range(n_base):
+        nodes.append(_make_node(f"node-{i:05d}", 16, 64, {"zone": f"z{i % 16}"}))
+    rep = n_pods // 4
+
+    def deploy(name, replicas, cpu, mem):
+        return {
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "bench", "labels": {"app": name}},
+            "spec": {
+                "replicas": replicas,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": f"img-{name}",
+                                "resources": {"requests": {"cpu": cpu, "memory": mem}},
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+
+    resources = ResourceTypes()
+    resources.deployments = [
+        deploy("large", rep, "4", "8Gi"),
+        deploy("medium", rep, "1", "2Gi"),
+        deploy("small", rep, "500m", "1Gi"),
+        deploy("mem", rep, "1", "8Gi"),
+    ]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    apps = [AppResource("bench", resources)]
+    specs = [
+        ("c16", 16, 64), ("c32", 32, 128), ("c48", 48, 192), ("c64", 64, 256),
+        ("c96", 96, 384), ("m32", 32, 256), ("m64", 64, 512), ("c128", 128, 512),
+    ]
+    templates = [_make_node(f"tpl-{nm}", cpu, mem) for nm, cpu, mem in specs]
+    # warm one spec (compiles the masked scan for this feature set; the
+    # other specs reuse the same compiled shapes)
+    reset_name_counter()
+    probe_plan(cluster, apps, templates[0])
+    t0 = time.perf_counter()
+    counts = []
+    for tpl in templates:
+        reset_name_counter()
+        r = probe_plan(cluster, apps, tpl)
+        counts.append(r.new_node_count if r.success else -1)
+    elapsed = time.perf_counter() - t0
+    return {
+        "elapsed_s": elapsed,
+        "specs": len(specs),
+        "counts": counts,
+        "pods": n_pods,
+        "nodes": n_base,
+    }
 
 
 def build_capacity_scenario():
@@ -347,19 +524,54 @@ def main():
             "unit": "s",
             "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / c["elapsed_s"], 3),
         }
-    else:  # all: capacity headline + scan rates embedded
+    elif scenario == "gpushare":
+        nodes, pods = build_gpushare_scenario()
+        r = _scan_rate(nodes, pods, "gpushare")
+        out = {
+            "metric": f"pods scheduled/sec at {r['nodes']} GPU nodes "
+            f"(gpushare fragmentation, {r['scheduled']}/{r['total']} placed)",
+            "value": round(r["pods_per_sec"], 1),
+            "unit": "pods/s",
+            "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
+        }
+    elif scenario == "defrag":
+        d = run_defrag()
+        out = {
+            "metric": f"defrag sweep wall-clock, {d['pods']} pods x {d['nodes']} "
+            f"nodes (drained {d['drained']} nodes, {d['moves']} migrations)",
+            "value": round(d["elapsed_s"], 2),
+            "unit": "s",
+            "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / d["elapsed_s"], 3),
+        }
+    elif scenario == "whatif":
+        w = run_whatif()
+        out = {
+            "metric": f"what-if sweep over {w['specs']} newnode specs, "
+            f"{w['pods']} pods x {w['nodes']} base nodes "
+            f"(min counts per spec: {w['counts']})",
+            "value": round(w["elapsed_s"], 2),
+            "unit": "s",
+            "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / w["elapsed_s"], 3),
+        }
+    else:  # all: capacity headline + the other BASELINE configs embedded
         c = run_capacity()
         nodes, pods = build_scenario()
         rd = _scan_rate(nodes, pods, "default")
         nodes, pods = build_affinity_scenario()
         ra = _scan_rate(nodes, pods, "affinity")
+        nodes, pods = build_gpushare_scenario()
+        rg = _scan_rate(nodes, pods, "gpushare")
+        d = run_defrag()
+        w = run_whatif()
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
             f"incl. expansion+encode+probes+replay+report; best of 2 runs; "
-            f"also: default scan "
-            f"{rd['pods_per_sec']:.0f} pods/s at 10k nodes, affinity-stress scan "
-            f"{ra['pods_per_sec']:.0f} pods/s at 2k nodes)",
+            f"also: default scan {rd['pods_per_sec']:.0f} pods/s at 10k nodes, "
+            f"affinity-stress {ra['pods_per_sec']:.0f} pods/s at 2k nodes, "
+            f"gpushare {rg['pods_per_sec']:.0f} pods/s at {rg['nodes']} 8-GPU nodes, "
+            f"defrag sweep {d['elapsed_s']:.2f}s/{d['drained']} drained at {d['nodes']} nodes, "
+            f"8-spec what-if {w['elapsed_s']:.2f}s)",
             "value": round(c["elapsed_s"], 2),
             "unit": "s",
             "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / c["elapsed_s"], 3),

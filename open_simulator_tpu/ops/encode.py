@@ -37,7 +37,7 @@ from ..utils.memo import IdentityMemo
 from .profiles import freeze as _freeze
 from .profiles import node_profiles as _shared_node_profiles
 from .profiles import uses_match_fields as _uses_match_fields
-from .terms import TermTables, build_term_tables
+from .terms import TermTables, build_term_tables, combined_pref_carry, combined_pref_init
 from ..scheduler.oracle import (
     GpuState,
     NodeState,
@@ -755,8 +755,8 @@ def to_scan_static(cluster: ClusterStatic, batch: PodBatch):
         topo_val=jnp.asarray(batch.terms.topo_val),
         term_match=jnp.asarray(batch.terms.match),
         carry_anti_req=jnp.asarray(batch.terms.carry_anti_req),
-        carry_aff_req=jnp.asarray(batch.terms.carry_aff_req),
         carry_aff_pref_w=jnp.asarray(batch.terms.carry_aff_pref_w),
+        carry_pref_comb=jnp.asarray(combined_pref_carry(batch.terms)),
         carry_anti_pref_w=jnp.asarray(batch.terms.carry_anti_pref_w),
         cls_rows=jnp.asarray(batch.terms.cls_rows),
         group_of_row=jnp.asarray(batch.terms.group_of_row),
@@ -838,8 +838,9 @@ def to_scan_state(dyn: DynamicState, batch: PodBatch):
         hdd_used=jnp.asarray(dyn.hdd_used),
         tgt=jnp.asarray(_value_to_node_space(t.init_tgt, tv)),
         own_anti_req=jnp.asarray(_value_to_node_space(t.init_own_anti_req, tv)),
-        own_aff_req=jnp.asarray(_value_to_node_space(t.init_own_aff_req, tv)),
-        own_aff_pref_w=jnp.asarray(_value_to_node_space(t.init_own_aff_pref_w, tv)),
+        own_aff_pref_w=jnp.asarray(
+            _value_to_node_space(combined_pref_init(t), tv)
+        ),
         own_anti_pref_w=jnp.asarray(_value_to_node_space(t.init_own_anti_pref_w, tv)),
         group_counts=jnp.asarray(
             _value_to_node_space(t.init_group_counts, tv[t.group_rows])

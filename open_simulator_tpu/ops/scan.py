@@ -166,10 +166,12 @@ class ScanStatic(NamedTuple):
     topo_val: jnp.ndarray  # [T, N] i32
     term_match: jnp.ndarray  # [T, U] bool
     carry_anti_req: jnp.ndarray  # [T, U]
-    carry_aff_req: jnp.ndarray  # [T, U]
     carry_aff_pref_w: jnp.ndarray  # [T, U]
     carry_anti_pref_w: jnp.ndarray  # [T, U]
     cls_rows: jnp.ndarray  # [U, Rmax]
+    # prefolded commit increment for the combined own-affinity state:
+    # HARD_POD_AFFINITY_WEIGHT * carry_aff_req + carry_aff_pref_w
+    carry_pref_comb: jnp.ndarray  # [T, U]
     group_of_row: jnp.ndarray  # [A]
     match_all: jnp.ndarray  # [Gn, U]
     cls_group_rows: jnp.ndarray  # [U, Gmax]
@@ -221,8 +223,10 @@ class ScanState(NamedTuple):
     # ~10x the cost of the whole rest of the step.
     tgt: jnp.ndarray  # [T, N] pods matching row selector at n's value
     own_anti_req: jnp.ndarray  # [T, N] carried required anti-affinity
-    own_aff_req: jnp.ndarray  # [T, N] carried required affinity
-    own_aff_pref_w: jnp.ndarray  # [T, N] carried preferred-affinity weight
+    # combined HARD_POD_AFFINITY_WEIGHT*required-affinity + preferred-
+    # affinity weight (their only reader sums them, scoring.go
+    # processExistingPod — one state array instead of two)
+    own_aff_pref_w: jnp.ndarray  # [T, N]
     own_anti_pref_w: jnp.ndarray  # [T, N] carried preferred-anti weight
     group_counts: jnp.ndarray  # [A, N] all-terms-match counts per group row
     group_total: jnp.ndarray  # [A] total matching pods per group row
@@ -373,7 +377,6 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
 
         tgt_at = gather(state.tgt)
         own_anti_at = gather(state.own_anti_req)
-        own_affreq_at = gather(state.own_aff_req)
         own_affpref_at = gather(state.own_aff_pref_w)
         own_antipref_at = gather(state.own_anti_pref_w)
 
@@ -387,15 +390,12 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
         # satisfyPodAntiAffinity (filtering.go:329-340)
         fail_own_anti = jnp.any((c_anti > 0)[:, None] & (tgt_at > 0), axis=0)
 
-        # InterPodAffinity raw score (scoring.go processExistingPod)
+        # InterPodAffinity raw score (scoring.go processExistingPod);
+        # own_affpref_at already carries HARD_POD_AFFINITY_WEIGHT x
+        # required affinity (combined state array)
         ipa_raw = jnp.sum(
             (c_paff - c_panti)[:, None] * tgt_at
-            + m[:, None]
-            * (
-                HARD_POD_AFFINITY_WEIGHT * own_affreq_at
-                + own_affpref_at
-                - own_antipref_at
-            ),
+            + m[:, None] * (own_affpref_at - own_antipref_at),
             axis=0,
         )
 
@@ -519,7 +519,6 @@ def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit
 
     tgt = state.tgt
     own_anti = state.own_anti_req
-    own_aff = state.own_aff_req
     own_paff = state.own_aff_pref_w
     own_panti = state.own_anti_pref_w
     group_counts = state.group_counts
@@ -536,8 +535,7 @@ def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit
 
     if features.ipa:
         own_anti = own_anti + (static.carry_anti_req[:, u] * inc)[:, None] * eqi
-        own_aff = own_aff + (static.carry_aff_req[:, u] * inc)[:, None] * eqi
-        own_paff = own_paff + (static.carry_aff_pref_w[:, u] * inc)[:, None] * eqi
+        own_paff = own_paff + (static.carry_pref_comb[:, u] * inc)[:, None] * eqi
         own_panti = own_panti + (static.carry_anti_pref_w[:, u] * inc)[:, None] * eqi
 
         # group counts: all A rows
@@ -560,7 +558,7 @@ def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit
         soft_counts = soft_counts + s_inc[:, None] * s_eq.astype(jnp.int64)
 
     return (
-        tgt, own_anti, own_aff, own_paff, own_panti,
+        tgt, own_anti, own_paff, own_panti,
         group_counts, group_total, soft_counts,
     )
 
@@ -826,7 +824,7 @@ def _run_scan_compiled(
         # ---- commit ----
         commit = placement >= 0
         (
-            tgt, own_anti, own_aff, own_paff, own_panti,
+            tgt, own_anti, own_paff, own_panti,
             group_counts, group_total, soft_counts,
         ) = _terms_commit(static, state, u, placement, commit, features)
         onehot = (
@@ -874,7 +872,6 @@ def _run_scan_compiled(
             ),
             tgt=tgt,
             own_anti_req=own_anti,
-            own_aff_req=own_aff,
             own_aff_pref_w=own_paff,
             own_anti_pref_w=own_panti,
             group_counts=group_counts,

@@ -38,6 +38,25 @@ HOSTNAME_KEY = "kubernetes.io/hostname"
 HARD_POD_AFFINITY_WEIGHT = 1
 
 
+def combined_pref_init(tables: "TermTables"):
+    """Init for the combined own-affinity state (one array holds
+    HARD_POD_AFFINITY_WEIGHT x required + preferred weights — their
+    only reader sums them, scoring.go processExistingPod). Single
+    definition keeps the XLA and Pallas paths in lockstep."""
+    return (
+        HARD_POD_AFFINITY_WEIGHT * tables.init_own_aff_req
+        + tables.init_own_aff_pref_w
+    )
+
+
+def combined_pref_carry(tables: "TermTables"):
+    """Per-(row, class) commit increment for the combined state."""
+    return (
+        HARD_POD_AFFINITY_WEIGHT * tables.carry_aff_req
+        + tables.carry_aff_pref_w
+    )
+
+
 def _selector_key(selector) -> str:
     return json.dumps(selector, sort_keys=True, default=str)
 

@@ -1,0 +1,256 @@
+"""Conformance of the fused Pallas kernel's term machinery (inter-pod
+affinity, hard/soft topology spread) against the XLA scan, which is
+itself conformance-tested against the serial oracle. Runs in Pallas
+interpret mode on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from open_simulator_tpu.models import workloads as wl
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.models.workloads import reset_name_counter
+from open_simulator_tpu.ops import pallas_scan
+from open_simulator_tpu.ops import scan as scan_ops
+from open_simulator_tpu.ops.encode import (
+    encode_batch,
+    encode_cluster,
+    encode_dynamic,
+    features_of_batch,
+    to_scan_static,
+    to_scan_state,
+)
+from open_simulator_tpu.scheduler.core import _sort_app_pods
+from open_simulator_tpu.scheduler.oracle import Oracle
+
+ZONES = ["a", "b", "c", "d"]
+
+
+def make_node(i, zone):
+    return {
+        "kind": "Node",
+        "metadata": {
+            "name": f"n{i:03d}",
+            "labels": {"kubernetes.io/hostname": f"n{i:03d}", "zone": zone},
+        },
+        "status": {"allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"}},
+    }
+
+
+def sts(name, reps, cpu="500m", anti_key=None, aff_key=None, spread=None):
+    spec = {
+        "containers": [
+            {"name": "c", "image": "i", "resources": {"requests": {"cpu": cpu, "memory": "1Gi"}}}
+        ]
+    }
+    affinity = {}
+    if anti_key:
+        affinity["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": name}}, "topologyKey": anti_key}
+            ]
+        }
+    if aff_key:
+        affinity["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"grp": "hub"}}, "topologyKey": aff_key}
+            ]
+        }
+    if affinity:
+        spec["affinity"] = affinity
+    if spread:
+        spec["topologySpreadConstraints"] = spread
+    labels = {"app": name, "grp": "hub" if aff_key else name}
+    return {
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "namespace": "d", "labels": labels},
+        "spec": {
+            "replicas": reps,
+            "template": {"metadata": {"labels": labels}, "spec": spec},
+        },
+    }
+
+
+def check_case(nodes, workloads, existing=None, node_valid=None, pod_active=None):
+    reset_name_counter()
+    res = ResourceTypes()
+    res.stateful_sets = workloads
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("t", res, nodes))
+    oracle = Oracle(nodes)
+    for p in existing or []:
+        oracle.place_existing_pod(p)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features, allow_terms=True)
+    assert plan is not None and plan.terms is not None
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    nv = np.ones(cluster.n, bool) if node_valid is None else node_valid
+    pa = np.ones(len(pods), bool) if pod_active is None else pod_active
+    ref, _ = scan_ops.run_scan_masked(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        jnp.asarray(nv),
+        jnp.asarray(pa),
+        features=features,
+    )
+    got, _ = pallas_scan.run_scan_pallas(
+        plan, batch.class_of_pod, pa, nv, interpret=True
+    )
+    assert (np.asarray(ref) == got).all()
+    return got
+
+
+def _nodes(n=32):
+    return [make_node(i, ZONES[i % 4]) for i in range(n)]
+
+
+def test_soft_zone_spread():
+    placements = check_case(
+        _nodes(),
+        [
+            sts(
+                "w1",
+                12,
+                spread=[
+                    {
+                        "maxSkew": 1,
+                        "topologyKey": "zone",
+                        "whenUnsatisfiable": "ScheduleAnyway",
+                        "labelSelector": {"matchLabels": {"app": "w1"}},
+                    }
+                ],
+            )
+        ],
+    )
+    assert (placements >= 0).all()
+
+
+def test_hard_zone_spread():
+    check_case(
+        _nodes(),
+        [
+            sts(
+                "w2",
+                10,
+                spread=[
+                    {
+                        "maxSkew": 2,
+                        "topologyKey": "zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": "w2"}},
+                    }
+                ],
+            )
+        ],
+    )
+
+
+def test_required_affinity_group():
+    check_case(_nodes(), [sts("hub", 3, aff_key="zone"), sts("spoke", 9, aff_key="zone")])
+
+
+def test_mixed_anti_affinity_and_spreads():
+    check_case(
+        _nodes(),
+        [
+            sts("a1", 8, anti_key="kubernetes.io/hostname"),
+            sts(
+                "a2",
+                8,
+                spread=[
+                    {
+                        "maxSkew": 1,
+                        "topologyKey": "zone",
+                        "whenUnsatisfiable": "ScheduleAnyway",
+                        "labelSelector": {"matchLabels": {"app": "a2"}},
+                    },
+                    {
+                        "maxSkew": 3,
+                        "topologyKey": "kubernetes.io/hostname",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": "a2"}},
+                    },
+                ],
+            ),
+        ],
+    )
+
+
+def test_existing_pods_and_scenario_mask():
+    existing = [
+        {
+            "metadata": {"name": f"ex{i}", "namespace": "d", "labels": {"app": "a1"}},
+            "spec": {
+                "nodeName": f"n{i:03d}",
+                "containers": [
+                    {"name": "c", "image": "i", "resources": {"requests": {"cpu": "1"}}}
+                ],
+            },
+            "status": {"phase": "Running"},
+        }
+        for i in range(6)
+    ]
+    nv = np.ones(32, bool)
+    nv[24:] = False
+    # anti-affinity vs existing pods: the 6 prefilled hosts are taken
+    placements = check_case(
+        _nodes(),
+        [sts("a1", 10, anti_key="kubernetes.io/hostname")],
+        existing=existing,
+        node_valid=nv,
+    )
+    taken = set(range(6))
+    assert not (set(placements[placements >= 0].tolist()) & taken)
+
+
+def test_inactive_pods_commit_nothing():
+    pa = np.ones(10, bool)
+    pa[3] = False
+    pa[7] = False
+    placements = check_case(
+        _nodes(), [sts("w3", 10, anti_key="zone")], pod_active=pa
+    )
+    assert placements[3] == pallas_scan.INACTIVE
+    assert placements[7] == pallas_scan.INACTIVE
+
+
+def test_affinity_stress_slice():
+    """A small slice of the bench's affinity-stress scenario."""
+    from open_simulator_tpu.testing import build_affinity_stress
+
+    reset_name_counter()
+    nodes, stss = build_affinity_stress(n_nodes=24, n_sts=6, replicas=4, zones=3)
+    res = ResourceTypes()
+    res.stateful_sets = stss
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("t", res, nodes))
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    assert features.ipa and features.hard_spread and features.soft_spread
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features, allow_terms=True)
+    assert plan is not None and plan.terms is not None
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    ref, _ = scan_ops.run_scan(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        features=features,
+    )
+    got, _ = pallas_scan.run_scan_pallas(
+        plan,
+        batch.class_of_pod,
+        np.ones(len(pods), bool),
+        np.ones(cluster.n, bool),
+        interpret=True,
+    )
+    assert (np.asarray(ref) == got).all()

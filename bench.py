@@ -410,15 +410,22 @@ def _scan_rate(nodes, pods, label: str) -> dict:
         if pallas_scan.should_use()
         else None
     )
+    # best of two measured runs, same protocol as the capacity headline
+    # (the relay adds ~0.1s jitter per dispatch)
     if plan is not None:
         ones_p = np.ones(len(pods), bool)
         ones_n = np.ones(cluster.n, bool)
-        pallas_scan.run_scan_pallas(plan, batch.class_of_pod, ones_p, ones_n)
-        t0 = time.perf_counter()
-        placements_np, _ = pallas_scan.run_scan_pallas(
-            plan, batch.class_of_pod, ones_p, ones_n
+        pallas_scan.run_scan_pallas(
+            plan, batch.class_of_pod, ones_p, ones_n, pinned=batch.pinned_node
         )
-        elapsed = time.perf_counter() - t0
+        elapsed = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            placements_np, _ = pallas_scan.run_scan_pallas(
+                plan, batch.class_of_pod, ones_p, ones_n,
+                pinned=batch.pinned_node,
+            )
+            elapsed = min(elapsed, time.perf_counter() - t0)
         label += "/pallas"
     else:
         static = to_scan_static(cluster, batch)
@@ -431,12 +438,14 @@ def _scan_rate(nodes, pods, label: str) -> dict:
         )
         np.asarray(placements)  # compile + warm
 
-        t0 = time.perf_counter()
-        placements, _ = scan_ops.run_scan(
-            static, init, class_arr, pinned_arr, features=features
-        )
-        placements_np = np.asarray(placements)
-        elapsed = time.perf_counter() - t0
+        elapsed = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            placements, _ = scan_ops.run_scan(
+                static, init, class_arr, pinned_arr, features=features
+            )
+            placements_np = np.asarray(placements)
+            elapsed = min(elapsed, time.perf_counter() - t0)
 
     return {
         "label": label,

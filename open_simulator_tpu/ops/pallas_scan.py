@@ -185,6 +185,7 @@ class PallasPlan(NamedTuple):
     w: tuple
     has_nodeaff: bool
     has_taint: bool
+    has_pins: bool  # any pod arrives with spec.nodeName
     # inter-pod affinity / topology-spread machinery (None = batch has
     # no terms)
     terms: Optional[TermsPlan]
@@ -423,7 +424,6 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         or features.ports
         or features.scalars
         or features.custom
-        or features.pins
     ):
         return None
     if allow_terms is None:
@@ -491,6 +491,25 @@ def build_plan(cluster, batch, dyn, features, weights=None,
     r = -(-n // LANES)
     r = -(-r // SUBLANES) * SUBLANES  # row count multiple of 8
 
+    if features.pins:
+        # forced pin commits bypass the feasibility gate, so per-node
+        # usage is no longer bounded by alloc: bound the worst case
+        # (all pinned pods on one node) against the f32/int32 guards
+        pin_mask = a(batch.pinned_node) >= 0
+        pin_cls = a(batch.class_of_pod)[pin_mask]
+        pin_c = int(req_mcpu[pin_cls].sum())
+        pin_m = int((req_mem // s_mem)[pin_cls].sum())
+        pin_nzc = int(nz_mcpu[pin_cls].sum())
+        pin_nzm = int((nz_mem // s_nzmem)[pin_cls].sum())
+        worst = max(
+            int(init_used_mcpu.max(initial=0)) + pin_c,
+            int((init_used_mem // s_mem).max(initial=0)) + pin_m,
+            int(init_nz_mcpu.max(initial=0)) + pin_nzc,
+            int((init_nz_mem // s_nzmem).max(initial=0)) + pin_nzm,
+        )
+        if worst >= 2**24:
+            return None
+
     terms = None
     if features.ipa or features.hard_spread or features.soft_spread:
         p_total = int(a(batch.class_of_pod).shape[0])
@@ -536,12 +555,13 @@ def build_plan(cluster, batch, dyn, features, weights=None,
            int(w.nodeaff), int(w.tainttol), int(w.spread), int(w.ipa)),
         has_nodeaff=bool(nodeaff_raw.any()),
         has_taint=bool(taint_intol.any()),
+        has_pins=bool(features.pins),
         terms=terms,
     )
 
 
 def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
-                 tc: Optional[TermsCfg]):
+                 has_pins: bool, tc: Optional[TermsCfg]):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -893,16 +913,28 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
             cand = jnp.where(feas & (masked == m), idx_mat, BIG)
             best = jnp.min(cand)
 
-            place = jnp.where(
-                active != 0, jnp.where(found, best, -1), INACTIVE
-            )
+            place = jnp.where(found, best, -1)
+            if has_pins:
+                # spec.nodeName overrides selection regardless of
+                # feasibility (scan.py: pinned pods commit as forced
+                # placements); a pin outside node_valid is INACTIVE
+                pin = pod_scalar(7)
+                pinc = jnp.maximum(pin, 0)
+                vrow = valid_ref[pl.ds(pinc // LANES, 1), :]
+                pin_ok = (
+                    jnp.sum(jnp.where(lane_iota == pinc % LANES, vrow, 0)) != 0
+                )
+                place = jnp.where(
+                    pin >= 0, jnp.where(pin_ok, pin, INACTIVE), place
+                )
+            place = jnp.where(active != 0, place, INACTIVE)
             # dynamic lane-dim stores are unsupported on TPU: rewrite
             # only the pod's 128-lane row, lane-selected via the mask
             prow = place_ref[pl.ds(pr, 1), :]
             place_ref[pl.ds(pr, 1), :] = jnp.where(lane, place, prow)
 
-            do = found & (active != 0)
-            sel = (idx_mat == best) & do
+            do = place >= 0
+            sel = (idx_mat == place) & do
             st_c_ref[:] = used_c + jnp.where(sel, rc, 0)
             st_m_ref[:] = used_m + jnp.where(sel, rm, 0)
             st_e_ref[:] = used_e + jnp.where(sel, re, 0)
@@ -912,8 +944,8 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
 
             if tc is not None:
                 inc = do.astype(jnp.int32)
-                nr = jnp.where(do, best // LANES, 0)
-                nc = jnp.where(do, best % LANES, 0)
+                nr = jnp.where(do, place // LANES, 0)
+                nc = jnp.where(do, place % LANES, 0)
                 lane_nc = (lane_iota == nc)[None, :, :]  # (1, 1, C)
                 lane_u3 = lane_iota == u  # (1, LANES) for (X, Up) tables
 
@@ -971,6 +1003,9 @@ _COMPILED_CACHE: dict = {}
 # a terms plan ships ~55 arrays), so transfer once per plan. Keyed by
 # id(plan) with a strong ref pinning it (utils/memo.py contract).
 _DEVICE_PLAN_CACHE: dict = {}
+
+# host-packed scenario-invariant pod-scalar rows, same identity contract
+_POD_SCAL_CACHE: dict = {}
 
 
 def _device_args(plan: PallasPlan) -> list:
@@ -1033,10 +1068,12 @@ def should_use() -> bool:
 
 
 def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
-                    interpret=None):
+                    pinned=None, interpret=None):
     """Run the fused scan. Returns (placements[P] np.int32, final used
-    dict in TRUE units for utilization reporting). `interpret` forces
-    the Pallas interpreter (None = auto: interpret off-TPU)."""
+    dict in TRUE units for utilization reporting). `pinned` ([P] node
+    index or -1; required when the plan was built with pins) forces
+    spec.nodeName placements. `interpret` forces the Pallas interpreter
+    (None = auto: interpret off-TPU)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -1052,10 +1089,11 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
         interpret = jax.default_backend() != "tpu"
     tc = plan.terms.cfg if plan.terms is not None else None
     key = (p_total, plan.r, plan.u, plan.w, plan.has_nodeaff, plan.has_taint,
-           tc, interpret)
+           plan.has_pins, tc, interpret)
     cached = _COMPILED_CACHE.get(key)
     if cached is None:
-        kernel = _make_kernel(p_total, plan.w, plan.has_nodeaff, plan.has_taint, tc)
+        kernel = _make_kernel(p_total, plan.w, plan.has_nodeaff, plan.has_taint,
+                              plan.has_pins, tc)
         rc = (plan.r, LANES)
         base_n = 17 + int(plan.has_nodeaff) + int(plan.has_taint)
         n_in = base_n + (39 if tc is not None else 0)
@@ -1126,11 +1164,29 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
 
     cls = np.asarray(class_of_pod, dtype=np.int32)
     # per-pod scalar rows: class + class-derived request scalars,
-    # gathered host-side so the kernel never lane-indexes a class table
-    pod_scal = np.zeros((8, pr_rows, LANES), dtype=np.int32)
-    pod_scal[0] = pack(cls)
-    for s in range(6):
-        pod_scal[1 + s] = pack(plan.class_scalars[cls, s])
+    # gathered host-side so the kernel never lane-indexes a class table;
+    # row 7 carries the nodeName pin (-1 = loose). Rows 0-6 are
+    # scenario-invariant — memoize per (plan, class array) so sweeps
+    # that loop scenarios (defrag depths, capacity counts) pack once.
+    memo_key = (id(plan), id(class_of_pod))
+    hit = _POD_SCAL_CACHE.get(memo_key)
+    if hit is not None and hit[0] is plan and hit[1] is class_of_pod:
+        pod_scal = hit[2].copy()
+    else:
+        pod_scal = np.zeros((8, pr_rows, LANES), dtype=np.int32)
+        pod_scal[0] = pack(cls)
+        for s in range(6):
+            pod_scal[1 + s] = pack(plan.class_scalars[cls, s])
+        if len(_POD_SCAL_CACHE) >= 16:
+            _POD_SCAL_CACHE.pop(next(iter(_POD_SCAL_CACHE)))
+        _POD_SCAL_CACHE[memo_key] = (plan, class_of_pod, pod_scal.copy())
+    if plan.has_pins:
+        if pinned is None:
+            raise ValueError("plan has pins: pass the pinned[] array")
+        pin_vec = np.asarray(pinned, dtype=np.int32)
+        pod_scal[7] = pack(np.where(pin_vec >= 0, pin_vec, -1))
+    elif pinned is not None and (np.asarray(pinned) >= 0).any():
+        raise ValueError("pinned pods but the plan was built without pins")
     active_2d = pack(np.asarray(pod_active).astype(np.int32))
     valid = _pad_nodes(np.asarray(node_valid).astype(np.int32), plan.r)
 

@@ -269,6 +269,7 @@ class CapacitySweep:
                     self.batch.class_of_pod,
                     self.pod_active(valid),
                     valid,
+                    pinned=self.batch.pinned_node,
                 )
                 # same utilization arithmetic as _scenario, on the host
                 v = valid[: self.n]

@@ -92,6 +92,7 @@ class TpuEngine:
                     batch.class_of_pod,
                     np.ones(len(pods), bool),
                     np.ones(cluster.n, bool),
+                    pinned=batch.pinned_node,
                 )
             return out
         with profiled("engine/scan"):

@@ -220,6 +220,50 @@ def test_inactive_pods_commit_nothing():
     assert placements[7] == pallas_scan.INACTIVE
 
 
+def test_pinned_pods_force_placement():
+    """spec.nodeName pins override selection (and commit resources on
+    the pinned node even when it would not be selected); a pin outside
+    the scenario's node_valid mask makes the pod INACTIVE."""
+    reset_name_counter()
+    nodes = _nodes(16)
+    res = ResourceTypes()
+    res.stateful_sets = [sts("w", 8, anti_key="zone")]
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("t", res, nodes))
+    # pin pod 0 to node 9; pin pod 1 to node 12, which the
+    # scenario mask below disables
+    pods[0]["spec"]["nodeName"] = "n009"
+    pods[1]["spec"]["nodeName"] = "n012"
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    assert features.pins
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features, allow_terms=True)
+    assert plan is not None and plan.has_pins
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    nv = np.ones(cluster.n, bool)
+    nv[12] = False  # pod 1's pin is masked out of this scenario
+    pa = np.ones(len(pods), bool)
+    ref, _ = scan_ops.run_scan_masked(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        jnp.asarray(nv),
+        jnp.asarray(pa),
+        features=features,
+    )
+    got, _ = pallas_scan.run_scan_pallas(
+        plan, batch.class_of_pod, pa, nv, pinned=batch.pinned_node,
+        interpret=True,
+    )
+    assert (np.asarray(ref) == got).all()
+    assert got[0] == 9
+    assert got[1] == pallas_scan.INACTIVE
+
+
 def test_affinity_stress_slice():
     """A small slice of the bench's affinity-stress scenario."""
     from open_simulator_tpu.testing import build_affinity_stress

@@ -72,11 +72,26 @@ def sts(name, reps, cpu="500m", anti_key=None, aff_key=None, spread=None):
     }
 
 
-def check_case(nodes, workloads, existing=None, node_valid=None, pod_active=None):
+def check_case(
+    nodes,
+    workloads,
+    existing=None,
+    node_valid=None,
+    pod_active=None,
+    mutate_pods=None,
+    skip_out_of_scope=False,
+):
+    """Run the expanded workload through both the XLA scan and the
+    fused kernel (interpret mode) and assert identical placements.
+    `mutate_pods` may edit the expanded pod list (e.g. add nodeName
+    pins) before encoding; `skip_out_of_scope` turns a kernel-scope
+    rejection into a pytest skip (for fuzzed inputs)."""
     reset_name_counter()
     res = ResourceTypes()
     res.stateful_sets = workloads
     pods = _sort_app_pods(wl.generate_valid_pods_from_app("t", res, nodes))
+    if mutate_pods is not None:
+        mutate_pods(pods)
     oracle = Oracle(nodes)
     for p in existing or []:
         oracle.place_existing_pod(p)
@@ -85,11 +100,19 @@ def check_case(nodes, workloads, existing=None, node_valid=None, pod_active=None
     dyn = encode_dynamic(oracle, cluster)
     features = features_of_batch(cluster, batch)
     plan = pallas_scan.build_plan(cluster, batch, dyn, features, allow_terms=True)
+    if plan is None and skip_out_of_scope:
+        pytest.skip("batch out of kernel scope")
     assert plan is not None and plan.terms is not None
     static = to_scan_static(cluster, batch)
     init = to_scan_state(dyn, batch)
     nv = np.ones(cluster.n, bool) if node_valid is None else node_valid
-    pa = np.ones(len(pods), bool) if pod_active is None else pod_active
+    if pod_active is None:
+        pa = np.ones(len(pods), bool)
+    elif isinstance(pod_active, dict):
+        # box filled by mutate_pods once the expanded pod count is known
+        pa = pod_active.get("pa", np.ones(len(pods), bool))
+    else:
+        pa = pod_active
     ref, _ = scan_ops.run_scan_masked(
         static,
         init,
@@ -100,7 +123,8 @@ def check_case(nodes, workloads, existing=None, node_valid=None, pod_active=None
         features=features,
     )
     got, _ = pallas_scan.run_scan_pallas(
-        plan, batch.class_of_pod, pa, nv, interpret=True
+        plan, batch.class_of_pod, pa, nv, pinned=batch.pinned_node,
+        interpret=True,
     )
     assert (np.asarray(ref) == got).all()
     return got
@@ -224,44 +248,83 @@ def test_pinned_pods_force_placement():
     """spec.nodeName pins override selection (and commit resources on
     the pinned node even when it would not be selected); a pin outside
     the scenario's node_valid mask makes the pod INACTIVE."""
-    reset_name_counter()
-    nodes = _nodes(16)
-    res = ResourceTypes()
-    res.stateful_sets = [sts("w", 8, anti_key="zone")]
-    pods = _sort_app_pods(wl.generate_valid_pods_from_app("t", res, nodes))
-    # pin pod 0 to node 9; pin pod 1 to node 12, which the
-    # scenario mask below disables
-    pods[0]["spec"]["nodeName"] = "n009"
-    pods[1]["spec"]["nodeName"] = "n012"
-    oracle = Oracle(nodes)
-    cluster = encode_cluster(oracle)
-    batch = encode_batch(oracle, cluster, pods)
-    dyn = encode_dynamic(oracle, cluster)
-    features = features_of_batch(cluster, batch)
-    assert features.pins
-    plan = pallas_scan.build_plan(cluster, batch, dyn, features, allow_terms=True)
-    assert plan is not None and plan.has_pins
-    static = to_scan_static(cluster, batch)
-    init = to_scan_state(dyn, batch)
-    nv = np.ones(cluster.n, bool)
-    nv[12] = False  # pod 1's pin is masked out of this scenario
-    pa = np.ones(len(pods), bool)
-    ref, _ = scan_ops.run_scan_masked(
-        static,
-        init,
-        jnp.asarray(batch.class_of_pod),
-        jnp.asarray(batch.pinned_node),
-        jnp.asarray(nv),
-        jnp.asarray(pa),
-        features=features,
+
+    def pin(pods):
+        # pin pod 0 to node 9; pin pod 1 to node 12, which the
+        # scenario mask below disables
+        pods[0]["spec"]["nodeName"] = "n009"
+        pods[1]["spec"]["nodeName"] = "n012"
+
+    nv = np.ones(16, bool)
+    nv[12] = False
+    got = check_case(
+        _nodes(16), [sts("w", 8, anti_key="zone")], node_valid=nv, mutate_pods=pin
     )
-    got, _ = pallas_scan.run_scan_pallas(
-        plan, batch.class_of_pod, pa, nv, pinned=batch.pinned_node,
-        interpret=True,
-    )
-    assert (np.asarray(ref) == got).all()
     assert got[0] == 9
     assert got[1] == pallas_scan.INACTIVE
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_mixed_conformance(seed):
+    """Fuzz: random mixes of anti-affinity / required affinity / hard+
+    soft spread / pins / scenario masks must match the XLA scan
+    placement-for-placement."""
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(12, 40))
+    k_zones = int(rng.randint(2, 5))
+    nodes = [make_node(i, ZONES[i % k_zones]) for i in range(n)]
+    workloads = []
+    for w in range(rng.randint(1, 4)):
+        name = f"w{w}"
+        kind = rng.randint(0, 4)
+        kwargs = {}
+        if kind == 0:
+            kwargs["anti_key"] = rng.choice(["kubernetes.io/hostname", "zone"])
+        elif kind == 1:
+            kwargs["aff_key"] = "zone"
+        elif kind == 2:
+            kwargs["spread"] = [
+                {
+                    "maxSkew": int(rng.randint(1, 4)),
+                    "topologyKey": str(rng.choice(["zone", "kubernetes.io/hostname"])),
+                    "whenUnsatisfiable": str(
+                        rng.choice(["DoNotSchedule", "ScheduleAnyway"])
+                    ),
+                    "labelSelector": {"matchLabels": {"app": name}},
+                }
+            ]
+        else:
+            kwargs["anti_key"] = "zone"
+            kwargs["spread"] = [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": name}},
+                }
+            ]
+        workloads.append(sts(name, int(rng.randint(2, 9)), **kwargs))
+
+    # pod_active needs the expanded pod count, which mutate_pods sees
+    # first: pin a couple of pods there and draw the activity mask
+    pa_box = {}
+
+    def pin_and_mask(pods):
+        for p_i in rng.choice(len(pods), size=min(2, len(pods)), replace=False):
+            pods[p_i]["spec"]["nodeName"] = f"n{rng.randint(0, n):03d}"
+        pa = rng.rand(len(pods)) > 0.1
+        pa_box["pa"] = pa
+
+    nv = rng.rand(n) > 0.15
+    nv[0] = True
+    check_case(
+        nodes,
+        workloads,
+        node_valid=nv,
+        pod_active=pa_box,
+        mutate_pods=pin_and_mask,
+        skip_out_of_scope=True,
+    )
 
 
 def test_affinity_stress_slice():

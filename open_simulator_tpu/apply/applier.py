@@ -35,6 +35,7 @@ from ..models.decode import (
     yaml_content_from_directory,
 )
 from ..scheduler.core import AppResource, SimulateResult, simulate
+from ..utils.memo import clear_all_memos
 from .report import report
 
 MAX_NUM_NEW_NODE = wl.MAX_NUM_NEW_NODE
@@ -264,6 +265,7 @@ def probe_plan(
             max_count, score_weights,
         )
     finally:
+        clear_all_memos()
         if gc_was_enabled:
             gc.enable()
             gc.collect()

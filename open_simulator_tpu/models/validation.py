@@ -73,12 +73,23 @@ def _max_len_error(length: int) -> str:
 
 
 def _to_int(value) -> Optional[int]:
-    """int() that returns None for non-numeric input instead of
-    raising, so malformed numerics aggregate as field errors."""
-    try:
-        return int(value)
-    except (TypeError, ValueError):
+    """Integer coercion that returns None for non-integral input
+    instead of raising, so malformed numerics aggregate as field
+    errors. Floats with a fractional part (containerPort: 80.5) are
+    rejected like the real apiserver's strict int fields, not
+    truncated."""
+    if isinstance(value, bool):
         return None
+    if isinstance(value, int):
+        return value
+    if isinstance(value, float):
+        return int(value) if value.is_integer() else None
+    if isinstance(value, str):
+        try:
+            return int(value, 10)
+        except ValueError:
+            return None
+    return None
 
 
 def _is_dns1123_label(value: str) -> List[str]:
@@ -168,6 +179,16 @@ def _validate_object_meta(meta: dict, path: str, errs: _ErrorList):
     elif name:
         for m in _is_dns1123_subdomain(name):
             errs.invalid(f"{path}.name", name, m)
+    if generate_name:
+        # ValidateObjectMeta runs the name fn over generateName with
+        # prefix=true: maskTrailingDash replaces a trailing "-" (and
+        # the char before it) with "a", since a random suffix will be
+        # appended — "web--" validates as "weba".
+        candidate = generate_name
+        if len(candidate) > 1 and candidate.endswith("-"):
+            candidate = candidate[:-2] + "a"
+        for m in _is_dns1123_subdomain(candidate):
+            errs.invalid(f"{path}.generateName", generate_name, m)
     ns = meta.get("namespace")
     if ns:
         for m in _is_dns1123_label(ns):
@@ -276,6 +297,12 @@ def _validate_tolerations(tolerations: list, path: str, errs: _ErrorList):
         if op not in ("", "Equal", "Exists"):
             errs.unsupported(f"{tpath}.operator", op, ["Equal", "Exists"])
         effect = tol.get("effect") or ""
+        if tol.get("tolerationSeconds") is not None and effect != "NoExecute":
+            errs.invalid(
+                f"{tpath}.effect",
+                effect,
+                "effect must be 'NoExecute' when `tolerationSeconds` is set",
+            )
         if effect and effect not in _TAINT_EFFECTS:
             errs.unsupported(f"{tpath}.effect", effect, _TAINT_EFFECTS)
 

@@ -8,9 +8,9 @@ cluster state resident in VMEM as (R, 128) int32 tiles — per-step cost
 collapses to pure VPU arithmetic with zero kernel-launch overhead.
 
 Scope (automatic fallback to the XLA scan otherwise):
-- no GPU-share / open-local / ports / custom-plugin / scalar-resource /
-  nodeName-pin machinery (features gates, same contract as
-  ScanFeatures),
+- no GPU-share / open-local / ports / custom-plugin / scalar-resource
+  machinery (features gates, same contract as ScanFeatures); nodeName
+  pins ARE in scope (`run_scan_pallas(pinned=...)`),
 - inter-pod affinity + hard/soft topology spread ARE in scope: term
   count state rides in VMEM scratch as node-space (T, R, 128) i32
   tiles (ops/scan.py ScanState docstring), per-(class, slot) eval
@@ -56,6 +56,7 @@ state outputs return stacked as a single fetch.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -1002,10 +1003,12 @@ _COMPILED_CACHE: dict = {}
 # makes per-call host->device transfers expensive (~10ms per array;
 # a terms plan ships ~55 arrays), so transfer once per plan. Keyed by
 # id(plan) with a strong ref pinning it (utils/memo.py contract).
-_DEVICE_PLAN_CACHE: dict = {}
+# LRU-ordered: hits move-to-end so eviction under >16 live plans
+# (concurrent sweeps) targets the coldest plan, not the hot one.
+_DEVICE_PLAN_CACHE: "OrderedDict" = OrderedDict()
 
 # host-packed scenario-invariant pod-scalar rows, same identity contract
-_POD_SCAL_CACHE: dict = {}
+_POD_SCAL_CACHE: "OrderedDict" = OrderedDict()
 
 
 def _device_args(plan: PallasPlan) -> list:
@@ -1013,6 +1016,7 @@ def _device_args(plan: PallasPlan) -> list:
 
     hit = _DEVICE_PLAN_CACHE.get(id(plan))
     if hit is not None and hit[0] is plan:
+        _DEVICE_PLAN_CACHE.move_to_end(id(plan))
         return hit[1]
     args = [
         plan.alloc_mcpu, plan.alloc_mem_s, plan.alloc_eph_s, plan.alloc_pods,
@@ -1046,9 +1050,9 @@ def _device_args(plan: PallasPlan) -> list:
     with jax.enable_x64(False):
         dev = [jax.device_put(a) for a in args]
     if len(_DEVICE_PLAN_CACHE) >= 16:
-        # evict the oldest single entry; a wholesale clear would drop
-        # the device copies of plans still in active use
-        _DEVICE_PLAN_CACHE.pop(next(iter(_DEVICE_PLAN_CACHE)))
+        # evict the least-recently-used entry; a wholesale clear would
+        # drop the device copies of plans still in active use
+        _DEVICE_PLAN_CACHE.popitem(last=False)
     _DEVICE_PLAN_CACHE[id(plan)] = (plan, dev)
     return dev
 
@@ -1171,6 +1175,7 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
     memo_key = (id(plan), id(class_of_pod))
     hit = _POD_SCAL_CACHE.get(memo_key)
     if hit is not None and hit[0] is plan and hit[1] is class_of_pod:
+        _POD_SCAL_CACHE.move_to_end(memo_key)
         pod_scal = hit[2].copy()
     else:
         pod_scal = np.zeros((8, pr_rows, LANES), dtype=np.int32)
@@ -1178,7 +1183,7 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
         for s in range(6):
             pod_scal[1 + s] = pack(plan.class_scalars[cls, s])
         if len(_POD_SCAL_CACHE) >= 16:
-            _POD_SCAL_CACHE.pop(next(iter(_POD_SCAL_CACHE)))
+            _POD_SCAL_CACHE.popitem(last=False)
         _POD_SCAL_CACHE[memo_key] = (plan, class_of_pod, pod_scal.copy())
     if plan.has_pins:
         if pinned is None:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 from ..models.decode import ResourceTypes
 from ..models import workloads as wl
+from ..utils.memo import clear_all_memos
 from .oracle import Oracle
 
 
@@ -268,18 +269,24 @@ def simulate(
         extenders=extenders,
         score_weights=score_weights,
     )
-    cluster = cluster.copy()
-    failed: List[UnscheduledPod] = []
-    preemptions: List[PreemptionEvent] = []
-    result = sim.run_cluster(cluster)
-    failed.extend(result.unscheduled_pods)
-    preemptions.extend(result.preemptions)
-    for app in apps:
-        result = sim.schedule_app(app)
+    # the finally drops the memo caches' strong refs to this run's
+    # object graph so a long-lived embedder doesn't pin finished (or
+    # failed) simulations in memory; re-warming costs one pass per call
+    try:
+        cluster = cluster.copy()
+        failed: List[UnscheduledPod] = []
+        preemptions: List[PreemptionEvent] = []
+        result = sim.run_cluster(cluster)
         failed.extend(result.unscheduled_pods)
         preemptions.extend(result.preemptions)
-    return SimulateResult(
-        unscheduled_pods=failed,
-        node_status=sim.node_status(),
-        preemptions=preemptions,
-    )
+        for app in apps:
+            result = sim.schedule_app(app)
+            failed.extend(result.unscheduled_pods)
+            preemptions.extend(result.preemptions)
+        return SimulateResult(
+            unscheduled_pods=failed,
+            node_status=sim.node_status(),
+            preemptions=preemptions,
+        )
+    finally:
+        clear_all_memos()

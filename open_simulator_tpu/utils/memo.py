@@ -16,9 +16,24 @@ set per run is far below the cap.
 
 from __future__ import annotations
 
+import weakref
 from typing import Callable, Tuple
 
 _DEFAULT_MAX = 8192
+
+# every memo registers here so long-lived embedders can drop the strong
+# references to finished simulations' object graphs in one call
+_ALL_MEMOS: "weakref.WeakSet[IdentityMemo]" = weakref.WeakSet()
+
+
+def clear_all_memos():
+    """Release every memo's strong references to pod/node sub-objects.
+
+    Called at the end of simulate()/probe_plan() so a long-lived
+    process embedding the library does not pin whole simulations'
+    object graphs between runs."""
+    for memo in list(_ALL_MEMOS):
+        memo.clear()
 
 
 class IdentityMemo:
@@ -27,6 +42,7 @@ class IdentityMemo:
     def __init__(self, max_entries: int = _DEFAULT_MAX):
         self._cache: dict = {}
         self._max = max_entries
+        _ALL_MEMOS.add(self)
 
     def get(self, sources: Tuple, compute: Callable):
         key = tuple(id(s) for s in sources)

@@ -131,6 +131,53 @@ def test_container_port_range():
     assert any("must be between 1 and 65535, inclusive" in e for e in errs)
 
 
+def test_fractional_container_port_rejected():
+    # the apiserver's int fields reject non-integral numerics rather
+    # than truncating (80.5 != port 80)
+    pod = _pod()
+    pod["spec"]["containers"][0]["ports"] = [{"containerPort": 80.5}]
+    errs = pod_validation_errors(pod)
+    assert any("containerPort" in e and "Invalid value" in e for e in errs)
+    pod["spec"]["containers"][0]["ports"] = [{"containerPort": 80.0}]
+    assert pod_validation_errors(pod) == []
+
+
+def test_toleration_seconds_requires_noexecute():
+    pod = _pod(
+        tolerations=[{"key": "k", "operator": "Exists", "tolerationSeconds": 30}]
+    )
+    errs = pod_validation_errors(pod)
+    assert any(
+        "effect must be 'NoExecute' when `tolerationSeconds` is set" in e
+        for e in errs
+    )
+    ok = _pod(
+        tolerations=[
+            {
+                "key": "k",
+                "operator": "Exists",
+                "effect": "NoExecute",
+                "tolerationSeconds": 30,
+            }
+        ]
+    )
+    assert pod_validation_errors(ok) == []
+
+
+def test_generate_name_syntax_validated():
+    pod = _pod()
+    del pod["metadata"]["name"]
+    pod["metadata"]["generateName"] = "ok-prefix-"
+    assert pod_validation_errors(pod) == []
+    pod["metadata"]["generateName"] = "Bad_Prefix-"
+    errs = pod_validation_errors(pod)
+    assert any("metadata.generateName" in e for e in errs)
+    # maskTrailingDash: "web--" masks to "weba", which is valid — the
+    # appended random suffix makes the final name legal
+    pod["metadata"]["generateName"] = "web--"
+    assert pod_validation_errors(pod) == []
+
+
 def test_validate_pod_raises_wrapped():
     pod = _pod()
     pod["metadata"]["name"] = ""

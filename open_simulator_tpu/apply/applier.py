@@ -411,6 +411,14 @@ class Applier:
         )
 
     def run(self, select_apps=None) -> ApplyResult:
+        # release the identity memos' strong refs to this run's object
+        # graph at exit (the serial guesses inside rely on them warm)
+        try:
+            return self._run_inner(select_apps)
+        finally:
+            clear_all_memos()
+
+    def _run_inner(self, select_apps=None) -> ApplyResult:
         from ..utils.trace import GLOBAL, phase
 
         # per-run phase times, not cumulative across runs in one process

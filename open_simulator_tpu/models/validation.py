@@ -73,17 +73,16 @@ def _max_len_error(length: int) -> str:
 
 
 def _to_int(value) -> Optional[int]:
-    """Integer coercion that returns None for non-integral input
+    """Integer coercion that returns None for non-integer input
     instead of raising, so malformed numerics aggregate as field
-    errors. Floats with a fractional part (containerPort: 80.5) are
-    rejected like the real apiserver's strict int fields, not
-    truncated."""
+    errors. ALL floats are rejected (even integral 80.0): the real
+    apiserver's strict JSON decode refuses any float into an int
+    field, so truncating or accepting here would pass manifests a
+    real cluster rejects."""
     if isinstance(value, bool):
         return None
     if isinstance(value, int):
         return value
-    if isinstance(value, float):
-        return int(value) if value.is_integer() else None
     if isinstance(value, str):
         try:
             return int(value, 10)

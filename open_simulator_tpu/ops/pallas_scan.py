@@ -1010,6 +1010,13 @@ _DEVICE_PLAN_CACHE: "OrderedDict" = OrderedDict()
 # host-packed scenario-invariant pod-scalar rows, same identity contract
 _POD_SCAL_CACHE: "OrderedDict" = OrderedDict()
 
+# both caches pin finished plans (host numpy + device buffers) until
+# eviction; release them with the memos at the planner boundary
+from ..utils.memo import register_cache as _register_cache  # noqa: E402
+
+_register_cache(_DEVICE_PLAN_CACHE.clear)
+_register_cache(_POD_SCAL_CACHE.clear)
+
 
 def _device_args(plan: PallasPlan) -> list:
     import jax
@@ -1154,9 +1161,11 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
                 scratch_shapes=scratch,
                 interpret=interpret,
             )(*arrays)
-            # one stacked state array: the host fetch is 2 blocking
-            # transfers instead of 7 (each costs ~0.1s on the relay)
-            return outs[0], jnp.stack(outs[1:])
+            # ONE output array (placements + 6 states concatenated on
+            # the row axis): every host-blocking point on the relay
+            # costs ~0.1s regardless of size, so the whole call must
+            # have exactly one — the single fetch below
+            return jnp.concatenate(outs, axis=0)
 
         cached = _Compiled(fn=call)
         _COMPILED_CACHE[key] = cached
@@ -1200,10 +1209,14 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
     # and Mosaic's convert rules recurse on x64-promoted loop indices —
     # trace and run with x64 off
     with jax.enable_x64(False):
-        inp = jax.device_put((pod_scal, active_2d, valid))
-        place_d, states_d = cached.fn(*inp, *_device_args(plan))
-        place = np.asarray(place_d)
-        states = np.asarray(states_d)
+        # per-call inputs ride as numpy straight into the dispatch: an
+        # explicit device_put is a second host-blocking relay roundtrip
+        # (~0.1s); the implicit transfer pipelines with the dispatch so
+        # the single np.asarray fetch is the call's only sync point
+        out_d = cached.fn(pod_scal, active_2d, valid, *_device_args(plan))
+        out = np.asarray(out_d)
+    place = out[:pr_rows]
+    states = out[pr_rows:]
     place = place.reshape(-1)[:p_total]
     # map padded slots: any placement index beyond n means "no node"
     place = np.where((place >= 0) & (place >= plan.n), -1, place)

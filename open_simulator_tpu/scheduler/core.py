@@ -28,7 +28,6 @@ from typing import Dict, List, Optional
 
 from ..models.decode import ResourceTypes
 from ..models import workloads as wl
-from ..utils.memo import clear_all_memos
 from .oracle import Oracle
 
 
@@ -269,24 +268,25 @@ def simulate(
         extenders=extenders,
         score_weights=score_weights,
     )
-    # the finally drops the memo caches' strong refs to this run's
-    # object graph so a long-lived embedder doesn't pin finished (or
-    # failed) simulations in memory; re-warming costs one pass per call
-    try:
-        cluster = cluster.copy()
-        failed: List[UnscheduledPod] = []
-        preemptions: List[PreemptionEvent] = []
-        result = sim.run_cluster(cluster)
+    # NOTE: the identity memos are deliberately NOT cleared here — the
+    # planner's serial bisection calls simulate() once per guess over
+    # the same object graphs and relies on warm caches. The planner
+    # entry points (Applier.run, probe_plan) clear at their boundary;
+    # long-lived embedders calling simulate() directly should call
+    # utils.memo.clear_all_memos() between runs to release the caches'
+    # strong refs to pod/node sub-objects.
+    cluster = cluster.copy()
+    failed: List[UnscheduledPod] = []
+    preemptions: List[PreemptionEvent] = []
+    result = sim.run_cluster(cluster)
+    failed.extend(result.unscheduled_pods)
+    preemptions.extend(result.preemptions)
+    for app in apps:
+        result = sim.schedule_app(app)
         failed.extend(result.unscheduled_pods)
         preemptions.extend(result.preemptions)
-        for app in apps:
-            result = sim.schedule_app(app)
-            failed.extend(result.unscheduled_pods)
-            preemptions.extend(result.preemptions)
-        return SimulateResult(
-            unscheduled_pods=failed,
-            node_status=sim.node_status(),
-            preemptions=preemptions,
-        )
-    finally:
-        clear_all_memos()
+    return SimulateResult(
+        unscheduled_pods=failed,
+        node_status=sim.node_status(),
+        preemptions=preemptions,
+    )

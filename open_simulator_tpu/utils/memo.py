@@ -22,18 +22,31 @@ from typing import Callable, Tuple
 _DEFAULT_MAX = 8192
 
 # every memo registers here so long-lived embedders can drop the strong
-# references to finished simulations' object graphs in one call
+# references to finished simulations' object graphs in one call;
+# other identity-keyed caches (e.g. the pallas device-plan caches)
+# register their own clear() via register_cache
 _ALL_MEMOS: "weakref.WeakSet[IdentityMemo]" = weakref.WeakSet()
+_EXTRA_CACHES: list = []
+
+
+def register_cache(clear_fn):
+    """Register an extra cache-clearing callback run by
+    clear_all_memos (for identity-keyed caches outside this module
+    that pin run-scoped objects — same contract)."""
+    _EXTRA_CACHES.append(clear_fn)
 
 
 def clear_all_memos():
     """Release every memo's strong references to pod/node sub-objects.
 
-    Called at the end of simulate()/probe_plan() so a long-lived
-    process embedding the library does not pin whole simulations'
-    object graphs between runs."""
+    Called at the planner boundaries (Applier.run, probe_plan) so a
+    long-lived process embedding the library does not pin whole
+    simulations' object graphs between runs. Library users driving
+    simulate() directly can call this themselves."""
     for memo in list(_ALL_MEMOS):
         memo.clear()
+    for fn in _EXTRA_CACHES:
+        fn()
 
 
 class IdentityMemo:

@@ -131,15 +131,14 @@ def test_container_port_range():
     assert any("must be between 1 and 65535, inclusive" in e for e in errs)
 
 
-def test_fractional_container_port_rejected():
-    # the apiserver's int fields reject non-integral numerics rather
-    # than truncating (80.5 != port 80)
+def test_float_container_port_rejected():
+    # the apiserver's strict JSON decode refuses ANY float into an int
+    # field — fractional or integral — rather than truncating
     pod = _pod()
-    pod["spec"]["containers"][0]["ports"] = [{"containerPort": 80.5}]
-    errs = pod_validation_errors(pod)
-    assert any("containerPort" in e and "Invalid value" in e for e in errs)
-    pod["spec"]["containers"][0]["ports"] = [{"containerPort": 80.0}]
-    assert pod_validation_errors(pod) == []
+    for bad in (80.5, 80.0):
+        pod["spec"]["containers"][0]["ports"] = [{"containerPort": bad}]
+        errs = pod_validation_errors(pod)
+        assert any("containerPort" in e and "Invalid value" in e for e in errs)
 
 
 def test_toleration_seconds_requires_noexecute():

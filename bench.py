@@ -127,15 +127,20 @@ def build_scenario():
     return nodes, pods
 
 
-def build_affinity_scenario():
+def build_affinity_scenario(n_nodes=2000, replicas=20):
     """SIMON_BENCH=affinity: the 100-StatefulSet anti-affinity +
-    topology-spread stress from BASELINE.md, expanded to pods."""
+    topology-spread stress from BASELINE.md, expanded to pods. The
+    `all` scenario also runs it at 10k nodes x 10k pods (replicas=100)
+    to record the BASELINE "pods scheduled/sec at 10k nodes" figure on
+    the term machinery."""
     from open_simulator_tpu.models import workloads as wl
     from open_simulator_tpu.models.decode import ResourceTypes
     from open_simulator_tpu.scheduler.core import _sort_app_pods
     from open_simulator_tpu.testing import build_affinity_stress
 
-    nodes, stss = build_affinity_stress(n_nodes=2000, n_sts=100, replicas=20, zones=16)
+    nodes, stss = build_affinity_stress(
+        n_nodes=n_nodes, n_sts=100, replicas=replicas, zones=16
+    )
     res = ResourceTypes()
     res.stateful_sets = stss
     pods = _sort_app_pods(wl.generate_valid_pods_from_app("stress", res, nodes))
@@ -568,6 +573,8 @@ def main():
         rd = _scan_rate(nodes, pods, "default")
         nodes, pods = build_affinity_scenario()
         ra = _scan_rate(nodes, pods, "affinity")
+        nodes, pods = build_affinity_scenario(n_nodes=10_000, replicas=100)
+        ra10 = _scan_rate(nodes, pods, "affinity-10k")
         nodes, pods = build_gpushare_scenario()
         rg = _scan_rate(nodes, pods, "gpushare")
         d = run_defrag()
@@ -577,7 +584,8 @@ def main():
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
             f"incl. expansion+encode+probes+replay+report; best of 2 runs; "
             f"also: default scan {rd['pods_per_sec']:.0f} pods/s at 10k nodes, "
-            f"affinity-stress {ra['pods_per_sec']:.0f} pods/s at 2k nodes, "
+            f"affinity-stress {ra['pods_per_sec']:.0f} pods/s at 2k nodes "
+            f"and {ra10['pods_per_sec']:.0f} pods/s at 10k nodes, "
             f"gpushare {rg['pods_per_sec']:.0f} pods/s at {rg['nodes']} 8-GPU nodes, "
             f"defrag sweep {d['elapsed_s']:.2f}s/{d['drained']} drained at {d['nodes']} nodes, "
             f"8-spec what-if {w['elapsed_s']:.2f}s)",

@@ -76,15 +76,23 @@ class TermsCfg(NamedTuple):
     """Static shape/slot configuration of the term machinery (part of
     the compiled-kernel cache key)."""
 
-    t: int  # term rows
+    t: int  # logical term rows (bit positions)
+    td: int  # distinct topology tiles
+    tc: int  # count-state rows (rows some consumer reads as counts)
+    tp: int  # pref-state rows (rows with preferred weights)
+    bp: int  # bitplane count = ceil(t / 32)
     a: int  # required-affinity group rows
     gn: int  # group count
-    ch: int  # hard spread instances
-    cs: int  # soft spread instances
+    csn: int  # non-hostname soft instances (with dedicated count state)
+    cd: int  # distinct hard-spread candidate tiles
+    sqd: int  # distinct soft qualifying-node tiles
+    hkd: int  # distinct has-all-soft-keys tiles
     rmax: int  # per-class relevant-row slots
     gmax: int  # per-class group-row slots
     hmax: int  # per-class hard slots
     smax: int  # per-class soft slots
+    cmax: int  # per-class commit slots
+    scmax: int  # per-class non-host soft commit slots
     vs: int  # non-hostname soft vocab size
     has_ipa: bool
     has_hard: bool
@@ -92,53 +100,74 @@ class TermsCfg(NamedTuple):
 
 
 class TermsPlan(NamedTuple):
-    """Term-machinery arrays for the fused kernel: node-space count
-    state as (T, R, 128) i32 tiles (ops/scan.py ScanState docstring),
-    per-class tables lane-padded for masked-reduce scalar reads."""
+    """Term-machinery arrays for the fused kernel.
+
+    Memory design (v3): count state is kept ONLY for rows some consumer
+    reads as counts (score carries, hard/soft spread); rows tested only
+    as `> 0` (required anti-affinity existence, own-anti targets) live
+    in int32 BITPLANES — exact, because those states are monotone under
+    the scan's commit-only updates. Static (R, C) tiles (topology
+    values, spread candidates, qualifying nodes, has-keys masks, class
+    tables) are deduplicated to their distinct rows with host-resolved
+    SMEM indices. Commits are SPARSE: each class carries at most cmax
+    (row, update) slots instead of a dense (T, R, C) broadcast. This
+    removes the T-proportional VMEM and per-step commit cost that
+    barred term-heavy batches at 10k nodes from the fused kernel."""
 
     cfg: TermsCfg
-    topo3: np.ndarray  # (T, R, C) i32, -1 = key missing
-    tgt0: np.ndarray  # (T, R, C) i32 init counts
-    own_anti0: np.ndarray  # (T, R, C)
-    own_pref0: np.ndarray  # (T, R, C) combined (scan.py ScanState)
-    own_panti0: np.ndarray  # (T, R, C)
-    # commit tables: column u is read per step, vectorized over T
-    term_match_tu: np.ndarray  # (T, Up) i32
-    carry_anti_tu: np.ndarray  # (T, Up)
-    carry_prefc_tu: np.ndarray  # (T, Up) prefolded commit increment
-    carry_panti_tu: np.ndarray  # (T, Up)
-    # SMEM slot tables: every per-(row, class) eval scalar prefolded to
-    # (U, slot) so the kernel's unrolled slot loops do scalar SMEM
-    # loads instead of masked VPU reduces (~40 reduces/step saved)
-    slot_rows: np.ndarray  # (U, Rmax) i32 cls_rows
-    slot_m: np.ndarray  # (U, Rmax) term_match[row, u]
-    slot_cpaff: np.ndarray  # (U, Rmax) carry_aff_pref_w[row, u]
-    slot_cpanti: np.ndarray  # (U, Rmax)
-    slot_canti: np.ndarray  # (U, Rmax)
-    gid_u: np.ndarray  # (U,)
-    self_ok_u: np.ndarray  # (U,) match_all[gid, u]
-    slot_grows: np.ndarray  # (U, Gmax)
-    slot_h: np.ndarray  # (U, Hmax)
-    slot_hself: np.ndarray  # (U, Hmax) h_self[h, u]
-    h_row_s: np.ndarray  # (Ch,)
-    h_skew_s: np.ndarray  # (Ch,)
-    slot_s: np.ndarray  # (U, Smax)
-    s_row_s: np.ndarray  # (Cs,)
-    s_is_host_s: np.ndarray  # (Cs,)
-    s_skew_s: np.ndarray  # (Cs,)
-    # groups
-    g_topo3: np.ndarray  # (A, R, C)
+    # --- VMEM tiles -------------------------------------------------
+    topo_dist: np.ndarray  # (Td, R, C) i32 distinct topo values, -1 = missing
+    g_topo3: np.ndarray  # (A, R, C) group-row topo values (dense, A small)
+    cand_dist: np.ndarray  # (Cd, R, C) distinct hard candidate masks
+    sq_dist: np.ndarray  # (Sqd, R, C) distinct soft qualifying masks
+    hk_dist: np.ndarray  # (Hkd, R, C) distinct has-all-soft-keys masks
+    g_match_au: np.ndarray  # (A, Up) = match_all[group_of_row] (commit)
+    # --- state inits (ANY memory; DMAed into scratch) ----------------
+    tgt0_c: np.ndarray  # (Tc, R, C) init counts for count rows
+    pref0_p: np.ndarray  # (Tp, R, C) combined preferred init
+    panti0_p: np.ndarray  # (Tp, R, C)
+    antib0: np.ndarray  # (Bp, R, C) init anti>0 bitplanes
+    tposb0: np.ndarray  # (Bp, R, C) init tgt>0 bitplanes
     group0: np.ndarray  # (A, R, C)
     gtot0: np.ndarray  # (A, 8, 128) per-group-row totals, replicated
-    g_match_au: np.ndarray  # (A, Up) = match_all[group_of_row]
-    # hard spread (term-row values read from topo3 via h_row_s)
-    cand3: np.ndarray  # (Ch, R, C) candidate nodes
-    # soft spread
-    soft0: np.ndarray  # (Cs, R, C)
-    s_topo3: np.ndarray  # (Cs, R, C)
-    s_q3: np.ndarray  # (Cs, R, C)
-    s_match_cu: np.ndarray  # (Cs, Up) = term_match[s_row] (commit)
-    haskeys3: np.ndarray  # (U, R, C)
+    soft0_nh: np.ndarray  # (Csn, R, C) init counts, non-host soft instances
+    # --- SMEM eval slot tables (U, Rmax/Gmax/Hmax/Smax) --------------
+    e_cnt: np.ndarray  # (U, Rmax) tgt_cnt idx (-1 = no count read)
+    e_pref: np.ndarray  # (U, Rmax) pref idx (-1 = no pref read; folds match)
+    e_cpd: np.ndarray  # (U, Rmax) carry_aff_pref_w - carry_anti_pref_w
+    e_antip: np.ndarray  # (U, Rmax) anti bitplane idx
+    e_antib: np.ndarray  # (U, Rmax) anti bitmask (0 = no test; folds m)
+    e_tposp: np.ndarray  # (U, Rmax) tgt>0 plane idx
+    e_tposb: np.ndarray  # (U, Rmax) tgt>0 bitmask (0 = no test; folds canti)
+    gid_u: np.ndarray  # (U,)
+    self_ok_u: np.ndarray  # (U,) match_all[gid, u]
+    slot_grows: np.ndarray  # (U, Gmax) A-row idx
+    h_topo: np.ndarray  # (U, Hmax) topo_dist idx (-1 = inactive)
+    h_cnt: np.ndarray  # (U, Hmax) tgt_cnt idx
+    h_cand: np.ndarray  # (U, Hmax) cand_dist idx
+    h_skew: np.ndarray  # (U, Hmax) max skew
+    h_selfm: np.ndarray  # (U, Hmax) h_self[h, u]
+    s_topo_i: np.ndarray  # (U, Smax) topo_dist idx (-1 = inactive)
+    s_ishost: np.ndarray  # (U, Smax)
+    s_cnt: np.ndarray  # (U, Smax) tgt_cnt idx (host rows; -1 otherwise)
+    s_nh: np.ndarray  # (U, Smax) soft_nh idx (non-host; -1 otherwise)
+    s_skewm1: np.ndarray  # (U, Smax) max_skew - 1 (prefolded)
+    # --- SMEM commit slot tables (U, Cmax) ---------------------------
+    c_topo: np.ndarray  # topo_dist idx (-1 = inactive slot)
+    c_cnt: np.ndarray  # tgt_cnt idx (-1 = no count update)
+    c_pref: np.ndarray  # pref idx (-1 = no pref update)
+    c_m: np.ndarray  # match increment
+    c_prefc: np.ndarray  # combined preferred commit increment
+    c_pantic: np.ndarray  # anti-preferred commit increment
+    c_antip: np.ndarray  # anti plane idx
+    c_antib: np.ndarray  # anti bitmask (0 = no bit set)
+    c_tposp: np.ndarray  # tgt>0 plane idx
+    c_tposb: np.ndarray  # tgt>0 bitmask (0 = no bit set)
+    # --- SMEM non-host soft commit slots (U, SCmax) ------------------
+    sc_nh: np.ndarray  # soft_nh idx (-1 = inactive)
+    sc_topo: np.ndarray  # topo_dist idx
+    sc_q: np.ndarray  # sq_dist idx
+    sc_m: np.ndarray  # match increment
     # f64 log-weight tables split for double-single arithmetic:
     # w = log(sz+2) computed in f64 on host; hi/lo f32 split, hi further
     # split into 12-bit halves h1+h2 for exact f32 products; 1-D SMEM
@@ -161,12 +190,15 @@ class PallasPlan(NamedTuple):
     alloc_eph_s: np.ndarray
     alloc_pods: np.ndarray
     alloc_nzmem_s: np.ndarray  # nz-scaled (balanced/least denominator)
-    # [U, R, C] class tables
-    static_feasible: np.ndarray
-    simon_raw: np.ndarray
-    nodeaff_raw: np.ndarray
-    taint_intol: np.ndarray
-    base_score: np.ndarray  # prefolded image*w_image + avoid*w_avoid
+    # class tables, deduplicated to distinct rows; clsmap (SMEM) maps
+    # class u -> row per table: 0=feas 1=simon 2=base 3=nodeaff 4=taint
+    # 5=haskeys (terms) 6/7 spare
+    static_feasible: np.ndarray  # (Fd, R, C)
+    simon_raw: np.ndarray  # (Sd, R, C)
+    nodeaff_raw: np.ndarray  # (Nad, R, C)
+    taint_intol: np.ndarray  # (Ttd, R, C)
+    base_score: np.ndarray  # (Bd, R, C) prefolded image*w_image + avoid*w_avoid
+    clsmap: np.ndarray  # (8, Up) i32
     # [U, 8] class scalars: req_mcpu, req_mem_s, req_eph_s, nz_mcpu,
     # nz_mem_s, has_request, 0, 0
     class_scalars: np.ndarray
@@ -242,13 +274,50 @@ def _pad_stack(tab: np.ndarray, r: int, fill=0) -> np.ndarray:
 
 # slot-count caps keep the kernel's static unrolled loops small; a batch
 # beyond them falls back to the XLA scan
-_MAX_SLOTS = dict(rmax=8, gmax=4, hmax=4, smax=4, a=8, gn=8, vs=32)
+_MAX_SLOTS = dict(rmax=8, gmax=4, hmax=4, smax=4, a=8, gn=8, vs=32,
+                  cmax=8, scmax=4)
 _MAX_COUNT = 1 << 17  # cnt exact-split bound for the soft f64 emulation
 _MAX_T = 512
 
 
-def _build_terms(batch, features, r: int, p_total: int, n: int) -> Optional[TermsPlan]:
-    """Term-machinery plan, or None when out of the kernel's scope."""
+def _dedup_rows(tab: np.ndarray):
+    """(X, N) -> (distinct (D, N), idx[X]) by row content."""
+    if tab.shape[0] == 0:
+        return tab.reshape(0, tab.shape[1]), np.zeros(0, dtype=np.int32)
+    seen: dict = {}
+    idx = np.zeros(tab.shape[0], dtype=np.int32)
+    rows = []
+    for i in range(tab.shape[0]):
+        key = tab[i].tobytes()
+        j = seen.get(key)
+        if j is None:
+            j = len(rows)
+            seen[key] = j
+            rows.append(tab[i])
+        idx[i] = j
+    return np.stack(rows), idx
+
+
+def _bit(r: int) -> int:
+    """int32 bitmask for logical row r (bit r & 31 of plane r >> 5)."""
+    return int(np.uint32(1 << (r & 31)).view(np.int32))
+
+
+def _pack_bitplanes(mask_tn: np.ndarray) -> np.ndarray:
+    """(T, N) bool -> (ceil(T/32), N) int32 planes, row r at bit r&31
+    of plane r>>5."""
+    t_rows, n_cols = mask_tn.shape
+    bp = max(-(-t_rows // 32), 1)
+    planes = np.zeros((bp, n_cols), dtype=np.uint32)
+    for r_i in range(t_rows):
+        planes[r_i >> 5] |= mask_tn[r_i].astype(np.uint32) << np.uint32(r_i & 31)
+    return planes.view(np.int32)
+
+
+def _build_terms(batch, features, r: int, p_total: int, n: int):
+    """Term-machinery plan (see TermsPlan docstring for the memory
+    design) plus the per-class haskeys map, or None when out of the
+    kernel's scope."""
     t = batch.terms
     has_ipa = bool(features.ipa)
     has_hard = bool(features.hard_spread)
@@ -260,25 +329,24 @@ def _build_terms(batch, features, r: int, p_total: int, n: int) -> Optional[Term
         return None
     if t.a > _MAX_SLOTS["a"] or len(t.match_all) > _MAX_SLOTS["gn"]:
         return None
-    if batch.u > LANES or t.ch > 120 or t.cs > 120:
+    if batch.u > LANES:
         return None  # lane-table reads assume one 128-lane row
 
     from .encode import _value_to_node_space
     from .terms import combined_pref_carry, combined_pref_init
 
     tv = t.topo_val
-    tgt0 = _value_to_node_space(t.init_tgt, tv)
-    own_anti0 = _value_to_node_space(t.init_own_anti_req, tv)
-    own_pref0 = _value_to_node_space(combined_pref_init(t), tv)
-    own_panti0 = _value_to_node_space(t.init_own_anti_pref_w, tv)
-    group0 = _value_to_node_space(t.init_group_counts, tv[t.group_rows])
-    soft0 = _value_to_node_space(t.init_soft_counts, tv[t.s_row])
+    u_n = batch.u
     carry_prefc = combined_pref_carry(t)
+    pref_init = combined_pref_init(t)
 
     # int32 exactness bounds (documented in the module docstring)
-    cnt_max = int(tgt0.max(initial=0)) + p_total
+    tgt0_all = _value_to_node_space(t.init_tgt, tv)
+    pref0_all = _value_to_node_space(pref_init, tv)
+    panti0_all = _value_to_node_space(t.init_own_anti_pref_w, tv)
+    cnt_max = int(tgt0_all.max(initial=0)) + p_total
     pref_max = int(
-        max(own_pref0.max(initial=0), own_panti0.max(initial=0))
+        max(pref0_all.max(initial=0), panti0_all.max(initial=0))
     ) + p_total * int(
         max(np.abs(carry_prefc).max(initial=0), np.abs(t.carry_anti_pref_w).max(initial=0), 1)
     )
@@ -303,21 +371,231 @@ def _build_terms(batch, features, r: int, p_total: int, n: int) -> Optional[Term
         if vs > _MAX_SLOTS["vs"]:
             return None
 
-    # VMEM budget (~16MB/core): persistent tiles = topo + 4 state
-    # scratches + group/soft scratch + cand/s_topo/s_q/haskeys + the
-    # base kernel's class tables (feas/simon/base; na/tt only when
-    # used). Init-state INPUTS live in ANY (HBM) and are DMAed into
-    # the scratches once, so they do not double-count.
-    tiles = (
-        5 * t.t  # topo3 + tgt/anti/pref/panti scratch
-        + 2 * t.a
-        + (3 * t.cs if has_soft else 0)  # soft scratch + s_topo + s_q
-        + (t.ch if has_hard else 0)
-        + (batch.u if has_soft else 0)  # haskeys
-        + 3 * batch.u  # feas + simon + base
+    # -- row storage classification ----------------------------------
+    # count rows: some consumer reads them as COUNTS — score carries
+    # (cpd != 0), hard-spread instances, host-topology soft instances.
+    # pref rows: any preferred-weight data (init or carry).
+    # Everything else is tested only as `> 0` and lives in bitplanes.
+    cpd_tu = (t.carry_aff_pref_w - t.carry_anti_pref_w).astype(np.int64)
+    cnt_need = np.zeros(t.t, dtype=bool)
+    cnt_need[np.nonzero((cpd_tu != 0).any(axis=1))[0]] = True
+    if has_hard:
+        used_h = np.unique(t.cls_h_rows[t.cls_h_rows >= 0])
+        cnt_need[t.h_row[used_h]] = True
+    if has_soft:
+        used_s = np.unique(t.cls_s_rows[t.cls_s_rows >= 0])
+        host_s = used_s[t.s_is_host[used_s]]
+        cnt_need[t.s_row[host_s]] = True
+    pref_need = (
+        (pref_init != 0).any(axis=1)
+        | (t.init_own_anti_pref_w != 0).any(axis=1)
+        | (carry_prefc != 0).any(axis=1)
+        | (t.carry_anti_pref_w != 0).any(axis=1)
     )
-    if tiles * r * LANES * 4 > 13 * 2**20:
+    cnt_idx = np.full(t.t, -1, dtype=np.int32)
+    cnt_rows = np.nonzero(cnt_need)[0]
+    cnt_idx[cnt_rows] = np.arange(len(cnt_rows))
+    pref_idx = np.full(t.t, -1, dtype=np.int32)
+    pref_rows = np.nonzero(pref_need)[0]
+    pref_idx[pref_rows] = np.arange(len(pref_rows))
+    tc_n = max(len(cnt_rows), 1)
+    tp_n = max(len(pref_rows), 1)
+    bp_n = max(-(-t.t // 32), 1)
+
+    # early VMEM pre-gate: the scratch state alone is a lower bound on
+    # the final tile count (build_plan re-checks exactly); rejecting
+    # here skips the O(U*T) slot-table construction for hopeless plans
+    scratch_tiles = tc_n + 2 * tp_n + 2 * bp_n + t.a
+    if scratch_tiles * r * LANES * 4 > 13 * 2**20:
         return None
+
+    # -- static dedup --------------------------------------------------
+    topo_dist, topo_idx = _dedup_rows(tv)
+    td_n = topo_dist.shape[0]
+    cand_dist, cand_idx = _dedup_rows(t.h_cand_nodes.astype(np.int32))
+    cd_n = max(cand_dist.shape[0], 1)
+    hk_dist, hk_map = _dedup_rows(t.cls_s_haskeys.astype(np.int32))
+    hkd_n = max(hk_dist.shape[0], 1)
+
+    # -- non-host soft instances --------------------------------------
+    nh_mask = ~t.s_is_host
+    nh_insts = np.nonzero(nh_mask)[0]
+    nh_idx = np.full(t.cs, -1, dtype=np.int32)
+    nh_idx[nh_insts] = np.arange(len(nh_insts))
+    csn_n = max(len(nh_insts), 1)
+    if len(nh_insts):
+        sq_dist, sq_idx_nh = _dedup_rows(t.s_q[nh_insts].astype(np.int32))
+        sq_idx = np.full(t.cs, -1, dtype=np.int32)
+        sq_idx[nh_insts] = sq_idx_nh
+        soft0_nh = _value_to_node_space(
+            t.init_soft_counts[nh_insts], tv[t.s_row[nh_insts]]
+        )
+    else:
+        sq_dist = np.zeros((1, n), dtype=np.int32)
+        sq_idx = np.full(t.cs, -1, dtype=np.int32)
+        soft0_nh = np.zeros((1, n), dtype=np.int64)
+    sqd_n = max(sq_dist.shape[0], 1)
+
+    # -- eval slot tables (resolved storage indices) -------------------
+    rmax = t.rmax
+    e_cnt = np.full((u_n, rmax), -1, dtype=np.int32)
+    e_pref = np.full((u_n, rmax), -1, dtype=np.int32)
+    e_cpd = np.zeros((u_n, rmax), dtype=np.int64)
+    e_antip = np.zeros((u_n, rmax), dtype=np.int32)
+    e_antib = np.zeros((u_n, rmax), dtype=np.int32)
+    e_tposp = np.zeros((u_n, rmax), dtype=np.int32)
+    e_tposb = np.zeros((u_n, rmax), dtype=np.int32)
+    for u_i in range(u_n):
+        for k in range(rmax):
+            row = int(t.cls_rows[u_i, k])
+            if row < 0:
+                continue
+            cpd = int(cpd_tu[row, u_i])
+            e_cpd[u_i, k] = cpd
+            if cpd != 0:
+                e_cnt[u_i, k] = cnt_idx[row]
+            m_k = bool(t.match[row, u_i])
+            if m_k and pref_idx[row] >= 0:
+                e_pref[u_i, k] = pref_idx[row]
+            e_antip[u_i, k] = row >> 5
+            e_tposp[u_i, k] = row >> 5
+            if m_k:
+                e_antib[u_i, k] = _bit(row)
+            if int(t.carry_anti_req[row, u_i]) > 0:
+                e_tposb[u_i, k] = _bit(row)
+
+    # -- commit slot tables --------------------------------------------
+    # bit updates are emitted only for rows some class actually tests:
+    # fail_exist tests anti bits on matched rows, fail_own tests tgt>0
+    # bits on rows the class carries required anti-affinity for
+    tested_exist = t.match.any(axis=1)
+    tested_own = (t.carry_anti_req > 0).any(axis=1)
+    commit_slots: list = [[] for _ in range(u_n)]
+    for u_i in range(u_n):
+        for row in range(t.t):
+            m_i = int(t.match[row, u_i])
+            prefc = int(carry_prefc[row, u_i])
+            pantic = int(t.carry_anti_pref_w[row, u_i])
+            canti = int(t.carry_anti_req[row, u_i])
+            upd_cnt = bool(m_i) and cnt_idx[row] >= 0
+            upd_pref = (prefc != 0 or pantic != 0) and pref_idx[row] >= 0
+            upd_anti = canti > 0 and bool(tested_exist[row])
+            upd_tpos = bool(m_i) and bool(tested_own[row])
+            if not (upd_cnt or upd_pref or upd_anti or upd_tpos):
+                continue
+            commit_slots[u_i].append(
+                dict(
+                    topo=int(topo_idx[row]),
+                    cnt=int(cnt_idx[row]) if upd_cnt else -1,
+                    pref=int(pref_idx[row]) if upd_pref else -1,
+                    m=m_i,
+                    prefc=prefc,
+                    pantic=pantic,
+                    antip=row >> 5,
+                    antib=_bit(row) if upd_anti else 0,
+                    tposp=row >> 5,
+                    tposb=_bit(row) if upd_tpos else 0,
+                )
+            )
+    cmax = max((len(s) for s in commit_slots), default=0)
+    cmax = max(cmax, 1)
+    if cmax > _MAX_SLOTS["cmax"]:
+        return None
+    c_topo = np.full((u_n, cmax), -1, dtype=np.int32)
+    c_cnt = np.full((u_n, cmax), -1, dtype=np.int32)
+    c_pref = np.full((u_n, cmax), -1, dtype=np.int32)
+    c_m = np.zeros((u_n, cmax), dtype=np.int32)
+    c_prefc = np.zeros((u_n, cmax), dtype=np.int32)
+    c_pantic = np.zeros((u_n, cmax), dtype=np.int32)
+    c_antip = np.zeros((u_n, cmax), dtype=np.int32)
+    c_antib = np.zeros((u_n, cmax), dtype=np.int32)
+    c_tposp = np.zeros((u_n, cmax), dtype=np.int32)
+    c_tposb = np.zeros((u_n, cmax), dtype=np.int32)
+    for u_i, slots in enumerate(commit_slots):
+        for j, s in enumerate(slots):
+            c_topo[u_i, j] = s["topo"]
+            c_cnt[u_i, j] = s["cnt"]
+            c_pref[u_i, j] = s["pref"]
+            c_m[u_i, j] = s["m"]
+            c_prefc[u_i, j] = s["prefc"]
+            c_pantic[u_i, j] = s["pantic"]
+            c_antip[u_i, j] = s["antip"]
+            c_antib[u_i, j] = s["antib"]
+            c_tposp[u_i, j] = s["tposp"]
+            c_tposb[u_i, j] = s["tposb"]
+
+    # non-host soft commit slots
+    sc_slots: list = [[] for _ in range(u_n)]
+    if has_soft and len(nh_insts):
+        for u_i in range(u_n):
+            for inst in nh_insts:
+                row = int(t.s_row[inst])
+                if not t.match[row, u_i]:
+                    continue
+                sc_slots[u_i].append(
+                    dict(nh=int(nh_idx[inst]), topo=int(topo_idx[row]),
+                         q=int(sq_idx[inst]), m=1)
+                )
+    scmax = max((len(s) for s in sc_slots), default=0)
+    scmax = max(scmax, 1)
+    if scmax > _MAX_SLOTS["scmax"]:
+        return None
+    sc_nh = np.full((u_n, scmax), -1, dtype=np.int32)
+    sc_topo = np.zeros((u_n, scmax), dtype=np.int32)
+    sc_q = np.zeros((u_n, scmax), dtype=np.int32)
+    sc_m = np.zeros((u_n, scmax), dtype=np.int32)
+    for u_i, slots in enumerate(sc_slots):
+        for j, s in enumerate(slots):
+            sc_nh[u_i, j] = s["nh"]
+            sc_topo[u_i, j] = s["topo"]
+            sc_q[u_i, j] = s["q"]
+            sc_m[u_i, j] = s["m"]
+
+    # -- hard / soft eval tables (resolved) ---------------------------
+    hmax, smax = t.hmax, t.smax
+    h_topo = np.full((u_n, hmax), -1, dtype=np.int32)
+    h_cnt = np.zeros((u_n, hmax), dtype=np.int32)
+    h_cand = np.zeros((u_n, hmax), dtype=np.int32)
+    h_skew = np.zeros((u_n, hmax), dtype=np.int32)
+    h_selfm = np.zeros((u_n, hmax), dtype=np.int32)
+    for u_i in range(u_n):
+        for k in range(hmax):
+            inst = int(t.cls_h_rows[u_i, k])
+            if inst < 0:
+                continue
+            row = int(t.h_row[inst])
+            h_topo[u_i, k] = topo_idx[row]
+            h_cnt[u_i, k] = cnt_idx[row]
+            h_cand[u_i, k] = cand_idx[inst]
+            h_skew[u_i, k] = int(t.h_max_skew[inst])
+            h_selfm[u_i, k] = int(t.h_self[inst, u_i])
+    s_topo_i = np.full((u_n, smax), -1, dtype=np.int32)
+    s_ishost = np.zeros((u_n, smax), dtype=np.int32)
+    s_cnt = np.full((u_n, smax), -1, dtype=np.int32)
+    s_nh = np.full((u_n, smax), -1, dtype=np.int32)
+    s_skewm1 = np.zeros((u_n, smax), dtype=np.int32)
+    for u_i in range(u_n):
+        for k in range(smax):
+            inst = int(t.cls_s_rows[u_i, k])
+            if inst < 0:
+                continue
+            row = int(t.s_row[inst])
+            s_topo_i[u_i, k] = topo_idx[row]
+            s_ishost[u_i, k] = int(t.s_is_host[inst])
+            if t.s_is_host[inst]:
+                s_cnt[u_i, k] = cnt_idx[row]
+            else:
+                s_nh[u_i, k] = nh_idx[inst]
+            s_skewm1[u_i, k] = int(t.s_max_skew[inst]) - 1
+
+    # -- state inits (node space, trimmed to stored rows) --------------
+    tgt0_c = tgt0_all[cnt_rows] if len(cnt_rows) else np.zeros((1, n), np.int64)
+    pref0_p = pref0_all[pref_rows] if len(pref_rows) else np.zeros((1, n), np.int64)
+    panti0_p = panti0_all[pref_rows] if len(pref_rows) else np.zeros((1, n), np.int64)
+    anti0_all = _value_to_node_space(t.init_own_anti_req, tv)
+    antib0 = _pack_bitplanes(anti0_all > 0)
+    tposb0 = _pack_bitplanes(tgt0_all > 0)
+    group0 = _value_to_node_space(t.init_group_counts, tv[t.group_rows])
 
     # f64 log weights, double-single split (sz ranges over 0..n+1)
     wn = n + 2
@@ -338,55 +616,32 @@ def _build_terms(batch, features, r: int, p_total: int, n: int) -> Optional[Term
         out[: m.shape[0], : m.shape[1]] = m
         return out
 
-    # per-(class, slot) prefolds: scalar eval reads become SMEM loads
-    u_n = batch.u
-    uu = np.arange(u_n)
-    rows_cl = np.maximum(t.cls_rows, 0)  # (U, Rmax)
-    rvalid_cl = t.cls_rows >= 0
-    slot_m = np.where(rvalid_cl, t.match[rows_cl, uu[:, None]], False)
-    slot_cpaff = np.where(rvalid_cl, t.carry_aff_pref_w[rows_cl, uu[:, None]], 0)
-    slot_cpanti = np.where(rvalid_cl, t.carry_anti_pref_w[rows_cl, uu[:, None]], 0)
-    slot_canti = np.where(rvalid_cl, t.carry_anti_req[rows_cl, uu[:, None]], 0)
     gid_u = t.cls_group_id.astype(np.int32)
+    uu = np.arange(u_n)
     self_ok_u = np.where(
         gid_u >= 0, t.match_all[np.maximum(gid_u, 0), uu], False
     )
-    h_cl = np.maximum(t.cls_h_rows, 0)
-    slot_hself = np.where(t.cls_h_rows >= 0, t.h_self[h_cl, uu[:, None]], False)
 
     cfg = TermsCfg(
-        t=t.t, a=t.a, gn=len(t.match_all), ch=t.ch, cs=t.cs,
-        rmax=t.rmax, gmax=t.gmax, hmax=t.hmax, smax=t.smax, vs=vs,
+        t=t.t, td=td_n, tc=tc_n, tp=tp_n, bp=bp_n, a=t.a,
+        gn=len(t.match_all), csn=csn_n, cd=cd_n, sqd=sqd_n, hkd=hkd_n,
+        rmax=rmax, gmax=t.gmax, hmax=hmax, smax=smax, cmax=cmax,
+        scmax=scmax, vs=vs,
         has_ipa=has_ipa, has_hard=has_hard, has_soft=has_soft,
     )
-    return TermsPlan(
+    plan = TermsPlan(
         cfg=cfg,
-        topo3=_pad_stack(tv, r, fill=-1),
-        tgt0=_pad_stack(tgt0, r),
-        own_anti0=_pad_stack(own_anti0, r),
-        own_pref0=_pad_stack(own_pref0, r),
-        own_panti0=_pad_stack(own_panti0, r),
-        term_match_tu=tab_u(t.match.astype(np.int32)),
-        carry_anti_tu=tab_u(t.carry_anti_req.astype(np.int32)),
-        carry_prefc_tu=tab_u(carry_prefc.astype(np.int32)),
-        carry_panti_tu=tab_u(t.carry_anti_pref_w.astype(np.int32)),
-        slot_rows=t.cls_rows.astype(np.int32),
-        slot_m=slot_m.astype(np.int32),
-        slot_cpaff=slot_cpaff.astype(np.int32),
-        slot_cpanti=slot_cpanti.astype(np.int32),
-        slot_canti=slot_canti.astype(np.int32),
-        gid_u=gid_u,
-        self_ok_u=self_ok_u.astype(np.int32),
-        slot_grows=t.cls_group_rows.astype(np.int32),
-        slot_h=t.cls_h_rows.astype(np.int32),
-        slot_hself=slot_hself.astype(np.int32),
-        h_row_s=t.h_row.astype(np.int32),
-        h_skew_s=t.h_max_skew.astype(np.int32),
-        slot_s=t.cls_s_rows.astype(np.int32),
-        s_row_s=t.s_row.astype(np.int32),
-        s_is_host_s=t.s_is_host.astype(np.int32),
-        s_skew_s=t.s_max_skew.astype(np.int32),
+        topo_dist=_pad_stack(topo_dist, r, fill=-1),
         g_topo3=_pad_stack(tv[t.group_rows], r, fill=-1),
+        cand_dist=_pad_stack(cand_dist, r),
+        sq_dist=_pad_stack(sq_dist, r),
+        hk_dist=_pad_stack(hk_dist, r),
+        g_match_au=tab_u(t.match_all[t.group_of_row].astype(np.int32)),
+        tgt0_c=_pad_stack(tgt0_c, r),
+        pref0_p=_pad_stack(pref0_p, r),
+        panti0_p=_pad_stack(panti0_p, r),
+        antib0=_pad_stack(antib0, r),
+        tposb0=_pad_stack(tposb0, r),
         group0=_pad_stack(group0, r),
         gtot0=np.ascontiguousarray(
             np.broadcast_to(
@@ -394,18 +649,36 @@ def _build_terms(batch, features, r: int, p_total: int, n: int) -> Optional[Term
                 (max(t.a, 1), SUBLANES, LANES),
             )
         ),
-        g_match_au=tab_u(t.match_all[t.group_of_row].astype(np.int32)),
-        cand3=_pad_stack(t.h_cand_nodes.astype(np.int32), r),
-        soft0=_pad_stack(soft0, r),
-        s_topo3=_pad_stack(tv[t.s_row], r, fill=-1),
-        s_q3=_pad_stack(t.s_q.astype(np.int32), r),
-        s_match_cu=tab_u(t.match[t.s_row].astype(np.int32)),
-        haskeys3=_pad_stack(t.cls_s_haskeys.astype(np.int32), r),
+        soft0_nh=_pad_stack(soft0_nh, r),
+        # (U, slot) tables ship FLATTENED 1-D: SMEM pads every row of a
+        # 2-D array to a full 512B lane-row, so (100, 3) would cost
+        # 51KB of the ~1MB SMEM; 1-D costs its actual bytes
+        e_cnt=e_cnt.reshape(-1), e_pref=e_pref.reshape(-1),
+        e_cpd=e_cpd.astype(np.int32).reshape(-1),
+        e_antip=e_antip.reshape(-1), e_antib=e_antib.reshape(-1),
+        e_tposp=e_tposp.reshape(-1), e_tposb=e_tposb.reshape(-1),
+        gid_u=gid_u,
+        self_ok_u=self_ok_u.astype(np.int32),
+        slot_grows=t.cls_group_rows.astype(np.int32).reshape(-1),
+        h_topo=h_topo.reshape(-1), h_cnt=h_cnt.reshape(-1),
+        h_cand=h_cand.reshape(-1), h_skew=h_skew.reshape(-1),
+        h_selfm=h_selfm.reshape(-1),
+        s_topo_i=s_topo_i.reshape(-1), s_ishost=s_ishost.reshape(-1),
+        s_cnt=s_cnt.reshape(-1), s_nh=s_nh.reshape(-1),
+        s_skewm1=s_skewm1.reshape(-1),
+        c_topo=c_topo.reshape(-1), c_cnt=c_cnt.reshape(-1),
+        c_pref=c_pref.reshape(-1), c_m=c_m.reshape(-1),
+        c_prefc=c_prefc.reshape(-1), c_pantic=c_pantic.reshape(-1),
+        c_antip=c_antip.reshape(-1), c_antib=c_antib.reshape(-1),
+        c_tposp=c_tposp.reshape(-1), c_tposb=c_tposb.reshape(-1),
+        sc_nh=sc_nh.reshape(-1), sc_topo=sc_topo.reshape(-1),
+        sc_q=sc_q.reshape(-1), sc_m=sc_m.reshape(-1),
         w_hi=w_hi,
         w_lo=w_lo,
         w_h1=w_h1,
         w_h2=w_h2,
     )
+    return plan, hk_map
 
 
 # the term-machinery kernel beats the XLA scan on term-heavy batches
@@ -512,11 +785,13 @@ def build_plan(cluster, batch, dyn, features, weights=None,
             return None
 
     terms = None
+    hk_map = None
     if features.ipa or features.hard_spread or features.soft_spread:
         p_total = int(a(batch.class_of_pod).shape[0])
-        terms = _build_terms(batch, features, r, p_total, n)
-        if terms is None:
+        built = _build_terms(batch, features, r, p_total, n)
+        if built is None:
             return None
+        terms, hk_map = built
 
     class_scalars = np.zeros((u, 8), dtype=np.int32)
     class_scalars[:, 0] = req_mcpu
@@ -526,7 +801,25 @@ def build_plan(cluster, batch, dyn, features, weights=None,
     class_scalars[:, 4] = nz_mem // s_nzmem
     class_scalars[:, 5] = a(batch.has_request).astype(np.int32)
 
-    return PallasPlan(
+    # class tables deduplicated to distinct rows; clsmap resolves class
+    # u -> row per table (big-U batches often share a handful of
+    # distinct node patterns across hundreds of classes)
+    feas_d, feas_i = _dedup_rows(a(batch.static_feasible).astype(np.int32))
+    simon_d, simon_i = _dedup_rows(simon_raw)
+    base_d, base_i = _dedup_rows(base_score)
+    na_d, na_i = _dedup_rows(nodeaff_raw)
+    tt_d, tt_i = _dedup_rows(taint_intol)
+    clsmap = np.zeros((8, max(u, 1)), dtype=np.int32)
+    clsmap[0, :u] = feas_i
+    clsmap[1, :u] = simon_i
+    clsmap[2, :u] = base_i
+    clsmap[3, :u] = na_i
+    clsmap[4, :u] = tt_i
+    if hk_map is not None:
+        clsmap[5, :u] = hk_map
+    clsmap = clsmap.reshape(-1)  # 1-D for SMEM (see TermsPlan note)
+
+    plan = PallasPlan(
         n=n,
         r=r,
         u=u,
@@ -535,13 +828,12 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         alloc_eph_s=_pad_nodes(alloc_eph // s_eph, r),
         alloc_pods=_pad_nodes(alloc_pods, r),
         alloc_nzmem_s=_pad_nodes(alloc_mem // s_nzmem, r),
-        static_feasible=_pad_class_table(
-            a(batch.static_feasible).astype(np.int32), r
-        ),
-        simon_raw=_pad_class_table(simon_raw, r),
-        nodeaff_raw=_pad_class_table(nodeaff_raw, r),
-        taint_intol=_pad_class_table(taint_intol, r),
-        base_score=_pad_class_table(base_score, r),
+        static_feasible=_pad_class_table(feas_d, r),
+        simon_raw=_pad_class_table(simon_d, r),
+        nodeaff_raw=_pad_class_table(na_d, r),
+        taint_intol=_pad_class_table(tt_d, r),
+        base_score=_pad_class_table(base_d, r),
+        clsmap=clsmap,
         class_scalars=class_scalars,
         init_used_mcpu=_pad_nodes(init_used_mcpu, r),
         init_used_mem_s=_pad_nodes(init_used_mem // s_mem, r),
@@ -560,9 +852,65 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         terms=terms,
     )
 
+    # VMEM budget (~16MB/core): count the PERSISTENT (R, C) tiles
+    # directly from the plan arrays. State-init INPUTS live in ANY
+    # (HBM) and are DMAed into scratch, so scratch counts once.
+    tiles = (
+        5  # alloc vectors
+        + 6 * 2  # state inputs + output copies
+        + 1  # valid
+        + plan.static_feasible.shape[0]
+        + plan.simon_raw.shape[0]
+        + plan.base_score.shape[0]
+        + (plan.nodeaff_raw.shape[0] if plan.has_nodeaff else 0)
+        + (plan.taint_intol.shape[0] if plan.has_taint else 0)
+    )
+    if terms is not None:
+        tc_ = terms.cfg
+        tiles += (
+            terms.topo_dist.shape[0]
+            + terms.g_topo3.shape[0]
+            + (terms.cand_dist.shape[0] if tc_.has_hard else 0)
+            + (terms.sq_dist.shape[0] if tc_.has_soft else 0)
+            + (terms.hk_dist.shape[0] if tc_.has_soft else 0)
+            # scratch: tgt + pref + panti + 2 bitplane sets + group + soft
+            + tc_.tc + 2 * tc_.tp + 2 * tc_.bp + tc_.a
+            + (tc_.csn if tc_.has_soft else 0)
+        )
+    if tiles * r * LANES * 4 > 13 * 2**20:
+        return None
+    return plan
 
-def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
-                 has_pins: bool, tc: Optional[TermsCfg]):
+
+# ordered (TermsPlan field, memory space) spec of the term-block kernel
+# inputs — the single source of truth shared by the arg packer
+# (_device_args), the BlockSpec assignment, and the kernel's unpacking
+_TERM_FIELDS = (
+    ("topo_dist", "vmem"), ("g_topo3", "vmem"), ("cand_dist", "vmem"),
+    ("sq_dist", "vmem"), ("hk_dist", "vmem"), ("g_match_au", "vmem"),
+    ("tgt0_c", "any"), ("pref0_p", "any"), ("panti0_p", "any"),
+    ("antib0", "any"), ("tposb0", "any"), ("group0", "any"),
+    ("gtot0", "any"), ("soft0_nh", "any"),
+    ("e_cnt", "smem"), ("e_pref", "smem"), ("e_cpd", "smem"),
+    ("e_antip", "smem"), ("e_antib", "smem"),
+    ("e_tposp", "smem"), ("e_tposb", "smem"),
+    ("gid_u", "smem"), ("self_ok_u", "smem"), ("slot_grows", "smem"),
+    ("h_topo", "smem"), ("h_cnt", "smem"), ("h_cand", "smem"),
+    ("h_skew", "smem"), ("h_selfm", "smem"),
+    ("s_topo_i", "smem"), ("s_ishost", "smem"), ("s_cnt", "smem"),
+    ("s_nh", "smem"), ("s_skewm1", "smem"),
+    ("c_topo", "smem"), ("c_cnt", "smem"), ("c_pref", "smem"),
+    ("c_m", "smem"), ("c_prefc", "smem"), ("c_pantic", "smem"),
+    ("c_antip", "smem"), ("c_antib", "smem"),
+    ("c_tposp", "smem"), ("c_tposb", "smem"),
+    ("sc_nh", "smem"), ("sc_topo", "smem"), ("sc_q", "smem"),
+    ("sc_m", "smem"),
+    ("w_hi", "smem"), ("w_lo", "smem"), ("w_h1", "smem"), ("w_h2", "smem"),
+)
+
+
+def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
+                 has_taint: bool, has_pins: bool, tc: Optional[TermsCfg]):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -572,8 +920,8 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
     # ---- ref layout: base inputs, term inputs, outputs, term scratch.
     # The na/tt class tables ride along only when their scores are live
     # (a [U, R, C] tile each — meaningful VMEM at U=100).
-    BASE_IN = 17 + int(has_nodeaff) + int(has_taint)
-    TERM_IN = 39 if tc is not None else 0
+    BASE_IN = 18 + int(has_nodeaff) + int(has_taint)
+    TERM_IN = len(_TERM_FIELDS) if tc is not None else 0
     N_OUT = 7
 
     def two_sum(a, b):
@@ -589,12 +937,14 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
         #   nzc, nzm, has_req, unused — pod p at [:, p//128, p%128]
         active_ref = next(it)  # (Pr, 128) i32
         valid_ref = next(it)  # (R, C) i32
+        clsmap_ref = next(it)  # (8*U,) SMEM: class -> dedup table row,
+        #   flattened row-major (table t, class u at [t * u_n + u])
         alloc_c_ref = next(it)
         alloc_m_ref = next(it)
         alloc_e_ref = next(it)
         alloc_p_ref = next(it)
         alloc_nzm_ref = next(it)
-        feas_ref = next(it)  # (U, R, C)
+        feas_ref = next(it)  # (Fd, R, C) dedup rows
         simon_ref = next(it)
         na_ref = next(it) if has_nodeaff else None
         tt_ref = next(it) if has_taint else None
@@ -606,24 +956,25 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
         inzm_ref = next(it)
         ipc_ref = next(it)
         if tc is not None:
-            (
-                topo_ref, tgt0_ref, anti0_ref, pref0_ref, panti0_ref,
-                tmatch_ref, canti_ref, cprefc_ref, cpanti_ref,
-                srows_ref, sm_ref, scpaff_ref, scpanti_ref, scanti_ref,
-                gid_ref, selfok_ref, sgrows_ref, sh_ref, shself_ref,
-                hrow_ref, hskew_ref, sslot_ref, srow_ref, sishost_ref,
-                sskew_ref,
-                gtopo_ref, group0_ref, gtot0_ref, gmatch_ref,
-                cand_ref,
-                soft0_ref, stopo_ref, sq_ref, smatch_ref, haskeys_ref,
-                whi_ref, wlo_ref, wh1_ref, wh2_ref,
-            ) = refs[BASE_IN : BASE_IN + TERM_IN]
+            tr = dict(zip((nm for nm, _ in _TERM_FIELDS),
+                          refs[BASE_IN : BASE_IN + TERM_IN]))
+            topo_ref = tr["topo_dist"]
+            gtopo_ref = tr["g_topo3"]
+            cand_ref = tr["cand_dist"]
+            sq_ref = tr["sq_dist"]
+            haskeys_ref = tr["hk_dist"]
+            gmatch_ref = tr["g_match_au"]
+            gid_ref = tr["gid_u"]
+            selfok_ref = tr["self_ok_u"]
+            sgrows_ref = tr["slot_grows"]
+            whi_ref, wlo_ref = tr["w_hi"], tr["w_lo"]
+            wh1_ref, wh2_ref = tr["w_h1"], tr["w_h2"]
         outs = refs[BASE_IN + TERM_IN : BASE_IN + TERM_IN + N_OUT]
         (place_ref, st_c_ref, st_m_ref, st_e_ref,
          st_nzc_ref, st_nzm_ref, st_p_ref) = outs
         if tc is not None:
-            (tgt_s, anti_s, pref_s, panti_s, group_s, gtot_s, soft_s,
-             dma_sem) = refs[BASE_IN + TERM_IN + N_OUT :]
+            (tgt_s, pref_s, panti_s, antib_s, tposb_s, group_s, gtot_s,
+             soft_s, dma_sem) = refs[BASE_IN + TERM_IN + N_OUT :]
 
         shape = valid_ref.shape
         rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
@@ -651,16 +1002,17 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
             # VMEM footprint of their scratch copies; one DMA each
             from jax.experimental.pallas import tpu as pltpu_mod
 
-            for src_ref, dst_ref in (
-                (tgt0_ref, tgt_s),
-                (anti0_ref, anti_s),
-                (pref0_ref, pref_s),
-                (panti0_ref, panti_s),
-                (group0_ref, group_s),
-                (gtot0_ref, gtot_s),
-                (soft0_ref, soft_s),
+            for src_name, dst_ref in (
+                ("tgt0_c", tgt_s),
+                ("pref0_p", pref_s),
+                ("panti0_p", panti_s),
+                ("antib0", antib_s),
+                ("tposb0", tposb_s),
+                ("group0", group_s),
+                ("gtot0", gtot_s),
+                ("soft0_nh", soft_s),
             ):
-                cp = pltpu_mod.make_async_copy(src_ref, dst_ref, dma_sem)
+                cp = pltpu_mod.make_async_copy(tr[src_name], dst_ref, dma_sem)
                 cp.start()
                 cp.wait()
 
@@ -683,6 +1035,10 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
             nzm = pod_scalar(5)
             has_req = pod_scalar(6)
             active = jnp.sum(jnp.where(lane, active_ref[pl.ds(pr, 1), :], 0))
+            # dedup-table rows for this pod's class (SMEM scalar reads)
+            fu = clsmap_ref[u]
+            su = clsmap_ref[u_n + u]
+            bu = clsmap_ref[2 * u_n + u]
 
             used_c = st_c_ref[:]
             used_m = st_m_ref[:]
@@ -697,35 +1053,39 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                 & (used_e + re <= alloc_e)
             )
             feas = (
-                (feas_ref[u] != 0)
+                (feas_ref[fu] != 0)
                 & valid
                 & (pod_cnt + 1 <= alloc_p)
                 & (fit | (has_req == 0))
             )
 
             # ---- inter-pod affinity + topology spread ----
+            # Eval reads state directly: count/pref state is zero at
+            # nodes whose topology key is missing (init masked, commits
+            # eq-gated), and inactive slots carry zero scalars, so no
+            # per-node key mask is needed.
             if tc is not None and tc.has_ipa:
                 fail_exist = jnp.zeros(shape, bool)
                 fail_own = jnp.zeros(shape, bool)
                 ipa_raw = jnp.zeros(shape, jnp.int32)
                 for k in range(tc.rmax):
-                    r_k = srows_ref[u, k]
-                    rv = r_k >= 0
-                    rk = jnp.maximum(r_k, 0)
-                    vals = topo_ref[rk]
-                    hask = (vals >= 0) & rv
-                    tgtk = jnp.where(hask, tgt_s[rk], 0)
-                    antik = jnp.where(hask, anti_s[rk], 0)
-                    prefk = jnp.where(hask, pref_s[rk], 0)
-                    pantik = jnp.where(hask, panti_s[rk], 0)
-                    m_k = (sm_ref[u, k] != 0) & rv
-                    c_paff = scpaff_ref[u, k]
-                    c_panti = scpanti_ref[u, k]
-                    c_anti = scanti_ref[u, k]
-                    fail_exist = fail_exist | (m_k & (antik > 0))
-                    fail_own = fail_own | ((c_anti > 0) & (tgtk > 0))
-                    ipa_raw = ipa_raw + (c_paff - c_panti) * tgtk + jnp.where(
-                        m_k, prefk - pantik, 0
+                    ci = tr["e_cnt"][u * tc.rmax + k]
+                    tgtk = tgt_s[jnp.maximum(ci, 0)] * (ci >= 0)
+                    pi = tr["e_pref"][u * tc.rmax + k]
+                    pv = (pi >= 0).astype(jnp.int32)
+                    pix = jnp.maximum(pi, 0)
+                    ipa_raw = (
+                        ipa_raw
+                        + tr["e_cpd"][u * tc.rmax + k] * tgtk
+                        + (pref_s[pix] - panti_s[pix]) * pv
+                    )
+                    ab = tr["e_antib"][u * tc.rmax + k]
+                    fail_exist = fail_exist | (
+                        (antib_s[tr["e_antip"][u * tc.rmax + k]] & ab) != 0
+                    )
+                    tb = tr["e_tposb"][u * tc.rmax + k]
+                    fail_own = fail_own | (
+                        (tposb_s[tr["e_tposp"][u * tc.rmax + k]] & tb) != 0
                     )
 
                 # satisfyPodAffinity: required-affinity groups
@@ -734,7 +1094,7 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                 pods_exist = jnp.ones(shape, bool)
                 total_g = jnp.zeros((), jnp.int32)
                 for k in range(tc.gmax):
-                    a_k = sgrows_ref[u, k]
+                    a_k = sgrows_ref[u * tc.gmax + k]
                     gv = a_k >= 0
                     ak = jnp.maximum(a_k, 0)
                     gvals = gtopo_ref[ak]
@@ -751,19 +1111,17 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
 
             if tc is not None and tc.has_hard:
                 for k in range(tc.hmax):
-                    h_k = sh_ref[u, k]
-                    hv = h_k >= 0
-                    hk = jnp.maximum(h_k, 0)
-                    hrow = jnp.maximum(hrow_ref[hk], 0)
-                    hvals = topo_ref[hrow]
-                    cand = (cand_ref[hk] != 0) & valid
-                    counts = tgt_s[hrow]
+                    ti = tr["h_topo"][u * tc.hmax + k]
+                    hv = ti >= 0
+                    hvals = topo_ref[jnp.maximum(ti, 0)]
+                    cand = (cand_ref[jnp.maximum(tr["h_cand"][u * tc.hmax + k], 0)] != 0) & valid
+                    counts = tgt_s[jnp.maximum(tr["h_cnt"][u * tc.hmax + k], 0)]
                     minc = jnp.min(jnp.where(cand, counts, BIG))
                     minc = jnp.where(jnp.any(cand), minc, 0)
                     cnt_eff = jnp.where(cand & (hvals >= 0), counts, 0)
-                    selfm = shself_ref[u, k]
+                    selfm = tr["h_selfm"][u * tc.hmax + k]
                     skew = cnt_eff + selfm - minc
-                    maxskew = hskew_ref[hk]
+                    maxskew = tr["h_skew"][u * tc.hmax + k]
                     ok_c = (skew <= maxskew) & (hvals >= 0)
                     feas = feas & (ok_c | ~hv)
 
@@ -779,7 +1137,7 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
             least_m = jnp.where(
                 ok_m, (alloc_nzm - totm) * MAX_SCORE // jnp.maximum(alloc_nzm, 1), 0
             )
-            total = base_ref[u] + ((least_c + least_m) // 2) * w_least
+            total = base_ref[bu] + ((least_c + least_m) // 2) * w_least
 
             if w_bal:
                 # BalancedAllocation: fractions are exact in f32 (inputs
@@ -798,7 +1156,7 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                 total = total + balanced * w_bal
 
             if w_simon:
-                raw = simon_ref[u]
+                raw = simon_ref[su]
                 hi = jnp.max(jnp.where(feas, raw, NEG))
                 lo = jnp.min(jnp.where(feas, raw, BIG))
                 rng = hi - lo
@@ -808,13 +1166,13 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                 total = total + sim * w_simon
 
             if w_na and has_nodeaff:
-                raw = na_ref[u]
+                raw = na_ref[clsmap_ref[3 * u_n + u]]
                 mx = jnp.max(jnp.where(feas, raw, 0))
                 na = jnp.where(mx > 0, MAX_SCORE * raw // jnp.maximum(mx, 1), 0)
                 total = total + na * w_na
 
             if w_tt and has_taint:
-                raw = tt_ref[u]
+                raw = tt_ref[clsmap_ref[4 * u_n + u]]
                 mx = jnp.max(jnp.where(feas, raw, 0))
                 base = jnp.where(mx > 0, MAX_SCORE * raw // jnp.maximum(mx, 1), 0)
                 tt = jnp.where(mx > 0, MAX_SCORE - base, MAX_SCORE)
@@ -840,18 +1198,17 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                 # so the product runs in double-single f32 (split tables
                 # w_h1/w_h2/w_lo, exact partial products, 2Sum chains) —
                 # ~2^-45 relative error, then integer truncation.
-                hkeys = haskeys_ref[u] != 0
+                hkeys = haskeys_ref[clsmap_ref[5 * u_n + u]] != 0
                 eligible = feas & hkeys
                 acc_hi = jnp.zeros(shape, jnp.float32)
                 acc_lo = jnp.zeros(shape, jnp.float32)
                 any_svalid = jnp.zeros((), bool)
                 for k in range(tc.smax):
-                    s_k = sslot_ref[u, k]
-                    sv = s_k >= 0
+                    sti = tr["s_topo_i"][u * tc.smax + k]
+                    sv = sti >= 0
                     any_svalid = any_svalid | sv
-                    sk = jnp.maximum(s_k, 0)
-                    svals = stopo_ref[sk]
-                    is_host = sishost_ref[sk] != 0
+                    svals = topo_ref[jnp.maximum(sti, 0)]
+                    is_host = tr["s_ishost"][u * tc.smax + k] != 0
                     sz_host = jnp.sum((eligible).astype(jnp.int32))
                     sz_nh = jnp.zeros((), jnp.int32)
                     for v in range(tc.vs):
@@ -863,9 +1220,9 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                     wlo = wlo_ref[sz]
                     wh1 = wh1_ref[sz]
                     wh2 = wh2_ref[sz]
-                    srow = jnp.maximum(srow_ref[sk], 0)
-                    cnt_host = tgt_s[srow]
-                    cnt_soft = soft_s[sk]
+                    ci_s = tr["s_cnt"][u * tc.smax + k]
+                    cnt_host = tgt_s[jnp.maximum(ci_s, 0)]
+                    cnt_soft = soft_s[jnp.maximum(tr["s_nh"][u * tc.smax + k], 0)]
                     cnt = jnp.where(is_host, cnt_host, cnt_soft) * (
                         svals >= 0
                     ).astype(jnp.int32)
@@ -877,7 +1234,7 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                     hi_p, e2 = two_sum(hi_p, c2f * wh1)
                     hi_p, e3 = two_sum(hi_p, c2f * wh2)
                     lo_p = e1 + e2 + e3 + cnt.astype(jnp.float32) * wlo
-                    skew_k = (sskew_ref[sk] - 1).astype(jnp.float32)
+                    skew_k = tr["s_skewm1"][u * tc.smax + k].astype(jnp.float32)
                     hi_p, e4 = two_sum(hi_p, skew_k)
                     lo_p = lo_p + e4
                     hi_p = jnp.where(sv, hi_p, 0.0)
@@ -948,6 +1305,7 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                 nr = jnp.where(do, place // LANES, 0)
                 nc = jnp.where(do, place % LANES, 0)
                 lane_nc = (lane_iota == nc)[None, :, :]  # (1, 1, C)
+                lane_nc2 = lane_iota == nc  # (1, C) for 2D slabs
                 lane_u3 = lane_iota == u  # (1, LANES) for (X, Up) tables
 
                 def col_u(tab_ref):
@@ -962,14 +1320,38 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                         jnp.where(lane_nc, colslab, 0), axis=2, keepdims=True
                     )
 
-                valt = val_at(topo_ref)  # (T, 1, 1)
-                eq = ((topo_ref[:] == valt) & (valt >= 0)).astype(jnp.int32)
-                m_t = col_u(tmatch_ref)[: tc.t]
-                tgt_s[:] = tgt_s[:] + (m_t * inc) * eq
+                def val_at_row(t3_ref, idx):
+                    """Row idx of a (X, R, C) tile at the placed node -> scalar."""
+                    slab = t3_ref[idx, pl.ds(nr, 1), :]  # (1, C)
+                    return jnp.sum(jnp.where(lane_nc2, slab, 0))
+
+                # SPARSE commit: each class updates at most cmax
+                # (row, topo) slots — count rows as += increments, bit
+                # rows as monotone ORs. Inactive slots multiply to zero
+                # (their read-modify-write of row 0 adds 0).
+                for j in range(tc.cmax):
+                    ti = tr["c_topo"][u * tc.cmax + j]
+                    tix = jnp.maximum(ti, 0)
+                    tvals = topo_ref[tix]
+                    valt = val_at_row(topo_ref, tix)
+                    upd = (
+                        (tvals == valt) & (valt >= 0) & (ti >= 0)
+                    ).astype(jnp.int32) * inc
+                    ci = tr["c_cnt"][u * tc.cmax + j]
+                    cix = jnp.maximum(ci, 0)
+                    tgt_s[cix] = tgt_s[cix] + tr["c_m"][u * tc.cmax + j] * upd * (ci >= 0)
+                    if tc.has_ipa:
+                        pi2 = tr["c_pref"][u * tc.cmax + j]
+                        pix = jnp.maximum(pi2, 0)
+                        pfac = upd * (pi2 >= 0)
+                        pref_s[pix] = pref_s[pix] + tr["c_prefc"][u * tc.cmax + j] * pfac
+                        panti_s[pix] = panti_s[pix] + tr["c_pantic"][u * tc.cmax + j] * pfac
+                        ap = tr["c_antip"][u * tc.cmax + j]
+                        antib_s[ap] = antib_s[ap] | (tr["c_antib"][u * tc.cmax + j] * upd)
+                        tp_ = tr["c_tposp"][u * tc.cmax + j]
+                        tposb_s[tp_] = tposb_s[tp_] | (tr["c_tposb"][u * tc.cmax + j] * upd)
+
                 if tc.has_ipa:
-                    anti_s[:] = anti_s[:] + (col_u(canti_ref)[: tc.t] * inc) * eq
-                    pref_s[:] = pref_s[:] + (col_u(cprefc_ref)[: tc.t] * inc) * eq
-                    panti_s[:] = panti_s[:] + (col_u(cpanti_ref)[: tc.t] * inc) * eq
                     g_valt = val_at(gtopo_ref)  # (A, 1, 1)
                     g_eq = ((gtopo_ref[:] == g_valt) & (g_valt >= 0)).astype(
                         jnp.int32
@@ -978,14 +1360,23 @@ def _make_kernel(p_total: int, w: tuple, has_nodeaff: bool, has_taint: bool,
                     group_s[:] = group_s[:] + (g_m * inc) * g_eq
                     gtot_s[:] = gtot_s[:] + g_m * inc
                 if tc.has_soft:
-                    s_valt = val_at(stopo_ref)  # (Cs, 1, 1)
-                    s_q_at = val_at(sq_ref) != 0
-                    s_ok = (s_valt >= 0) & s_q_at
-                    s_m = col_u(smatch_ref)[: tc.cs] * s_ok
-                    s_eq = ((stopo_ref[:] == s_valt) & (s_valt >= 0)).astype(
-                        jnp.int32
-                    )
-                    soft_s[:] = soft_s[:] + (s_m * inc) * s_eq
+                    for j in range(tc.scmax):
+                        si = tr["sc_nh"][u * tc.scmax + j]
+                        six = jnp.maximum(si, 0)
+                        sti2 = jnp.maximum(tr["sc_topo"][u * tc.scmax + j], 0)
+                        stvals = topo_ref[sti2]
+                        s_valt = val_at_row(topo_ref, sti2)
+                        s_q_at = (
+                            val_at_row(sq_ref, jnp.maximum(tr["sc_q"][u * tc.scmax + j], 0))
+                            != 0
+                        )
+                        s_upd = (
+                            (stvals == s_valt)
+                            & (s_valt >= 0)
+                            & (si >= 0)
+                            & s_q_at
+                        ).astype(jnp.int32) * inc
+                        soft_s[six] = soft_s[six] + tr["sc_m"][u * tc.scmax + j] * s_upd
             return 0
 
         jax.lax.fori_loop(0, p_total, step, 0)
@@ -1026,6 +1417,7 @@ def _device_args(plan: PallasPlan) -> list:
         _DEVICE_PLAN_CACHE.move_to_end(id(plan))
         return hit[1]
     args = [
+        plan.clsmap,
         plan.alloc_mcpu, plan.alloc_mem_s, plan.alloc_eph_s, plan.alloc_pods,
         plan.alloc_nzmem_s,
         plan.static_feasible, plan.simon_raw,
@@ -1040,20 +1432,7 @@ def _device_args(plan: PallasPlan) -> list:
         plan.init_nz_mcpu, plan.init_nz_mem_s, plan.init_pod_cnt,
     ]
     if plan.terms is not None:
-        tp = plan.terms
-        args += [
-            tp.topo3, tp.tgt0, tp.own_anti0, tp.own_pref0, tp.own_panti0,
-            tp.term_match_tu, tp.carry_anti_tu, tp.carry_prefc_tu,
-            tp.carry_panti_tu,
-            tp.slot_rows, tp.slot_m, tp.slot_cpaff, tp.slot_cpanti,
-            tp.slot_canti, tp.gid_u, tp.self_ok_u, tp.slot_grows,
-            tp.slot_h, tp.slot_hself, tp.h_row_s, tp.h_skew_s,
-            tp.slot_s, tp.s_row_s, tp.s_is_host_s, tp.s_skew_s,
-            tp.g_topo3, tp.group0, tp.gtot0, tp.g_match_au,
-            tp.cand3,
-            tp.soft0, tp.s_topo3, tp.s_q3, tp.s_match_cu, tp.haskeys3,
-            tp.w_hi, tp.w_lo, tp.w_h1, tp.w_h2,
-        ]
+        args += [getattr(plan.terms, name) for name, _ in _TERM_FIELDS]
     with jax.enable_x64(False):
         dev = [jax.device_put(a) for a in args]
     if len(_DEVICE_PLAN_CACHE) >= 16:
@@ -1103,37 +1482,35 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
            plan.has_pins, tc, interpret)
     cached = _COMPILED_CACHE.get(key)
     if cached is None:
-        kernel = _make_kernel(p_total, plan.w, plan.has_nodeaff, plan.has_taint,
-                              plan.has_pins, tc)
+        kernel = _make_kernel(p_total, plan.u, plan.w, plan.has_nodeaff,
+                              plan.has_taint, plan.has_pins, tc)
         rc = (plan.r, LANES)
-        base_n = 17 + int(plan.has_nodeaff) + int(plan.has_taint)
-        n_in = base_n + (39 if tc is not None else 0)
+        base_n = 18 + int(plan.has_nodeaff) + int(plan.has_taint)
+        n_in = base_n + (len(_TERM_FIELDS) if tc is not None else 0)
         scratch = []
-        # term-block memory spaces (offsets relative to base_n):
-        # init states (DMAed into scratch) in ANY; slot/scalar tables in
-        # SMEM; everything else VMEM
-        any_idx = (
-            {base_n + k for k in (1, 2, 3, 4, 26, 27, 30)}
-            if tc is not None
-            else set()
-        )
-        smem_idx = (
-            {base_n + k for k in list(range(9, 25)) + [35, 36, 37, 38]}
-            if tc is not None
-            else set()
-        )
+        # memory spaces: clsmap (base idx 3) in SMEM; term-block spaces
+        # come from _TERM_FIELDS (state inits in ANY, tables in SMEM)
+        smem_idx = {3}
+        any_idx = set()
         if tc is not None:
+            for off, (_, space) in enumerate(_TERM_FIELDS):
+                if space == "any":
+                    any_idx.add(base_n + off)
+                elif space == "smem":
+                    smem_idx.add(base_n + off)
+
             from jax.experimental.pallas import tpu as _pltpu
 
-            trc = (tc.t, plan.r, LANES)
+            rl = (plan.r, LANES)
             scratch = [
-                _pltpu.VMEM(trc, jnp.int32),  # tgt
-                _pltpu.VMEM(trc, jnp.int32),  # own_anti
-                _pltpu.VMEM(trc, jnp.int32),  # own_pref (combined)
-                _pltpu.VMEM(trc, jnp.int32),  # own_panti
-                _pltpu.VMEM((tc.a, plan.r, LANES), jnp.int32),  # group
+                _pltpu.VMEM((tc.tc,) + rl, jnp.int32),  # tgt counts
+                _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # pref (combined)
+                _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # panti
+                _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # anti>0 bitplanes
+                _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # tgt>0 bitplanes
+                _pltpu.VMEM((tc.a,) + rl, jnp.int32),  # group
                 _pltpu.VMEM((tc.a, SUBLANES, LANES), jnp.int32),  # gtot
-                _pltpu.VMEM((tc.cs, plan.r, LANES), jnp.int32),  # soft
+                _pltpu.VMEM((tc.csn,) + rl, jnp.int32),  # soft non-host
                 _pltpu.SemaphoreType.DMA,
             ]
 

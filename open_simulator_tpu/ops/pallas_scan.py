@@ -222,6 +222,18 @@ class PallasPlan(NamedTuple):
     # inter-pod affinity / topology-spread machinery (None = batch has
     # no terms)
     terms: Optional[TermsPlan]
+    # extended scalar resources (noderesources/fit.go scalar path):
+    # s_n resource kinds, per-kind GCD-scaled int32
+    s_n: int = 0
+    alloc_scal: Optional[np.ndarray] = None  # (S, R, C) VMEM
+    iscal0: Optional[np.ndarray] = None  # (S, R, C) init used (ANY)
+    req_scal: Optional[np.ndarray] = None  # (U*S,) SMEM
+    # hostPorts (NodePorts plugin): occupancy as pw int32 bitplanes
+    # over the port vocab, conflict/want masks as per-class words
+    pw: int = 0
+    ports0: Optional[np.ndarray] = None  # (Pw, R, C) init planes (ANY)
+    want_w: Optional[np.ndarray] = None  # (U*Pw,) SMEM
+    confl_w: Optional[np.ndarray] = None  # (U*Pw,) SMEM
 
 
 def _pad_nodes(vec: np.ndarray, r: int, fill=0) -> np.ndarray:
@@ -692,13 +704,7 @@ def build_plan(cluster, batch, dyn, features, weights=None,
     """Build a kernel plan from the (numpy) ClusterStatic + PodBatch +
     DynamicState, or None when the batch is outside the fast path's
     scope."""
-    if (
-        features.gpu
-        or features.storage
-        or features.ports
-        or features.scalars
-        or features.custom
-    ):
+    if features.gpu or features.storage or features.custom:
         return None
     if allow_terms is None:
         allow_terms = TERMS_DEFAULT_ENABLE
@@ -784,6 +790,63 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         if worst >= 2**24:
             return None
 
+    # extended scalar resources: per-kind GCD scaling + int32 guards
+    s_n = 0
+    alloc_scal = iscal0 = req_scal_t = None
+    if features.scalars:
+        scal_alloc = a(cluster.scalar_alloc, dtype=np.int64)
+        req_scalar = a(batch.req_scalar, dtype=np.int64)
+        used_scal0 = a(dyn.used_scalar, dtype=np.int64)
+        s_n = scal_alloc.shape[0]
+        if s_n > 8:
+            return None
+        scales = []
+        for s_i in range(s_n):
+            sc = _gcd_scale(scal_alloc[s_i], req_scalar[:, s_i], used_scal0[s_i])
+            scales.append(sc)
+        scal_s = np.stack([scal_alloc[s_i] // scales[s_i] for s_i in range(s_n)])
+        req_s = np.stack(
+            [req_scalar[:, s_i] // scales[s_i] for s_i in range(s_n)], axis=1
+        )
+        used_s0 = np.stack([used_scal0[s_i] // scales[s_i] for s_i in range(s_n)])
+        worst_scal = used_s0.max(initial=0)
+        if features.pins:
+            pin_mask = a(batch.pinned_node) >= 0
+            pin_cls = a(batch.class_of_pod)[pin_mask]
+            worst_scal = worst_scal + req_s[pin_cls].sum(axis=0).max(initial=0)
+        if (
+            scal_s.max(initial=0) > _MAX_SCALED
+            or req_s.max(initial=0) > _MAX_SCALED
+            or worst_scal >= 2**30
+        ):
+            return None
+        alloc_scal = _pad_stack(scal_s, r)
+        iscal0 = _pad_stack(used_s0, r)
+        req_scal_t = req_s.astype(np.int32).reshape(-1)  # (U*S,) row-major
+
+    # hostPorts: occupancy bitplanes over the port vocab
+    pw = 0
+    ports0 = want_w = confl_w = None
+    if features.ports:
+        want_p = a(batch.want_ports).astype(bool)
+        confl_p = a(batch.conflict_ports).astype(bool)
+        pt = want_p.shape[1]
+        if pt > 4 * 32:
+            return None
+        pw = max(-(-pt // 32), 1)
+        ports0 = _pad_stack(_pack_bitplanes(a(dyn.ports_used).astype(bool).T), r)
+
+        def pack_words(tab):  # (U, Pt) bool -> (U*Pw,) i32 words
+            # same bit layout as the node-space planes (_pack_bitplanes:
+            # port p at bit p&31 of word p>>5), transposed to per-class
+            words = _pack_bitplanes(tab.T).T  # (U, Pw)
+            if words.shape[1] < pw:  # pad classes with no ports
+                words = np.pad(words, ((0, 0), (0, pw - words.shape[1])))
+            return np.ascontiguousarray(words).reshape(-1)
+
+        want_w = pack_words(want_p)
+        confl_w = pack_words(confl_p)
+
     terms = None
     hk_map = None
     if features.ipa or features.hard_spread or features.soft_spread:
@@ -850,6 +913,14 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         has_taint=bool(taint_intol.any()),
         has_pins=bool(features.pins),
         terms=terms,
+        s_n=s_n,
+        alloc_scal=alloc_scal,
+        iscal0=iscal0,
+        req_scal=req_scal_t,
+        pw=pw,
+        ports0=ports0,
+        want_w=want_w,
+        confl_w=confl_w,
     )
 
     # VMEM budget (~16MB/core): count the PERSISTENT (R, C) tiles
@@ -864,6 +935,8 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         + plan.base_score.shape[0]
         + (plan.nodeaff_raw.shape[0] if plan.has_nodeaff else 0)
         + (plan.taint_intol.shape[0] if plan.has_taint else 0)
+        + 2 * s_n  # scalar alloc + used scratch
+        + pw  # port occupancy planes
     )
     if terms is not None:
         tc_ = terms.cfg
@@ -910,7 +983,8 @@ _TERM_FIELDS = (
 
 
 def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
-                 has_taint: bool, has_pins: bool, tc: Optional[TermsCfg]):
+                 has_taint: bool, has_pins: bool, s_n: int, pw: int,
+                 tc: Optional[TermsCfg]):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -920,7 +994,10 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
     # ---- ref layout: base inputs, term inputs, outputs, term scratch.
     # The na/tt class tables ride along only when their scores are live
     # (a [U, R, C] tile each — meaningful VMEM at U=100).
-    BASE_IN = 18 + int(has_nodeaff) + int(has_taint)
+    BASE_IN = (
+        18 + int(has_nodeaff) + int(has_taint)
+        + (3 if s_n else 0) + (3 if pw else 0)
+    )
     TERM_IN = len(_TERM_FIELDS) if tc is not None else 0
     N_OUT = 7
 
@@ -955,6 +1032,14 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         inzc_ref = next(it)  # — unread inputs are elided)
         inzm_ref = next(it)
         ipc_ref = next(it)
+        if s_n:
+            scal_alloc_ref = next(it)  # (S, R, C) VMEM
+            iscal0_ref = next(it)  # (S, R, C) ANY, DMAed to scratch
+            reqscal_ref = next(it)  # (U*S,) SMEM
+        if pw:
+            ports0_ref = next(it)  # (Pw, R, C) ANY, DMAed to scratch
+            wantw_ref = next(it)  # (U*Pw,) SMEM
+            conflw_ref = next(it)  # (U*Pw,) SMEM
         if tc is not None:
             tr = dict(zip((nm for nm, _ in _TERM_FIELDS),
                           refs[BASE_IN : BASE_IN + TERM_IN]))
@@ -972,9 +1057,20 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         outs = refs[BASE_IN + TERM_IN : BASE_IN + TERM_IN + N_OUT]
         (place_ref, st_c_ref, st_m_ref, st_e_ref,
          st_nzc_ref, st_nzm_ref, st_p_ref) = outs
+        extra = refs[BASE_IN + TERM_IN + N_OUT :]
+        ei = 0
+        if s_n:
+            uscal_s = extra[ei]
+            ei += 1
+        if pw:
+            ports_pl = extra[ei]
+            ei += 1
         if tc is not None:
             (tgt_s, pref_s, panti_s, antib_s, tposb_s, group_s, gtot_s,
-             soft_s, dma_sem) = refs[BASE_IN + TERM_IN + N_OUT :]
+             soft_s) = extra[ei : ei + 8]
+            ei += 8
+        if s_n or pw or tc is not None:
+            dma_sem = extra[ei]
 
         shape = valid_ref.shape
         rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
@@ -997,22 +1093,29 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         st_nzc_ref[:] = inzc_ref[:]
         st_nzm_ref[:] = inzm_ref[:]
         st_p_ref[:] = ipc_ref[:]
-        if tc is not None:
+        if s_n or pw or tc is not None:
             # init states arrive in ANY (HBM) so they do not double the
             # VMEM footprint of their scratch copies; one DMA each
             from jax.experimental.pallas import tpu as pltpu_mod
 
-            for src_name, dst_ref in (
-                ("tgt0_c", tgt_s),
-                ("pref0_p", pref_s),
-                ("panti0_p", panti_s),
-                ("antib0", antib_s),
-                ("tposb0", tposb_s),
-                ("group0", group_s),
-                ("gtot0", gtot_s),
-                ("soft0_nh", soft_s),
-            ):
-                cp = pltpu_mod.make_async_copy(tr[src_name], dst_ref, dma_sem)
+            copies = []
+            if s_n:
+                copies.append((iscal0_ref, uscal_s))
+            if pw:
+                copies.append((ports0_ref, ports_pl))
+            if tc is not None:
+                copies += [
+                    (tr["tgt0_c"], tgt_s),
+                    (tr["pref0_p"], pref_s),
+                    (tr["panti0_p"], panti_s),
+                    (tr["antib0"], antib_s),
+                    (tr["tposb0"], tposb_s),
+                    (tr["group0"], group_s),
+                    (tr["gtot0"], gtot_s),
+                    (tr["soft0_nh"], soft_s),
+                ]
+            for src_ref, dst_ref in copies:
+                cp = pltpu_mod.make_async_copy(src_ref, dst_ref, dma_sem)
                 cp.start()
                 cp.wait()
 
@@ -1052,12 +1155,27 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 & (used_m + rm <= alloc_m)
                 & (used_e + re <= alloc_e)
             )
+            if s_n:
+                # extended scalar resources join NodeResourcesFit
+                # (fit.go scalar path), inside the zero-request gate
+                for s in range(s_n):
+                    rq = reqscal_ref[u * s_n + s]
+                    fit = fit & (uscal_s[s] + rq <= scal_alloc_ref[s])
             feas = (
                 (feas_ref[fu] != 0)
                 & valid
                 & (pod_cnt + 1 <= alloc_p)
                 & (fit | (has_req == 0))
             )
+            if pw:
+                # NodePorts: conflict when any occupied port matches the
+                # class's conflict mask (HostPortInfo.CheckConflict)
+                clash = jnp.zeros(shape, bool)
+                for w_i in range(pw):
+                    clash = clash | (
+                        (ports_pl[w_i] & conflw_ref[u * pw + w_i]) != 0
+                    )
+                feas = feas & ~clash
 
             # ---- inter-pod affinity + topology spread ----
             # Eval reads state directly: count/pref state is zero at
@@ -1299,6 +1417,16 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             st_nzc_ref[:] = st_nzc + jnp.where(sel, nzc, 0)
             st_nzm_ref[:] = st_nzm + jnp.where(sel, nzm, 0)
             st_p_ref[:] = pod_cnt + jnp.where(sel, 1, 0)
+            if s_n or pw:
+                sel_i = sel.astype(jnp.int32)
+            if s_n:
+                for s in range(s_n):
+                    uscal_s[s] = uscal_s[s] + reqscal_ref[u * s_n + s] * sel_i
+            if pw:
+                for w_i in range(pw):
+                    ports_pl[w_i] = ports_pl[w_i] | (
+                        wantw_ref[u * pw + w_i] * sel_i
+                    )
 
             if tc is not None:
                 inc = do.astype(jnp.int32)
@@ -1431,6 +1559,10 @@ def _device_args(plan: PallasPlan) -> list:
         plan.init_used_mcpu, plan.init_used_mem_s, plan.init_used_eph_s,
         plan.init_nz_mcpu, plan.init_nz_mem_s, plan.init_pod_cnt,
     ]
+    if plan.s_n:
+        args += [plan.alloc_scal, plan.iscal0, plan.req_scal]
+    if plan.pw:
+        args += [plan.ports0, plan.want_w, plan.confl_w]
     if plan.terms is not None:
         args += [getattr(plan.terms, name) for name, _ in _TERM_FIELDS]
     with jax.enable_x64(False):
@@ -1479,40 +1611,60 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
         interpret = jax.default_backend() != "tpu"
     tc = plan.terms.cfg if plan.terms is not None else None
     key = (p_total, plan.r, plan.u, plan.w, plan.has_nodeaff, plan.has_taint,
-           plan.has_pins, tc, interpret)
+           plan.has_pins, plan.s_n, plan.pw, tc, interpret)
     cached = _COMPILED_CACHE.get(key)
     if cached is None:
         kernel = _make_kernel(p_total, plan.u, plan.w, plan.has_nodeaff,
-                              plan.has_taint, plan.has_pins, tc)
+                              plan.has_taint, plan.has_pins, plan.s_n,
+                              plan.pw, tc)
         rc = (plan.r, LANES)
-        base_n = 18 + int(plan.has_nodeaff) + int(plan.has_taint)
+        base_n = (
+            18 + int(plan.has_nodeaff) + int(plan.has_taint)
+            + (3 if plan.s_n else 0) + (3 if plan.pw else 0)
+        )
         n_in = base_n + (len(_TERM_FIELDS) if tc is not None else 0)
-        scratch = []
-        # memory spaces: clsmap (base idx 3) in SMEM; term-block spaces
-        # come from _TERM_FIELDS (state inits in ANY, tables in SMEM)
+        # memory spaces: clsmap (base idx 3) in SMEM; the scalar/port
+        # blocks sit at the end of the base args (alloc VMEM, init ANY,
+        # tables SMEM); term-block spaces come from _TERM_FIELDS
         smem_idx = {3}
         any_idx = set()
+        off = 18 + int(plan.has_nodeaff) + int(plan.has_taint)
+        if plan.s_n:
+            any_idx.add(off + 1)  # iscal0
+            smem_idx.add(off + 2)  # req_scal
+            off += 3
+        if plan.pw:
+            any_idx.add(off)  # ports0
+            smem_idx.update((off + 1, off + 2))  # want/conflict words
+            off += 3
         if tc is not None:
-            for off, (_, space) in enumerate(_TERM_FIELDS):
+            for toff, (_, space) in enumerate(_TERM_FIELDS):
                 if space == "any":
-                    any_idx.add(base_n + off)
+                    any_idx.add(base_n + toff)
                 elif space == "smem":
-                    smem_idx.add(base_n + off)
+                    smem_idx.add(base_n + toff)
 
+        scratch = []
+        if plan.s_n or plan.pw or tc is not None:
             from jax.experimental.pallas import tpu as _pltpu
 
             rl = (plan.r, LANES)
-            scratch = [
-                _pltpu.VMEM((tc.tc,) + rl, jnp.int32),  # tgt counts
-                _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # pref (combined)
-                _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # panti
-                _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # anti>0 bitplanes
-                _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # tgt>0 bitplanes
-                _pltpu.VMEM((tc.a,) + rl, jnp.int32),  # group
-                _pltpu.VMEM((tc.a, SUBLANES, LANES), jnp.int32),  # gtot
-                _pltpu.VMEM((tc.csn,) + rl, jnp.int32),  # soft non-host
-                _pltpu.SemaphoreType.DMA,
-            ]
+            if plan.s_n:
+                scratch.append(_pltpu.VMEM((plan.s_n,) + rl, jnp.int32))
+            if plan.pw:
+                scratch.append(_pltpu.VMEM((plan.pw,) + rl, jnp.int32))
+            if tc is not None:
+                scratch += [
+                    _pltpu.VMEM((tc.tc,) + rl, jnp.int32),  # tgt counts
+                    _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # pref (combined)
+                    _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # panti
+                    _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # anti>0 bitplanes
+                    _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # tgt>0 bitplanes
+                    _pltpu.VMEM((tc.a,) + rl, jnp.int32),  # group
+                    _pltpu.VMEM((tc.a, SUBLANES, LANES), jnp.int32),  # gtot
+                    _pltpu.VMEM((tc.csn,) + rl, jnp.int32),  # soft non-host
+                ]
+            scratch.append(_pltpu.SemaphoreType.DMA)
 
         @jax.jit
         def call(*arrays):

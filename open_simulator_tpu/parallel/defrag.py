@@ -202,6 +202,9 @@ def plan_defrag(
         if pallas_scan.should_use()
         else None
     )
+    from ..utils.trace import GLOBAL
+
+    GLOBAL.note("defrag-kernel", "pallas" if plan is not None else "xla-scan")
     if plan is not None:
         unsched = np.zeros(sc, dtype=np.int64)
         for s_i in range(sc):

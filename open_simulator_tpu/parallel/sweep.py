@@ -206,6 +206,11 @@ class CapacitySweep:
             if pallas_scan.should_use()
             else None
         )
+        from ..utils.trace import GLOBAL
+
+        GLOBAL.note(
+            "sweep-kernel", "pallas" if self._pallas_plan is not None else "xla-scan"
+        )
 
     # -- masks -------------------------------------------------------------
 

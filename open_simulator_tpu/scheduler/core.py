@@ -161,6 +161,9 @@ class Simulator:
             # every later placement the batched scan committed
             and not self.oracle.registry.has_permit
         )
+        from ..utils.trace import GLOBAL
+
+        GLOBAL.note("engine", "batch" if use_tpu else "serial-oracle")
         if use_tpu:
             failed = self._schedule_pods_tpu(pods)
         else:

@@ -83,6 +83,9 @@ class TpuEngine:
             if plan is None:
                 static = to_scan_static(cluster, batch)
                 init = to_scan_state(dyn, batch)
+        from ..utils.trace import GLOBAL
+
+        GLOBAL.note("batch-kernel", "pallas" if plan is not None else "xla-scan")
         if plan is not None:
             # fused single-kernel fast path; bit-identical placements
             # (tests/test_pallas_scan.py)

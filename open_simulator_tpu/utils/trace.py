@@ -33,6 +33,7 @@ class PhaseRecord:
 class Trace:
     phases: Dict[str, PhaseRecord] = field(default_factory=dict)
     order: List[str] = field(default_factory=list)
+    notes: Dict[str, str] = field(default_factory=dict)
 
     def add(self, name: str, seconds: float):
         with _lock:
@@ -44,13 +45,20 @@ class Trace:
                 rec.seconds += seconds
                 rec.count += 1
 
+    def note(self, name: str, value: str):
+        """Record a fact about the run (e.g. which engine path ran:
+        `engine=pallas` vs `engine=xla-scan`) for `--trace` output."""
+        with _lock:
+            self.notes[name] = value
+
     def reset(self):
         with _lock:
             self.phases.clear()
             self.order.clear()
+            self.notes.clear()
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "phases": [
                 {
                     "name": n,
@@ -61,6 +69,9 @@ class Trace:
             ],
             "total_seconds": round(sum(p.seconds for p in self.phases.values()), 6),
         }
+        if self.notes:
+            out["notes"] = dict(self.notes)
+        return out
 
     def as_json(self) -> str:
         return json.dumps(self.as_dict())

@@ -215,3 +215,157 @@ def test_sweep_skips_kernel_off_tpu(monkeypatch):
     res.deployments = [make_fake_deployment("web", "default", 4)]
     sweep = CapacitySweep(cluster, [AppResource("a", res)], None, 0)
     assert sweep._pallas_plan is None
+
+
+# ------------------------------------------------- ports & scalar resources
+
+
+def _port_pod(name, port, cpu="100m"):
+    pod = make_fake_pod(name, "default", cpu, "100Mi")
+    pod["spec"]["containers"][0]["ports"] = [
+        {"containerPort": port, "hostPort": port, "protocol": "TCP"}
+    ]
+    return pod
+
+
+def test_host_ports_one_per_node():
+    """NodePorts in the kernel: a hostPort conflicts with itself, so
+    replicas spread one per node and the overflow goes unplaced."""
+    nodes = [make_fake_node(f"pn-{i}", "8", "16Gi") for i in range(3)]
+    pods = [_port_pod(f"web-{i}", 8080) for i in range(4)]
+    xla, pl_, _ = _run_both(nodes, pods)
+    assert (pl_ == xla).all()
+    placed = pl_[pl_ >= 0]
+    assert len(placed) == 3 and len(set(placed.tolist())) == 3
+    assert (pl_ < 0).sum() == 1
+
+
+def test_host_ports_mixed_batch_stays_on_fast_path():
+    """A batch where only some pods carry hostPorts must still build a
+    kernel plan (the round-2 cliff sent the whole batch to the XLA
+    scan)."""
+    nodes = [make_fake_node(f"pn-{i}", "8", "16Gi") for i in range(4)]
+    pods = [make_fake_pod(f"plain-{i}", "default", "500m", "1Gi") for i in range(12)]
+    pods += [_port_pod(f"svc-{i}", 9090) for i in range(3)]
+    xla, pl_, _ = _run_both(nodes, pods)
+    assert (pl_ == xla).all()
+    assert (pl_ >= 0).all()
+
+
+def test_different_ports_do_not_conflict():
+    nodes = [make_fake_node("pn-0", "8", "16Gi")]
+    pods = [_port_pod("a", 8080), _port_pod("b", 8081)]
+    xla, pl_, _ = _run_both(nodes, pods)
+    assert (pl_ == xla).all()
+    assert (pl_ == 0).all()
+
+
+def _scalar_pod(name, resource, amount, cpu="100m"):
+    pod = make_fake_pod(name, "default", cpu, "100Mi")
+    reqs = pod["spec"]["containers"][0]["resources"]["requests"]
+    reqs[resource] = str(amount)
+    return pod
+
+
+def test_scalar_resources_capacity():
+    """Extended scalar resources in the kernel: nodes advertise 2
+    example.com/widget each; 1-per-pod requests cap at 2 per node."""
+    nodes = []
+    for i in range(2):
+        node = make_fake_node(f"sn-{i}", "8", "16Gi")
+        node["status"]["allocatable"]["example.com/widget"] = "2"
+        nodes.append(node)
+    pods = [_scalar_pod(f"w-{i}", "example.com/widget", 1) for i in range(5)]
+    xla, pl_, _ = _run_both(nodes, pods)
+    assert (pl_ == xla).all()
+    placed = pl_[pl_ >= 0]
+    assert len(placed) == 4
+    counts = np.bincount(placed, minlength=2)
+    assert (counts == 2).all()
+    assert (pl_ < 0).sum() == 1
+
+
+def test_scalars_and_ports_with_terms():
+    """Scalars + ports + affinity terms coexist in one kernel plan."""
+    nodes = []
+    for i in range(4):
+        node = make_fake_node(
+            f"mx-{i}", "8", "16Gi", with_node_labels({"zone": f"z{i % 2}"})
+        )
+        node["status"]["allocatable"]["example.com/widget"] = "4"
+        nodes.append(node)
+    pods = []
+    for i in range(6):
+        pod = _scalar_pod(f"m-{i}", "example.com/widget", 1)
+        pod["metadata"]["labels"] = {"app": "mx"}
+        pod["spec"]["affinity"] = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": {"matchLabels": {"app": "mx"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }
+                ]
+            }
+        }
+        if i % 2:
+            pod["spec"]["containers"][0]["ports"] = [
+                {"containerPort": 7070, "hostPort": 7070, "protocol": "TCP"}
+            ]
+        pods.append(pod)
+    xla, pl_, _ = _run_both(nodes, pods)
+    assert (pl_ == xla).all()
+    # anti-affinity: at most one per node -> 4 placed, 2 unplaced
+    placed = pl_[pl_ >= 0]
+    assert len(placed) == 4 and len(set(placed.tolist())) == 4
+
+
+def _run_both_existing(nodes, pods, existing):
+    """_run_both with pre-placed pods (nodeName-bound) seeding dynamic
+    state — exercises the kernel's non-zero init DMA planes."""
+    import jax.numpy as jnp
+
+    oracle = Oracle(nodes)
+    for p in existing:
+        oracle.place_existing_pod(p)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    features = features_of_batch(cluster, batch)
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features)
+    assert plan is not None
+    xla, _ = scan_ops.run_scan(
+        static, init, jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node), features=features,
+    )
+    got, _ = pallas_scan.run_scan_pallas(
+        plan, batch.class_of_pod, np.ones(len(pods), bool),
+        np.ones(cluster.n, bool), pinned=batch.pinned_node,
+    )
+    return np.asarray(xla), got
+
+
+def test_ports_and_scalars_nonzero_init_state():
+    """Existing pods already holding a hostPort / scalar units must
+    seed the kernel's occupancy planes: a newcomer conflicts with the
+    pre-existing port and scalar capacity, identically to the XLA
+    path."""
+    nodes = []
+    for i in range(2):
+        node = make_fake_node(f"en-{i}", "8", "16Gi")
+        node["status"]["allocatable"]["example.com/widget"] = "2"
+        nodes.append(node)
+    holder = _port_pod("holder", 7070)
+    holder["spec"]["nodeName"] = "en-0"
+    eater = _scalar_pod("eater", "example.com/widget", 2)
+    eater["spec"]["nodeName"] = "en-0"
+    pods = [
+        _port_pod("new-port", 7070),
+        _scalar_pod("new-widget", "example.com/widget", 1),
+    ]
+    xla, got = _run_both_existing(nodes, pods, [holder, eater])
+    assert (got == xla).all()
+    # both newcomers must avoid en-0: its port is taken and widgets full
+    assert (got == 1).all()

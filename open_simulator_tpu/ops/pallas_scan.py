@@ -1590,12 +1590,15 @@ def should_use() -> bool:
 
 
 def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
-                    pinned=None, interpret=None):
+                    pinned=None, interpret=None, defer=False):
     """Run the fused scan. Returns (placements[P] np.int32, final used
     dict in TRUE units for utilization reporting). `pinned` ([P] node
     index or -1; required when the plan was built with pins) forces
     spec.nodeName placements. `interpret` forces the Pallas interpreter
-    (None = auto: interpret off-TPU)."""
+    (None = auto: interpret off-TPU). With `defer=True` the raw DEVICE
+    output array is returned unfetched, so a caller dispatching many
+    scans (defrag depths) can stack them and pay the ~0.1s relay sync
+    once; decode each row-block with decode_scan_output."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -1743,7 +1746,20 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
         # (~0.1s); the implicit transfer pipelines with the dispatch so
         # the single np.asarray fetch is the call's only sync point
         out_d = cached.fn(pod_scal, active_2d, valid, *_device_args(plan))
+        if defer:
+            # caller batches several scans (e.g. defrag depths) and
+            # fetches them stacked in ONE sync via decode_scan_output
+            return out_d
         out = np.asarray(out_d)
+    return decode_scan_output(plan, out, p_total)
+
+
+def decode_scan_output(plan: PallasPlan, out: np.ndarray, p_total: int):
+    """Split a fetched kernel output row-block into (placements, final
+    used dict) — the tail of run_scan_pallas, exposed for deferred
+    (stacked-fetch) callers."""
+    pr_rows = max(-(-p_total // LANES), 1)
+    pr_rows = -(-pr_rows // SUBLANES) * SUBLANES
     place = out[:pr_rows]
     states = out[pr_rows:]
     place = place.reshape(-1)[:p_total]

@@ -29,7 +29,6 @@ with one shared pod order across scenarios (vmap requirement).
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -78,12 +77,25 @@ def _is_daemonset_pod(pod: dict) -> bool:
 
 
 def _strip_node_name(pod: dict) -> dict:
-    out = copy.deepcopy(pod)
-    spec = out.setdefault("spec", {})
+    """Shallow per-level copy (pod/metadata/annotations/spec/status):
+    deep-copying 6k pods cost ~1s per plan while the big sub-objects
+    (containers, affinity) are read-only downstream — only the dicts
+    the replay's bind path mutates need to be private."""
+    out = dict(pod)
+    meta = dict(out.get("metadata") or {})
+    if "annotations" in meta and meta["annotations"] is not None:
+        meta["annotations"] = dict(meta["annotations"])
+    out["metadata"] = meta
+    spec = dict(out.get("spec") or {})
     spec.pop("nodeName", None)
-    # stale placement state must not leak into re-scheduling
-    status = out.get("status") or {}
-    status.pop("phase", None)
+    out["spec"] = spec
+    # stale placement state must not leak into re-scheduling — copy
+    # whenever present (even {}: the bind path mutates status in place)
+    status = out.get("status")
+    if status is not None:
+        status = dict(status)
+        status.pop("phase", None)
+        out["status"] = status
     return out
 
 
@@ -206,26 +218,40 @@ def plan_defrag(
 
     GLOBAL.note("defrag-kernel", "pallas" if plan is not None else "xla-scan")
     if plan is not None:
-        unsched = np.zeros(sc, dtype=np.int64)
-        for s_i in range(sc):
-            placements, _ = pallas_scan.run_scan_pallas(
+        # dispatch every depth's scan without fetching, stack on the
+        # device, and pay the relay's ~0.1s sync latency ONCE for all
+        # depths instead of once per depth
+        outs = [
+            pallas_scan.run_scan_pallas(
                 plan,
                 batch.class_of_pod,
                 pod_active[s_i],
                 node_valid[s_i],
                 pinned=pinned[s_i],
+                defer=True,
             )
+            for s_i in range(sc)
+        ]
+        stacked = np.asarray(jnp.stack(outs))
+        unsched = np.zeros(sc, dtype=np.int64)
+        place_by_depth = {}
+        for s_i in range(sc):
+            placements, _ = pallas_scan.decode_scan_output(
+                plan, stacked[s_i], p_cnt
+            )
+            place_by_depth[s_i] = placements
             unsched[s_i] = int((placements == -1).sum())
         return _pick_depth(
-            snapshot, ranked, ranked_names, depths, unsched, entries
+            snapshot, ranked, ranked_names, depths, unsched, entries,
+            place_by_depth.get,
         )
 
     def one_scenario(pin, valid, active):
         placements, _final = scan_ops.run_scan_masked(
             static, init, class_arr, pin, valid, active, features=features
         )
-        # only the count leaves the device; the serial _replay derives
-        # the winning depth's exact placements
+        # only the count leaves the device; the winning depth's exact
+        # placements are re-derived on demand by placements_for below
         return jnp.sum(placements == -1)
 
     sweep_fn = jax.vmap(one_scenario)
@@ -254,15 +280,35 @@ def plan_defrag(
     else:
         unsched = np.asarray(jax.jit(sweep_fn)(pin_j, valid_j, active_j))
 
-    return _pick_depth(snapshot, ranked, ranked_names, depths, unsched, entries)
+    def placements_for(depth):
+        placements, _ = scan_ops.run_scan_masked(
+            static, init, class_arr,
+            jnp.asarray(pinned[depth]), jnp.asarray(node_valid[depth]),
+            jnp.asarray(pod_active[depth]), features=features,
+        )
+        return np.asarray(placements)
+
+    return _pick_depth(
+        snapshot, ranked, ranked_names, depths, unsched, entries,
+        placements_for,
+    )
 
 
-def _pick_depth(snapshot, ranked, ranked_names, depths, unsched, entries):
-    """Deepest feasible drain per the batched search, then serial-oracle
+def _pick_depth(snapshot, ranked, ranked_names, depths, unsched, entries,
+                placements_for=None):
+    """Deepest feasible drain per the batched search, then host-state
     validation (mirrors the applier's sweep-hint + authoritative-run
-    split); on disagreement fall back to the next shallower depth."""
+    split): the batched scan's own placements replay as filter-checked
+    forced commits; a full serial re-schedule only runs if that
+    disagrees, and on failure the next shallower depth is tried."""
     for depth in sorted((d for d in depths if unsched[d] == 0), reverse=True):
-        validated = _replay(snapshot, ranked, depth, entries)
+        validated = None
+        if placements_for is not None and depth > 0:
+            validated = _replay_forced(
+                snapshot, ranked, depth, entries, placements_for(depth)
+            )
+        if validated is None:
+            validated = _replay(snapshot, ranked, depth, entries)
         if validated is not None:
             moves, result = validated
             return DefragResult(
@@ -283,28 +329,85 @@ def _pick_depth(snapshot, ranked, ranked_names, depths, unsched, entries):
     )
 
 
-def _replay(snapshot, ranked, depth, entries):
-    """Serial-oracle validation of one drain depth. Returns
-    (moves, SimulateResult) or None if any evicted pod fails."""
+def _replay_setup(snapshot, ranked, depth, entries):
+    """Shared prologue of both replay flavors: a preemption-free oracle
+    over the kept nodes with every kept pod re-committed, a map from
+    snapshot node index to its kept NodeState, and the evicted pods as
+    (entry_idx, node_idx, pod)."""
     from ..scheduler.oracle import Oracle
 
     statuses = snapshot.node_status
     drained = set(ranked[:depth])
-    kept_nodes = [ns.node for i, ns in enumerate(statuses) if i not in drained]
+    kept = [(i, ns) for i, ns in enumerate(statuses) if i not in drained]
     # a defrag replay must never evict running pods to make a drained
     # pod fit — moves have to land in genuinely free capacity
-    oracle = Oracle(kept_nodes, enable_preemption=False)
+    oracle = Oracle([ns.node for _, ns in kept], enable_preemption=False)
+    kept_state = {i: oracle.nodes[k] for k, (i, _) in enumerate(kept)}
 
     evicted = []
-    for _rank, node_idx, pod, is_ds in entries:
+    for e_i, (_rank, node_idx, pod, is_ds) in enumerate(entries):
         if node_idx in drained:
             if not is_ds:
-                evicted.append((node_idx, pod))
+                evicted.append((e_i, node_idx, pod))
             continue
         oracle.place_existing_pod(pod)
+    return oracle, kept_state, evicted
+
+
+def _replay_result(oracle):
+    return SimulateResult(
+        unscheduled_pods=[],
+        node_status=[
+            NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes
+        ],
+    )
+
+
+def _replay_forced(snapshot, ranked, depth, entries, placements):
+    """Validated replay driven by the batched scan's placements for
+    this depth: kept pods re-commit as-is; each evicted pod's scan
+    target is checked against live host state with the full framework
+    filter set plus the permit plugins, then force-committed — O(1)
+    nodes per move instead of the serial path's full prioritize cycle.
+    Returns None (caller falls back to the serial _replay) on any
+    disagreement."""
+    statuses = snapshot.node_status
+    oracle, kept_state, evicted = _replay_setup(snapshot, ranked, depth, entries)
 
     moves: List[PodMove] = []
-    for node_idx, pod in evicted:
+    for e_i, node_idx, pod in evicted:
+        target = int(placements[e_i])
+        ns = kept_state.get(target)
+        if ns is None:  # unplaced, or a target the drain masked out
+            return None
+        clean = _strip_node_name(pod)
+        if not oracle.passes_filters_on_node(clean, ns):
+            return None
+        # the serial path enforces Permit via _select_and_bind — a
+        # forced commit must not skip a permit plugin's veto
+        for plugin in oracle.registry.plugins:
+            if not plugin.permit(clean, ns.node):
+                return None
+        oracle._reserve_and_bind(clean, ns)
+        moves.append(
+            PodMove(
+                pod=clean,
+                from_node=statuses[node_idx].node["metadata"]["name"],
+                to_node=ns.name,
+            )
+        )
+    return moves, _replay_result(oracle)
+
+
+def _replay(snapshot, ranked, depth, entries):
+    """Serial-oracle validation of one drain depth (full scheduling
+    cycle per evicted pod). Returns (moves, SimulateResult) or None if
+    any evicted pod fails."""
+    statuses = snapshot.node_status
+    oracle, _kept_state, evicted = _replay_setup(snapshot, ranked, depth, entries)
+
+    moves: List[PodMove] = []
+    for _e_i, node_idx, pod in evicted:
         clean = _strip_node_name(pod)
         target, _reason = oracle.schedule_pod(clean)
         if target is None:
@@ -318,8 +421,4 @@ def _replay(snapshot, ranked, depth, entries):
         )
 
     # a validated plan schedules every evicted pod by construction
-    result = SimulateResult(
-        unscheduled_pods=[],
-        node_status=[NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes],
-    )
-    return moves, result
+    return moves, _replay_result(oracle)

@@ -310,6 +310,15 @@ def _dedup_rows(tab: np.ndarray):
     return np.stack(rows), idx
 
 
+def _pr_rows(p_total: int) -> int:
+    """Rows of the dense (Pr, 128) placement packing — the one
+    definition shared by run_scan_pallas (output allocation) and
+    decode_scan_output (row split); they must agree or the split lands
+    mid-block."""
+    rows = max(-(-p_total // LANES), 1)
+    return -(-rows // SUBLANES) * SUBLANES
+
+
 def _bit(r: int) -> int:
     """int32 bitmask for logical row r (bit r & 31 of plane r >> 5)."""
     return int(np.uint32(1 << (r & 31)).view(np.int32))
@@ -1607,8 +1616,7 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
     p_total = int(np.asarray(class_of_pod).shape[0])
     # dense (Pr, 128) packing: a (P, 1) VMEM array would be lane-padded
     # 128x by the (8, 128) tile layout (51 MB at 100k pods)
-    pr_rows = max(-(-p_total // LANES), 1)
-    pr_rows = -(-pr_rows // SUBLANES) * SUBLANES
+    pr_rows = _pr_rows(p_total)
     p_pad = pr_rows * LANES
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -1758,8 +1766,7 @@ def decode_scan_output(plan: PallasPlan, out: np.ndarray, p_total: int):
     """Split a fetched kernel output row-block into (placements, final
     used dict) — the tail of run_scan_pallas, exposed for deferred
     (stacked-fetch) callers."""
-    pr_rows = max(-(-p_total // LANES), 1)
-    pr_rows = -(-pr_rows // SUBLANES) * SUBLANES
+    pr_rows = _pr_rows(p_total)
     place = out[:pr_rows]
     states = out[pr_rows:]
     place = place.reshape(-1)[:p_total]

@@ -10,6 +10,11 @@ Mirrors vendor/k8s.io/kubernetes/pkg/scheduler/core/extender.go:
   MaxNodeScore/MaxExtenderPriority = 10 into the plugin score sum
   (generic_scheduler.go:519-556)
 - Bind (extender.go:385-399): a binder extender is delegated the bind
+- ProcessPreemption (extender.go:164-205): a preempt-verb extender is
+  consulted during DefaultPreemption's candidate selection
+  (default_preemption.go:346-393 CallExtenders) with the dry-run victim
+  map and returns the subset of (node, victims) it accepts — possibly
+  with a different victim list per node
 - IsInterested (extender.go:406-424): only pods requesting a managed
   resource reach the extender (no managedResources = all pods)
 
@@ -36,6 +41,18 @@ class ExtenderError(RuntimeError):
     pass
 
 
+def _pod_uid(pod: dict) -> str:
+    """Pod identifier for MetaPod round-trips. The reference matches on
+    metadata.uid alone (convertPodUIDToPod); simulated pods often carry
+    no uid, so fall back to namespace/name — stable and unique within a
+    simulation."""
+    meta = pod.get("metadata") or {}
+    uid = meta.get("uid")
+    if uid:
+        return str(uid)
+    return f"{meta.get('namespace') or 'default'}/{meta.get('name', '')}"
+
+
 @dataclass
 class ExtenderConfig:
     """KubeSchedulerConfiguration `extenders:` entry (v1beta1)."""
@@ -44,6 +61,7 @@ class ExtenderConfig:
     filter_verb: str = ""
     prioritize_verb: str = ""
     bind_verb: str = ""
+    preempt_verb: str = ""
     weight: int = 1
     node_cache_capable: bool = False
     ignorable: bool = False
@@ -57,6 +75,7 @@ class ExtenderConfig:
             filter_verb=d.get("filterVerb", ""),
             prioritize_verb=d.get("prioritizeVerb", ""),
             bind_verb=d.get("bindVerb", ""),
+            preempt_verb=d.get("preemptVerb", ""),
             weight=int(d.get("weight", 1) or 1),
             node_cache_capable=bool(d.get("nodeCacheCapable", False)),
             ignorable=bool(d.get("ignorable", False)),
@@ -78,6 +97,11 @@ class HTTPExtender:
     @property
     def is_binder(self) -> bool:
         return bool(self.config.bind_verb)
+
+    @property
+    def supports_preemption(self) -> bool:
+        """SupportsPreemption (extender.go:158-162)."""
+        return bool(self.config.preempt_verb)
 
     def is_interested(self, pod: dict) -> bool:
         if not self.config.managed_resources:
@@ -173,6 +197,83 @@ class HTTPExtender:
             h.get("host", ""): int(h.get("score", 0)) for h in result
         }
 
+    def process_preemption(
+        self,
+        pod: dict,
+        victims_map: Dict[str, dict],
+        get_node_pods,
+    ) -> Dict[str, dict]:
+        """ProcessPreemption (extender.go:164-205).
+
+        `victims_map` is {node_name: {"pods": [pod dicts],
+        "numPDBViolations": int}}; `get_node_pods(node_name)` returns the
+        pods currently committed on that node (the NodeInfoLister role).
+
+        POSTs ExtenderPreemptionArgs — `nodeNameToMetaVictims` (pod UIDs
+        only) when nodeCacheCapable, else `nodeNameToVictims` (full pods)
+        — and converts the result's meta victims back to pod objects via
+        the node's pod list (convertToNodeNameToVictims,
+        extender.go:207-233). A meta victim naming an unknown node or a
+        pod not on that node is a scheduler/extender cache inconsistency
+        and raises (convertPodUIDToPod, extender.go:236-247).
+
+        Like the reference conversion, numPDBViolations is NOT carried
+        back from the extender result (extender.go:218-220 builds Victims
+        with pods only), so post-extender candidates tie at 0 violations.
+        """
+        if not self.supports_preemption:
+            raise ExtenderError(
+                f"preempt verb is not defined for extender {self.name} "
+                "but run into ProcessPreemption"
+            )
+        args: dict = {"pod": pod}
+        if self.config.node_cache_capable:
+            args["nodeNameToMetaVictims"] = {
+                node: {
+                    "pods": [{"uid": _pod_uid(p)} for p in v.get("pods") or []],
+                    "numPDBViolations": int(v.get("numPDBViolations") or 0),
+                }
+                for node, v in victims_map.items()
+            }
+        else:
+            args["nodeNameToVictims"] = {
+                node: {
+                    "pods": list(v.get("pods") or []),
+                    "numPDBViolations": int(v.get("numPDBViolations") or 0),
+                }
+                for node, v in victims_map.items()
+            }
+        result = self._send(self.config.preempt_verb, args)
+        if not isinstance(result, dict):
+            raise ExtenderError(
+                f"extender {self.name}: malformed preemption response"
+            )
+        # extenders always answer with meta victims (extender.go:197-198);
+        # accept Go-default field casing too (the structs carry no json
+        # tags, so a Go extender marshals `NodeNameToMetaVictims`)
+        meta = result.get("nodeNameToMetaVictims")
+        if meta is None:
+            meta = result.get("NodeNameToMetaVictims")
+        out: Dict[str, dict] = {}
+        for node, mv in (meta or {}).items():
+            if node not in victims_map:
+                raise ExtenderError(
+                    f"extender {self.name} claims unknown node {node!r}"
+                )
+            node_pods = {_pod_uid(p): p for p in get_node_pods(node)}
+            pods = []
+            for mp in (mv or {}).get("pods") or (mv or {}).get("Pods") or []:
+                uid = (mp or {}).get("uid") or (mp or {}).get("UID") or ""
+                if uid not in node_pods:
+                    raise ExtenderError(
+                        f"extender {self.name} claims to preempt pod "
+                        f"(UID: {uid}) on node: {node}, but the pod is not "
+                        "found on that node"
+                    )
+                pods.append(node_pods[uid])
+            out[node] = {"pods": pods, "numPDBViolations": 0}
+        return out
+
     def bind(self, pod: dict, node_name: str) -> None:
         meta = pod.get("metadata") or {}
         result = self._send(
@@ -234,6 +335,32 @@ def extender_scores(
                 combined[host] += score * ext.config.weight
     scale = MAX_NODE_SCORE // MAX_EXTENDER_PRIORITY
     return [combined[ns.name] * scale for ns in feasible]
+
+
+def call_extenders_preemption(
+    extenders: List[HTTPExtender],
+    pod: dict,
+    victims_map: Dict[str, dict],
+    get_node_pods,
+) -> Dict[str, dict]:
+    """CallExtenders (default_preemption.go:346-393): run every
+    preemption-capable, interested extender over the victim map in
+    order, each seeing the previous one's output. An erroring ignorable
+    extender is skipped; a non-ignorable error propagates (failing the
+    preemption attempt). An empty map short-circuits — no later extender
+    can resurrect candidates."""
+    for ext in extenders:
+        if not ext.supports_preemption or not ext.is_interested(pod):
+            continue
+        try:
+            victims_map = ext.process_preemption(pod, victims_map, get_node_pods)
+        except ExtenderError:
+            if ext.config.ignorable:
+                continue
+            raise
+        if not victims_map:
+            break
+    return victims_map
 
 
 def extenders_from_config_doc(doc: dict) -> List[HTTPExtender]:

@@ -412,9 +412,16 @@ class Oracle:
         # when nothing committed is lower, skip the whole dry run
         if not (prio > self._min_prio):
             return None
+        from .extender import ExtenderError
         from .preemption import run_preemption
 
-        result = run_preemption(self, pod, codes)
+        try:
+            result = run_preemption(self, pod, codes)
+        except ExtenderError:
+            # non-ignorable preempt-verb extender failure: the PostFilter
+            # returns an error status and the pod stays unschedulable
+            # (CallExtenders error path, default_preemption.go:146-149)
+            return None
         if result is None:
             return None
         preemptor = (pod.get("metadata") or {}).get("name", "")
@@ -430,8 +437,6 @@ class Oracle:
         # Victims stay evicted even if the retry fails (the reference
         # likewise never restores PrepareCandidate's deletions); an
         # extender error here fails this pod's cycle, not the run.
-        from .extender import ExtenderError
-
         try:
             feasible, _, _ = self._find_feasible(pod)
             if not feasible:
@@ -574,8 +579,9 @@ class Oracle:
 
     def passes_filters_on_node(self, pod: dict, ns: NodeState, ctx=None) -> bool:
         """PodPassesFiltersOnNode for the preemption dry run: framework
-        filters only (extenders join preemption via ProcessPreemption,
-        not here), with PreFilter state recomputed against current
+        filters only (extenders join preemption via ProcessPreemption —
+        preemption.run_preemption calls them over the finished candidate
+        map, not per dry-run node), with PreFilter state recomputed against current
         cluster state. `ctx` (state-independent, from _pod_filter_ctx)
         may be precomputed by the caller and reused across calls."""
         if ctx is None:

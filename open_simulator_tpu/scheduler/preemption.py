@@ -291,9 +291,13 @@ def select_victims_on_node(oracle, pod: dict, ns, pdbs: List[dict], ctx=None):
 
 
 def run_preemption(oracle, pod: dict, codes: Dict[int, str]) -> Optional[PreemptionResult]:
-    """The preempt() pipeline (default_preemption.go:118-163) minus
-    extender ProcessPreemption (no configured extender of the reference
-    example set supports preemption).
+    """The preempt() pipeline (default_preemption.go:118-163) including
+    extender ProcessPreemption (CallExtenders,
+    default_preemption.go:146): preemption-capable extenders see the
+    dry-run candidate map and may drop nodes or rewrite victim lists
+    before pickOneNodeForPreemption. A non-ignorable extender error
+    raises ExtenderError — the caller fails this preemption attempt
+    (PostFilter error status), not the run.
 
     `codes` is the per-node-index failure code map from the failed
     scheduling cycle ("unschedulable" | "unresolvable")."""
@@ -330,9 +334,62 @@ def run_preemption(oracle, pod: dict, codes: Dict[int, str]) -> Optional[Preempt
                 num_pdb_violations=num_violating,
             )
         )
+    candidates = _call_preemption_extenders(oracle, pod, candidates)
     best = pick_one_node(candidates, oracle)
     if best is None:
         return None
     return PreemptionResult(
         node_name=best.node_name, node_index=best.node_index, victims=best.victims
     )
+
+
+def _call_preemption_extenders(
+    oracle, pod: dict, candidates: List[Candidate]
+) -> List[Candidate]:
+    """CallExtenders adaptation over oracle Candidates. Rebuilt
+    candidates keep the extender's victim lists; like the reference's
+    convertToNodeNameToVictims they carry 0 PDB violations, and a node
+    whose victim list the extender emptied is dropped (evicting nothing
+    cannot help — same rule as the dry run). Raises ExtenderError on a
+    non-ignorable extender failure."""
+    extenders = getattr(oracle, "extenders", None) or []
+    if not candidates or not any(e.supports_preemption for e in extenders):
+        return candidates
+    from .extender import call_extenders_preemption
+
+    victims_map = {
+        c.node_name: {
+            "pods": list(c.victims),
+            "numPDBViolations": c.num_pdb_violations,
+        }
+        for c in candidates
+    }
+    new_map = call_extenders_preemption(
+        extenders,
+        pod,
+        victims_map,
+        lambda name: oracle.nodes[oracle.node_index[name]].pods,
+    )
+    if new_map is victims_map:
+        return candidates
+    out: List[Candidate] = []
+    for c in candidates:
+        v = new_map.get(c.node_name)
+        if v is None or not v.get("pods"):
+            continue
+        # restore the MoreImportantPod invariant pick_one_node relies on
+        # (victims[0] = highest-priority victim) — the extender's
+        # response order is arbitrary
+        victims = sorted(
+            v["pods"],
+            key=lambda p: (-oracle.pod_priority(p), oracle.commit_seq_of(p)),
+        )
+        out.append(
+            Candidate(
+                node_index=c.node_index,
+                node_name=c.node_name,
+                victims=victims,
+                num_pdb_violations=int(v.get("numPDBViolations") or 0),
+            )
+        )
+    return out

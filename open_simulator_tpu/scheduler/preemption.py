@@ -348,10 +348,13 @@ def _call_preemption_extenders(
 ) -> List[Candidate]:
     """CallExtenders adaptation over oracle Candidates. Rebuilt
     candidates keep the extender's victim lists; like the reference's
-    convertToNodeNameToVictims they carry 0 PDB violations, and a node
-    whose victim list the extender emptied is dropped (evicting nothing
-    cannot help — same rule as the dry run). Raises ExtenderError on a
-    non-ignorable extender failure."""
+    convertToNodeNameToVictims they carry 0 PDB violations. A node whose
+    victim list the extender emptied is dropped — deliberate deviation:
+    the vendored v1.20.5 pickOneNodeForPreemption would panic on it
+    (victims.Pods[0], default_preemption.go:476; later k8s releases
+    return such a node immediately as the nominee), and with no eviction
+    the retry cycle cannot succeed here anyway. Raises ExtenderError on
+    a non-ignorable extender failure."""
     extenders = getattr(oracle, "extenders", None) or []
     if not candidates or not any(e.supports_preemption for e in extenders):
         return candidates

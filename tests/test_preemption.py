@@ -411,3 +411,78 @@ def test_evicting_unannotated_gpu_pod_releases_devices():
     assert pod["metadata"]["annotations"].get(stor.GPU_INDEX_ANNO)
     oracle.remove_pod_from_node(ns, pod)
     assert sum(ns.gpu.used) == 0
+
+
+# ---------------------------------------------------- hybrid engine routing
+
+
+def _hybrid_case(extra_cluster_pods=(), n_zero=8):
+    """4 full 1-cpu nodes (800m victim each), 2 preemptors, n_zero
+    50m zero-prio pods: the head preempts, the zero run scans, the
+    deferred victims fail at the end."""
+    nodes = [make_fake_node(f"node-{i}", "1", "4Gi") for i in range(4)]
+    victims = [
+        make_fake_pod(f"victim-{i}", "default", "800m", "1Gi", with_priority(0))
+        for i in range(4)
+    ]
+    preemptors = [
+        make_fake_pod(f"pre-{i}", "default", "800m", "1Gi", with_priority(100))
+        for i in range(2)
+    ]
+    zeros = [
+        make_fake_pod(f"zero-{i}", "default", "50m", "8Mi", with_priority(0))
+        for i in range(n_zero)
+    ]
+    cluster = _cluster(nodes, pods=victims + list(extra_cluster_pods))
+    return cluster, [_app("a", preemptors + zeros)]
+
+
+def _run_both(cluster, apps, min_run, monkeypatch):
+    """Run the same scenario on the serial oracle and the tpu engine
+    (hybrid split forced small) and return both results + the engine
+    note the tpu run recorded."""
+    from open_simulator_tpu.scheduler import core as core_mod
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    serial = simulate(cluster, apps, engine="oracle")
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", min_run)
+    GLOBAL.reset()
+    tpu = simulate(cluster, apps, engine="tpu")
+    note = GLOBAL.notes.get("engine")
+    return serial, tpu, note
+
+
+def _summary(res):
+    return (
+        _placement(res),
+        sorted(u.pod["metadata"]["name"] for u in res.unscheduled_pods),
+        sorted(ev.victim["metadata"]["name"] for ev in res.preemptions),
+    )
+
+
+def test_hybrid_split_matches_serial_oracle(monkeypatch):
+    cluster, apps = _hybrid_case()
+    serial, tpu, note = _run_both(cluster, apps, 4, monkeypatch)
+    assert note == "hybrid"
+    assert _summary(serial) == _summary(tpu)
+    # the scenario actually preempted and actually scanned a zero run
+    assert serial.preemptions
+
+
+def test_hybrid_negative_priority_commit_stays_serial(monkeypatch):
+    # a committed negative-priority pod makes zero-prio pods potential
+    # preemptors: the mid segment must not ride the scan
+    neg = make_fake_pod("neg", "default", "100m", "8Mi", with_priority(-5))
+    neg["spec"]["nodeName"] = "node-3"
+    cluster, apps = _hybrid_case(extra_cluster_pods=[neg])
+    serial, tpu, note = _run_both(cluster, apps, 4, monkeypatch)
+    assert note == "hybrid-serial"
+    assert _summary(serial) == _summary(tpu)
+
+
+def test_hybrid_short_run_stays_serial(monkeypatch):
+    # below MIN_SCAN_RUN the batch goes fully serial (engine note)
+    cluster, apps = _hybrid_case(n_zero=2)
+    serial, tpu, note = _run_both(cluster, apps, 64, monkeypatch)
+    assert note == "serial-oracle"
+    assert _summary(serial) == _summary(tpu)

@@ -92,16 +92,20 @@ class Simulator:
         use_greed: bool = False,
         extenders=None,
         score_weights=None,
+        select_host: str = "first-max",
     ):
         self.engine_kind = engine
         self.use_greed = use_greed
         # KubeSchedulerConfiguration score-plugin weights
         # (scheduler/schedconfig.py); None = default profile
         self.score_weights = score_weights
+        # selectHost tie rule (oracle.py module docstring): "sample"
+        # consumes a host RNG per tie, so it forces the serial path
+        self.select_host = select_host
         # HTTP extenders are host RPC per pod: they force the serial
         # oracle path (SURVEY.md §2.3 host-callback escape hatch)
         self.extenders = list(extenders or [])
-        if self.extenders:
+        if self.extenders or select_host == "sample":
             self.engine_kind = "oracle"
         self.oracle: Optional[Oracle] = None
         self.cluster_pods: List[dict] = []
@@ -116,6 +120,7 @@ class Simulator:
             pdbs=cluster.pod_disruption_budgets,
             priority_classes=cluster.priority_classes,
             score_weights=self.score_weights,
+            select_host=self.select_host,
         )
         pods = wl.pods_excluding_daemon_sets(cluster)
         for ds in cluster.daemon_sets:
@@ -330,6 +335,7 @@ def simulate(
     use_greed: bool = False,
     extenders=None,
     score_weights=None,
+    select_host: str = "first-max",
 ) -> SimulateResult:
     """One-shot simulation (core.go:64-103)."""
     sim = Simulator(
@@ -337,6 +343,7 @@ def simulate(
         use_greed=use_greed,
         extenders=extenders,
         score_weights=score_weights,
+        select_host=select_host,
     )
     # NOTE: the identity memos are deliberately NOT cleared here — the
     # planner's serial bisection calls simulate() once per guess over

@@ -23,8 +23,16 @@ utils.go:476-484), so no pod ever carries a PVC volume source.
 
 Deviation from the reference (documented, deliberate): selectHost uses
 reservoir sampling among top-score nodes (generic_scheduler.go:186-209,
-rand.Intn) — we pin the deterministic first maximum in node order so the
-oracle and the TPU engine agree bit-for-bit.
+rand.Intn) — by default we pin the deterministic first maximum in node
+order so the oracle and the TPU engine agree bit-for-bit. The opt-in
+`select_host="sample"` mode reproduces the reference's reservoir
+sampling algorithm with exact per-tie Intn consumption semantics
+(utils/gorand.py ports Go math/rand, whose global source the reference
+never seeds, i.e. the seed-1 stream); the stream itself is
+bit-identical to Go's only when the rngCooked warm-up table is
+supplied (SIMON_GO_RNG_COOKED — see gorand.py docstring).
+tests/test_selecthost.py pins the measured first-max divergence on
+tie-heavy clusters.
 
 This oracle exists for conformance: the JAX engine
 (open_simulator_tpu/ops/scan.py) must reproduce its placements exactly.
@@ -215,6 +223,8 @@ class Oracle:
         priority_classes=None,
         enable_preemption: bool = True,
         score_weights=None,
+        select_host: str = "first-max",
+        rng=None,
     ):
         if registry is None:
             from .plugins import default_registry
@@ -237,6 +247,18 @@ class Oracle:
         self.pdbs = list(pdbs or [])
         self._prio_resolver = build_priority_resolver(priority_classes or [])
         self.enable_preemption = enable_preemption
+        # selectHost tie rule: "first-max" (default, deterministic,
+        # scan-conformant) or "sample" (the reference's reservoir
+        # sampling; `rng` must expose .intn(n), default GoRand(1) —
+        # see module docstring deviation note)
+        if select_host not in ("first-max", "sample"):
+            raise ValueError(f"unknown select_host mode {select_host!r}")
+        self.select_host = select_host
+        if select_host == "sample" and rng is None:
+            from ..utils.gorand import GoRand
+
+            rng = GoRand(1)
+        self._rng = rng
         # priority bookkeeping: commit sequence is the start-time proxy
         # for MoreImportantPod ties; _min_prio gates the preemption
         # attempt (a preemptor needs a strictly lower-priority pod to
@@ -386,9 +408,24 @@ class Oracle:
         scores = self._prioritize(pod, feasible)
         best = feasible[0]
         best_score = scores[0]
-        for ns, sc in zip(feasible[1:], scores[1:]):
-            if sc > best_score:
-                best, best_score = ns, sc
+        if self.select_host == "sample":
+            # selectHost (generic_scheduler.go:186-209): keep a count of
+            # max-score nodes seen; replace the candidate with
+            # probability 1/count — one Intn per tie, same consumption
+            # order as the reference
+            cnt = 1
+            for ns, sc in zip(feasible[1:], scores[1:]):
+                if sc > best_score:
+                    best, best_score = ns, sc
+                    cnt = 1
+                elif sc == best_score:
+                    cnt += 1
+                    if self._rng.intn(cnt) == 0:
+                        best = ns
+        else:
+            for ns, sc in zip(feasible[1:], scores[1:]):
+                if sc > best_score:
+                    best, best_score = ns, sc
         for plugin in self.registry.plugins:
             if not plugin.permit(pod, best.node):
                 return None, plugin.name

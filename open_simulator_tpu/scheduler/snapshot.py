@@ -90,7 +90,12 @@ def resume_simulator(
     devices honored via the gpu-index annotation). PDBs and
     PriorityClasses default to what load_snapshot carried
     (snapshot_extras) so preemption on the resumed simulator matches a
-    fresh simulate()."""
+    fresh simulate().
+
+    Always resumes with the default first-max selectHost: the "sample"
+    mode's RNG stream position is not part of a snapshot (Go's global
+    rand has no checkpoint either), so a sample-mode run cannot be
+    resumed stream-faithfully — re-run it fresh instead."""
     extras = getattr(result, "snapshot_extras", {}) or {}
     if pdbs is None:
         pdbs = extras.get("pdbs") or []

@@ -1,0 +1,156 @@
+"""Go `math/rand` compatibility source for selectHost sampling.
+
+The reference scheduler breaks score ties by reservoir sampling with
+the *global, unseeded* Go math/rand (generic_scheduler.go:186-209,
+`rand.Intn`; nothing in the reference or its vendored scheduler calls
+`rand.Seed`, so the stream is the deterministic seed-1 stream of Go's
+additive lagged Fibonacci generator ALFG(607, 273)).
+
+This is an exact port of that generator's machinery
+(math/rand/rng.go + rand.go):
+- `_seedrand`: the 48271 Lehmer step used to expand the seed
+- `GoRand.seed`: the `rngSource.Seed` expansion (3 Lehmer draws per
+  slot, XOR-folded at shifts 40/20/0, XORed with the warm-up table)
+- `GoRand.uint64`: the x[n] = x[n-607] + x[n-273] (mod 2^64) step
+- `int63 / int31 / int31n / int63n / intn`: bit-for-bit the rejection
+  and modulo semantics of Go's `Rand` methods
+
+One piece cannot be reproduced in this environment: Go bakes a
+607-entry warm-up table (`rngCooked`, the generator state after ~1e13
+burn-in steps) into its source, and no Go toolchain or source tree is
+available here to copy it from. `GoRand` therefore accepts the table
+via the `cooked` argument or the `SIMON_GO_RNG_COOKED` env var (a file
+of 607 integers, one per line, signed or unsigned — exactly the
+literals of Go's rng.go). With the table supplied the stream is
+bit-identical to Go's; without it the generator runs the same
+recurrence XORed with a zero table — deterministic and well-mixed, but
+a different stream. Every *consumer* semantic (which draw happens for
+which tie, rejection retries, modulo bias handling) is exact either
+way, so supplying the table is the only step between this and
+bit-matching the reference binary's placements.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+_LEN = 607
+_TAP = 273
+_MASK64 = (1 << 64) - 1
+_MASK63 = (1 << 63) - 1
+_INT32MAX = (1 << 31) - 1
+
+
+def _seedrand(x: int) -> int:
+    """seedrand (rng.go): one step of the 48271 Lehmer generator in
+    Schrage form over int32."""
+    hi, lo = divmod(x, 44488)
+    x = 48271 * lo - 3399 * hi
+    if x < 0:
+        x += _INT32MAX
+    return x
+
+
+def _load_cooked_env() -> Optional[List[int]]:
+    path = os.environ.get("SIMON_GO_RNG_COOKED")
+    if not path:
+        return None
+    with open(path) as f:
+        vals = [int(tok) for tok in f.read().replace(",", " ").split()]
+    if len(vals) != _LEN:
+        raise ValueError(
+            f"SIMON_GO_RNG_COOKED: expected {_LEN} integers, got {len(vals)}"
+        )
+    return vals
+
+
+class GoRand:
+    """Go math/rand `*Rand` over an `rngSource`, defaulting to seed 1 —
+    the stream the reference's unseeded global source produces."""
+
+    def __init__(self, seed: int = 1, cooked: Optional[List[int]] = None):
+        if cooked is None:
+            cooked = _load_cooked_env()
+        # store the warm-up table as uint64; Go's literals are int64
+        self._cooked = [0] * _LEN if cooked is None else [
+            v & _MASK64 for v in cooked
+        ]
+        if len(self._cooked) != _LEN:
+            raise ValueError(f"cooked table must have {_LEN} entries")
+        self.vec = [0] * _LEN
+        self.tap = 0
+        self.feed = 0
+        self.seed(seed)
+
+    def seed(self, seed: int) -> None:
+        """rngSource.Seed (rng.go): Lehmer-expand the seed into the
+        607-word state, XORed with the warm-up table."""
+        self.tap = 0
+        self.feed = _LEN - _TAP
+        seed %= _INT32MAX
+        if seed < 0:
+            seed += _INT32MAX
+        if seed == 0:
+            seed = 89482311
+        x = seed
+        for i in range(-20, _LEN):
+            x = _seedrand(x)
+            if i >= 0:
+                u = x << 40
+                x = _seedrand(x)
+                u ^= x << 20
+                x = _seedrand(x)
+                u ^= x
+                u ^= self._cooked[i]
+                self.vec[i] = u & _MASK64
+
+    def uint64(self) -> int:
+        """rngSource.Uint64: x[n] = x[n-607] + x[n-273] mod 2^64."""
+        self.tap -= 1
+        if self.tap < 0:
+            self.tap += _LEN
+        self.feed -= 1
+        if self.feed < 0:
+            self.feed += _LEN
+        x = (self.vec[self.feed] + self.vec[self.tap]) & _MASK64
+        self.vec[self.feed] = x
+        return x
+
+    def int63(self) -> int:
+        return self.uint64() & _MASK63
+
+    def int31(self) -> int:
+        return self.int63() >> 32
+
+    def int31n(self, n: int) -> int:
+        """Rand.Int31n incl. the power-of-two fast path and the
+        modulo-bias rejection loop."""
+        if n <= 0:
+            raise ValueError("invalid argument to int31n")
+        if n & (n - 1) == 0:
+            return self.int31() & (n - 1)
+        max_ = _INT32MAX - (1 << 31) % n
+        v = self.int31()
+        while v > max_:
+            v = self.int31()
+        return v % n
+
+    def int63n(self, n: int) -> int:
+        if n <= 0:
+            raise ValueError("invalid argument to int63n")
+        if n & (n - 1) == 0:
+            return self.int63() & (n - 1)
+        max_ = _MASK63 - (1 << 63) % n
+        v = self.int63()
+        while v > max_:
+            v = self.int63()
+        return v % n
+
+    def intn(self, n: int) -> int:
+        """Rand.Intn — the call selectHost makes per score tie."""
+        if n <= 0:
+            raise ValueError("invalid argument to intn")
+        if n <= _INT32MAX:
+            return self.int31n(n)
+        return self.int63n(n)

@@ -1,0 +1,227 @@
+"""selectHost tie-breaking modes (oracle.py + utils/gorand.py).
+
+The default pins the deterministic first maximum (scan-conformant); the
+opt-in `select_host="sample"` mode reproduces the reference's reservoir
+sampling (generic_scheduler.go:186-209) over a Go math/rand port. These
+tests pin the consumption semantics and the measured divergence between
+the two modes on a tie-heavy cluster — the "one knowingly unmatched
+bit" of the bit-matching north star, now bounded.
+"""
+
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.scheduler.oracle import Oracle
+from open_simulator_tpu.testing import make_fake_node, make_fake_pod
+from open_simulator_tpu.utils.gorand import GoRand
+
+
+# ------------------------------------------------------------------ GoRand
+
+
+def test_gorand_deterministic_and_seed_sensitive():
+    a, b, c = GoRand(1), GoRand(1), GoRand(2)
+    sa = [a.intn(100) for _ in range(50)]
+    sb = [b.intn(100) for _ in range(50)]
+    sc = [c.intn(100) for _ in range(50)]
+    assert sa == sb
+    assert sa != sc
+    assert all(0 <= v < 100 for v in sa)
+    # seed 0 is remapped (rng.go: seed == 0 -> 89482311), not an error
+    assert [GoRand(0).intn(10) for _ in range(5)] == [
+        GoRand(0).intn(10) for _ in range(5)
+    ]
+
+
+def test_gorand_int31n_power_of_two_uses_mask():
+    # the pow2 fast path is a pure mask of Int31 — verify against a
+    # clone consuming the same stream
+    r, clone = GoRand(7), GoRand(7)
+    for _ in range(100):
+        v = r.intn(64)
+        assert v == clone.int31() & 63
+
+
+def test_gorand_rejection_loop_matches_modulo_semantics():
+    # non-pow2: value = first Int31 <= max, then % n (Int31n). Replay
+    # the raw stream and apply the documented semantics independently.
+    n = 1000
+    r, clone = GoRand(3), GoRand(3)
+    max_ = (1 << 31) - 1 - (1 << 31) % n
+    for _ in range(100):
+        v = r.intn(n)
+        raw = clone.int31()
+        while raw > max_:
+            raw = clone.int31()
+        assert v == raw % n
+
+
+def test_gorand_intn_large_n_uses_int63n():
+    n = (1 << 31) + 17
+    r = GoRand(5)
+    vals = [r.intn(n) for _ in range(20)]
+    assert all(0 <= v < n for v in vals)
+
+
+def test_gorand_rejects_bad_n():
+    r = GoRand(1)
+    with pytest.raises(ValueError):
+        r.intn(0)
+    with pytest.raises(ValueError):
+        r.intn(-3)
+
+
+def test_gorand_cooked_table_changes_stream(tmp_path, monkeypatch):
+    base = [GoRand(1).intn(1000) for _ in range(10)]
+    cooked = [(i * 2654435761) & ((1 << 64) - 1) for i in range(607)]
+    alt = GoRand(1, cooked=cooked)
+    assert [alt.intn(1000) for _ in range(10)] != base
+    # env-var plumbing: signed int64 literals, one per line (the exact
+    # shape of Go's rng.go rngCooked block)
+    path = tmp_path / "cooked.txt"
+    signed = [v - (1 << 64) if v >= (1 << 63) else v for v in cooked]
+    path.write_text("\n".join(str(v) for v in signed))
+    monkeypatch.setenv("SIMON_GO_RNG_COOKED", str(path))
+    env = GoRand(1)
+    ref = GoRand(1, cooked=cooked)
+    assert [env.intn(1000) for _ in range(10)] == [
+        ref.intn(1000) for _ in range(10)
+    ]
+
+
+# ------------------------------------------------------- reservoir sampling
+
+
+class _ScriptedRng:
+    """Records intn calls; pops scripted answers."""
+
+    def __init__(self, answers):
+        self.answers = list(answers)
+        self.calls = []
+
+    def intn(self, n):
+        self.calls.append(n)
+        return self.answers.pop(0)
+
+
+def _tied_oracle(n_nodes, **kw):
+    # identical empty nodes: every score plugin ties across all of them
+    return Oracle(
+        [make_fake_node(f"n-{i}", "8", "16Gi") for i in range(n_nodes)], **kw
+    )
+
+
+def test_sample_mode_consumption_order_and_replacement():
+    # selectHost draws Intn(2), Intn(3), ... Intn(k) for k tied nodes;
+    # a draw of 0 replaces the candidate, anything else keeps it
+    pod = make_fake_pod("p", "default", "100m", "100Mi")
+    rng = _ScriptedRng([1, 0, 1])  # keep, replace with n-2, keep
+    oracle = _tied_oracle(4, select_host="sample", rng=rng)
+    node, reason = oracle.schedule_pod(pod)
+    assert reason == ""
+    assert rng.calls == [2, 3, 4]
+    assert node == "n-2"
+
+
+def test_sample_mode_first_max_reset_on_higher_score():
+    # a strictly better node appearing later resets the reservoir
+    # count: `big` scores lower for this pod (the Simon packing score
+    # favors the tighter nodes), so with big FIRST, n-0 resets the
+    # reservoir and only the n-0/n-1 tie consumes the rng
+    nodes = [make_fake_node("big", "64", "128Gi")]
+    nodes += [make_fake_node(f"n-{i}", "8", "16Gi") for i in range(2)]
+    rng = _ScriptedRng([1])  # consumed by the n-0/n-1 tie only
+    oracle = Oracle(nodes, select_host="sample", rng=rng)
+    pod = make_fake_pod("p", "default", "4", "8Gi")
+    node, _ = oracle.schedule_pod(pod)
+    assert node == "n-0"
+    assert rng.calls == [2]
+
+
+def test_sample_default_rng_is_seed1_gorand():
+    pod = make_fake_pod("p", "default", "100m", "100Mi")
+    a = _tied_oracle(8, select_host="sample")
+    b = _tied_oracle(8, select_host="sample", rng=GoRand(1))
+    assert a.schedule_pod(pod)[0] == b.schedule_pod(pod)[0]
+
+
+def test_bad_select_host_mode_rejected():
+    with pytest.raises(ValueError):
+        _tied_oracle(2, select_host="lottery")
+
+
+# ------------------------------------------------------- divergence pinning
+
+
+def _tie_heavy_case(n_nodes=16, n_pods=48):
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node(f"n-{i:02d}", "8", "16Gi") for i in range(n_nodes)]
+    pods = [
+        make_fake_pod(f"p-{i:03d}", "default", "500m", "1Gi") for i in range(n_pods)
+    ]
+    return cluster, [AppResource("a", ResourceTypes(pods=pods))]
+
+
+def test_divergence_pinned_on_tie_heavy_cluster():
+    """The committed divergence bound (VERDICT r2 missing #3): on a
+    16-identical-node cluster with 48 identical pods, sampled selectHost
+    places a majority of pods on different nodes than first-max — the
+    two modes agree on feasibility and per-node pod COUNTS (the spread
+    scores force balance) but not on identities. Any change to this
+    number means the tie surface moved; re-derive deliberately."""
+    cluster, apps = _tie_heavy_case()
+    first = simulate(cluster, apps, select_host="first-max")
+    sampled = simulate(cluster, apps, select_host="sample")
+    assert not first.unscheduled_pods and not sampled.unscheduled_pods
+
+    def by_pod(res):
+        return {
+            p["metadata"]["name"]: ns.node["metadata"]["name"]
+            for ns in res.node_status
+            for p in ns.pods
+        }
+
+    f, s = by_pod(first), by_pod(sampled)
+    assert set(f) == set(s)
+    diverged = sum(1 for k in f if f[k] != s[k])
+    # deterministic (GoRand(1) stream): pin the exact measured value
+    assert diverged == DIVERGED_TIE_HEAVY, (
+        f"tie divergence changed: {diverged} of {len(f)} placements "
+        f"(was {DIVERGED_TIE_HEAVY})"
+    )
+    # aggregate shape is identical: same pods-per-node histogram
+    from collections import Counter
+
+    assert Counter(Counter(f.values()).values()) == Counter(
+        Counter(s.values()).values()
+    )
+
+
+def test_no_divergence_when_scores_are_unique():
+    # staircase node sizes → LeastAllocated scores are distinct, no
+    # ties, sampling never consults the rng → identical placements
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_fake_node(f"n-{i}", str(8 + 8 * i), f"{16 + 16 * i}Gi")
+        for i in range(6)
+    ]
+    pods = [make_fake_pod(f"p-{i}", "default", "100m", "100Mi") for i in range(6)]
+    apps = [AppResource("a", ResourceTypes(pods=pods))]
+
+    def by_pod(res):
+        return {
+            p["metadata"]["name"]: ns.node["metadata"]["name"]
+            for ns in res.node_status
+            for p in ns.pods
+        }
+
+    assert by_pod(simulate(cluster, apps, select_host="first-max")) == by_pod(
+        simulate(cluster, apps, select_host="sample")
+    )
+
+
+# measured once against the GoRand(1) stream and pinned (see
+# test_divergence_pinned_on_tie_heavy_cluster): 43 of 48 placements
+# land on a different (equal-score) node than first-max picks
+DIVERGED_TIE_HEAVY = 43

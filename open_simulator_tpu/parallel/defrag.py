@@ -383,12 +383,24 @@ def _replay_forced(snapshot, ranked, depth, entries, placements):
         clean = _strip_node_name(pod)
         if not oracle.passes_filters_on_node(clean, ns):
             return None
-        # the serial path enforces Permit via _select_and_bind — a
-        # forced commit must not skip a permit plugin's veto
+        # the serial path enforces Reserve/Permit/PreBind via
+        # _select_and_bind — a forced commit must not skip a plugin's
+        # veto or cache mutation. Any veto aborts to the serial replay
+        # (no unreserve bookkeeping needed here: the caller discards
+        # this oracle and the serial path rebuilds plugin state from a
+        # fresh run).
+        for plugin in oracle.registry.plugins:
+            if not plugin.reserve(clean, ns.node):
+                return None
         for plugin in oracle.registry.plugins:
             if not plugin.permit(clean, ns.node):
                 return None
+        for plugin in oracle.registry.plugins:
+            if not plugin.prebind(clean, ns.node):
+                return None
         oracle._reserve_and_bind(clean, ns)
+        for plugin in oracle.registry.plugins:
+            plugin.postbind(clean, ns.node)
         moves.append(
             PodMove(
                 pod=clean,

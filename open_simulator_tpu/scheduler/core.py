@@ -167,9 +167,10 @@ class Simulator:
         from .preemption import pod_uses_priority
         from ..utils.trace import GLOBAL
 
-        # a permit reject on the selected node would invalidate every
-        # later placement the batched scan committed
-        tpu_ok = self.engine_kind == "tpu" and not self.oracle.registry.has_permit
+        # a permit reject or a stateful plugin hook on the selected node
+        # would invalidate / miss every later placement the batched scan
+        # committed (plugins.py: needs_serial)
+        tpu_ok = self.engine_kind == "tpu" and not self.oracle.registry.needs_serial
         priority_free = tpu_ok and (
             not self.oracle.saw_priority
             and not any(pod_uses_priority(p, self.oracle._prio_resolver) for p in pods)

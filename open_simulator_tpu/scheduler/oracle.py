@@ -276,6 +276,9 @@ class Oracle:
         self.node_index: Dict[str, int] = {}
         for n in nodes:
             self.add_node(n)
+        # a fresh Oracle is a fresh scheduler run: stateful custom
+        # plugins reset their per-run caches (plugins.py lifecycle)
+        self.registry.begin_run(nodes)
 
     # -- priority helpers ---------------------------------------------------
 
@@ -353,6 +356,12 @@ class Oracle:
                 ns.gpu.commit(devs, gpu_mem)
                 ns.alloc[stor.GPU_COUNT_ANNO] = Fraction(ns.gpu.allocatable_count())
                 self.alloc_epoch += 1
+        # stateful custom plugins hear about the pre-bound pod through
+        # reserve with the veto ignored (the tracker adds it regardless
+        # — same as the reference cache's informer ADD event); this
+        # keeps their caches balanced with the unreserve on eviction
+        for plugin in self.registry.plugins:
+            plugin.reserve(pod, ns.node)
         self._commit(pod, ns)
 
     # -- the scheduling cycle ----------------------------------------------
@@ -391,20 +400,22 @@ class Oracle:
                 f"{meta.get('name', '')}): {e}"
             )
         if rejecter is not None:
-            # Permit reject fails the cycle outright (scheduler.go:
-            # 536-553) — no retry on other nodes
+            # a plugin veto (permit/reserve/prebind) fails the cycle
+            # outright (scheduler.go:536-553) — no retry on other nodes
             return None, (
                 f"failed to schedule pod ({meta.get('namespace', 'default')}/"
-                f"{meta.get('name', '')}): rejected by permit plugin "
-                f'"{rejecter}"'
+                f"{meta.get('name', '')}): rejected by {rejecter}"
             )
         return best.name, ""
 
     def _select_and_bind(self, pod: dict, feasible: List[NodeState]):
         """prioritizeNodes + selectHost (first-max tie rule, see module
-        docstring) + Permit + the reserve/bind sequence. Returns
-        (node, None) on success or (None, plugin_name) on a permit
-        reject; may raise ExtenderError from a binder extender."""
+        docstring) + the Reserve/Permit/PreBind/Bind/PostBind sequence
+        of scheduleOne (scheduler.go:457-620, custom-plugin hooks per
+        interface.go:412-524). Returns (node, None) on success or
+        (None, 'phase plugin "name"') on a plugin veto; any veto after
+        Reserve unreserves in reverse order first. May raise
+        ExtenderError from a binder extender."""
         scores = self._prioritize(pod, feasible)
         best = feasible[0]
         best_score = scores[0]
@@ -426,10 +437,30 @@ class Oracle:
             for ns, sc in zip(feasible[1:], scores[1:]):
                 if sc > best_score:
                     best, best_score = ns, sc
+        # custom Reserve plugins claim state first; any later veto rolls
+        # them back in reverse order (framework.go RunReservePlugins*)
+        reserved = []
+
+        def unreserve_all():
+            for p in reversed(reserved):
+                p.unreserve(pod, best.node)
+
+        for plugin in self.registry.plugins:
+            if not plugin.reserve(pod, best.node):
+                unreserve_all()
+                return None, f'reserve plugin "{plugin.name}"'
+            reserved.append(plugin)
         for plugin in self.registry.plugins:
             if not plugin.permit(pod, best.node):
-                return None, plugin.name
+                unreserve_all()
+                return None, f'permit plugin "{plugin.name}"'
+        for plugin in self.registry.plugins:
+            if not plugin.prebind(pod, best.node):
+                unreserve_all()
+                return None, f'prebind plugin "{plugin.name}"'
         self._reserve_and_bind(pod, best)
+        for plugin in self.registry.plugins:
+            plugin.postbind(pod, best.node)
         return best, None
 
     def _post_filter_preempt(self, pod: dict, codes: Dict[int, str]) -> Optional[str]:
@@ -1421,7 +1452,11 @@ class Oracle:
     def evict_pod(self, ns: NodeState, pod: dict):
         """Evict a victim for real (PrepareCandidate's DeletePod): the
         binding state written into the pod dict is stripped so the
-        Simulator can re-enqueue it as a fresh, schedulable pod."""
+        Simulator can re-enqueue it as a fresh, schedulable pod.
+        Stateful custom plugins get `unreserve` — the analogue of the
+        pod-delete informer event their live cache would consume."""
+        for plugin in self.registry.plugins:
+            plugin.unreserve(pod, ns.node)
         self.remove_pod_from_node(ns, pod)
         (pod.get("spec") or {}).pop("nodeName", None)
         pod.pop("status", None)

@@ -22,9 +22,23 @@ vendor/.../plugins/helper/normalize_score.go and plugin/simon.go:75).
 Stateless means: the verdict may depend on the pod and the node's
 static definition, not on placements made during the run — the same
 contract the reference's Filter plugins get from the immutable cycle
-snapshot, minus pod-derived state. Stateful custom plugins (like the
-built-in GPU/storage/affinity machinery) need tensor state in the scan
-carry and are built-in only.
+snapshot, minus pod-derived state.
+
+STATEFUL plugins (interface.go:412-524 ReservePlugin / PreBindPlugin /
+PostBindPlugin) are supported too: override `reserve` / `unreserve` /
+`prebind` / `postbind` and keep whatever state you need on the plugin
+instance (the role the reference plugin's informer-fed cache plays —
+e.g. open-gpu-share's GpuNodeInfo). A registry containing any stateful
+plugin routes every batch to the serial oracle automatically (same
+mechanism as `permit`): scan placements are committed in-kernel, where
+a host-side veto or cache mutation per pod cannot participate. With
+plugin state feeding `filter`/`score`, such plugins behave exactly
+like the reference's out-of-tree framework plugins in the serial
+scheduler. Two documented deviations, both shared with the reference:
+preemption dry runs do not notify plugins (the reference's dry run
+clones NodeInfo but not plugin caches — they go stale the same way),
+and a real eviction calls `unreserve` (the analogue of the delete
+informer event a live cache would consume).
 
 The serial oracle honors the same registry, so conformance between the
 two paths holds for custom plugins too.
@@ -66,6 +80,47 @@ class SchedulerPlugin:
         the committed state."""
         return True
 
+    # -- stateful extension points (serial path only) -------------------
+    #
+    # Lifecycle: a fresh Oracle (one per simulate()/probe run) calls
+    # `begin_run` — clear per-run caches there, the way the reference
+    # constructs plugins fresh via their factory per scheduler run.
+    # Pre-bound cluster pods are admitted through `reserve` with the
+    # veto ignored (the tracker's unconditional add / informer ADD
+    # event); evictions arrive as `unreserve`. So a cache that charges
+    # in reserve and releases in unreserve stays balanced across
+    # admission, scheduling, preemption, and re-scheduling.
+
+    def begin_run(self, nodes: List[dict]) -> None:  # pragma: no cover - interface
+        """Called by each new Oracle before any pod is admitted —
+        reset per-run plugin state here (the factory-construction
+        analogue of the reference framework)."""
+
+    def reserve(self, pod: dict, node: dict) -> bool:  # pragma: no cover - interface
+        """ReservePlugin.Reserve (interface.go:412-424): claim plugin
+        state for the pod on the selected node. Returning False fails
+        the pod's cycle; every already-reserved plugin is unreserved in
+        reverse registration order (RunReservePluginsReserve,
+        framework.go error path)."""
+        return True
+
+    def unreserve(self, pod: dict, node: dict) -> None:  # pragma: no cover - interface
+        """ReservePlugin.Unreserve (interface.go:426-431): roll back
+        `reserve`. Called when a later reserve/permit/prebind phase
+        fails, and when a committed pod is evicted by preemption (the
+        analogue of the cache's pod-delete informer event)."""
+
+    def prebind(self, pod: dict, node: dict) -> bool:  # pragma: no cover - interface
+        """PreBindPlugin.PreBind (interface.go:462-468): last plugin
+        work before the bind is recorded (the reference open-gpu-share
+        patches the pod's GPU annotation here). Returning False fails
+        the cycle and unreserves."""
+        return True
+
+    def postbind(self, pod: dict, node: dict) -> None:  # pragma: no cover - interface
+        """PostBindPlugin.PostBind (interface.go:491-497):
+        informational; runs after a successful bind."""
+
 
 class PluginRegistry:
     def __init__(self):
@@ -88,14 +143,36 @@ class PluginRegistry:
     def plugins(self) -> List[SchedulerPlugin]:
         return list(self._plugins.values())
 
+    def _overrides(self, method: str) -> bool:
+        return any(
+            getattr(type(p), method) is not getattr(SchedulerPlugin, method)
+            for p in self._plugins.values()
+        )
+
     @property
     def has_permit(self) -> bool:
         """Whether any registered plugin overrides `permit` (forces the
         serial engine — see SchedulerPlugin.permit)."""
+        return self._overrides("permit")
+
+    @property
+    def has_stateful(self) -> bool:
+        """Whether any plugin overrides a stateful extension point
+        (reserve/unreserve/prebind/postbind)."""
         return any(
-            type(p).permit is not SchedulerPlugin.permit
-            for p in self._plugins.values()
+            self._overrides(m)
+            for m in ("reserve", "unreserve", "prebind", "postbind")
         )
+
+    def begin_run(self, nodes: List[dict]) -> None:
+        for p in self._plugins.values():
+            p.begin_run(nodes)
+
+    @property
+    def needs_serial(self) -> bool:
+        """True when the registry cannot ride the batched scan: permit
+        vetoes and stateful hooks both act per pod on the host."""
+        return self.has_permit or self.has_stateful
 
 
 # process-global out-of-tree registry (WithFrameworkOutOfTreeRegistry

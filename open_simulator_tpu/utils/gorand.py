@@ -4,7 +4,10 @@ The reference scheduler breaks score ties by reservoir sampling with
 the *global, unseeded* Go math/rand (generic_scheduler.go:186-209,
 `rand.Intn`; nothing in the reference or its vendored scheduler calls
 `rand.Seed`, so the stream is the deterministic seed-1 stream of Go's
-additive lagged Fibonacci generator ALFG(607, 273)).
+additive lagged Fibonacci generator ALFG(607, 273)). The reference
+pins `go 1.15` (go.mod); note Go 1.20+ auto-seeds the global source
+randomly, so a reference binary rebuilt with a modern toolchain only
+reproduces this stream under `GODEBUG=randautoseed=0`.
 
 This is an exact port of that generator's machinery
 (math/rand/rng.go + rand.go):

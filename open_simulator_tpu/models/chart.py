@@ -28,11 +28,13 @@ engine's default used by the reference).
 
 from __future__ import annotations
 
+import atexit
 import base64
 import hashlib
 import json
 import os
 import re
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import yaml
@@ -965,10 +967,80 @@ def _dependency_enabled(dep: dict, parent_values: dict) -> bool:
     return True
 
 
+# unpacked .tgz dependencies, keyed by (path, mtime) so repeated
+# renders of the same chart reuse one scratch extraction; LRU-bounded,
+# evicted/exit-time scratch dirs removed (value = (chart_root, tmpdir))
+_ARCHIVE_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_ARCHIVE_CACHE_CAP = 32
+
+
+def _drop_archive_scratch(entry: tuple) -> None:
+    import shutil
+
+    _root, tmp = entry
+    if tmp:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _cleanup_archive_cache() -> None:
+    while _ARCHIVE_CACHE:
+        _drop_archive_scratch(_ARCHIVE_CACHE.popitem()[1])
+
+
+atexit.register(_cleanup_archive_cache)
+
+
+def _unpack_chart_archive(archive_path: str) -> Optional[str]:
+    """Helm packaged dependency (helm loader.Load accepts both a chart
+    directory and a .tgz archive): extract to a scratch dir and return
+    the chart root — the top-level directory holding Chart.yaml, which
+    `helm package` names after the chart. Archive members with unsafe
+    paths are refused by tarfile's data filter (manual member screening
+    on Pythons predating the `filter` kwarg)."""
+    key = (archive_path, os.path.getmtime(archive_path))
+    cached = _ARCHIVE_CACHE.get(key)
+    if cached is not None:
+        _ARCHIVE_CACHE.move_to_end(key)
+        return cached[0]
+    import tarfile
+    import tempfile
+
+    root = None
+    tmp = None
+    try:
+        tmp = tempfile.mkdtemp(prefix="simon-chart-")
+        with tarfile.open(archive_path, "r:gz") as tf:
+            try:
+                tf.extractall(tmp, filter="data")
+            except TypeError:  # Python < 3.10.12/3.11.4: no filter kwarg
+                safe = [
+                    m
+                    for m in tf.getmembers()
+                    if (m.isreg() or m.isdir())
+                    and not m.name.startswith("/")
+                    and ".." not in m.name.split("/")
+                ]
+                tf.extractall(tmp, members=safe)
+        for entry in sorted(os.listdir(tmp)):
+            cand = os.path.join(tmp, entry)
+            if os.path.isdir(cand) and os.path.isfile(
+                os.path.join(cand, "Chart.yaml")
+            ):
+                root = cand
+                break
+    except (tarfile.TarError, OSError):
+        root = None
+    _ARCHIVE_CACHE[key] = (root, tmp)
+    if len(_ARCHIVE_CACHE) > _ARCHIVE_CACHE_CAP:
+        _drop_archive_scratch(_ARCHIVE_CACHE.popitem(last=False)[1])
+    return root
+
+
 def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[_Subchart]:
     """Flatten parent + enabled subcharts with helm value scoping:
     subchart values = deep_merge(subchart defaults, parent.values[name]),
-    with `global` propagated down."""
+    with `global` propagated down. charts/ entries may be unpacked
+    directories or `helm package` .tgz archives."""
     meta, own_values = _load_chart_meta(path)
     merged = _deep_merge(own_values, values)
     g = _deep_merge(globals_, merged.get("global") or {})
@@ -983,7 +1055,16 @@ def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[
     if os.path.isdir(charts_dir):
         for entry in sorted(os.listdir(charts_dir)):
             sub_path = os.path.join(charts_dir, entry)
-            if not os.path.isdir(sub_path) or not os.path.isfile(
+            if os.path.isfile(sub_path) and entry.endswith((".tgz", ".tar.gz")):
+                # packaged dependency: the dependency key is the chart's
+                # metadata name (helm matches deps by name, the archive
+                # filename carries name-version)
+                sub_path = _unpack_chart_archive(sub_path)
+                if sub_path is None:
+                    continue
+                sub_meta, _ = _load_chart_meta(sub_path)
+                entry = sub_meta.get("name") or entry
+            elif not os.path.isdir(sub_path) or not os.path.isfile(
                 os.path.join(sub_path, "Chart.yaml")
             ):
                 continue

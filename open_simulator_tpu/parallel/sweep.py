@@ -155,11 +155,12 @@ class CapacitySweep:
                     "scan has no priority/preemption semantics — use the "
                     "serial engine (scheduler/core.py falls back automatically)"
                 )
-            if self.oracle.registry.has_permit:
+            if self.oracle.registry.needs_serial:
                 raise PrioritySignalError(
-                    "a registered plugin defines permit(); a post-hoc reject "
-                    "would invalidate later batched placements — use the "
-                    "serial engine (scheduler/core.py falls back automatically)"
+                    "a registered plugin defines permit() or a stateful hook "
+                    "(reserve/prebind); the batched scan cannot honor per-pod "
+                    "host callbacks — use the serial engine "
+                    "(scheduler/core.py falls back automatically)"
                 )
         self.pods = pods
         self.n = len(padded.nodes)

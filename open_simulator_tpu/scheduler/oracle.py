@@ -458,7 +458,13 @@ class Oracle:
             if not plugin.prebind(pod, best.node):
                 unreserve_all()
                 return None, f'prebind plugin "{plugin.name}"'
-        self._reserve_and_bind(pod, best)
+        try:
+            self._reserve_and_bind(pod, best)
+        except Exception:
+            # a binder-extender failure aborts the bind after Reserve —
+            # the framework runs Unreserve then (scheduler.go:597-608)
+            unreserve_all()
+            raise
         for plugin in self.registry.plugins:
             plugin.postbind(pod, best.node)
         return best, None

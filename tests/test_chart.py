@@ -347,3 +347,103 @@ def test_subchart_alias_condition_and_values(tmp_path):
         os.path.join(parent, "charts", "redis"), os.path.join(parent2, "charts", "redis")
     )
     assert process_chart("rel", parent2) == []
+
+
+# ---------------------------------------------------------------------------
+# packaged (.tgz) subcharts — helm loader.Load archive parity
+# ---------------------------------------------------------------------------
+
+
+def _package_chart(chart_dir, dest_dir, filename=None):
+    """`helm package` stand-in: tar the chart dir under its own name."""
+    import shutil
+    import tarfile
+
+    name = os.path.basename(chart_dir)
+    out = os.path.join(str(dest_dir), filename or f"{name}-0.1.0.tgz")
+    os.makedirs(str(dest_dir), exist_ok=True)
+    with tarfile.open(out, "w:gz") as tf:
+        tf.add(chart_dir, arcname=name)
+    shutil.rmtree(chart_dir)
+    return out
+
+
+def test_packaged_subchart_renders_with_scoping(tmp_path):
+    parent = write_chart(
+        tmp_path,
+        "parent",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: parent\n"},
+        chart_yaml={
+            "name": "parent",
+            "version": "1.0.0",
+            "dependencies": [
+                {"name": "childa", "condition": "childa.enabled"},
+                {"name": "childb", "condition": "childb.enabled"},
+            ],
+        },
+        values={
+            "global": {"zone": "z9"},
+            "childa": {"enabled": True, "who": "override"},
+            "childb": {"enabled": False},
+        },
+    )
+    childa = write_chart(
+        str(tmp_path / "scratch"),
+        "childa",
+        {
+            "cm.yaml": "kind: ConfigMap\nmetadata:\n"
+            "  name: a-{{ .Values.who }}-{{ .Values.global.zone }}\n"
+        },
+        values={"who": "default"},
+    )
+    childb = write_chart(
+        str(tmp_path / "scratch"),
+        "childb",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: b\n"},
+    )
+    _package_chart(childa, os.path.join(parent, "charts"))
+    _package_chart(childb, os.path.join(parent, "charts"))
+    docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
+    names = sorted(d["metadata"]["name"] for d in docs)
+    # identical outcome to the unpacked-directory test: childb gated
+    # off, childa sees the parent override and the global
+    assert names == ["a-override-z9", "parent"]
+
+
+def test_packaged_subchart_keyed_by_chart_name_not_filename(tmp_path):
+    # helm matches dependencies by chart metadata name; the archive
+    # filename (name-version.tgz by convention) is not load-bearing
+    parent = write_chart(
+        tmp_path,
+        "parent",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: parent\n"},
+        chart_yaml={
+            "name": "parent",
+            "version": "1.0.0",
+            "dependencies": [{"name": "childa", "condition": "childa.enabled"}],
+        },
+        values={"childa": {"enabled": False}},
+    )
+    childa = write_chart(
+        str(tmp_path / "scratch"),
+        "childa",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: a\n"},
+    )
+    _package_chart(childa, os.path.join(parent, "charts"), filename="weird-blob.tgz")
+    docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
+    # condition keyed on the chart name gated the archive off
+    assert [d["metadata"]["name"] for d in docs] == ["parent"]
+
+
+def test_corrupt_subchart_archive_skipped(tmp_path):
+    parent = write_chart(
+        tmp_path,
+        "parent",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: parent\n"},
+    )
+    charts_dir = os.path.join(parent, "charts")
+    os.makedirs(charts_dir)
+    with open(os.path.join(charts_dir, "broken-0.1.0.tgz"), "wb") as f:
+        f.write(b"not a tarball")
+    docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
+    assert [d["metadata"]["name"] for d in docs] == ["parent"]

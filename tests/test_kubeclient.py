@@ -34,8 +34,10 @@ def _pod(name, phase="Running", owner_kind=None, deleting=False):
 class _FakeApiServer:
     """Serves the seven LIST endpoints; records auth headers."""
 
-    def __init__(self, pdb_version="v1beta1"):
+    def __init__(self, pdb_version="v1beta1", expire_continue=False):
         self.seen_auth = []
+        self.seen_queries = []
+        self.expire_continue = expire_continue
         outer = self
 
         nodes = [make_fake_node("live-0", cpu="8", memory="16Gi")]
@@ -66,16 +68,40 @@ class _FakeApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                from urllib.parse import parse_qs, urlsplit
+
                 outer.seen_auth.append(self.headers.get("Authorization"))
-                route = outer.routes.get(self.path)
+                split = urlsplit(self.path)
+                query = parse_qs(split.query)
+                outer.seen_queries.append((split.path, query))
+                route = outer.routes.get(split.path)
                 if route is None:
                     self.send_response(404)
                     self.end_headers()
                     self.wfile.write(b"{}")
                     return
                 kind, api_version, items = route
+                # chunked LIST: honor limit/continue like the apiserver
+                limit = int(query.get("limit", ["0"])[0] or 0)
+                if outer.expire_continue and "continue" in query:
+                    self.send_response(410)  # expired continue token
+                    self.end_headers()
+                    self.wfile.write(b"{}")
+                    return
+                start = int(query.get("continue", ["0"])[0] or 0)
+                meta = {}
+                page = items
+                if limit:
+                    page = items[start : start + limit]
+                    if start + limit < len(items):
+                        meta["continue"] = str(start + limit)
                 body = json.dumps(
-                    {"kind": kind, "apiVersion": api_version, "items": items}
+                    {
+                        "kind": kind,
+                        "apiVersion": api_version,
+                        "metadata": meta,
+                        "items": page,
+                    }
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -235,3 +261,145 @@ def test_applier_end_to_end_with_kubeconfig(tmp_path):
     }
     assert "static-ok" in names
     assert any(n.startswith("web-") for n in names)
+
+
+def test_list_pagination_follows_continue(tmp_path, monkeypatch):
+    from open_simulator_tpu.models import kubeclient as kc_mod
+
+    monkeypatch.setattr(kc_mod, "LIST_PAGE_LIMIT", 2)
+    srv = _FakeApiServer()
+    srv.routes["/api/v1/nodes"] = (
+        "NodeList",
+        "v1",
+        [make_fake_node(f"pg-{i}", cpu="1", memory="1Gi") for i in range(5)],
+    )
+    try:
+        kc = _write_kubeconfig(tmp_path, srv.url)
+        res = create_cluster_resource_from_client(kc)
+    finally:
+        srv.stop()
+    assert [n["metadata"]["name"] for n in res.nodes] == [
+        f"pg-{i}" for i in range(5)
+    ]
+    # three chunks: limit=2 twice with continue, then the tail
+    node_queries = [q for p, q in srv.seen_queries if p == "/api/v1/nodes"]
+    assert len(node_queries) == 3
+    assert node_queries[1].get("continue") == ["2"]
+    assert node_queries[2].get("continue") == ["4"]
+
+
+def _write_exec_kubeconfig(tmp_path, server, script_body, args=None):
+    import sys
+
+    script = tmp_path / "cred-plugin.py"
+    script.write_text(script_body)
+    cfg = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server}}],
+        "users": [
+            {
+                "name": "u",
+                "user": {
+                    "exec": {
+                        "apiVersion": "client.authentication.k8s.io/v1beta1",
+                        "command": sys.executable,
+                        "args": [str(script)] + list(args or []),
+                        "env": [{"name": "PLUGIN_MARK", "value": "on"}],
+                    }
+                },
+            }
+        ],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_exec_credential_plugin_token(tmp_path):
+    # the plugin proves it saw its env and KUBERNETES_EXEC_INFO by
+    # embedding both in the token the fake apiserver then records
+    body = (
+        "import json, os\n"
+        "info = json.loads(os.environ['KUBERNETES_EXEC_INFO'])\n"
+        "tok = 'exec-' + os.environ['PLUGIN_MARK'] + '-' + info['kind']\n"
+        "print(json.dumps({'apiVersion': 'client.authentication.k8s.io/v1beta1',"
+        " 'kind': 'ExecCredential', 'status': {'token': tok}}))\n"
+    )
+    srv = _FakeApiServer()
+    try:
+        kc = _write_exec_kubeconfig(tmp_path, srv.url, body)
+        res = create_cluster_resource_from_client(kc)
+    finally:
+        srv.stop()
+    assert res.nodes
+    assert set(srv.seen_auth) == {"Bearer exec-on-ExecCredential"}
+
+
+def test_exec_credential_plugin_failure_raises(tmp_path):
+    body = "import sys\nsys.exit(3)\n"
+    kc = _write_exec_kubeconfig(tmp_path, "http://127.0.0.1:1", body)
+    with pytest.raises(KubeConfigError, match="exec credential plugin"):
+        KubeClient(kc)
+
+
+def test_auth_provider_access_token_and_cmd(tmp_path):
+    import sys
+
+    # cached access-token wins
+    cfg = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": "http://x"}}],
+        "users": [
+            {
+                "name": "u",
+                "user": {
+                    "auth-provider": {
+                        "name": "gcp",
+                        "config": {"access-token": "cached-tok"},
+                    }
+                },
+            }
+        ],
+    }
+    path = tmp_path / "kc1"
+    path.write_text(yaml.safe_dump(cfg))
+    assert KubeClient(str(path)).token == "cached-tok"
+
+    # gcp cmd-path + token-key extraction
+    script = tmp_path / "gcloud.py"
+    script.write_text(
+        "import json\n"
+        "print(json.dumps({'credential': {'access_token': 'fresh-tok'}}))\n"
+    )
+    cfg["users"][0]["user"]["auth-provider"]["config"] = {
+        "cmd-path": sys.executable,
+        "cmd-args": str(script),
+        "token-key": "{.credential.access_token}",
+    }
+    path = tmp_path / "kc2"
+    path.write_text(yaml.safe_dump(cfg))
+    assert KubeClient(str(path)).token == "fresh-tok"
+
+
+def test_list_410_expired_continue_falls_back_to_full_list(tmp_path, monkeypatch):
+    from open_simulator_tpu.models import kubeclient as kc_mod
+
+    monkeypatch.setattr(kc_mod, "LIST_PAGE_LIMIT", 2)
+    srv = _FakeApiServer(expire_continue=True)
+    srv.routes["/api/v1/nodes"] = (
+        "NodeList",
+        "v1",
+        [make_fake_node(f"ch-{i}", cpu="1", memory="1Gi") for i in range(5)],
+    )
+    try:
+        kc = _write_kubeconfig(tmp_path, srv.url)
+        res = create_cluster_resource_from_client(kc)
+    finally:
+        srv.stop()
+    # page 1 (2 items) -> continue expires with 410 -> one full list,
+    # no duplicates
+    assert [n["metadata"]["name"] for n in res.nodes] == [
+        f"ch-{i}" for i in range(5)
+    ]

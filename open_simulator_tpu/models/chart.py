@@ -970,24 +970,23 @@ def _dependency_enabled(dep: dict, parent_values: dict) -> bool:
 # unpacked .tgz dependencies, keyed by (path, mtime) so repeated
 # renders of the same chart reuse one scratch extraction; LRU-bounded,
 # evicted/exit-time scratch dirs removed (value = (chart_root, tmpdir))
-_ARCHIVE_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_ARCHIVE_CACHE: "OrderedDict[tuple, Optional[str]]" = OrderedDict()
 _ARCHIVE_CACHE_CAP = 32
+# every scratch dir ever created; removed only at process exit —
+# eviction from the LRU must NOT rmtree, because an in-flight render
+# may still hold _Subchart.path pointers into an evicted extraction
+_ARCHIVE_SCRATCH_DIRS: List[str] = []
 
 
-def _drop_archive_scratch(entry: tuple) -> None:
+def _cleanup_archive_scratch() -> None:
     import shutil
 
-    _root, tmp = entry
-    if tmp:
-        shutil.rmtree(tmp, ignore_errors=True)
+    while _ARCHIVE_SCRATCH_DIRS:
+        shutil.rmtree(_ARCHIVE_SCRATCH_DIRS.pop(), ignore_errors=True)
+    _ARCHIVE_CACHE.clear()
 
 
-def _cleanup_archive_cache() -> None:
-    while _ARCHIVE_CACHE:
-        _drop_archive_scratch(_ARCHIVE_CACHE.popitem()[1])
-
-
-atexit.register(_cleanup_archive_cache)
+atexit.register(_cleanup_archive_scratch)
 
 
 def _unpack_chart_archive(archive_path: str) -> Optional[str]:
@@ -998,17 +997,16 @@ def _unpack_chart_archive(archive_path: str) -> Optional[str]:
     paths are refused by tarfile's data filter (manual member screening
     on Pythons predating the `filter` kwarg)."""
     key = (archive_path, os.path.getmtime(archive_path))
-    cached = _ARCHIVE_CACHE.get(key)
-    if cached is not None:
+    if key in _ARCHIVE_CACHE:
         _ARCHIVE_CACHE.move_to_end(key)
-        return cached[0]
+        return _ARCHIVE_CACHE[key]
     import tarfile
     import tempfile
 
     root = None
-    tmp = None
     try:
         tmp = tempfile.mkdtemp(prefix="simon-chart-")
+        _ARCHIVE_SCRATCH_DIRS.append(tmp)
         with tarfile.open(archive_path, "r:gz") as tf:
             try:
                 tf.extractall(tmp, filter="data")
@@ -1030,9 +1028,9 @@ def _unpack_chart_archive(archive_path: str) -> Optional[str]:
                 break
     except (tarfile.TarError, OSError):
         root = None
-    _ARCHIVE_CACHE[key] = (root, tmp)
+    _ARCHIVE_CACHE[key] = root
     if len(_ARCHIVE_CACHE) > _ARCHIVE_CACHE_CAP:
-        _drop_archive_scratch(_ARCHIVE_CACHE.popitem(last=False)[1])
+        _ARCHIVE_CACHE.popitem(last=False)
     return root
 
 
@@ -1053,6 +1051,7 @@ def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[
     deps_by_name = {d.get("name"): d for d in _dependencies(path, meta)}
     charts_dir = os.path.join(path, "charts")
     if os.path.isdir(charts_dir):
+        seen_entries = set()
         for entry in sorted(os.listdir(charts_dir)):
             sub_path = os.path.join(charts_dir, entry)
             if os.path.isfile(sub_path) and entry.endswith((".tgz", ".tar.gz")):
@@ -1068,6 +1067,12 @@ def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[
                 os.path.join(sub_path, "Chart.yaml")
             ):
                 continue
+            # a dependency vendored both unpacked and as a .tgz (helm
+            # pull --untar next to helm dependency update leftovers)
+            # loads once — the sorted walk puts the directory first
+            if entry in seen_entries:
+                continue
+            seen_entries.add(entry)
             dep = deps_by_name.get(entry, {})
             if dep and not _dependency_enabled(dep, merged):
                 continue

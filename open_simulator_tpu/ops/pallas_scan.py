@@ -8,9 +8,10 @@ cluster state resident in VMEM as (R, 128) int32 tiles — per-step cost
 collapses to pure VPU arithmetic with zero kernel-launch overhead.
 
 Scope (automatic fallback to the XLA scan otherwise):
-- no GPU-share / open-local / ports / custom-plugin / scalar-resource
-  machinery (features gates, same contract as ScanFeatures); nodeName
-  pins ARE in scope (`run_scan_pallas(pinned=...)`),
+- no GPU-share / open-local / custom-plugin machinery (features gates,
+  same contract as ScanFeatures); nodeName pins
+  (`run_scan_pallas(pinned=...)`), hostPorts (per-(ip,proto,port)
+  vocab bitmask tiles), and extended scalar resources ARE in scope,
 - inter-pod affinity + hard/soft topology spread ARE in scope: term
   count state rides in VMEM scratch as node-space (T, R, 128) i32
   tiles (ops/scan.py ScanState docstring), per-(class, slot) eval
@@ -1681,7 +1682,7 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
         def call(*arrays):
             def spec(i):
                 if i in any_idx:
-                    return pl.BlockSpec(memory_space=pltpu.ANY)
+                    return pl.BlockSpec(memory_space=pl.ANY)
                 if i in smem_idx:
                     return pl.BlockSpec(memory_space=pltpu.SMEM)
                 return pl.BlockSpec(memory_space=pltpu.VMEM)

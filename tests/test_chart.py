@@ -447,3 +447,24 @@ def test_corrupt_subchart_archive_skipped(tmp_path):
         f.write(b"not a tarball")
     docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
     assert [d["metadata"]["name"] for d in docs] == ["parent"]
+
+
+def test_duplicate_dir_and_archive_subchart_loads_once(tmp_path):
+    # helm pull --untar leaves a directory next to helm dependency
+    # update's .tgz: the subchart must render exactly once
+    parent = write_chart(
+        tmp_path,
+        "parent",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: parent\n"},
+    )
+    child_src = write_chart(
+        str(tmp_path / "scratch"),
+        "childa",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: a\n"},
+    )
+    import shutil
+
+    shutil.copytree(child_src, os.path.join(parent, "charts", "childa"))
+    _package_chart(child_src, os.path.join(parent, "charts"))
+    docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
+    assert sorted(d["metadata"]["name"] for d in docs) == ["a", "parent"]

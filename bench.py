@@ -622,7 +622,7 @@ def main():
         p = run_priority()
         out = {
             "metric": f"pods scheduled/sec at {p['nodes']} nodes, e2e simulate "
-            f"({p['priority_pods']} priority pods routed serial, rest on the "
+            f"({p['priority_pods']} priority pods hybrid-routed, bulk on the "
             f"fused scan; {p['scheduled']}/{p['total']} placed)",
             "value": round(p["pods_per_sec"], 1),
             "unit": "pods/s",

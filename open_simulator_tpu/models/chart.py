@@ -970,19 +970,29 @@ def _dependency_enabled(dep: dict, parent_values: dict) -> bool:
 # unpacked .tgz dependencies, keyed by (path, mtime) so repeated
 # renders of the same chart reuse one scratch extraction; LRU-bounded,
 # evicted/exit-time scratch dirs removed (value = (chart_root, tmpdir))
-_ARCHIVE_CACHE: "OrderedDict[tuple, Optional[str]]" = OrderedDict()
+_ARCHIVE_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()  # key -> (root, tmp)
 _ARCHIVE_CACHE_CAP = 32
-# every scratch dir ever created; removed only at process exit —
-# eviction from the LRU must NOT rmtree, because an in-flight render
-# may still hold _Subchart.path pointers into an evicted extraction
-_ARCHIVE_SCRATCH_DIRS: List[str] = []
+# LRU eviction must NOT rmtree immediately — an in-flight render may
+# still hold _Subchart.path pointers into the evicted extraction.
+# Evicted dirs park here and are reclaimed at the next process_chart
+# entry (no render in flight then) or at process exit.
+_ARCHIVE_EVICTED: List[str] = []
+_ARCHIVE_LIVE: List[str] = []  # dirs still referenced by the cache
+
+
+def _purge_evicted_archives() -> None:
+    import shutil
+
+    while _ARCHIVE_EVICTED:
+        shutil.rmtree(_ARCHIVE_EVICTED.pop(), ignore_errors=True)
 
 
 def _cleanup_archive_scratch() -> None:
     import shutil
 
-    while _ARCHIVE_SCRATCH_DIRS:
-        shutil.rmtree(_ARCHIVE_SCRATCH_DIRS.pop(), ignore_errors=True)
+    _purge_evicted_archives()
+    while _ARCHIVE_LIVE:
+        shutil.rmtree(_ARCHIVE_LIVE.pop(), ignore_errors=True)
     _ARCHIVE_CACHE.clear()
 
 
@@ -999,14 +1009,15 @@ def _unpack_chart_archive(archive_path: str) -> Optional[str]:
     key = (archive_path, os.path.getmtime(archive_path))
     if key in _ARCHIVE_CACHE:
         _ARCHIVE_CACHE.move_to_end(key)
-        return _ARCHIVE_CACHE[key]
+        return _ARCHIVE_CACHE[key][0]
     import tarfile
     import tempfile
 
     root = None
+    tmp = None
     try:
         tmp = tempfile.mkdtemp(prefix="simon-chart-")
-        _ARCHIVE_SCRATCH_DIRS.append(tmp)
+        _ARCHIVE_LIVE.append(tmp)
         with tarfile.open(archive_path, "r:gz") as tf:
             try:
                 tf.extractall(tmp, filter="data")
@@ -1028,9 +1039,12 @@ def _unpack_chart_archive(archive_path: str) -> Optional[str]:
                 break
     except (tarfile.TarError, OSError):
         root = None
-    _ARCHIVE_CACHE[key] = root
+    _ARCHIVE_CACHE[key] = (root, tmp)
     if len(_ARCHIVE_CACHE) > _ARCHIVE_CACHE_CAP:
-        _ARCHIVE_CACHE.popitem(last=False)
+        _evicted_root, evicted_tmp = _ARCHIVE_CACHE.popitem(last=False)[1]
+        if evicted_tmp:
+            _ARCHIVE_LIVE.remove(evicted_tmp)
+            _ARCHIVE_EVICTED.append(evicted_tmp)
     return root
 
 
@@ -1085,6 +1099,8 @@ def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[
 def process_chart(name: str, path: str, extra_values: Optional[dict] = None) -> List[str]:
     """ProcessChart (pkg/chart/chart.go:18-41): render a chart directory
     (with its subcharts) into YAML manifest strings in install order."""
+    # no render in flight here: safe to reclaim LRU-evicted extractions
+    _purge_evicted_archives()
     charts = _collect_charts(name, path, extra_values or {}, {})
 
     release = {

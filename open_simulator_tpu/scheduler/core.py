@@ -218,17 +218,33 @@ class Simulator:
         return best if best[1] - best[0] >= MIN_SCAN_RUN else None
 
     def _schedule_pods_hybrid(self, pods, split) -> List[UnscheduledPod]:
-        """Serial-oracle prefix, scan the zero-priority run, serial
+        """Scan-or-serial prefix, scan the zero-priority run, serial
         suffix. Exact queue equivalence with the full serial run:
         victims evicted during the prefix would rejoin the serial queue
         BEHIND the suffix pods (they append to the back), so they are
-        deferred into the final serial segment in eviction order."""
+        deferred into the final serial segment in eviction order.
+
+        The priority prefix itself first rides the scan optimistically:
+        preemption (the one semantic the scan lacks) only triggers when
+        a pod FAILS to place, so a prefix the scan places completely is
+        placement-identical to the serial cycle (engine conformance) —
+        a serial cycle costs ~0.5 s at 10k nodes, the scan ~0.1 s for
+        the whole prefix. Any failure discards the attempt and replays
+        the prefix serially with full preemption."""
         from ..utils.trace import GLOBAL
 
         start, end = split
-        failed, deferred = self._schedule_pods_oracle(
-            pods[:start], defer_victims=True
-        )
+        head = pods[:start]
+        failed: List[UnscheduledPod] = []
+        deferred: List[dict] = []
+        if head:
+            if self._try_scan_segment(head):
+                GLOBAL.note("hybrid-head", "scan")
+            else:
+                GLOBAL.note("hybrid-head", "serial")
+                failed, deferred = self._schedule_pods_oracle(
+                    head, defer_victims=True
+                )
         mid, tail = pods[start:end], list(pods[end:])
         # a zero-priority pod can preempt only a committed pod with
         # negative priority (PostFilter gate: prio > min committed);
@@ -242,6 +258,14 @@ class Simulator:
         f2, _ = self._schedule_pods_oracle(tail + deferred)
         failed.extend(f2)
         return failed
+
+    def _try_scan_segment(self, pods: List[dict]) -> bool:
+        """Optimistically place a segment through the scan engine;
+        commit and return True only when every schedulable pod placed —
+        the case where the serial cycle could not have preempted either,
+        so the placements are identical by engine conformance. Commits
+        nothing and returns False otherwise (caller replays serially)."""
+        return self._scan_and_commit(pods, all_or_nothing=True) is not None
 
     def _schedule_pods_oracle(
         self, pods: List[dict], defer_victims: bool = False
@@ -287,6 +311,13 @@ class Simulator:
     def _schedule_pods_tpu(self, pods: List[dict]) -> List[UnscheduledPod]:
         """JAX scan path. Pods keep their order (pinned pods are forced
         placements inside the scan)."""
+        return self._scan_and_commit(pods)
+
+    def _scan_and_commit(self, pods: List[dict], all_or_nothing: bool = False):
+        """Scan a batch and replay the placements onto the oracle.
+        Returns the failed pods, or None — nothing committed — when
+        `all_or_nothing` is set and any schedulable pod failed (the
+        optimistic hybrid-head contract, _try_scan_segment)."""
         from .engine import TpuEngine
 
         # pods pinned to unknown nodes never reach the scheduler
@@ -298,13 +329,17 @@ class Simulator:
                 dangling.append(p)
             else:
                 batch.append(p)
+        placements = []
+        if batch:
+            if self._engine is None or self._engine.oracle is not self.oracle:
+                self._engine = TpuEngine(self.oracle)
+            placements = self._engine.schedule(batch)
+            if all_or_nothing and any(
+                int(idx) < 0 and not (p.get("spec") or {}).get("nodeName")
+                for p, idx in zip(batch, placements)
+            ):
+                return None
         self.cluster_pods.extend(dangling)
-        if not batch:
-            return []
-        if self._engine is None or self._engine.oracle is not self.oracle:
-            self._engine = TpuEngine(self.oracle)
-        engine = self._engine
-        placements = engine.schedule(batch)
         failed: List[UnscheduledPod] = []
         for pod, node_idx in zip(batch, placements):
             if (pod.get("spec") or {}).get("nodeName"):
@@ -318,7 +353,7 @@ class Simulator:
                     UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reasons))
                 )
             else:
-                engine.commit_host(pod, int(node_idx))
+                self._engine.commit_host(pod, int(node_idx))
                 self.cluster_pods.append(pod)
         return failed
 

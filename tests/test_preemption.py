@@ -486,3 +486,30 @@ def test_hybrid_short_run_stays_serial(monkeypatch):
     serial, tpu, note = _run_both(cluster, apps, 64, monkeypatch)
     assert note == "serial-oracle"
     assert _summary(serial) == _summary(tpu)
+
+
+def test_hybrid_head_rides_scan_when_no_preemption_needed(monkeypatch):
+    # enough capacity for the priority pods: the head must take the
+    # optimistic scan path and match the serial oracle exactly
+    from open_simulator_tpu.scheduler import core as core_mod
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    nodes = [make_fake_node(f"node-{i}", "4", "16Gi") for i in range(3)]
+    pres = [
+        make_fake_pod(f"pre-{i}", "default", "500m", "1Gi", with_priority(100))
+        for i in range(2)
+    ]
+    zeros = [
+        make_fake_pod(f"zero-{i}", "default", "250m", "512Mi", with_priority(0))
+        for i in range(8)
+    ]
+    cluster = _cluster(nodes)
+    apps = [_app("a", pres + zeros)]
+    serial = simulate(cluster, apps, engine="oracle")
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 4)
+    GLOBAL.reset()
+    tpu = simulate(cluster, apps, engine="tpu")
+    assert GLOBAL.notes.get("engine") == "hybrid"
+    assert GLOBAL.notes.get("hybrid-head") == "scan"
+    assert not tpu.unscheduled_pods and not tpu.preemptions
+    assert _placement(serial) == _placement(tpu)

@@ -17,6 +17,10 @@ Scenarios (SIMON_BENCH env):
   nodes (simon-gpushare-config.yaml at scale).
 - `priority`: the default batch with a few high-priority pods — the
   hybrid engine split keeps the bulk on the fused scan.
+- `fuzz`: on-device Pallas-vs-XLA placement conformance over a
+  mixed-feature scenario (terms+ports+scalars+pins); `all` runs it
+  first and aborts on any mismatch, so every recorded number is backed
+  by a fresh hardware numerics check.
 - `defrag`: pod-migration defragmentation sweep on a cluster snapshot.
 - `whatif`: minimal-count capacity plan over 8 candidate newnode specs.
 - `all`: capacity headline with the others embedded in the metric
@@ -341,6 +345,114 @@ def run_whatif(n_base=500, n_pods=5000) -> dict:
     }
 
 
+def run_conformance_fuzz(n_nodes=1000, n_pods=2000, seed=0) -> dict:
+    """Hardware conformance check (the only real-TPU numerics check —
+    unit tests run the kernel in interpret mode on CPU): build a
+    feature-mixed scenario (affinity/spread terms + hostPorts + scalar
+    resources + nodeName pins), run the COMPILED Pallas kernel and the
+    XLA scan on identical inputs, and require placement-for-placement
+    equality. Runs inside `all` so every recorded bench is backed by a
+    fresh on-device conformance pass."""
+    import numpy as np
+
+    from open_simulator_tpu.models import workloads as wl
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.ops import pallas_scan
+    from open_simulator_tpu.ops import scan as scan_ops
+    from open_simulator_tpu.ops.encode import (
+        encode_batch,
+        encode_cluster,
+        encode_dynamic,
+        features_of_batch,
+        to_scan_static,
+        to_scan_state,
+    )
+    from open_simulator_tpu.scheduler.core import _sort_app_pods
+    from open_simulator_tpu.scheduler.oracle import Oracle
+    from open_simulator_tpu.testing import build_affinity_stress
+
+    rng = np.random.RandomState(seed)
+    nodes, stss = build_affinity_stress(
+        n_nodes=n_nodes, n_sts=20, replicas=max(n_pods // 20, 1), zones=8
+    )
+    res = ResourceTypes()
+    res.stateful_sets = stss
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("fuzz", res, nodes))
+    # mix in the non-term feature surface: ports, scalars, pins
+    for node in nodes[: n_nodes // 2]:
+        node["status"]["allocatable"]["example.com/accel"] = "4"
+    import copy
+
+    for i, pod in enumerate(pods[:n_pods]):
+        k = rng.randint(0, 40)
+        if k > 2:
+            continue
+        # replica clones share nested spec objects (workloads.py
+        # _expand_template, read-only-after-expansion contract): give
+        # this pod its own deep copy before stamping per-pod features,
+        # or the whole template's replicas would inherit them. Each
+        # mutated pod mints a fresh pod class, and pins/ports draw from
+        # small vocabularies, so the class count stays under the
+        # kernel's 128-class scope (build_plan: batch.u > LANES).
+        pod["spec"] = spec = copy.deepcopy(pod["spec"])
+        if k == 0:
+            port = 9000 + int(rng.randint(0, 3))
+            spec["containers"][0]["ports"] = [
+                {"containerPort": port, "hostPort": port, "protocol": "TCP"}
+            ]
+        elif k == 1:
+            spec["containers"][0]["resources"]["requests"][
+                "example.com/accel"
+            ] = "1"
+        else:
+            spec["nodeName"] = nodes[int(rng.randint(0, 8))]["metadata"]["name"]
+    pods = pods[:n_pods]
+
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    ones_p = np.ones(len(pods), bool)
+    ones_n = np.ones(cluster.n, bool)
+
+    plan = (
+        pallas_scan.build_plan(cluster, batch, dyn, features)
+        if pallas_scan.should_use()
+        else None
+    )
+    if plan is None:
+        return {"checked": 0, "mismatches": -1, "note": "pallas path unavailable"}
+    place_k, _ = pallas_scan.run_scan_pallas(
+        plan, batch.class_of_pod, ones_p, ones_n, pinned=batch.pinned_node
+    )
+    import jax.numpy as jnp
+
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    place_x, _ = scan_ops.run_scan(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        features=features,
+    )
+    place_k = np.asarray(place_k)
+    place_x = np.asarray(place_x)
+    # normalize the no-node encodings before comparing
+    place_k = np.where(place_k < 0, -1, place_k)
+    place_x = np.where(place_x < 0, -1, place_x)
+    mism = int((place_k != place_x).sum())
+    if mism:
+        idx = np.nonzero(place_k != place_x)[0][:5]
+        raise AssertionError(
+            f"pallas/xla conformance fuzz FAILED: {mism} of {len(pods)} "
+            f"placements differ (first at pods {idx.tolist()}: "
+            f"kernel={place_k[idx].tolist()} xla={place_x[idx].tolist()})"
+        )
+    return {"checked": len(pods), "mismatches": 0}
+
+
 def run_priority(n_priority=5) -> dict:
     """SIMON_BENCH=priority: the default 20k-pod x 10k-node batch with a
     few high-priority pods mixed in. Round 2 sent any such batch to the
@@ -618,6 +730,21 @@ def main():
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
         }
+    elif scenario == "fuzz":
+        z = run_conformance_fuzz()
+        skipped = z["checked"] == 0
+        out = {
+            "metric": (
+                "pallas/xla conformance fuzz SKIPPED (pallas path unavailable "
+                "on this backend)"
+                if skipped
+                else f"pallas/xla on-device conformance fuzz "
+                f"({z['checked']} mixed-feature placements compared)"
+            ),
+            "value": z["mismatches"],
+            "unit": "mismatches",
+            "vs_baseline": 1.0 if z["mismatches"] == 0 else 0.0,
+        }
     elif scenario == "priority":
         p = run_priority()
         out = {
@@ -648,6 +775,7 @@ def main():
             "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / w["elapsed_s"], 3),
         }
     else:  # all: capacity headline + the other BASELINE configs embedded
+        z = run_conformance_fuzz()  # raises on any on-device mismatch
         c = run_capacity()
         nodes, pods = build_scenario()
         rd = _scan_rate(nodes, pods, "default")
@@ -674,7 +802,12 @@ def main():
             f"defrag sweep {d['elapsed_s']:.2f}s/{d['drained']} drained at {d['nodes']} nodes, "
             f"8-spec what-if {w['elapsed_s']:.2f}s, "
             f"priority-mixed e2e {p['pods_per_sec']:.0f} pods/s "
-            f"({p['priority_pods']} priority pods hybrid-routed))",
+            f"({p['priority_pods']} priority pods hybrid-routed); "
+            + (
+                f"on-device conformance fuzz: {z['checked']} placements ok)"
+                if z["checked"]
+                else "conformance fuzz SKIPPED: no TPU)"
+            ),
             "value": round(c["elapsed_s"], 2),
             "unit": "s",
             "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / c["elapsed_s"], 3),

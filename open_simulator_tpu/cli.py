@@ -47,6 +47,36 @@ def _force_platform():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        return
+    # A wedged TPU relay plugin (JAX_PLATFORMS naming a plugin backend
+    # that fails to initialize) would otherwise kill the run mid-plan:
+    # probe the backend in a subprocess — the same guard bench.py uses
+    # — and degrade to CPU when it is unhealthy. Only plugin platforms
+    # are probed; the builtin cpu/tpu paths initialize in-process.
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not platforms or platforms in ("cpu", "tpu"):
+        return
+    if "jax" in sys.modules:
+        return  # too late to change the platform; let jax report it
+    import subprocess
+
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                timeout=150,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        logging.warning(
+            "JAX platform %r failed to initialize; falling back to CPU",
+            platforms,
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def cmd_apply(args) -> int:
@@ -226,16 +256,75 @@ def cmd_version(_args) -> int:
 
 
 def cmd_gen_doc(args) -> int:
-    """Markdown CLI docs (cmd/doc/generate_markdown.go)."""
+    """Markdown CLI docs (cmd/doc/generate_markdown.go -> cobra
+    doc.GenMarkdownTree): one page per command — title, synopsis,
+    usage, options, SEE ALSO cross-links — not a single dump. We
+    create the output directory when missing (the reference instead
+    errors on a missing directory — friendlier here, noted)."""
     parser = build_parser()
     out_dir = args.output
     os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, "simon.md")
-    with open(path, "w") as f:
-        f.write("# simon\n\n```\n")
-        f.write(parser.format_help())
-        f.write("```\n")
-    print(f"wrote {path}")
+
+    def page(path: str, title: str, p: argparse.ArgumentParser, see_also):
+        desc = (p.description or "").strip()
+        lines = [
+            f"## {title}",
+            "",
+            desc,
+            "",
+            "### Synopsis",
+            "",
+            desc,
+            "",
+            "```",
+            p.format_usage().strip(),
+            "```",
+            "",
+            "### Options",
+            "",
+            "```",
+        ]
+        opts = p.format_help()
+        # keep only the options tail of the help text (cobra pages
+        # list flags, not the usage/positional preamble)
+        for marker in ("options:", "optional arguments:"):
+            if marker in opts:
+                opts = opts.split(marker, 1)[1]
+                break
+        lines.append(opts.strip("\n"))
+        lines += ["```", "", "### SEE ALSO", ""]
+        for target, file_name, blurb in see_also:
+            lines.append(f"* [{target}]({file_name})\t - {blurb}")
+        lines.append("")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+
+    sub_action = next(
+        a for a in parser._actions if isinstance(a, argparse._SubParsersAction)
+    )
+    helps = {
+        a.dest: a.help or "" for a in sub_action._choices_actions
+    } if sub_action._choices_actions else {}
+    root_desc = (parser.description or "").strip()
+    subs = sorted(sub_action.choices.items())
+    page(
+        os.path.join(out_dir, "simon.md"),
+        "simon",
+        parser,
+        [
+            (f"simon {name}", f"simon_{name}.md", helps.get(name, ""))
+            for name, _p in subs
+        ],
+    )
+    for name, sp in subs:
+        sp.description = sp.description or helps.get(name, "")
+        page(
+            os.path.join(out_dir, f"simon_{name}.md"),
+            f"simon {name}",
+            sp,
+            [("simon", "simon.md", root_desc)],
+        )
+    print(f"wrote {len(subs) + 1} pages to {out_dir}")
     return 0
 
 

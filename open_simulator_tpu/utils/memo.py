@@ -7,11 +7,13 @@ freezes, port scans) can run once per template instead of once per pod.
 
 Contract: keys are `id()` tuples of the source objects; each cache
 entry holds STRONG references to those objects, so their ids cannot be
-reused while the entry lives, and a hit re-checks identity before
-trusting the key. Sources must be read-only after first use (the
-sharing contract established in `_expand_template`). The cache clears
-wholesale when full — entries are cheap to recompute and the working
-set per run is far below the cap.
+reused while the entry lives — which makes a key hit a PROOF of
+identity (the caller's sources are alive, the entry's sources are
+alive, and two live objects never share an id), so the hot path trusts
+the key without re-checking. Sources must be read-only after first use
+(the sharing contract established in `_expand_template`). The cache
+clears wholesale when full — entries are cheap to recompute and the
+working set per run is far below the cap.
 """
 
 from __future__ import annotations
@@ -58,9 +60,11 @@ class IdentityMemo:
         _ALL_MEMOS.add(self)
 
     def get(self, sources: Tuple, compute: Callable):
-        key = tuple(id(s) for s in sources)
+        key = tuple(map(id, sources))
         hit = self._cache.get(key)
-        if hit is not None and all(a is b for a, b in zip(hit[0], sources)):
+        if hit is not None:
+            # key hit == identity (see module docstring: strong refs
+            # make live-id collisions impossible)
             return hit[1]
         value = compute()
         if len(self._cache) >= self._max:

@@ -309,7 +309,13 @@ def test_cli_version_and_gen_doc(tmp_path, capsys):
     assert main(["version"]) == 0
     assert "simon-tpu version" in capsys.readouterr().out
     assert main(["gen-doc", "--output", str(tmp_path)]) == 0
+    # cobra GenMarkdownTree parity: one page per command, cross-linked
     assert (tmp_path / "simon.md").exists()
+    for cmd in ("apply", "defrag", "version", "gen-doc"):
+        text = (tmp_path / f"simon_{cmd}.md").read_text()
+        assert f"## simon {cmd}" in text
+        assert "### SEE ALSO" in text and "(simon.md)" in text
+    assert "(simon_apply.md)" in (tmp_path / "simon.md").read_text()
 
 
 def test_sweep_with_hostname_spread_matches_serial():

@@ -50,28 +50,22 @@ def _force_platform():
         return
     # A wedged TPU relay plugin (JAX_PLATFORMS naming a plugin backend
     # that fails to initialize) would otherwise kill the run mid-plan:
-    # probe the backend in a subprocess — the same guard bench.py uses
-    # — and degrade to CPU when it is unhealthy. Only plugin platforms
-    # are probed; the builtin cpu/tpu paths initialize in-process.
+    # probe the backend in a subprocess (utils/backend.py, shared with
+    # bench.py) and degrade to CPU when it is unhealthy. Only plugin
+    # platforms are probed — builtin cpu/tpu initialize in-process —
+    # and the probe costs one extra backend init on the healthy path;
+    # SIMON_BACKEND_PROBE=0 skips it for operators who prefer the
+    # faster cold start over the guard.
     platforms = os.environ.get("JAX_PLATFORMS", "")
     if not platforms or platforms in ("cpu", "tpu"):
         return
+    if os.environ.get("SIMON_BACKEND_PROBE") == "0":
+        return
     if "jax" in sys.modules:
         return  # too late to change the platform; let jax report it
-    import subprocess
+    from .utils.backend import probe_backend
 
-    try:
-        ok = (
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True,
-                timeout=150,
-            ).returncode
-            == 0
-        )
-    except subprocess.TimeoutExpired:
-        ok = False
-    if not ok:
+    if not probe_backend():
         logging.warning(
             "JAX platform %r failed to initialize; falling back to CPU",
             platforms,

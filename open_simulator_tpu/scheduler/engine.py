@@ -85,7 +85,17 @@ class TpuEngine:
                 init = to_scan_state(dyn, batch)
         from ..utils.trace import GLOBAL
 
-        GLOBAL.note("batch-kernel", "pallas" if plan is not None else "xla-scan")
+        if plan is not None:
+            GLOBAL.note("batch-kernel", "pallas")
+        else:
+            # never a silent fallback: name why the fused kernel was
+            # out of scope (pallas_scan.last_reject) or unavailable
+            why = (
+                (pallas_scan.last_reject() or "rejected")
+                if pallas_scan.should_use()
+                else "no TPU backend"
+            )
+            GLOBAL.note("batch-kernel", f"xla-scan ({why})")
         if plan is not None:
             # fused single-kernel fast path; bit-identical placements
             # (tests/test_pallas_scan.py)

@@ -1,0 +1,35 @@
+"""JAX backend health probing.
+
+Plugin TPU backends reached over a relay can wedge the first process
+that touches them (hang inside backend init, not an exception), so the
+only safe probe is a SUBPROCESS that pays the init cost and reports
+back. bench.py and the CLI share this helper; the CLI additionally
+lets operators skip the probe (SIMON_BACKEND_PROBE=0) when they know
+the backend is healthy and want the ~backend-init-time faster cold
+start — the probe's verdict cannot be cached across invocations
+because a relay wedge is exactly the kind of state that changes
+between runs.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+PROBE_TIMEOUT_S = 150.0
+
+
+def probe_backend(timeout: float = PROBE_TIMEOUT_S) -> bool:
+    """True when `import jax; jax.devices()` succeeds in a fresh
+    subprocess under the current environment."""
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                timeout=timeout,
+            ).returncode
+            == 0
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return False

@@ -513,3 +513,50 @@ def test_hybrid_head_rides_scan_when_no_preemption_needed(monkeypatch):
     assert GLOBAL.notes.get("hybrid-head") == "scan"
     assert not tpu.unscheduled_pods and not tpu.preemptions
     assert _placement(serial) == _placement(tpu)
+
+
+def test_hybrid_randomized_conformance(monkeypatch):
+    """Randomized priority mixes (positive/zero/negative, bound
+    victims, preemption chains): the hybrid engine must match the
+    serial oracle placement-for-placement on every seed."""
+    import numpy as np
+
+    from open_simulator_tpu.scheduler import core as core_mod
+
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 3)
+    for seed in range(5):
+        rng = np.random.RandomState(seed)
+        n_nodes = int(rng.randint(3, 7))
+        nodes = [
+            make_fake_node(f"node-{i}", str(int(rng.choice([1, 2, 4]))), "16Gi")
+            for i in range(n_nodes)
+        ]
+        bound = []
+        for i in range(int(rng.randint(0, 4))):
+            p = make_fake_pod(
+                f"bound-{i}", "default", f"{int(rng.choice([300, 700]))}m",
+                "512Mi", with_priority(int(rng.choice([-2, 0]))),
+            )
+            p["spec"]["nodeName"] = f"node-{int(rng.randint(0, n_nodes))}"
+            bound.append(p)
+        pods = [
+            make_fake_pod(
+                f"p-{i:02d}", "default", f"{int(rng.choice([200, 500, 900]))}m",
+                "256Mi",
+                with_priority(int(rng.choice([0, 0, 0, 0, 100, 50, -5]))),
+            )
+            for i in range(int(rng.randint(10, 24)))
+        ]
+        cluster = _cluster(nodes, pods=bound)
+        apps = [_app("a", pods)]
+        serial = simulate(cluster, apps, engine="oracle")
+        tpu = simulate(cluster, apps, engine="tpu")
+
+        def summary(res):
+            return (
+                _placement(res),
+                sorted(u.pod["metadata"]["name"] for u in res.unscheduled_pods),
+                sorted(ev.victim["metadata"]["name"] for ev in res.preemptions),
+            )
+
+        assert summary(serial) == summary(tpu), f"seed {seed}"

@@ -382,9 +382,10 @@ def run_conformance_fuzz(n_nodes=1000, n_pods=2000, seed=0) -> dict:
         # _expand_template, read-only-after-expansion contract): give
         # this pod its own deep copy before stamping per-pod features,
         # or the whole template's replicas would inherit them. Each
-        # mutated pod mints a fresh pod class, and pins/ports draw from
-        # small vocabularies, so the class count stays under the
-        # kernel's 128-class scope (build_plan: batch.u > LANES).
+        # mutated pod mints a fresh pod class — deliberately pushing the
+        # batch past 128 classes so the kernel's multi-row class-column
+        # tables (col_u dynamic sublane reads) get a fresh hardware
+        # check every run, while staying under the 512-class scope.
         pod["spec"] = spec = copy.deepcopy(pod["spec"])
         if k == 0:
             port = 9000 + int(rng.randint(0, 3))
@@ -394,14 +395,19 @@ def run_conformance_fuzz(n_nodes=1000, n_pods=2000, seed=0) -> dict:
         elif k == 1:
             spec["containers"][0]["resources"]["requests"][
                 "example.com/accel"
-            ] = "1"
+            ] = str(1 + i % 4)
         else:
-            spec["nodeName"] = nodes[int(rng.randint(0, 8))]["metadata"]["name"]
+            spec["nodeName"] = nodes[int(rng.randint(0, n_nodes))]["metadata"]["name"]
     pods = pods[:n_pods]
 
     oracle = Oracle(nodes)
     cluster = encode_cluster(oracle)
     batch = encode_batch(oracle, cluster, pods)
+    # the deliberate point of the mutation mix: cross the 128-class
+    # boundary so the kernel's multi-row class-column tables get a
+    # hardware check (content-keyed class dedup could silently collapse
+    # this if the vocabularies shrink)
+    assert batch.u > 128, f"fuzz scenario dedup'd to {batch.u} classes"
     dyn = encode_dynamic(oracle, cluster)
     features = features_of_batch(cluster, batch)
     ones_p = np.ones(len(pods), bool)

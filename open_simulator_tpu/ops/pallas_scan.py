@@ -291,6 +291,13 @@ _MAX_SLOTS = dict(rmax=8, gmax=4, hmax=4, smax=4, a=8, gn=8, vs=32,
                   cmax=8, scmax=4)
 _MAX_COUNT = 1 << 17  # cnt exact-split bound for the soft f64 emulation
 _MAX_T = 512
+# pod classes the term kernel accepts: class-column tables span
+# ceil(U/128) sublane rows (col_u reads one dynamically); the cap
+# bounds their VMEM rows and the U-strided SMEM slot tables
+_MAX_U = 4 * LANES
+# total int32 entries across the SMEM-destined term tables (~1MB SMEM
+# per core; stay well under it so Mosaic never fails at compile time)
+_MAX_SMEM_ENTRIES = 200_000
 
 
 def _dedup_rows(tab: np.ndarray):
@@ -319,6 +326,15 @@ _LAST_REJECT: Optional[str] = None
 
 def last_reject() -> Optional[str]:
     return _LAST_REJECT
+
+
+def fallback_reason() -> str:
+    """The trace-note suffix for a plan==None outcome, read immediately
+    after a build_plan call — shared by every consumer so no fast-path
+    fallback is ever noted without its reason."""
+    if not should_use():
+        return "no TPU backend"
+    return _LAST_REJECT or "rejected"
 
 
 def _reject(reason: str) -> None:
@@ -367,9 +383,10 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
         return _reject("terms: spread slot count over kernel bounds")
     if t.a > _MAX_SLOTS["a"] or len(t.match_all) > _MAX_SLOTS["gn"]:
         return _reject("terms: affinity-group count over kernel bounds")
-    if batch.u > LANES:
-        # lane-table reads assume one 128-lane row
-        return _reject(f"terms: {batch.u} pod classes > 128-class scope")
+    if batch.u > _MAX_U:
+        # class-indexed lane tables span ceil(U/128) sublane rows; the
+        # cap bounds their VMEM rows and the SMEM slot tables
+        return _reject(f"terms: {batch.u} pod classes > {_MAX_U}-class scope")
 
     from .encode import _value_to_node_space
     from .terms import combined_pref_carry, combined_pref_init
@@ -648,12 +665,19 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
     w_h1 = (tmp - (tmp - w_hi)).astype(np.float32)  # Veltkamp split
     w_h2 = (w_hi - w_h1).astype(np.float32)
 
-    up = LANES  # u <= 128 gate above
+    # class-column tables: ceil(U/128) sublane rows of 128 lanes each,
+    # padded to the (8, 128) tile grain; the kernel's col_u selects row
+    # u//128 dynamically and lane u%128 by mask
+    u_rows = -(-max(u_n, 1) // LANES)
+    u_rows_p = -(-u_rows // SUBLANES) * SUBLANES
+    up = u_rows * LANES
 
     def tab_u(m, dtype=np.int32):
-        out = np.zeros((max(m.shape[0], SUBLANES), up), dtype=dtype)
+        """(X, U) -> (X, Ur_p, 128) class-column tile."""
+        x = max(m.shape[0], 1)
+        out = np.zeros((x, u_rows_p * LANES), dtype=dtype)
         out[: m.shape[0], : m.shape[1]] = m
-        return out
+        return out.reshape(x, u_rows_p, LANES)
 
     gid_u = t.cls_group_id.astype(np.int32)
     uu = np.arange(u_n)
@@ -717,6 +741,17 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
         w_h1=w_h1,
         w_h2=w_h2,
     )
+    smem_entries = sum(
+        getattr(plan, name).size
+        for name, space in _TERM_FIELDS
+        if space == "smem"
+    )
+    if smem_entries > _MAX_SMEM_ENTRIES:
+        # reject here rather than let Mosaic fail at compile time —
+        # the caller falls back to the XLA scan
+        return _reject(
+            f"terms: {smem_entries} SMEM slot-table entries over budget"
+        )
     return plan, hk_map
 
 
@@ -1465,12 +1500,16 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 nc = jnp.where(do, place % LANES, 0)
                 lane_nc = (lane_iota == nc)[None, :, :]  # (1, 1, C)
                 lane_nc2 = lane_iota == nc  # (1, C) for 2D slabs
-                lane_u3 = lane_iota == u  # (1, LANES) for (X, Up) tables
+                lane_u3 = lane_iota == u % LANES  # (1, LANES)
 
                 def col_u(tab_ref):
-                    """Column u of a (X, Up) table -> (X, 1, 1) i32."""
-                    t2 = jnp.where(lane_u3, tab_ref[:], 0)
-                    return jnp.sum(t2, axis=1, keepdims=True)[:, :, None]
+                    """Class-u column of a (X, Ur_p, 128) table ->
+                    (X, 1, 1) i32 (dynamic sublane row u//128, lane
+                    u%128 by mask — same pattern as pod_scalar)."""
+                    slab = tab_ref[:, pl.ds(u // LANES, 1), :]
+                    return jnp.sum(
+                        jnp.where(lane_u3, slab, 0), axis=2, keepdims=True
+                    )
 
                 def val_at(t3_ref):
                     """(X, R, C) tile values at the placed node -> (X, 1, 1)."""

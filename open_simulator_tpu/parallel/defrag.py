@@ -216,7 +216,12 @@ def plan_defrag(
     )
     from ..utils.trace import GLOBAL
 
-    GLOBAL.note("defrag-kernel", "pallas" if plan is not None else "xla-scan")
+    GLOBAL.note(
+        "defrag-kernel",
+        "pallas"
+        if plan is not None
+        else f"xla-scan ({pallas_scan.fallback_reason()})",
+    )
     if plan is not None:
         # dispatch every depth's scan without fetching, stack on the
         # device, and pay the relay's ~0.1s sync latency ONCE for all

@@ -209,15 +209,12 @@ class CapacitySweep:
         )
         from ..utils.trace import GLOBAL
 
-        if self._pallas_plan is not None:
-            GLOBAL.note("sweep-kernel", "pallas")
-        else:
-            why = (
-                (pallas_scan.last_reject() or "rejected")
-                if pallas_scan.should_use()
-                else "no TPU backend"
-            )
-            GLOBAL.note("sweep-kernel", f"xla-scan ({why})")
+        GLOBAL.note(
+            "sweep-kernel",
+            "pallas"
+            if self._pallas_plan is not None
+            else f"xla-scan ({pallas_scan.fallback_reason()})",
+        )
 
     # -- masks -------------------------------------------------------------
 

@@ -85,17 +85,14 @@ class TpuEngine:
                 init = to_scan_state(dyn, batch)
         from ..utils.trace import GLOBAL
 
-        if plan is not None:
-            GLOBAL.note("batch-kernel", "pallas")
-        else:
-            # never a silent fallback: name why the fused kernel was
-            # out of scope (pallas_scan.last_reject) or unavailable
-            why = (
-                (pallas_scan.last_reject() or "rejected")
-                if pallas_scan.should_use()
-                else "no TPU backend"
-            )
-            GLOBAL.note("batch-kernel", f"xla-scan ({why})")
+        # never a silent fallback: name why the fused kernel was out of
+        # scope or unavailable (pallas_scan.fallback_reason)
+        GLOBAL.note(
+            "batch-kernel",
+            "pallas"
+            if plan is not None
+            else f"xla-scan ({pallas_scan.fallback_reason()})",
+        )
         if plan is not None:
             # fused single-kernel fast path; bit-identical placements
             # (tests/test_pallas_scan.py)

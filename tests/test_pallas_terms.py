@@ -361,3 +361,60 @@ def test_affinity_stress_slice():
         interpret=True,
     )
     assert (np.asarray(ref) == got).all()
+
+
+def test_many_classes_beyond_128():
+    """Class-column tables span multiple sublane rows when the batch
+    has more than 128 pod classes (live-cluster imports are this
+    heterogeneous); the kernel must agree with the XLA scan across the
+    row boundary."""
+    from open_simulator_tpu.testing import build_affinity_stress
+
+    reset_name_counter()
+    nodes, stss = build_affinity_stress(n_nodes=24, n_sts=6, replicas=4, zones=3)
+
+    def add_unique_classes(pods):
+        # 140 pods with distinct cpu requests -> 140 distinct classes
+        # on top of the STS template classes, crossing 128
+        import copy
+
+        base = pods[0]
+        for i in range(140):
+            p = copy.deepcopy(base)
+            p["metadata"]["name"] = f"uniq-{i:03d}"
+            p["spec"]["containers"][0]["resources"]["requests"]["cpu"] = f"{i + 1}m"
+            p["spec"].pop("affinity", None)
+            pods.append(p)
+
+    res = ResourceTypes()
+    res.stateful_sets = stss
+    reset_name_counter()
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("t", res, nodes))
+    add_unique_classes(pods)
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    assert batch.u > 128, f"scenario only built {batch.u} classes"
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    assert features.ipa
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features, allow_terms=True)
+    assert plan is not None and plan.terms is not None
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    ref, _ = scan_ops.run_scan(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        features=features,
+    )
+    got, _ = pallas_scan.run_scan_pallas(
+        plan,
+        batch.class_of_pod,
+        np.ones(len(pods), bool),
+        np.ones(cluster.n, bool),
+        pinned=batch.pinned_node,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

@@ -122,7 +122,7 @@ class TermsPlan(NamedTuple):
     cand_dist: np.ndarray  # (Cd, R, C) distinct hard candidate masks
     sq_dist: np.ndarray  # (Sqd, R, C) distinct soft qualifying masks
     hk_dist: np.ndarray  # (Hkd, R, C) distinct has-all-soft-keys masks
-    g_match_au: np.ndarray  # (A, Up) = match_all[group_of_row] (commit)
+    g_match_au: np.ndarray  # (A, Ur_p, 128) match_all[group_of_row] (commit)
     # --- state inits (ANY memory; DMAed into scratch) ----------------
     tgt0_c: np.ndarray  # (Tc, R, C) init counts for count rows
     pref0_p: np.ndarray  # (Tp, R, C) combined preferred init
@@ -670,7 +670,6 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
     # u//128 dynamically and lane u%128 by mask
     u_rows = -(-max(u_n, 1) // LANES)
     u_rows_p = -(-u_rows // SUBLANES) * SUBLANES
-    up = u_rows * LANES
 
     def tab_u(m, dtype=np.int32):
         """(X, U) -> (X, Ur_p, 128) class-column tile."""

@@ -280,26 +280,7 @@ class CapacitySweep:
                     valid,
                     pinned=self.batch.pinned_node,
                 )
-                # same utilization arithmetic as _scenario, on the host
-                v = valid[: self.n]
-                alloc_c = np.asarray(self.cluster_enc.alloc_mcpu)
-                alloc_m = np.asarray(self.cluster_enc.alloc_mem)
-                denom_c = max(int(alloc_c[v].sum()), 1)
-                denom_m = max(int(alloc_m[v].sum()), 1)
-                cpu_util = 100.0 * float(final["used_mcpu"][v].sum()) / denom_c
-                mem_util = 100.0 * float(final["used_mem"][v].sum()) / denom_m
-                vg_cap = np.asarray(self.cluster_enc.vg_cap)
-                vg_used = np.asarray(self.dyn.vg_used)
-                denom_vg = max(int(vg_cap[v].sum()), 1)
-                vg_util = 100.0 * float(vg_used[v].sum()) / denom_vg
-            return ProbeResult(
-                count=count,
-                unscheduled=int((placements == -1).sum()),
-                cpu_util=cpu_util,
-                mem_util=mem_util,
-                vg_util=vg_util,
-                placements=placements,
-            )
+            return self._pallas_result(count, valid, placements, final)
         if self._probe_jit is None:
             self._probe_jit = jax.jit(self._scenario)
         with phase("sweep/probe"):
@@ -315,6 +296,65 @@ class CapacitySweep:
             vg_util=float(vg),
             placements=placements,
         )
+
+    def _pallas_result(self, count, valid, placements, final) -> ProbeResult:
+        # same utilization arithmetic as _scenario, on the host
+        v = valid[: self.n]
+        alloc_c = np.asarray(self.cluster_enc.alloc_mcpu)
+        alloc_m = np.asarray(self.cluster_enc.alloc_mem)
+        denom_c = max(int(alloc_c[v].sum()), 1)
+        denom_m = max(int(alloc_m[v].sum()), 1)
+        cpu_util = 100.0 * float(final["used_mcpu"][v].sum()) / denom_c
+        mem_util = 100.0 * float(final["used_mem"][v].sum()) / denom_m
+        vg_cap = np.asarray(self.cluster_enc.vg_cap)
+        vg_used = np.asarray(self.dyn.vg_used)
+        denom_vg = max(int(vg_cap[v].sum()), 1)
+        vg_util = 100.0 * float(vg_used[v].sum()) / denom_vg
+        return ProbeResult(
+            count=count,
+            unscheduled=int((placements == -1).sum()),
+            cpu_util=cpu_util,
+            mem_util=mem_util,
+            vg_util=vg_util,
+            placements=placements,
+        )
+
+    def probe_pair(self, c1: int, c2: int):
+        """Two candidate counts with ONE device sync: on the Pallas
+        path both scans dispatch deferred and fetch stacked (the defrag
+        batching pattern) — the relay's per-sync latency is paid once.
+        Falls back to two sequential probes on the XLA path."""
+        if self._pallas_plan is None:
+            return self.probe(c1), self.probe(c2)
+        import jax.numpy as jnp
+
+        from ..ops import pallas_scan
+        from ..utils.trace import phase
+
+        with phase("sweep/probe"):
+            valids, outs = [], []
+            for c in (c1, c2):
+                valid = self.node_valid(c)
+                valids.append(valid)
+                outs.append(
+                    pallas_scan.run_scan_pallas(
+                        self._pallas_plan,
+                        self.batch.class_of_pod,
+                        self.pod_active(valid),
+                        valid,
+                        pinned=self.batch.pinned_node,
+                        defer=True,
+                    )
+                )
+            stacked = np.asarray(jnp.stack(outs))
+        p_total = int(np.asarray(self.batch.class_of_pod).shape[0])
+        results = []
+        for c, valid, out in zip((c1, c2), valids, stacked):
+            placements, final = pallas_scan.decode_scan_output(
+                self._pallas_plan, out, p_total
+            )
+            results.append(self._pallas_result(c, valid, placements, final))
+        return tuple(results)
 
     def probe_many(self, counts: List[int], mesh=None) -> SweepResult:
         """Evaluate many counts batched (vmap; scenario-sharded over a
@@ -468,7 +508,21 @@ class CapacitySweep:
         while True:
             step = max(self.estimate_extra(probe(lo)), 1 << escalations)
             hi = min(lo + step, self.max_count)
-            res = probe(hi)
+            if hi - lo > 1 and hi not in probes and self._pallas_plan is not None:
+                # the estimate usually lands exactly, making hi-1 the
+                # bisection's very next question — dispatch both scans
+                # in one device sync (probe_pair) and seed the cache.
+                # Pallas path only: the XLA fallback would pay two full
+                # sequential scans for a speculative answer
+                r_minus, r_hi = self.probe_pair(hi - 1, hi)
+                for r in (r_minus, r_hi):
+                    if r.count not in probes:
+                        probes[r.count] = r
+                        if on_probe is not None:
+                            on_probe(r)
+                res = r_hi
+            else:
+                res = probe(hi)
             if feasible(res):
                 break
             lo = hi

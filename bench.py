@@ -775,21 +775,31 @@ def main():
             "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / w["elapsed_s"], 3),
         }
     else:  # all: capacity headline + the other BASELINE configs embedded
-        z = run_conformance_fuzz()  # raises on any on-device mismatch
-        c = run_capacity()
+        from open_simulator_tpu.utils.memo import clear_all_memos
+
+        def isolated(fn, *args, **kw):
+            # each scenario starts with empty identity memos, exactly
+            # like its standalone run — the 100k-pod scenarios would
+            # otherwise overflow the caps mid-measurement of the later
+            # ones (wholesale clears inside their timed region)
+            clear_all_memos()
+            return fn(*args, **kw)
+
+        z = isolated(run_conformance_fuzz)  # raises on any mismatch
+        c = isolated(run_capacity)
         nodes, pods = build_scenario()
-        rd = _scan_rate(nodes, pods, "default")
+        rd = isolated(_scan_rate, nodes, pods, "default")
         nodes, pods = build_affinity_scenario()
-        ra = _scan_rate(nodes, pods, "affinity")
+        ra = isolated(_scan_rate, nodes, pods, "affinity")
         nodes, pods = build_affinity_scenario(n_nodes=10_000, replicas=100)
-        ra10 = _scan_rate(nodes, pods, "affinity-10k")
+        ra10 = isolated(_scan_rate, nodes, pods, "affinity-10k")
         nodes, pods = build_scenario(port_frac=0.01, scalar_frac=0.01)
-        rm = _scan_rate(nodes, pods, "mixed")
+        rm = isolated(_scan_rate, nodes, pods, "mixed")
         nodes, pods = build_gpushare_scenario()
-        rg = _scan_rate(nodes, pods, "gpushare")
-        d = run_defrag()
-        w = run_whatif()
-        p = run_priority()
+        rg = isolated(_scan_rate, nodes, pods, "gpushare")
+        d = isolated(run_defrag)
+        w = isolated(run_whatif)
+        p = isolated(run_priority)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "

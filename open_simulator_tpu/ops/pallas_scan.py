@@ -1822,6 +1822,27 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
     return decode_scan_output(plan, out, p_total)
 
 
+def run_scan_pallas_batch(plan: PallasPlan, class_of_pod, scenarios):
+    """Several scan scenarios with ONE device sync: each dispatches
+    deferred, the outputs stack on the device, and one fetch pays the
+    relay's per-sync latency for all of them (defrag depths, paired
+    capacity probes). `scenarios` is a list of (pod_active, node_valid,
+    pinned) triples; returns [(placements, final), ...]. Keeping the
+    dispatch/stack/decode protocol here means the kernel's output
+    row-split contract has exactly one consumer module."""
+    import jax.numpy as jnp
+
+    outs = [
+        run_scan_pallas(
+            plan, class_of_pod, pod_active, node_valid, pinned=pin, defer=True
+        )
+        for pod_active, node_valid, pin in scenarios
+    ]
+    stacked = np.asarray(jnp.stack(outs))
+    p_total = int(np.asarray(class_of_pod).shape[0])
+    return [decode_scan_output(plan, row, p_total) for row in stacked]
+
+
 def decode_scan_output(plan: PallasPlan, out: np.ndarray, p_total: int):
     """Split a fetched kernel output row-block into (placements, final
     used dict) — the tail of run_scan_pallas, exposed for deferred

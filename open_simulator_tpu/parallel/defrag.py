@@ -223,27 +223,15 @@ def plan_defrag(
         else f"xla-scan ({pallas_scan.fallback_reason()})",
     )
     if plan is not None:
-        # dispatch every depth's scan without fetching, stack on the
-        # device, and pay the relay's ~0.1s sync latency ONCE for all
-        # depths instead of once per depth
-        outs = [
-            pallas_scan.run_scan_pallas(
-                plan,
-                batch.class_of_pod,
-                pod_active[s_i],
-                node_valid[s_i],
-                pinned=pinned[s_i],
-                defer=True,
-            )
-            for s_i in range(sc)
-        ]
-        stacked = np.asarray(jnp.stack(outs))
+        # one sync for every depth's scan (run_scan_pallas_batch)
+        decoded = pallas_scan.run_scan_pallas_batch(
+            plan,
+            batch.class_of_pod,
+            [(pod_active[s_i], node_valid[s_i], pinned[s_i]) for s_i in range(sc)],
+        )
         unsched = np.zeros(sc, dtype=np.int64)
         place_by_depth = {}
-        for s_i in range(sc):
-            placements, _ = pallas_scan.decode_scan_output(
-                plan, stacked[s_i], p_cnt
-            )
+        for s_i, (placements, _final) in enumerate(decoded):
             place_by_depth[s_i] = placements
             unsched[s_i] = int((placements == -1).sum())
         return _pick_depth(

@@ -326,35 +326,23 @@ class CapacitySweep:
         Falls back to two sequential probes on the XLA path."""
         if self._pallas_plan is None:
             return self.probe(c1), self.probe(c2)
-        import jax.numpy as jnp
-
         from ..ops import pallas_scan
         from ..utils.trace import phase
 
+        valids = [self.node_valid(c) for c in (c1, c2)]
         with phase("sweep/probe"):
-            valids, outs = [], []
-            for c in (c1, c2):
-                valid = self.node_valid(c)
-                valids.append(valid)
-                outs.append(
-                    pallas_scan.run_scan_pallas(
-                        self._pallas_plan,
-                        self.batch.class_of_pod,
-                        self.pod_active(valid),
-                        valid,
-                        pinned=self.batch.pinned_node,
-                        defer=True,
-                    )
-                )
-            stacked = np.asarray(jnp.stack(outs))
-        p_total = int(np.asarray(self.batch.class_of_pod).shape[0])
-        results = []
-        for c, valid, out in zip((c1, c2), valids, stacked):
-            placements, final = pallas_scan.decode_scan_output(
-                self._pallas_plan, out, p_total
+            decoded = pallas_scan.run_scan_pallas_batch(
+                self._pallas_plan,
+                self.batch.class_of_pod,
+                [
+                    (self.pod_active(v), v, self.batch.pinned_node)
+                    for v in valids
+                ],
             )
-            results.append(self._pallas_result(c, valid, placements, final))
-        return tuple(results)
+        return tuple(
+            self._pallas_result(c, valid, placements, final)
+            for c, valid, (placements, final) in zip((c1, c2), valids, decoded)
+        )
 
     def probe_many(self, counts: List[int], mesh=None) -> SweepResult:
         """Evaluate many counts batched (vmap; scenario-sharded over a

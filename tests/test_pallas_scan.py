@@ -369,3 +369,33 @@ def test_ports_and_scalars_nonzero_init_state():
     assert (got == xla).all()
     # both newcomers must avoid en-0: its port is taken and widgets full
     assert (got == 1).all()
+
+
+def test_probe_pair_matches_sequential_probes(monkeypatch):
+    """probe_pair's deferred-dispatch + stacked-fetch path must decode
+    to exactly what two sequential probes produce (capacity bisection
+    relies on the pair seeding the probe cache)."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.ops import pallas_scan as ps
+    from open_simulator_tpu.parallel.sweep import CapacitySweep
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.testing import make_fake_deployment
+
+    monkeypatch.setattr(ps, "FORCE_ENABLE", True)
+    reset_name_counter()
+    cluster = ResourceTypes()
+    cluster.nodes = _nodes(3, seed=21)
+    res = ResourceTypes()
+    res.deployments = [make_fake_deployment("web", "default", 30, "1", "1Gi")]
+    apps = [AppResource("app", res)]
+    reset_name_counter()
+    sweep = CapacitySweep(cluster, apps, _nodes(1, seed=22)[0], 6)
+    assert sweep._pallas_plan is not None
+    a2, b2 = sweep.probe_pair(2, 4)
+    a1, b1 = sweep.probe(2), sweep.probe(4)
+    for paired, seq in ((a2, a1), (b2, b1)):
+        assert paired.count == seq.count
+        assert paired.unscheduled == seq.unscheduled
+        assert paired.cpu_util == seq.cpu_util
+        assert paired.mem_util == seq.mem_util
+        np.testing.assert_array_equal(paired.placements, seq.placements)

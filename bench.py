@@ -52,10 +52,18 @@ NORTH_STAR_PODS_PER_SEC = 10_000.0
 NORTH_STAR_PLAN_SECONDS = 10.0
 
 
-def _tpu_healthy(timeout: float = 150.0) -> bool:
+def _tpu_healthy(timeout: float = 150.0, attempts: int = 3) -> bool:
+    """The relay flaps on the order of minutes: retry the probe a few
+    times before surrendering to the CPU fallback, so a transient wedge
+    at bench start doesn't turn the recorded run into a CPU number."""
     from open_simulator_tpu.utils.backend import probe_backend
 
-    return probe_backend(timeout)
+    for i in range(attempts):
+        if probe_backend(timeout):
+            return True
+        if i < attempts - 1:
+            time.sleep(60)
+    return False
 
 
 def _make_node(name: str, cpu: int, mem_gi: int, labels=None, taints=None) -> dict:
@@ -450,6 +458,96 @@ def run_conformance_fuzz(n_nodes=1000, n_pods=2000, seed=0) -> dict:
             f"pallas/xla conformance fuzz FAILED: {mism} of {len(pods)} "
             f"placements differ (first at pods {idx.tolist()}: "
             f"kernel={place_k[idx].tolist()} xla={place_x[idx].tolist()})"
+        )
+    gpu = _gpu_conformance_fuzz(seed)
+    return {"checked": len(pods) + gpu["checked"], "mismatches": 0}
+
+
+def _gpu_conformance_fuzz(seed=0, n_nodes=500, n_pods=1500) -> dict:
+    """Second fuzz flavor: gpu device packing + affinity terms together
+    on the compiled kernel (no pins — gpu+pins is out of scope)."""
+    import copy
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from open_simulator_tpu.models import workloads as wl
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.ops import pallas_scan
+    from open_simulator_tpu.ops import scan as scan_ops
+    from open_simulator_tpu.ops.encode import (
+        encode_batch,
+        encode_cluster,
+        encode_dynamic,
+        features_of_batch,
+        to_scan_static,
+        to_scan_state,
+    )
+    from open_simulator_tpu.scheduler.core import _sort_app_pods
+    from open_simulator_tpu.scheduler.oracle import Oracle
+    from open_simulator_tpu.testing import build_affinity_stress
+
+    rng = np.random.RandomState(seed + 1)
+    nodes, stss = build_affinity_stress(
+        n_nodes=n_nodes, n_sts=10, replicas=max(n_pods // 10, 1), zones=8
+    )
+    gi_units = "32"
+    for node in nodes:
+        for section in ("allocatable", "capacity"):
+            node["status"].setdefault(section, {}).update(
+                {
+                    "alibabacloud.com/gpu-count": "4",
+                    "alibabacloud.com/gpu-mem": gi_units,
+                }
+            )
+    res = ResourceTypes()
+    res.stateful_sets = stss
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("gfuzz", res, nodes))
+    for i, pod in enumerate(pods[:n_pods]):
+        if rng.randint(0, 5) != 0:
+            continue
+        pod["metadata"] = copy.deepcopy(pod["metadata"])
+        mem = int(rng.choice([2, 4, 8, 17]))
+        cnt = int(rng.choice([1, 1, 1, 2]))
+        pod["metadata"].setdefault("annotations", {}).update(
+            {
+                "alibabacloud.com/gpu-mem": str(mem),
+                "alibabacloud.com/gpu-count": str(cnt),
+            }
+        )
+    pods = pods[:n_pods]
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    assert features.gpu and features.terms
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features)
+    if plan is None:
+        raise AssertionError(
+            "gpu conformance fuzz scenario no longer rides the kernel: "
+            f"{pallas_scan.last_reject() or 'rejected'}"
+        )
+    ones_p = np.ones(len(pods), bool)
+    ones_n = np.ones(cluster.n, bool)
+    place_k, _ = pallas_scan.run_scan_pallas(
+        plan, batch.class_of_pod, ones_p, ones_n, pinned=batch.pinned_node
+    )
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    place_x, _ = scan_ops.run_scan(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        features=features,
+    )
+    place_k = np.where(np.asarray(place_k) < 0, -1, np.asarray(place_k))
+    place_x = np.where(np.asarray(place_x) < 0, -1, np.asarray(place_x))
+    mism = int((place_k != place_x).sum())
+    if mism:
+        raise AssertionError(
+            f"gpu conformance fuzz FAILED: {mism} of {len(pods)} differ"
         )
     return {"checked": len(pods), "mismatches": 0}
 

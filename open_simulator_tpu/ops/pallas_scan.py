@@ -8,10 +8,13 @@ cluster state resident in VMEM as (R, 128) int32 tiles — per-step cost
 collapses to pure VPU arithmetic with zero kernel-launch overhead.
 
 Scope (automatic fallback to the XLA scan otherwise):
-- no GPU-share / open-local / custom-plugin machinery (features gates,
-  same contract as ScanFeatures); nodeName pins
+- no open-local / custom-plugin machinery (features gates, same
+  contract as ScanFeatures); nodeName pins
   (`run_scan_pallas(pinned=...)`), hostPorts (per-(ip,proto,port)
-  vocab bitmask tiles), and extended scalar resources ARE in scope,
+  vocab bitmask tiles), extended scalar resources, and open-gpu-share
+  device packing (per-device (G, R, 128) memory tiles, tightest-fit /
+  two-pointer allocation mirroring scan.py _gpu_allocate; gpu+pins
+  falls back) ARE in scope,
 - inter-pod affinity + hard/soft topology spread ARE in scope: term
   count state rides in VMEM scratch as node-space (T, R, 128) i32
   tiles (ops/scan.py ScanState docstring), per-(class, slot) eval
@@ -235,6 +238,14 @@ class PallasPlan(NamedTuple):
     ports0: Optional[np.ndarray] = None  # (Pw, R, C) init planes (ANY)
     want_w: Optional[np.ndarray] = None  # (U*Pw,) SMEM
     confl_w: Optional[np.ndarray] = None  # (U*Pw,) SMEM
+    # open-gpu-share: g_n devices per node, memory in GCD-scaled int32
+    g_n: int = 0
+    gpu_per_dev: Optional[np.ndarray] = None  # (R, C) VMEM
+    gpu_cnt_n: Optional[np.ndarray] = None  # (R, C) VMEM device counts
+    gpu_tot: Optional[np.ndarray] = None  # (R, C) VMEM capacity gpu-mem
+    igpu0: Optional[np.ndarray] = None  # (G, R, C) init used (ANY)
+    gpu_mem_u: Optional[np.ndarray] = None  # (U,) SMEM per-GPU request
+    gpu_cnt_u: Optional[np.ndarray] = None  # (U,) SMEM device count
 
 
 def _pad_nodes(vec: np.ndarray, r: int, fill=0) -> np.ndarray:
@@ -765,10 +776,14 @@ def build_plan(cluster, batch, dyn, features, weights=None,
     """Build a kernel plan from the (numpy) ClusterStatic + PodBatch +
     DynamicState, or None when the batch is outside the fast path's
     scope."""
-    if features.gpu or features.storage or features.custom:
+    if features.storage or features.custom:
         return _reject(
-            "gpu/storage/custom-plugin machinery (XLA scan carries it)"
+            "storage/custom-plugin machinery (XLA scan carries it)"
         )
+    if features.gpu and features.pins:
+        # forced gpu commits would need device allocation outside the
+        # feasibility gate; rare combination, XLA scan carries it
+        return _reject("gpu batch with nodeName pins")
     if allow_terms is None:
         allow_terms = TERMS_DEFAULT_ENABLE
     if not allow_terms and (
@@ -887,6 +902,40 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         iscal0 = _pad_stack(used_s0, r)
         req_scal_t = req_s.astype(np.int32).reshape(-1)  # (U*S,) row-major
 
+    # open-gpu-share: per-device memory state (G tiles), tightest-fit /
+    # two-pointer allocation mirrored from ops/scan.py _gpu_allocate
+    g_n = 0
+    gpu_per_dev_s = gpu_cnt_nodes = gpu_tot_s = igpu0 = None
+    gpu_mem_u = gpu_cnt_u = None
+    if features.gpu:
+        gused0_raw = a(dyn.gpu_used, dtype=np.int64)
+        # encode pads the device axis to >= 1 even for gpu-free nodes;
+        # per_dev = 0 there makes every device unfit, which is correct
+        g_n = int(gused0_raw.shape[1])
+        if g_n > 8:
+            return _reject(f"{g_n} GPU devices per node > 8-device scope")
+        gper = a(cluster.gpu_per_dev, dtype=np.int64)
+        gcnt = a(cluster.gpu_count, dtype=np.int64)
+        gtot = a(cluster.gpu_total, dtype=np.int64)
+        bmem = a(batch.gpu_mem, dtype=np.int64)
+        s_gpu = _gcd_scale(gper, bmem, gused0_raw)
+        gper_s = gper // s_gpu
+        gtot_f = gtot // s_gpu  # exact for >= vs scaled bmem (bmem % s == 0)
+        bmem_s = bmem // s_gpu
+        gused0_s = gused0_raw // s_gpu
+        if (
+            gper_s.max(initial=0) > _MAX_SCALED
+            or gtot_f.max(initial=0) > _MAX_SCALED
+            or bmem_s.max(initial=0) > _MAX_SCALED
+        ):
+            return _reject("gpu-memory magnitudes exceed int32 exactness")
+        gpu_per_dev_s = _pad_nodes(gper_s, r)
+        gpu_cnt_nodes = _pad_nodes(gcnt, r)
+        gpu_tot_s = _pad_nodes(gtot_f, r)
+        igpu0 = _pad_stack(np.ascontiguousarray(gused0_s.T), r)
+        gpu_mem_u = bmem_s.astype(np.int32)
+        gpu_cnt_u = a(batch.gpu_cnt, dtype=np.int64).astype(np.int32)
+
     # hostPorts: occupancy bitplanes over the port vocab
     pw = 0
     ports0 = want_w = confl_w = None
@@ -984,6 +1033,13 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         ports0=ports0,
         want_w=want_w,
         confl_w=confl_w,
+        g_n=g_n,
+        gpu_per_dev=gpu_per_dev_s,
+        gpu_cnt_n=gpu_cnt_nodes,
+        gpu_tot=gpu_tot_s,
+        igpu0=igpu0,
+        gpu_mem_u=gpu_mem_u,
+        gpu_cnt_u=gpu_cnt_u,
     )
 
     # VMEM budget (~16MB/core): count the PERSISTENT (R, C) tiles
@@ -998,6 +1054,7 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         + plan.base_score.shape[0]
         + (plan.nodeaff_raw.shape[0] if plan.has_nodeaff else 0)
         + (plan.taint_intol.shape[0] if plan.has_taint else 0)
+        + (3 + plan.g_n if plan.g_n else 0)  # gpu statics + used scratch
         + 2 * s_n  # scalar alloc + used scratch
         + pw  # port occupancy planes
     )
@@ -1048,8 +1105,8 @@ _TERM_FIELDS = (
 
 
 def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
-                 has_taint: bool, has_pins: bool, s_n: int, pw: int,
-                 tc: Optional[TermsCfg]):
+                 has_taint: bool, has_pins: bool, s_n: int, g_n: int,
+                 pw: int, tc: Optional[TermsCfg]):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -1061,7 +1118,7 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
     # (a [U, R, C] tile each — meaningful VMEM at U=100).
     BASE_IN = (
         18 + int(has_nodeaff) + int(has_taint)
-        + (3 if s_n else 0) + (3 if pw else 0)
+        + (3 if s_n else 0) + (6 if g_n else 0) + (3 if pw else 0)
     )
     TERM_IN = len(_TERM_FIELDS) if tc is not None else 0
     N_OUT = 7
@@ -1101,6 +1158,13 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             scal_alloc_ref = next(it)  # (S, R, C) VMEM
             iscal0_ref = next(it)  # (S, R, C) ANY, DMAed to scratch
             reqscal_ref = next(it)  # (U*S,) SMEM
+        if g_n:
+            gperdev_ref = next(it)  # (R, C) VMEM per-device memory
+            gcntn_ref = next(it)  # (R, C) VMEM device counts
+            gtot_ref = next(it)  # (R, C) VMEM capacity gpu-mem
+            igpu0_ref = next(it)  # (G, R, C) ANY, DMAed to scratch
+            gmem_ref = next(it)  # (U,) SMEM per-GPU request
+            gcnt_ref = next(it)  # (U,) SMEM device count
         if pw:
             ports0_ref = next(it)  # (Pw, R, C) ANY, DMAed to scratch
             wantw_ref = next(it)  # (U*Pw,) SMEM
@@ -1127,6 +1191,9 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         if s_n:
             uscal_s = extra[ei]
             ei += 1
+        if g_n:
+            ugpu_s = extra[ei]
+            ei += 1
         if pw:
             ports_pl = extra[ei]
             ei += 1
@@ -1134,7 +1201,7 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             (tgt_s, pref_s, panti_s, antib_s, tposb_s, group_s, gtot_s,
              soft_s) = extra[ei : ei + 8]
             ei += 8
-        if s_n or pw or tc is not None:
+        if s_n or g_n or pw or tc is not None:
             dma_sem = extra[ei]
 
         shape = valid_ref.shape
@@ -1158,7 +1225,7 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         st_nzc_ref[:] = inzc_ref[:]
         st_nzm_ref[:] = inzm_ref[:]
         st_p_ref[:] = ipc_ref[:]
-        if s_n or pw or tc is not None:
+        if s_n or g_n or pw or tc is not None:
             # init states arrive in ANY (HBM) so they do not double the
             # VMEM footprint of their scratch copies; one DMA each
             from jax.experimental.pallas import tpu as pltpu_mod
@@ -1166,6 +1233,8 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             copies = []
             if s_n:
                 copies.append((iscal0_ref, uscal_s))
+            if g_n:
+                copies.append((igpu0_ref, ugpu_s))
             if pw:
                 copies.append((ports0_ref, ports_pl))
             if tc is not None:
@@ -1226,12 +1295,57 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 for s in range(s_n):
                     rq = reqscal_ref[u * s_n + s]
                     fit = fit & (uscal_s[s] + rq <= scal_alloc_ref[s])
+            if g_n:
+                # open-gpu-share filter + allocation choice, mirroring
+                # ops/scan.py _gpu_allocate exactly: tightest fit
+                # (strict '<', first device on ties) for one GPU,
+                # two-pointer greedy prefix in device order for several
+                gm = gmem_ref[u]
+                gc = gcnt_ref[u]
+                gm1 = jnp.maximum(gm, 1)
+                perdev = gperdev_ref[:]
+                cntn = gcntn_ref[:]
+                gpu_fits_any = jnp.zeros(shape, bool)
+                gpu_best_key = jnp.full(shape, BIG, jnp.int32)
+                gpu_best_dev = jnp.full(shape, -1, jnp.int32)
+                gpu_caps = []
+                gpu_prefix = []
+                run_prefix = jnp.zeros(shape, jnp.int32)
+                for g in range(g_n):
+                    dvalid = cntn > g
+                    availg = perdev - ugpu_s[g]
+                    fitg = dvalid & (availg >= gm)
+                    gpu_fits_any = gpu_fits_any | fitg
+                    keyg = jnp.where(fitg, availg, BIG)
+                    better = keyg < gpu_best_key
+                    gpu_best_key = jnp.where(better, keyg, gpu_best_key)
+                    gpu_best_dev = jnp.where(better, g, gpu_best_dev)
+                    capg = jnp.maximum(
+                        jnp.where(dvalid, availg // gm1, 0), 0
+                    )
+                    gpu_caps.append(capg)
+                    gpu_prefix.append(run_prefix)
+                    run_prefix = run_prefix + capg
+                needs_gpu = gm > 0
+                # select over i32 (Mosaic cannot legalize i1-vector
+                # select), same pattern as the pin override
+                gpu_found = (
+                    jnp.where(
+                        gc == 1,
+                        gpu_fits_any.astype(jnp.int32),
+                        (run_prefix >= gc).astype(jnp.int32),
+                    )
+                    != 0
+                )
+                gpu_ok = ~needs_gpu | ((gtot_ref[:] >= gm) & gpu_found)
             feas = (
                 (feas_ref[fu] != 0)
                 & valid
                 & (pod_cnt + 1 <= alloc_p)
                 & (fit | (has_req == 0))
             )
+            if g_n:
+                feas = feas & gpu_ok
             if pw:
                 # NodePorts: conflict when any occupied port matches the
                 # class's conflict mask (HostPortInfo.CheckConflict)
@@ -1487,6 +1601,17 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             if s_n:
                 for s in range(s_n):
                     uscal_s[s] = uscal_s[s] + reqscal_ref[u * s_n + s] * sel_i
+            if g_n:
+                # charge the chosen devices at the placed node only
+                # (scan.py commit: gpu_used += onehot * take * gpu_mem[u])
+                for g in range(g_n):
+                    single_take = (
+                        (gpu_best_dev == g) & gpu_fits_any
+                    ).astype(jnp.int32)
+                    multi_take = jnp.clip(gc - gpu_prefix[g], 0, gpu_caps[g])
+                    take_g = jnp.where(gc == 1, single_take, multi_take)
+                    charge = jnp.where(needs_gpu, take_g * gm, 0)
+                    ugpu_s[g] = ugpu_s[g] + jnp.where(sel, charge, 0)
             if pw:
                 for w_i in range(pw):
                     ports_pl[w_i] = ports_pl[w_i] | (
@@ -1630,6 +1755,11 @@ def _device_args(plan: PallasPlan) -> list:
     ]
     if plan.s_n:
         args += [plan.alloc_scal, plan.iscal0, plan.req_scal]
+    if plan.g_n:
+        args += [
+            plan.gpu_per_dev, plan.gpu_cnt_n, plan.gpu_tot,
+            plan.igpu0, plan.gpu_mem_u, plan.gpu_cnt_u,
+        ]
     if plan.pw:
         args += [plan.ports0, plan.want_w, plan.confl_w]
     if plan.terms is not None:
@@ -1682,16 +1812,17 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
         interpret = jax.default_backend() != "tpu"
     tc = plan.terms.cfg if plan.terms is not None else None
     key = (p_total, plan.r, plan.u, plan.w, plan.has_nodeaff, plan.has_taint,
-           plan.has_pins, plan.s_n, plan.pw, tc, interpret)
+           plan.has_pins, plan.s_n, plan.g_n, plan.pw, tc, interpret)
     cached = _COMPILED_CACHE.get(key)
     if cached is None:
         kernel = _make_kernel(p_total, plan.u, plan.w, plan.has_nodeaff,
                               plan.has_taint, plan.has_pins, plan.s_n,
-                              plan.pw, tc)
+                              plan.g_n, plan.pw, tc)
         rc = (plan.r, LANES)
         base_n = (
             18 + int(plan.has_nodeaff) + int(plan.has_taint)
-            + (3 if plan.s_n else 0) + (3 if plan.pw else 0)
+            + (3 if plan.s_n else 0) + (6 if plan.g_n else 0)
+            + (3 if plan.pw else 0)
         )
         n_in = base_n + (len(_TERM_FIELDS) if tc is not None else 0)
         # memory spaces: clsmap (base idx 3) in SMEM; the scalar/port
@@ -1704,6 +1835,10 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
             any_idx.add(off + 1)  # iscal0
             smem_idx.add(off + 2)  # req_scal
             off += 3
+        if plan.g_n:
+            any_idx.add(off + 3)  # igpu0
+            smem_idx.update((off + 4, off + 5))  # gpu_mem_u / gpu_cnt_u
+            off += 6
         if plan.pw:
             any_idx.add(off)  # ports0
             smem_idx.update((off + 1, off + 2))  # want/conflict words
@@ -1716,12 +1851,14 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
                     smem_idx.add(base_n + toff)
 
         scratch = []
-        if plan.s_n or plan.pw or tc is not None:
+        if plan.s_n or plan.g_n or plan.pw or tc is not None:
             from jax.experimental.pallas import tpu as _pltpu
 
             rl = (plan.r, LANES)
             if plan.s_n:
                 scratch.append(_pltpu.VMEM((plan.s_n,) + rl, jnp.int32))
+            if plan.g_n:
+                scratch.append(_pltpu.VMEM((plan.g_n,) + rl, jnp.int32))
             if plan.pw:
                 scratch.append(_pltpu.VMEM((plan.pw,) + rl, jnp.int32))
             if tc is not None:

@@ -142,7 +142,8 @@ def test_final_state_matches_placements():
 
 
 def test_build_plan_rejects_out_of_scope():
-    """A GPU pod batch must fall back to the XLA path."""
+    """Open-local storage stays outside the kernel; a gpu batch with no
+    gpu capacity anywhere also falls back (the scan handles both)."""
     reset_name_counter()
     nodes = [make_fake_node("g-0", "8", "32Gi")]
     oracle = Oracle(nodes)
@@ -151,8 +152,11 @@ def test_build_plan_rejects_out_of_scope():
     batch = encode_batch(oracle, cluster, pods)
     dyn = encode_dynamic(oracle, cluster)
     features = features_of_batch(cluster, batch)
-    plan = pallas_scan.build_plan(cluster, batch, dyn, features._replace(gpu=True))
+    plan = pallas_scan.build_plan(
+        cluster, batch, dyn, features._replace(storage=True)
+    )
     assert plan is None
+    assert "storage" in (pallas_scan.last_reject() or "")
 
 
 def test_engine_and_sweep_integration_forced(monkeypatch):
@@ -399,3 +403,92 @@ def test_probe_pair_matches_sequential_probes(monkeypatch):
         assert paired.cpu_util == seq.cpu_util
         assert paired.mem_util == seq.mem_util
         np.testing.assert_array_equal(paired.placements, seq.placements)
+
+
+def test_gpu_share_kernel_conformance():
+    """Open-gpu-share rides the fused kernel: tightest-fit single-GPU,
+    two-pointer multi-GPU, device-state evolution, and pre-bound pods
+    charging devices through init state — placements must equal the
+    XLA scan's (which is conformance-tested against the oracle)."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.testing import with_node_gpu
+
+    reset_name_counter()
+    nodes = [
+        make_fake_node(f"g{i}", "64", "256Gi", with_node_gpu(2 + i % 3, "32"))
+        for i in range(8)
+    ]
+    oracle = Oracle(nodes)
+    # one running pod already holding 16 units of g0 device 0
+    bound = make_fake_pod("existing", "d", "1", "1Gi")
+    bound["spec"]["nodeName"] = "g0"
+    bound["metadata"]["annotations"] = {
+        "alibabacloud.com/gpu-mem": "16",
+        "alibabacloud.com/gpu-count": "1",
+        "alibabacloud.com/gpu-index": "0",
+    }
+    oracle.place_existing_pod(bound)
+    shapes = [(4, 1), (8, 1), (16, 1), (8, 2), (32, 1), (16, 2), (4, 3), (17, 1)]
+    pods = []
+    for i, (mem, cnt) in enumerate(shapes * 4):
+        p = make_fake_pod(f"p{i:02d}", "d", "1", "1Gi")
+        p["metadata"]["annotations"] = {
+            "alibabacloud.com/gpu-mem": str(mem),
+            "alibabacloud.com/gpu-count": str(cnt),
+        }
+        pods.append(p)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    assert features.gpu
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features)
+    assert plan is not None, pallas_scan.last_reject()
+    assert plan.g_n == 4  # max device count across nodes
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    ref, _ = scan_ops.run_scan(
+        static,
+        init,
+        jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node),
+        features=features,
+    )
+    got, _ = pallas_scan.run_scan_pallas(
+        plan,
+        batch.class_of_pod,
+        np.ones(len(pods), bool),
+        np.ones(cluster.n, bool),
+        pinned=batch.pinned_node,
+        interpret=True,
+    )
+    ref = np.asarray(ref)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+    # the scenario really exercised device packing: failures + spreads
+    assert (ref == -1).any() and len(set(ref[ref >= 0])) > 3
+
+
+def test_gpu_with_pins_falls_back():
+    """A pinned pod in a gpu batch must reject the kernel: the pin
+    override bypasses the feasibility gate, so device state would never
+    be checked or charged for it (the XLA scan handles the combo)."""
+    from open_simulator_tpu.testing import with_node_gpu
+
+    reset_name_counter()
+    nodes = [make_fake_node("g0", "8", "32Gi", with_node_gpu(2, "32"))]
+    gpod = make_fake_pod("gp", "d", "1", "1Gi")
+    gpod["metadata"]["annotations"] = {
+        "alibabacloud.com/gpu-mem": "8",
+        "alibabacloud.com/gpu-count": "1",
+    }
+    pinned = make_fake_pod("pin", "d", "1", "1Gi")
+    pinned["spec"]["nodeName"] = "g0"
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, [gpod, pinned])
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    assert features.gpu and features.pins
+    assert pallas_scan.build_plan(cluster, batch, dyn, features) is None
+    assert "pins" in (pallas_scan.last_reject() or "")

@@ -58,23 +58,29 @@ def test_engine_records_phases():
 
 
 def test_kernel_fallback_names_reason():
-    # a GPU batch is outside the fused kernel's scope: the trace must
-    # say so instead of silently noting a fallback
+    # an open-local batch is outside the fused kernel's scope: the
+    # trace must say so instead of silently noting a fallback
     from open_simulator_tpu.models.decode import ResourceTypes
     from open_simulator_tpu.scheduler.core import AppResource, simulate
-    from open_simulator_tpu.testing import make_fake_node, make_fake_pod, with_node_gpu
+    from open_simulator_tpu.testing import make_fake_node, make_fake_pod
     from open_simulator_tpu.utils.trace import GLOBAL
 
+    node = make_fake_node("s0", "8", "16Gi")
+    node["metadata"].setdefault("annotations", {})[
+        "simon/node-local-storage"
+    ] = (
+        '{"vgs": [{"name": "open-local-pool-0", "capacity": 107374182400}],'
+        ' "devices": []}'
+    )
     cluster = ResourceTypes()
-    cluster.nodes = [make_fake_node("g0", "8", "16Gi", with_node_gpu(2, "32"))]
+    cluster.nodes = [node]
     pod = make_fake_pod("p", "default", "1", "1Gi")
     pod["metadata"]["annotations"] = {
-        "alibabacloud.com/gpu-mem": "8",
-        "alibabacloud.com/gpu-count": "1",
+        "simon/pod-local-storage": '{"volumes": [{"kind": "LVM", "size": 1073741824}]}'
     }
     GLOBAL.reset()
     res = simulate(cluster, [AppResource("a", ResourceTypes(pods=[pod]))], engine="tpu")
     assert not res.unscheduled_pods
     note = GLOBAL.notes.get("batch-kernel", "")
     assert note.startswith("xla-scan (")
-    assert "gpu" in note or "no TPU" in note
+    assert "storage" in note or "no TPU" in note

@@ -485,21 +485,14 @@ def _gpu_conformance_fuzz(seed=0, n_nodes=500, n_pods=1500) -> dict:
     )
     from open_simulator_tpu.scheduler.core import _sort_app_pods
     from open_simulator_tpu.scheduler.oracle import Oracle
-    from open_simulator_tpu.testing import build_affinity_stress
+    from open_simulator_tpu.testing import build_affinity_stress, with_node_gpu
 
     rng = np.random.RandomState(seed + 1)
     nodes, stss = build_affinity_stress(
         n_nodes=n_nodes, n_sts=10, replicas=max(n_pods // 10, 1), zones=8
     )
-    gi_units = "32"
     for node in nodes:
-        for section in ("allocatable", "capacity"):
-            node["status"].setdefault(section, {}).update(
-                {
-                    "alibabacloud.com/gpu-count": "4",
-                    "alibabacloud.com/gpu-mem": gi_units,
-                }
-            )
+        with_node_gpu(4, "32")(node)
     res = ResourceTypes()
     res.stateful_sets = stss
     pods = _sort_app_pods(wl.generate_valid_pods_from_app("gfuzz", res, nodes))

@@ -943,8 +943,8 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         want_p = a(batch.want_ports).astype(bool)
         confl_p = a(batch.conflict_ports).astype(bool)
         pt = want_p.shape[1]
-        if pt > 4 * 32:
-            return _reject(f"{pt} distinct host ports > 128-port scope")
+        if pt > 8 * 32:
+            return _reject(f"{pt} distinct host ports > 256-port scope")
         pw = max(-(-pt // 32), 1)
         ports0 = _pad_stack(_pack_bitplanes(a(dyn.ports_used).astype(bool).T), r)
 

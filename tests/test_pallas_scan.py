@@ -492,3 +492,29 @@ def test_gpu_with_pins_falls_back():
     assert features.gpu and features.pins
     assert pallas_scan.build_plan(cluster, batch, dyn, features) is None
     assert "pins" in (pallas_scan.last_reject() or "")
+
+
+def test_port_vocab_beyond_128():
+    """Port-conflict bitplanes span multiple 32-bit words; a vocab past
+    the old 128-port cap (155 distinct ports -> 5 words) must still
+    match the XLA scan, including real conflict rejections: five ports
+    are requested by 10 pods each on a 4-node cluster, so 6 pods per
+    hot port MUST fail."""
+    reset_name_counter()
+    nodes = [make_fake_node(f"n{i}", "64", "64Gi") for i in range(4)]
+    pods = []
+    for i in range(200):
+        p = make_fake_pod(f"p{i:03d}", "d", "100m", "64Mi")
+        if i < 150:
+            port = 7000 + i  # 150 distinct cold ports
+        else:
+            port = 7200 + (i % 5)  # 5 hot ports x 10 pods each
+        p["spec"]["containers"][0]["ports"] = [
+            {"containerPort": port, "hostPort": port, "protocol": "TCP"}
+        ]
+        pods.append(p)
+    xla, pal, _ = _run_both(nodes, pods)
+    np.testing.assert_array_equal(xla, pal)
+    # 4 nodes per hot port place, the other 6 of each 10 fail
+    assert (pal == -1).sum() == 5 * 6
+    assert (pal[:150] >= 0).all()

@@ -9,7 +9,11 @@ collapses to pure VPU arithmetic with zero kernel-launch overhead.
 
 Scope (automatic fallback to the XLA scan otherwise):
 - no open-local / custom-plugin machinery (features gates, same
-  contract as ScanFeatures); nodeName pins
+  contract as ScanFeatures). Open-local stays out deliberately: its
+  ScoreLVM/ScoreDevice fractions are f64 under the engine's global
+  x64 (sizes are byte counts), and matching them bit-exactly in a
+  f32 kernel would need double-single division emulation — the XLA
+  scan carries those batches instead. nodeName pins
   (`run_scan_pallas(pinned=...)`), hostPorts (per-(ip,proto,port)
   vocab bitmask tiles), extended scalar resources, and open-gpu-share
   device packing (per-device (G, R, 128) memory tiles, tightest-fit /

@@ -518,3 +518,35 @@ def test_port_vocab_beyond_128():
     # 4 nodes per hot port place, the other 6 of each 10 fail
     assert (pal == -1).sum() == 5 * 6
     assert (pal[:150] >= 0).all()
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33])
+def test_gpu_share_kernel_randomized(seed):
+    """Randomized gpu mixes (device counts 1-4, memory 2-32, counts
+    1-3, plus non-gpu pods) against the XLA scan in interpret mode."""
+    from open_simulator_tpu.testing import with_node_gpu
+
+    rng = np.random.RandomState(seed)
+    reset_name_counter()
+    nodes = []
+    for i in range(int(rng.randint(4, 9))):
+        if rng.rand() < 0.7:
+            nodes.append(
+                make_fake_node(
+                    f"g{i}", "64", "256Gi",
+                    with_node_gpu(int(rng.randint(1, 5)), "32"),
+                )
+            )
+        else:
+            nodes.append(make_fake_node(f"c{i}", "64", "256Gi"))
+    pods = []
+    for i in range(int(rng.randint(30, 60))):
+        p = make_fake_pod(f"p{i:02d}", "d", "500m", "512Mi")
+        if rng.rand() < 0.6:
+            p["metadata"]["annotations"] = {
+                "alibabacloud.com/gpu-mem": str(int(rng.choice([2, 4, 8, 16, 32]))),
+                "alibabacloud.com/gpu-count": str(int(rng.choice([1, 1, 2, 3]))),
+            }
+        pods.append(p)
+    xla, pal, _ = _run_both(nodes, pods)
+    np.testing.assert_array_equal(xla, pal)

@@ -231,21 +231,51 @@ class Simulator:
         a serial cycle costs ~0.5 s at 10k nodes, the scan ~0.1 s for
         the whole prefix. Any failure discards the attempt and replays
         the prefix serially with full preemption."""
+        from .preemption import pod_uses_priority
         from ..utils.trace import GLOBAL
 
         start, end = split
         head = pods[:start]
+        mid, tail = pods[start:end], list(pods[end:])
         failed: List[UnscheduledPod] = []
         deferred: List[dict] = []
+
+        # fused fast path: when the head carries no NEGATIVE priority
+        # (so its commits cannot arm later preemption) and nothing
+        # negative is committed, head+mid ride ONE scan — aborting only
+        # if a PRIORITY pod fails to place (the one event that would
+        # have preempted serially). A zero-priority failure commits
+        # normally: with min committed priority >= 0 the serial cycle
+        # would just record the failure too.
+        fused_aborted = False
+        if (
+            head
+            and self.oracle._min_prio >= 0
+            and all(self.oracle.pod_priority(p) >= 0 for p in head)
+        ):
+            resolver = self.oracle._prio_resolver
+            fused = self._scan_and_commit(
+                head + mid,
+                all_or_nothing=True,
+                abort_if=lambda p: pod_uses_priority(p, resolver),
+            )
+            if fused is not None:
+                GLOBAL.note("engine", "hybrid")
+                GLOBAL.note("hybrid-head", "scan-fused")
+                f2, _ = self._schedule_pods_oracle(tail)
+                return fused + f2
+            # the abort means a priority pod failed; a head-only scan
+            # from the same state would fail the same pod (sequential
+            # prefix identity), so go straight to the serial replay
+            fused_aborted = True
         if head:
-            if self._try_scan_segment(head):
+            if not fused_aborted and self._try_scan_segment(head):
                 GLOBAL.note("hybrid-head", "scan")
             else:
                 GLOBAL.note("hybrid-head", "serial")
                 failed, deferred = self._schedule_pods_oracle(
                     head, defer_victims=True
                 )
-        mid, tail = pods[start:end], list(pods[end:])
         # a zero-priority pod can preempt only a committed pod with
         # negative priority (PostFilter gate: prio > min committed);
         # if one exists the run must stay serial for exactness
@@ -313,11 +343,18 @@ class Simulator:
         placements inside the scan)."""
         return self._scan_and_commit(pods)
 
-    def _scan_and_commit(self, pods: List[dict], all_or_nothing: bool = False):
+    def _scan_and_commit(
+        self,
+        pods: List[dict],
+        all_or_nothing: bool = False,
+        abort_if=None,
+    ):
         """Scan a batch and replay the placements onto the oracle.
         Returns the failed pods, or None — nothing committed — when
-        `all_or_nothing` is set and any schedulable pod failed (the
-        optimistic hybrid-head contract, _try_scan_segment)."""
+        `all_or_nothing` is set and a schedulable pod failed (the
+        optimistic hybrid contract). `abort_if(pod)` narrows which
+        failures abort: the fused head+mid path aborts only on a
+        priority pod's failure (the one that would have preempted)."""
         from .engine import TpuEngine
 
         # pods pinned to unknown nodes never reach the scheduler
@@ -335,7 +372,9 @@ class Simulator:
                 self._engine = TpuEngine(self.oracle)
             placements = self._engine.schedule(batch)
             if all_or_nothing and any(
-                int(idx) < 0 and not (p.get("spec") or {}).get("nodeName")
+                int(idx) < 0
+                and not (p.get("spec") or {}).get("nodeName")
+                and (abort_if is None or abort_if(p))
                 for p, idx in zip(batch, placements)
             ):
                 return None

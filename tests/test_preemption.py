@@ -510,7 +510,8 @@ def test_hybrid_head_rides_scan_when_no_preemption_needed(monkeypatch):
     GLOBAL.reset()
     tpu = simulate(cluster, apps, engine="tpu")
     assert GLOBAL.notes.get("engine") == "hybrid"
-    assert GLOBAL.notes.get("hybrid-head") == "scan"
+    # head and bulk fit together -> ONE fused scan for both
+    assert GLOBAL.notes.get("hybrid-head") == "scan-fused"
     assert not tpu.unscheduled_pods and not tpu.preemptions
     assert _placement(serial) == _placement(tpu)
 

@@ -37,9 +37,7 @@ from open_simulator_tpu.scheduler.oracle import Oracle
 from open_simulator_tpu.models.workloads import reset_name_counter
 from open_simulator_tpu.testing import build_affinity_stress, with_node_gpu
 
-from open_simulator_tpu.ops import pallas_scan as _ps
-
-if not _ps.should_use():
+if not pallas_scan.should_use():
     # without this guard run_scan_pallas silently interprets on CPU and
     # this tool would report hardware conformance it never ran
     print("ERROR: no TPU backend — this sweep validates the COMPILED kernel")

@@ -561,3 +561,31 @@ def test_hybrid_randomized_conformance(monkeypatch):
             )
 
         assert summary(serial) == summary(tpu), f"seed {seed}"
+
+
+def test_hybrid_head_scan_unfused_with_negative_priority(monkeypatch):
+    # a negative-priority pod in the head blocks the fused path (its
+    # commit arms future preemption) but the head-only optimistic scan
+    # still applies; the mid segment then goes serial (min_prio < 0)
+    from open_simulator_tpu.scheduler import core as core_mod
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    nodes = [make_fake_node(f"node-{i}", "4", "16Gi") for i in range(3)]
+    head = [
+        make_fake_pod("pre", "default", "500m", "1Gi", with_priority(100)),
+        make_fake_pod("neg", "default", "500m", "1Gi", with_priority(-5)),
+    ]
+    zeros = [
+        make_fake_pod(f"zero-{i}", "default", "250m", "512Mi", with_priority(0))
+        for i in range(8)
+    ]
+    cluster = _cluster(nodes)
+    apps = [_app("a", head + zeros)]
+    serial = simulate(cluster, apps, engine="oracle")
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 4)
+    GLOBAL.reset()
+    tpu = simulate(cluster, apps, engine="tpu")
+    assert GLOBAL.notes.get("hybrid-head") == "scan"
+    assert GLOBAL.notes.get("engine") == "hybrid-serial"
+    assert not tpu.unscheduled_pods
+    assert _placement(serial) == _placement(tpu)

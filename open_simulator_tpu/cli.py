@@ -57,7 +57,13 @@ def _force_platform():
     # SIMON_BACKEND_PROBE=0 skips it for operators who prefer the
     # faster cold start over the guard.
     platforms = os.environ.get("JAX_PLATFORMS", "")
-    if not platforms or platforms in ("cpu", "tpu"):
+    # JAX_PLATFORMS is a comma list; skip the probe only when every
+    # entry is a builtin (in-process init). A builtin fallback later in
+    # the list does NOT make a leading plugin safe: a wedged plugin
+    # hangs inside backend init rather than erroring (utils/backend.py),
+    # so jax never reaches the fallback
+    entries = [p.strip().lower() for p in platforms.split(",") if p.strip()]
+    if not entries or all(p in ("cpu", "tpu") for p in entries):
         return
     if os.environ.get("SIMON_BACKEND_PROBE") == "0":
         return

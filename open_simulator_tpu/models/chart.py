@@ -1048,12 +1048,16 @@ def _unpack_chart_archive(archive_path: str) -> Optional[str]:
     return root
 
 
-def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[_Subchart]:
+def _collect_charts(
+    name: str, path: str, values: dict, globals_: dict, _loaded=None
+) -> List[_Subchart]:
     """Flatten parent + enabled subcharts with helm value scoping:
     subchart values = deep_merge(subchart defaults, parent.values[name]),
     with `global` propagated down. charts/ entries may be unpacked
-    directories or `helm package` .tgz archives."""
-    meta, own_values = _load_chart_meta(path)
+    directories or `helm package` .tgz archives. `_loaded` carries an
+    already-parsed (meta, values) pair so callers that peeked at
+    Chart.yaml for the dedup key don't parse it twice."""
+    meta, own_values = _loaded if _loaded is not None else _load_chart_meta(path)
     merged = _deep_merge(own_values, values)
     g = _deep_merge(globals_, merged.get("global") or {})
     if g:
@@ -1071,16 +1075,37 @@ def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[
             if os.path.isfile(sub_path) and entry.endswith((".tgz", ".tar.gz")):
                 # packaged dependency: the dependency key is the chart's
                 # metadata name (helm matches deps by name, the archive
-                # filename carries name-version)
+                # filename carries name-version). Cheap pre-check before
+                # extracting: a loaded name followed by "-X.Y.Z" and
+                # nothing else means this archive duplicates an unpacked
+                # sibling. Only the BARE three-part version is skipped:
+                # a digit-leading chart name ("app-2048") fails the
+                # fullmatch, and a prerelease/build tail is ambiguous
+                # (chart "childa" at 1.2.3-1.0.0 vs chart "childa-1.2.3"
+                # at 1.0.0), so those fall through to extraction and the
+                # metadata-name dedup below
+                base = entry[: entry.rindex(".tgz" if entry.endswith(".tgz") else ".tar.gz")]
+                if any(
+                    base.startswith(s + "-")
+                    and re.fullmatch(r"\d+\.\d+\.\d+", base[len(s) + 1 :])
+                    for s in seen_entries
+                ):
+                    continue
                 sub_path = _unpack_chart_archive(sub_path)
                 if sub_path is None:
                     continue
-                sub_meta, _ = _load_chart_meta(sub_path)
-                entry = sub_meta.get("name") or entry
+                sub_loaded = _load_chart_meta(sub_path)
+                entry = sub_loaded[0].get("name") or entry
             elif not os.path.isdir(sub_path) or not os.path.isfile(
                 os.path.join(sub_path, "Chart.yaml")
             ):
                 continue
+            else:
+                # dedup + dependency lookup key on the chart's metadata
+                # name for directories too — a vendored dir may carry a
+                # versioned name that differs from the chart name
+                sub_loaded = _load_chart_meta(sub_path)
+                entry = sub_loaded[0].get("name") or entry
             # a dependency vendored both unpacked and as a .tgz (helm
             # pull --untar next to helm dependency update leftovers)
             # loads once — the sorted walk puts the directory first
@@ -1092,7 +1117,9 @@ def _collect_charts(name: str, path: str, values: dict, globals_: dict) -> List[
                 continue
             sub_name = dep.get("alias") or entry
             sub_values = merged.get(sub_name) or {}
-            charts.extend(_collect_charts(sub_name, sub_path, sub_values, g))
+            charts.extend(
+                _collect_charts(sub_name, sub_path, sub_values, g, _loaded=sub_loaded)
+            )
     return charts
 
 

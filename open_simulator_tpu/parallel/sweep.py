@@ -496,7 +496,12 @@ class CapacitySweep:
         while True:
             step = max(self.estimate_extra(probe(lo)), 1 << escalations)
             hi = min(lo + step, self.max_count)
-            if hi - lo > 1 and hi not in probes and self._pallas_plan is not None:
+            if (
+                hi - lo > 1
+                and hi not in probes
+                and hi - 1 not in probes
+                and self._pallas_plan is not None
+            ):
                 # the estimate usually lands exactly, making hi-1 the
                 # bisection's very next question — dispatch both scans
                 # in one device sync (probe_pair) and seed the cache.

@@ -18,19 +18,22 @@ This is an exact port of that generator's machinery
 - `int63 / int31 / int31n / int63n / intn`: bit-for-bit the rejection
   and modulo semantics of Go's `Rand` methods
 
-One piece cannot be reproduced in this environment: Go bakes a
-607-entry warm-up table (`rngCooked`, the generator state after ~1e13
-burn-in steps) into its source, and no Go toolchain or source tree is
-available here to copy it from. `GoRand` therefore accepts the table
-via the `cooked` argument or the `SIMON_GO_RNG_COOKED` env var (a file
-of 607 integers, one per line, signed or unsigned — exactly the
-literals of Go's rng.go). With the table supplied the stream is
-bit-identical to Go's; without it the generator runs the same
-recurrence XORed with a zero table — deterministic and well-mixed, but
-a different stream. Every *consumer* semantic (which draw happens for
-which tie, rejection retries, modulo bias handling) is exact either
-way, so supplying the table is the only step between this and
-bit-matching the reference binary's placements.
+Go bakes a 607-entry warm-up table into its source (`rngCooked`, the
+generator state after 7.8e12 burn-in steps — gen_cooked.go). No Go
+toolchain or source tree is available in this environment, so the
+table is DERIVED instead (tools/gen_rng_cooked.py): the burn-in is the
+linear recurrence x[n] = x[n-607] + x[n-273] over Z_2^64, jumped in
+seconds by computing t^7.8e12 mod (t^607 - t^334 - 1) with
+square-and-multiply, starting from the original Plan 9 lrand.c seed
+expansion (XOR folds 20/10/0 — Go's Seed later widened them to
+40/20/0, but the baked table predates that). The derived table ships
+as data/go_rng_cooked.txt and is loaded by default; it reproduces
+Go's documented seed-1 stream exactly (Int63 -> 5577006791947779410,
+8674665223082153551, 6129484611666145821; Intn(100) -> 81 87 47 59 81
+18 25 40 56 0; Float64 -> 0.6046602879796196), so `sample` mode
+bit-matches a reference binary's placements out of the box.
+`SIMON_GO_RNG_COOKED` (a file of 607 integers, one per line) still
+overrides the packaged table, and `cooked=` overrides both.
 """
 
 from __future__ import annotations
@@ -58,7 +61,7 @@ def _seedrand(x: int) -> int:
 def _load_cooked_env() -> Optional[List[int]]:
     path = os.environ.get("SIMON_GO_RNG_COOKED")
     if not path:
-        return None
+        return _load_cooked_packaged()
     with open(path) as f:
         vals = [int(tok) for tok in f.read().replace(",", " ").split()]
     if len(vals) != _LEN:
@@ -66,6 +69,37 @@ def _load_cooked_env() -> Optional[List[int]]:
             f"SIMON_GO_RNG_COOKED: expected {_LEN} integers, got {len(vals)}"
         )
     return vals
+
+
+_PACKAGED_COOKED: Optional[List[int]] = None
+
+
+def _load_cooked_packaged() -> Optional[List[int]]:
+    """The derived rngCooked table shipped with the package (see module
+    docstring + tools/gen_rng_cooked.py). Cached after first load."""
+    global _PACKAGED_COOKED
+    if _PACKAGED_COOKED is None:
+        try:
+            from importlib import resources
+
+            text = (
+                resources.files("open_simulator_tpu") / "data/go_rng_cooked.txt"
+            ).read_text()
+            vals = [int(line) for line in text.splitlines() if line.strip()]
+            if len(vals) != _LEN:
+                raise ValueError(f"expected {_LEN} entries, found {len(vals)}")
+        except (OSError, ValueError) as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "packaged go_rng_cooked.txt unusable (%s): sample-mode "
+                "streams will not bit-match a Go reference binary",
+                e,
+            )
+            _PACKAGED_COOKED = []
+        else:
+            _PACKAGED_COOKED = vals
+    return _PACKAGED_COOKED or None
 
 
 class GoRand:

@@ -468,3 +468,58 @@ def test_duplicate_dir_and_archive_subchart_loads_once(tmp_path):
     _package_chart(child_src, os.path.join(parent, "charts"))
     docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
     assert sorted(d["metadata"]["name"] for d in docs) == ["a", "parent"]
+
+
+def test_versioned_dir_and_archive_subchart_loads_once(tmp_path):
+    # dedup keys on chart metadata name, not the directory entry name:
+    # a vendored dir named childa-1.2.3 (chart name childa) next to a
+    # childa .tgz renders once, and a sibling chart whose NAME merely
+    # starts with "childa-" is not swallowed by the archive pre-skip
+    import shutil
+
+    parent = write_chart(
+        tmp_path,
+        "parent",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: parent\n"},
+    )
+    child_src = write_chart(
+        str(tmp_path / "scratch"),
+        "childa",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: a\n"},
+    )
+    shutil.copytree(child_src, os.path.join(parent, "charts", "childa-1.2.3"))
+    _package_chart(child_src, os.path.join(parent, "charts"))
+    sibling = write_chart(
+        str(tmp_path / "scratch2"),
+        "childa-extra",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: extra\n"},
+    )
+    _package_chart(sibling, os.path.join(parent, "charts"))
+    # digit-leading chart name: childa-2048-1.0.0.tgz must NOT be
+    # swallowed by the pre-skip for sibling "childa" (the remainder
+    # "2048-1.0.0" is not a full semver)
+    numeric = write_chart(
+        str(tmp_path / "scratch3"),
+        "childa-2048",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: num\n"},
+    )
+    _package_chart(numeric, os.path.join(parent, "charts"), filename="childa-2048-1.0.0.tgz")
+    # chart whose NAME ends in a full semver: childa-1.2.3-1.0.0.tgz is
+    # ambiguous from the filename alone (childa @ 1.2.3-1.0.0 vs
+    # childa-1.2.3 @ 1.0.0) — must be extracted and kept, not pre-skipped
+    semver_named = write_chart(
+        str(tmp_path / "scratch4"),
+        "childa-1.2.3",
+        {"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: semver\n"},
+    )
+    _package_chart(
+        semver_named, os.path.join(parent, "charts"), filename="childa-1.2.3-1.0.0.tgz"
+    )
+    docs = [yaml.safe_load(m) for m in process_chart("rel", parent)]
+    assert sorted(d["metadata"]["name"] for d in docs) == [
+        "a",
+        "extra",
+        "num",
+        "parent",
+        "semver",
+    ]

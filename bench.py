@@ -16,7 +16,11 @@ Scenarios (SIMON_BENCH env):
 - `gpushare`: per-device GPU-memory fragmentation scoring at 1k 8-GPU
   nodes (simon-gpushare-config.yaml at scale).
 - `priority`: the default batch with a few high-priority pods — the
-  hybrid engine split keeps the bulk on the fused scan.
+  priority-scan engine keeps the bulk on the fused scan.
+- `priority-dense`: 75% of the 20k pods carry non-zero priorities over
+  8 tiers (the round-3 serial cliff, VERDICT r3 weak #2) — the
+  priority-scan engine places it in one optimistic ordered scan per
+  preemption escape.
 - `fuzz`: on-device Pallas-vs-XLA placement conformance over a
   mixed-feature scenario (terms+ports+scalars+pins); `all` runs it
   first and aborts on any mismatch, so every recorded number is backed
@@ -50,6 +54,27 @@ CAP_NODES = 10_000
 CAP_PODS = 100_000
 NORTH_STAR_PODS_PER_SEC = 10_000.0
 NORTH_STAR_PLAN_SECONDS = 10.0
+TIMED_RUNS = 3
+
+
+def _timed(fn, runs=TIMED_RUNS):
+    """Median-of-N timing with recorded spread (VERDICT r3 weak #5:
+    best-of-2 hid relay run-to-run variance — affinity numbers swung
+    38-58k pods/s between rounds with no way to tell regression from
+    flap). Returns (median_s, spread, result) where spread is
+    {"min_s", "max_s", "runs"}; callers quote the MEDIAN."""
+    times, result = [], None
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        result = fn()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    spread = {
+        "min_s": round(times[0], 4),
+        "max_s": round(times[-1], 4),
+        "runs": runs,
+    }
+    return times[len(times) // 2], spread, result
 
 
 def _tpu_healthy(timeout: float = 150.0, attempts: int = 3) -> bool:
@@ -263,11 +288,10 @@ def run_defrag(n_nodes=1000, n_pods=6000) -> dict:
         ns.pods.append(pod)
     snapshot = SimulateResult(unscheduled_pods=[], node_status=statuses)
     plan_defrag(snapshot, max_drain=16)  # warm/compile
-    t0 = time.perf_counter()
-    res = plan_defrag(snapshot, max_drain=16)
-    elapsed = time.perf_counter() - t0
+    elapsed, spread, res = _timed(lambda: plan_defrag(snapshot, max_drain=16))
     return {
         "elapsed_s": elapsed,
+        "spread": spread,
         "drained": res.chosen_depth,
         "moves": len(res.moves),
         "nodes": n_nodes,
@@ -328,15 +352,19 @@ def run_whatif(n_base=500, n_pods=5000) -> dict:
     # other specs reuse the same compiled shapes)
     reset_name_counter()
     probe_plan(cluster, apps, templates[0])
-    t0 = time.perf_counter()
-    counts = []
-    for tpl in templates:
-        reset_name_counter()
-        r = probe_plan(cluster, apps, tpl)
-        counts.append(r.new_node_count if r.success else -1)
-    elapsed = time.perf_counter() - t0
+
+    def sweep():
+        counts = []
+        for tpl in templates:
+            reset_name_counter()
+            r = probe_plan(cluster, apps, tpl)
+            counts.append(r.new_node_count if r.success else -1)
+        return counts
+
+    elapsed, spread, counts = _timed(sweep)
     return {
         "elapsed_s": elapsed,
+        "spread": spread,
         "specs": len(specs),
         "counts": counts,
         "pods": n_pods,
@@ -568,17 +596,57 @@ def run_priority(n_priority=5) -> dict:
     res.pods = pods
     apps = [AppResource("bench", res)]
     simulate(cluster, apps, engine="tpu")  # warm/compile
-    elapsed = float("inf")
-    for _ in range(2):
-        t0 = time.perf_counter()
-        result = simulate(cluster, apps, engine="tpu")
-        elapsed = min(elapsed, time.perf_counter() - t0)
+    elapsed, spread, result = _timed(lambda: simulate(cluster, apps, engine="tpu"))
     return {
         "elapsed_s": elapsed,
+        "spread": spread,
         "pods_per_sec": len(pods) / elapsed,
         "scheduled": len(pods) - len(result.unscheduled_pods),
         "total": len(pods),
         "priority_pods": n_priority,
+        "nodes": len(nodes),
+    }
+
+
+def run_priority_dense(frac=0.75) -> dict:
+    """SIMON_BENCH=priority-dense: the round-3 cliff (VERDICT r3 weak
+    #2) — 20k pods x 10k nodes where 75% of pods carry a non-zero
+    priority across 8 distinct classes. Round 3 routed the whole
+    non-zero segment to the pure-Python serial oracle ("serial
+    (minutes, unmeasured)", docs/PERFORMANCE.md); the round-4
+    priority-scan engine places it with one optimistic ordered scan
+    per preemption escape (zero escapes here: the cluster fits), so
+    dense-priority throughput should sit near the plain scan rate.
+    End-to-end through the Simulator: sort, scan, serial escapes,
+    host replay."""
+    import copy
+
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.scheduler.core import AppResource, simulate
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    nodes, pods = build_scenario()
+    tiers = [100000, 10000, 5000, 1000, 500, 100, 50, 10]
+    n_dense = int(len(pods) * frac)
+    for i in range(n_dense):
+        pods[i] = copy.deepcopy(pods[i])
+        pods[i]["spec"]["priority"] = tiers[i % len(tiers)]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    res = ResourceTypes()
+    res.pods = pods
+    apps = [AppResource("bench", res)]
+    simulate(cluster, apps, engine="tpu")  # warm/compile
+    elapsed, spread, result = _timed(lambda: simulate(cluster, apps, engine="tpu"))
+    return {
+        "elapsed_s": elapsed,
+        "spread": spread,
+        "pods_per_sec": len(pods) / elapsed,
+        "scheduled": len(pods) - len(result.unscheduled_pods),
+        "total": len(pods),
+        "priority_pods": n_dense,
+        "scan_rounds": GLOBAL.notes.get("priority-scan-rounds"),
+        "escapes": GLOBAL.notes.get("priority-scan-escapes"),
         "nodes": len(nodes),
     }
 
@@ -678,22 +746,20 @@ def _scan_rate(nodes, pods, label: str) -> dict:
         if pallas_scan.should_use()
         else None
     )
-    # best of two measured runs, same protocol as the capacity headline
-    # (the relay adds ~0.1s jitter per dispatch)
+    # median of three measured runs (the relay adds ~0.1s jitter per
+    # dispatch; see _timed)
     if plan is not None:
         ones_p = np.ones(len(pods), bool)
         ones_n = np.ones(cluster.n, bool)
         pallas_scan.run_scan_pallas(
             plan, batch.class_of_pod, ones_p, ones_n, pinned=batch.pinned_node
         )
-        elapsed = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            placements_np, _ = pallas_scan.run_scan_pallas(
+        elapsed, spread, (placements_np, _) = _timed(
+            lambda: pallas_scan.run_scan_pallas(
                 plan, batch.class_of_pod, ones_p, ones_n,
                 pinned=batch.pinned_node,
             )
-            elapsed = min(elapsed, time.perf_counter() - t0)
+        )
         label += "/pallas"
     else:
         static = to_scan_static(cluster, batch)
@@ -706,14 +772,13 @@ def _scan_rate(nodes, pods, label: str) -> dict:
         )
         np.asarray(placements)  # compile + warm
 
-        elapsed = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
+        def once():
             placements, _ = scan_ops.run_scan(
                 static, init, class_arr, pinned_arr, features=features
             )
-            placements_np = np.asarray(placements)
-            elapsed = min(elapsed, time.perf_counter() - t0)
+            return np.asarray(placements)
+
+        elapsed, spread, placements_np = _timed(once)
 
     return {
         "label": label,
@@ -721,6 +786,7 @@ def _scan_rate(nodes, pods, label: str) -> dict:
         "scheduled": int((placements_np >= 0).sum()),
         "total": len(pods),
         "nodes": len(nodes),
+        "spread": spread,
     }
 
 
@@ -734,21 +800,20 @@ def run_capacity() -> dict:
     reset_name_counter()
     warm = probe_plan(cluster, apps, new_node)
     # measured: full end-to-end plan (expansion, encode, lower bound,
-    # probes, replay, report) with warm compile caches. Best of two
-    # runs: the host phases (100k-pod expansion/replay/report in
-    # Python) carry ~1-2 s of OS/allocator jitter per run, and min-of-K
-    # is the standard steady-state protocol for isolating that noise.
-    elapsed = float("inf")
-    for _ in range(2):
+    # probes, replay, report) with warm compile caches, median of
+    # three runs with spread recorded (_timed)
+    def once():
         reset_name_counter()
         GLOBAL.reset()
-        t0 = time.perf_counter()
         result = probe_plan(cluster, apps, new_node)
-        elapsed = min(elapsed, time.perf_counter() - t0)
         assert result.success and result.new_node_count == warm.new_node_count
+        return result
+
+    elapsed, spread, result = _timed(once)
     return {
         "elapsed_s": elapsed,
-        "protocol": "best-of-2",
+        "protocol": f"median-of-{spread['runs']}",
+        "spread": spread,
         "new_node_count": result.new_node_count,
         "pods": CAP_PODS,
         "nodes": CAP_NODES,
@@ -807,7 +872,8 @@ def main():
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes (plan: +{c['new_node_count']} nodes; "
-            f"incl. expansion+encode+probes+replay+report; best of 2 runs)",
+            f"incl. expansion+encode+probes+replay+report; median of "
+            f"{c['spread']['runs']}, min {c['spread']['min_s']:.2f}s)",
             "value": round(c["elapsed_s"], 2),
             "unit": "s",
             "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / c["elapsed_s"], 3),
@@ -840,8 +906,21 @@ def main():
         p = run_priority()
         out = {
             "metric": f"pods scheduled/sec at {p['nodes']} nodes, e2e simulate "
-            f"({p['priority_pods']} priority pods hybrid-routed, bulk on the "
-            f"fused scan; {p['scheduled']}/{p['total']} placed)",
+            f"({p['priority_pods']} priority pods, priority-scan engine; "
+            f"{p['scheduled']}/{p['total']} placed; median of "
+            f"{p['spread']['runs']}, min {p['spread']['min_s']:.2f}s)",
+            "value": round(p["pods_per_sec"], 1),
+            "unit": "pods/s",
+            "vs_baseline": round(p["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
+        }
+    elif scenario == "priority-dense":
+        p = run_priority_dense()
+        out = {
+            "metric": f"pods scheduled/sec at {p['nodes']} nodes, e2e simulate "
+            f"({p['priority_pods']}/{p['total']} pods priority-bearing over 8 "
+            f"tiers, priority-scan engine, {p['scan_rounds']} scan rounds / "
+            f"{p['escapes']} serial escapes; {p['scheduled']}/{p['total']} "
+            f"placed; median of {p['spread']['runs']})",
             "value": round(p["pods_per_sec"], 1),
             "unit": "pods/s",
             "vs_baseline": round(p["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
@@ -891,19 +970,27 @@ def main():
         d = isolated(run_defrag)
         w = isolated(run_whatif)
         p = isolated(run_priority)
+        pd = isolated(run_priority_dense)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
-            f"incl. expansion+encode+probes+replay+report; best of 2 runs; "
+            f"incl. expansion+encode+probes+replay+report; median of "
+            f"{c['spread']['runs']} runs, min {c['spread']['min_s']:.2f}s "
+            f"max {c['spread']['max_s']:.2f}s; "
             f"also: default scan {rd['pods_per_sec']:.0f} pods/s at 10k nodes "
             f"({rm['pods_per_sec']:.0f} with 1% hostPort+extended-resource pods), "
             f"affinity-stress {ra['pods_per_sec']:.0f} pods/s at 2k nodes "
-            f"and {ra10['pods_per_sec']:.0f} pods/s at 10k nodes, "
+            f"and {ra10['pods_per_sec']:.0f} pods/s at 10k nodes "
+            f"(min-max {ra10['spread']['min_s']:.2f}-{ra10['spread']['max_s']:.2f}s), "
             f"gpushare {rg['pods_per_sec']:.0f} pods/s at {rg['nodes']} 8-GPU nodes, "
             f"defrag sweep {d['elapsed_s']:.2f}s/{d['drained']} drained at {d['nodes']} nodes, "
             f"8-spec what-if {w['elapsed_s']:.2f}s, "
             f"priority-mixed e2e {p['pods_per_sec']:.0f} pods/s "
-            f"({p['priority_pods']} priority pods hybrid-routed); "
+            f"({p['priority_pods']} priority pods), "
+            f"priority-dense e2e {pd['pods_per_sec']:.0f} pods/s "
+            f"({pd['priority_pods']}/{pd['total']} priority-bearing, "
+            f"{pd['scan_rounds']} rounds/{pd['escapes']} escapes); "
+            f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"
                 if z["checked"]

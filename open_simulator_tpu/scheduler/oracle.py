@@ -211,6 +211,44 @@ class PreemptedPod:
     preemptor: str
 
 
+class PostFilterContext:
+    """The narrow cluster view handed to out-of-tree post_filter
+    plugins (plugins.py SchedulerPlugin.post_filter): enough to
+    implement a custom preemption policy without exposing oracle
+    internals. Evictions are recorded exactly like DefaultPreemption's
+    (the Simulator re-enqueues the victims; committed plugin state
+    unreserves)."""
+
+    def __init__(self, oracle: "Oracle", preemptor: dict):
+        self._oracle = oracle
+        self._preemptor = ((preemptor.get("metadata") or {}).get("name", ""))
+
+    @property
+    def nodes(self) -> List[dict]:
+        return [ns.node for ns in self._oracle.nodes]
+
+    def pods_on(self, node_name: str) -> List[dict]:
+        idx = self._oracle.node_index.get(node_name)
+        if idx is None:
+            return []
+        return list(self._oracle.nodes[idx].pods)
+
+    def evict(self, pod: dict, node_name: str) -> None:
+        idx = self._oracle.node_index.get(node_name)
+        if idx is None:
+            raise ValueError(f"unknown node {node_name!r}")
+        ns = self._oracle.nodes[idx]
+        if not any(p is pod for p in ns.pods):
+            raise ValueError(
+                f"pod {(pod.get('metadata') or {}).get('name', '')!r} "
+                f"is not on node {node_name!r}"
+            )
+        self._oracle.evict_pod(ns, pod)
+        self._oracle.preempted.append(
+            PreemptedPod(pod=pod, node_name=node_name, preemptor=self._preemptor)
+        )
+
+
 class Oracle:
     """Serial scheduler over mutable node states."""
 
@@ -458,6 +496,17 @@ class Oracle:
             if not plugin.prebind(pod, best.node):
                 unreserve_all()
                 return None, f'prebind plugin "{plugin.name}"'
+        # custom Bind plugins (interface.go:499-524): first non-skip
+        # verdict handles the bind; the simulator still records the
+        # placement locally below (like binder extenders,
+        # _reserve_and_bind) so the run keeps tracking it
+        for plugin in self.registry.bind_plugins:
+            verdict = plugin.bind(pod, best.node)
+            if verdict == "success":
+                break
+            if verdict != "skip":
+                unreserve_all()
+                return None, f'bind plugin "{plugin.name}"'
         try:
             self._reserve_and_bind(pod, best)
         except Exception:
@@ -479,6 +528,16 @@ class Oracle:
         reruns scheduleOne (scheduler.go:320-369); with the victims
         gone the retry binds.
         """
+        # out-of-tree PostFilter plugins run first, in registration
+        # order; the first returning a node wins and the built-in
+        # DefaultPreemption is skipped for this pod (the framework runs
+        # PostFilter plugins until the first Success status). They run
+        # even with preemption disabled — that switch disables the
+        # DefaultPreemption plugin, not the PostFilter stage
+        for plugin in self.registry.post_filter_plugins:
+            nominated = plugin.post_filter(pod, PostFilterContext(self, pod))
+            if nominated is not None:
+                return self._retry_cycle(pod)
         if not self.enable_preemption:
             return None
         prio = self.pod_priority(pod)
@@ -511,6 +570,15 @@ class Oracle:
         # Victims stay evicted even if the retry fails (the reference
         # likewise never restores PrepareCandidate's deletions); an
         # extender error here fails this pod's cycle, not the run.
+        return self._retry_cycle(pod)
+
+    def _retry_cycle(self, pod: dict):
+        """Fresh filter+score+bind cycle after a PostFilter mutated the
+        cluster (built-in preemption or a custom post_filter plugin).
+        The nominated node is not forced: the fresh cycle may pick any
+        feasible node, like the reference's re-queued scheduleOne."""
+        from .extender import ExtenderError
+
         try:
             feasible, _, _ = self._find_feasible(pod)
             if not feasible:

@@ -117,11 +117,10 @@ def pod_priority(pod: dict, resolver: Optional[PriorityAdmission] = None) -> int
 
 
 def pod_uses_priority(pod: dict, resolver: Optional[PriorityAdmission] = None) -> bool:
-    """True when the pod's *effective* priority is non-zero — the
-    Simulator routes such pods to the serial oracle (the scan has no
-    preemption semantics); a batch containing them splits around its
-    longest zero-priority run so the bulk keeps the scan
-    (core.py._schedule_pods_hybrid).
+    """True when the pod's *effective* priority is non-zero — a batch
+    containing such pods rides the ordered scan optimistically with a
+    per-pod serial escape hatch for failures that pass the PostFilter
+    preemption gates (core.py._schedule_pods_priority).
 
     An explicit `spec.priority: 0` (what a real apiserver stamps on
     every default pod, so every live-cluster import carries it) is NOT

@@ -539,3 +539,197 @@ def test_stateful_plugin_state_resets_between_runs():
     names = lambda r: sorted(u.pod["metadata"]["name"] for u in r.unscheduled_pods)
     assert names(r1) == names(r2)
     assert len(r1.unscheduled_pods) == len(r2.unscheduled_pods)
+
+
+# --------------------------- QueueSort / PostFilter / Bind (r4, VERDICT #3)
+
+
+class SmallestFirst(SchedulerPlugin):
+    """QueueSort replacing PrioritySort: smallest cpu request first."""
+
+    name = "Smallest-First"
+
+    def queue_sort_less(self, pod_a, pod_b):
+        def mcpu(p):
+            from open_simulator_tpu.utils.quantity import q_milli
+
+            r = (p["spec"]["containers"][0].get("resources") or {}).get("requests") or {}
+            return q_milli(r.get("cpu", "0"))
+
+        return mcpu(pod_a) < mcpu(pod_b)
+
+
+def test_queue_sort_plugin_replaces_priority_sort():
+    import open_simulator_tpu.testing as tb2
+
+    default_registry.register(SmallestFirst())
+    # one node that fits only one of the two (equal-priority) pods:
+    # arrival order would let the big pod win; the custom Less puts
+    # the small pod first instead. (Priorities are deliberately equal —
+    # queue order reorders the queue, it does not disable preemption.)
+    res = ResourceTypes()
+    res.nodes = [tb2.make_fake_node("n0", "1", "4Gi")]
+    big = tb2.make_fake_pod("big", "default", "900m", "1Gi")
+    small = tb2.make_fake_pod("small", "default", "200m", "256Mi")
+    app = AppResource("app", ResourceTypes(pods=[big, small]))
+    for engine in ("oracle", "tpu"):
+        out = simulate(res, [app], engine=engine)
+        placed = {
+            p["metadata"]["name"]
+            for ns in out.node_status
+            for p in ns.pods
+        }
+        assert placed == {"small"}, engine
+        assert [u.pod["metadata"]["name"] for u in out.unscheduled_pods] == ["big"]
+
+
+def test_second_queue_sort_plugin_rejected():
+    import pytest as _pytest
+
+    default_registry.register(SmallestFirst())
+
+    class AnotherSort(SmallestFirst):
+        name = "Another-Sort"
+
+    with _pytest.raises(ValueError, match="queue-sort"):
+        default_registry.register(AnotherSort())
+
+
+class EvictAnyVictim(SchedulerPlugin):
+    """Custom preemption policy: evict the first pod labeled
+    evictable=true, regardless of priority (something
+    DefaultPreemption would never do for an equal-priority
+    preemptor). The label bound is what guarantees termination —
+    DefaultPreemption descends strictly in priority instead; a policy
+    with neither would ping-pong evictions forever."""
+
+    name = "Evict-Any"
+    calls = 0
+
+    def post_filter(self, pod, ctx):
+        type(self).calls += 1
+        for node in ctx.nodes:
+            for victim in ctx.pods_on(node["metadata"]["name"]):
+                labels = (victim.get("metadata") or {}).get("labels") or {}
+                if labels.get("evictable") == "true":
+                    ctx.evict(victim, node["metadata"]["name"])
+                    return node["metadata"]["name"]
+        return None
+
+
+def test_post_filter_plugin_custom_preemption():
+    import open_simulator_tpu.testing as tb2
+
+    EvictAnyVictim.calls = 0
+    default_registry.register(EvictAnyVictim())
+    res = ResourceTypes()
+    res.nodes = [tb2.make_fake_node("n0", "1", "4Gi")]
+    # equal priority: DefaultPreemption could never evict the sitter
+    sitter = tb2.make_fake_pod(
+        "sitter", "default", "800m", "1Gi", tb2.with_labels({"evictable": "true"})
+    )
+    sitter["spec"]["nodeName"] = "n0"
+    res.pods = [sitter]
+    newcomer = tb2.make_fake_pod("newcomer", "default", "800m", "1Gi")
+    app = AppResource("app", ResourceTypes(pods=[newcomer]))
+    out = simulate(res, [app], engine="oracle")
+    placed = {
+        p["metadata"]["name"]: ns.node["metadata"]["name"]
+        for ns in out.node_status
+        for p in ns.pods
+    }
+    assert placed.get("newcomer") == "n0"
+    assert EvictAnyVictim.calls >= 1
+    assert [ev.victim["metadata"]["name"] for ev in out.preemptions] == ["sitter"]
+    # the evicted sitter re-queued and failed (node is full again)
+    assert [u.pod["metadata"]["name"] for u in out.unscheduled_pods] == ["sitter"]
+
+
+def test_post_filter_plugin_scan_batch_escapes(monkeypatch):
+    # a big zero-priority batch + a custom post_filter: the batch rides
+    # the priority-scan engine and each failure escapes serially so the
+    # plugin sees it; placements match the pure-oracle run
+    import open_simulator_tpu.testing as tb2
+    from open_simulator_tpu.scheduler import core as core_mod
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 4)
+
+    def build():
+        res = ResourceTypes()
+        res.nodes = [tb2.make_fake_node(f"n{i}", "2", "8Gi") for i in range(4)]
+        sitter = tb2.make_fake_pod(
+            "sitter", "default", "1900m", "1Gi",
+            tb2.with_labels({"evictable": "true"}),
+        )
+        sitter["spec"]["nodeName"] = "n0"
+        res.pods = [sitter]
+        pods = [
+            tb2.make_fake_pod(f"p-{i:02d}", "default", "450m", "256Mi")
+            for i in range(16)
+        ]
+        return res, [AppResource("app", ResourceTypes(pods=pods))]
+
+    EvictAnyVictim.calls = 0
+    default_registry.register(EvictAnyVictim())
+    cluster, apps = build()
+    serial = simulate(cluster, apps, engine="oracle")
+    cluster, apps = build()
+    GLOBAL.reset()
+    tpu = simulate(cluster, apps, engine="tpu")
+    assert GLOBAL.notes.get("engine") == "priority-scan"
+
+    def summary(r):
+        return (
+            {
+                p["metadata"]["name"]: ns.node["metadata"]["name"]
+                for ns in r.node_status
+                for p in ns.pods
+            },
+            sorted(u.pod["metadata"]["name"] for u in r.unscheduled_pods),
+            sorted(ev.victim["metadata"]["name"] for ev in r.preemptions),
+        )
+
+    assert summary(serial) == summary(tpu)
+
+
+class RecordingBinder(SchedulerPlugin):
+    name = "Recording-Binder"
+    bound = None  # class-level: survives registry copies
+
+    def bind(self, pod, node):
+        name = pod["metadata"]["name"]
+        if name.endswith("skipme"):
+            return "skip"
+        if name.endswith("failme"):
+            return "error"
+        type(self).bound = type(self).bound or []
+        type(self).bound.append((name, node["metadata"]["name"]))
+        return "success"
+
+
+def test_bind_plugin_handles_skips_and_errors():
+    import open_simulator_tpu.testing as tb2
+
+    RecordingBinder.bound = None
+    default_registry.register(RecordingBinder())
+    res = ResourceTypes()
+    res.nodes = [tb2.make_fake_node("n0", "8", "16Gi")]
+    pods = [
+        tb2.make_fake_pod("a-bindme", "default", "100m", "128Mi"),
+        tb2.make_fake_pod("b-skipme", "default", "100m", "128Mi"),
+        tb2.make_fake_pod("c-failme", "default", "100m", "128Mi"),
+    ]
+    app = AppResource("app", ResourceTypes(pods=pods))
+    out = simulate(res, [app], engine="tpu")  # bind => stateful => serial
+    placed = {
+        p["metadata"]["name"]
+        for ns in out.node_status
+        for p in ns.pods
+    }
+    # custom-bound and skipped (default binder) pods both place; the
+    # "error" verdict fails that pod's cycle outright
+    assert placed == {"a-bindme", "b-skipme"}
+    assert [u.pod["metadata"]["name"] for u in out.unscheduled_pods] == ["c-failme"]
+    assert ("a-bindme", "n0") in (RecordingBinder.bound or [])
+    assert all(n != "b-skipme" for n, _ in (RecordingBinder.bound or []))

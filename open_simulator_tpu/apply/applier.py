@@ -109,23 +109,32 @@ def _resource_caps():
     return cap("MaxCPU"), cap("MaxMemory"), cap("MaxVG")
 
 
-def satisfy_resource_setting(node_statuses) -> tuple:
-    """satisfyResourceSetting (apply.go:611-697)."""
+def satisfy_resource_setting(node_statuses, oracle=None) -> tuple:
+    """satisfyResourceSetting (apply.go:611-697). With `oracle` (the
+    replay oracle whose NodeStates back these statuses), per-node
+    floor totals come from the commit-time aggregates instead of a
+    100k-pod re-walk."""
     from ..models import requests as req
-    from .report import _pod_req_summary
+    from .report import _pod_req_summary, matched_node_state, node_state_index
 
     max_cpu, max_mem, max_vg = _resource_caps()
     total_alloc_cpu = total_alloc_mem = 0
     total_used_cpu = total_used_mem = 0
     vg_cap = vg_req = 0
+    by_node = node_state_index(oracle)
     for status in node_statuses:
         node = status.node
         total_alloc_cpu += req.node_alloc_milli_cpu(node)
         total_alloc_mem += req.node_alloc_int(node, req.MEMORY)
-        for pod in status.pods:
-            mcpu, mem = _pod_req_summary(pod)
-            total_used_cpu += mcpu
-            total_used_mem += mem
+        state = matched_node_state(by_node, status)
+        if state is not None:
+            total_used_cpu += state.req_floor_mcpu
+            total_used_mem += state.req_floor_mem
+        else:
+            for pod in status.pods:
+                mcpu, mem = _pod_req_summary(pod)
+                total_used_cpu += mcpu
+                total_used_mem += mem
         storage = stor.parse_node_storage(node)
         if storage:
             for vg in storage.vgs:
@@ -193,6 +202,8 @@ def replay_scenario(sweep, count: int, placements):
     class_of_pod = np.asarray(batch.class_of_pod)
     had_node_name = sweep.had_node_name
     failed = []
+    class_info: dict = {}
+    from ..models.requests import pod_request_summary as req_summary
     for p_i, (pod, idx) in enumerate(zip(sweep.pods, placements)):
         idx = int(idx)
         if idx == -2:  # inactive in this scenario (disabled-node ds pod)
@@ -228,7 +239,19 @@ def replay_scenario(sweep, count: int, placements):
             ns = oracle.nodes[idx]
             pod["spec"]["nodeName"] = ns.name
             pod.setdefault("status", {})["phase"] = "Running"
-            oracle._commit(pod, ns)
+            # pods of one class share request/port content by class-key
+            # construction, so the summary walk runs once per class —
+            # the per-pod residue is pure aggregate arithmetic
+            cls = int(class_of_pod[p_i])
+            info = class_info.get(cls)
+            if info is None:
+                from ..scheduler.oracle import _pod_host_ports
+
+                info = class_info[cls] = (
+                    req_summary(pod),
+                    tuple(_pod_host_ports(pod)),
+                )
+            oracle._commit_known(pod, ns, info[0], info[1])
         else:
             oracle._reserve_and_bind(pod, oracle.nodes[idx])
     status = [NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes]
@@ -314,16 +337,18 @@ def _probe_plan_inner(
             success=False, new_node_count=max_count, result=result, message=message
         )
     with phase("apply/replay"):
-        result, _ = replay_scenario(sweep, best.count, best.placements)
+        result, replay_oracle = replay_scenario(sweep, best.count, best.placements)
     # authoritative host-side check of the caps on real state
-    ok, reason = satisfy_resource_setting(result.node_status)
+    ok, reason = satisfy_resource_setting(result.node_status, oracle=replay_oracle)
     if result.unscheduled_pods or not ok:  # pragma: no cover - defensive
         raise RuntimeError(
             "probe replay disagreed with scan: "
             + (reason or f"{len(result.unscheduled_pods)} unscheduled")
         )
     with phase("apply/report"):
-        report_text = report(result.node_status, extended_resources or [])
+        report_text = report(
+            result.node_status, extended_resources or [], oracle=replay_oracle
+        )
     return ApplyResult(
         success=True,
         new_node_count=best.count,

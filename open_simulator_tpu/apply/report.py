@@ -19,7 +19,10 @@ from ..utils.quantity import format_quantity_bin
 
 def render_table(headers: List[str], rows: List[List[str]]) -> str:
     widths = [len(h) for h in headers]
-    str_rows = [[str(c) for c in row] for row in rows]
+    str_rows = [
+        row if all(type(c) is str for c in row) else [str(c) for c in row]
+        for row in rows
+    ]
     for row in str_rows:
         for i, cell in enumerate(row):
             if len(cell) > widths[i]:
@@ -28,15 +31,37 @@ def render_table(headers: List[str], rows: List[List[str]]) -> str:
     def line(ch="-", junction="+"):
         return junction + junction.join(ch * (w + 2) for w in widths) + junction
 
-    def fmt_row(cells):
-        return "| " + " | ".join(c.ljust(w) for c, w in zip(cells, widths)) + " |"
+    # one C-level str.format per row beats per-cell ljust+join at 100k
+    # rows (capacity-report host tail)
+    row_fmt = "| " + " | ".join(f"{{:<{w}}}" for w in widths) + " |"
+    fmt_row = row_fmt.format
 
     sep = line()  # identical between every row: render once, not per row
-    out = [sep, fmt_row(headers), line("=")]
+    out = [sep, fmt_row(*headers), line("=")]
     for row in str_rows:
-        out.append(fmt_row(row))
+        out.append(fmt_row(*row))
         out.append(sep)
     return "\n".join(out)
+
+
+def node_state_index(oracle):
+    """{id(node dict): NodeState} for the oracle-backed fast paths
+    (report node table, satisfy_resource_setting). Empty when no
+    oracle is in play."""
+    if oracle is None:
+        return {}
+    return {id(ns.node): ns for ns in oracle.nodes}
+
+
+def matched_node_state(by_node, status):
+    """The NodeState backing `status`, or None when the fast path is
+    unsound for it. Identity match proves the status was built from
+    this oracle's node; the pod-count check guards against a status
+    whose pod list was filtered or extended after the fact."""
+    state = by_node.get(id(status.node))
+    if state is not None and len(state.pods) == len(status.pods):
+        return state
+    return None
 
 
 def _fmt_cpu(mcpu: int) -> str:
@@ -58,14 +83,18 @@ def report(
     node_statuses,
     extended_resources: Optional[List[str]] = None,
     select_nodes=None,
+    oracle=None,
 ) -> str:
     """Render the result tables. `select_nodes` (a set of node names, or
     None for all) filters the Pod Info table only — the reference's
     interactive node multi-select (reportNodeInfo, apply.go:510-530)
-    narrows the pod table while the cluster tables stay complete."""
+    narrows the pod table while the cluster tables stay complete.
+    `oracle` (when the caller just replayed into one) lets the node
+    table read per-node floor aggregates instead of re-walking every
+    pod (r4 capacity host-tail trim)."""
     extended_resources = extended_resources or []
     out = ["Node Info"]
-    out.append(_node_table(node_statuses, extended_resources))
+    out.append(_node_table(node_statuses, extended_resources, oracle=oracle))
     if extended_resources:
         out.append("")
         out.append("Extended Resource Info")
@@ -90,12 +119,19 @@ def report(
     return "\n".join(out)
 
 
-def _node_table(node_statuses, extended_resources) -> str:
+def _node_table(node_statuses, extended_resources, oracle=None) -> str:
     headers = ["Node", "CPU Allocatable", "CPU Requests", "Memory Allocatable", "Memory Requests"]
     gpu = "gpu" in extended_resources
     if gpu:
         headers += ["GPU Mem Allocatable", "GPU Mem Requests"]
     headers += ["Pod Count", "New Node"]
+    # fast path: the replay oracle tracks floor-semantics totals per
+    # node (NodeState.req_floor_*), identical to summing the per-pod
+    # floors below. NOT used for the gpu column: its per-pod
+    # g_mem*g_cnt semantics diverge from the commit-time device
+    # accounting on degenerate annotations (mem without count), and
+    # the report must render identically on every code path
+    by_node = node_state_index(oracle) if not gpu else {}
     rows = []
     for status in node_statuses:
         node = status.node
@@ -103,14 +139,19 @@ def _node_table(node_statuses, extended_resources) -> str:
         alloc_mem = req.node_alloc_int(node, req.MEMORY)
         used_mcpu = used_mem = 0
         gpu_req = 0
-        summary = req.pod_request_summary
-        for pod in status.pods:
-            s = summary(pod)
-            used_mcpu += s.floor_mcpu
-            used_mem += s.floor_mem
-            if gpu:  # column only rendered for the gpu table
-                g_mem, g_cnt = stor.pod_gpu_request(pod)
-                gpu_req += g_mem * g_cnt
+        state = matched_node_state(by_node, status)
+        if state is not None:
+            used_mcpu = state.req_floor_mcpu
+            used_mem = state.req_floor_mem
+        else:
+            summary = req.pod_request_summary
+            for pod in status.pods:
+                s = summary(pod)
+                used_mcpu += s.floor_mcpu
+                used_mem += s.floor_mem
+                if gpu:  # column only rendered for the gpu table
+                    g_mem, g_cnt = stor.pod_gpu_request(pod)
+                    gpu_req += g_mem * g_cnt
         labels = (node.get("metadata") or {}).get("labels") or {}
         row = [
             (node.get("metadata") or {}).get("name", ""),

@@ -95,6 +95,20 @@ def _meta_from_owner(owner: dict, kind: str, gen_pod: bool) -> dict:
     }
 
 
+def _meta_for_replica(base_anno: dict, namespace, gen_name: str, shared_refs) -> dict:
+    """Per-replica metadata with the template-invariant parts hoisted
+    (annotations still copied per pod — the GPU binder writes a
+    per-pod device index into them; labels are assigned by the caller
+    from the template's shared dict)."""
+    return {
+        "name": f"{gen_name}-{_hash_suffix(POD_HASH_DIGITS)}",
+        "namespace": namespace,
+        "generateName": gen_name,
+        "annotations": dict(base_anno),
+        "ownerReferences": shared_refs,
+    }
+
+
 def make_valid_pod(pod: dict, _name_only_validation: bool = False) -> dict:
     """MakeValidPod: defaulting + sanitization (utils.go:410-492).
 
@@ -158,20 +172,36 @@ def _expand_template(owner: dict, kind: str, count: int) -> list:
     from .validation import validate_pod_name
 
     ometa = owner.get("metadata") or {}
+    owner_name = ometa.get("name", "")
+    owner_ns = ometa.get("namespace", "")
     pods = []
     shared_spec = None
     for i in range(count):
-        meta = _meta_from_owner(owner, kind, gen_pod=True)
         if shared_spec is None:
             pod = make_valid_pod(
                 {
-                    "metadata": meta,
+                    "metadata": _meta_from_owner(owner, kind, gen_pod=True),
                     "spec": copy.deepcopy(
                         ((owner.get("spec") or {}).get("template") or {}).get("spec") or {}
                     ),
                 }
             )
             shared_spec = pod["spec"]
+            first_meta = pod["metadata"]
+            # replicas share ONE labels dict and ONE ownerReferences
+            # list (content is identical per template; the only
+            # post-expansion label write — the app-name label,
+            # generate_valid_pods_from_app — stamps the same value for
+            # every replica, and nothing mutates ownerReferences).
+            # Annotations stay per-pod: the GPU binder writes a per-pod
+            # device index there. Sharing lets the encode class-key
+            # memo hit by identity (ops/encode.py) instead of
+            # re-freezing 100k label dicts.
+            shared_labels = first_meta.setdefault("labels", {})
+            shared_refs = first_meta.get("ownerReferences")
+            namespace = first_meta.get("namespace")
+            add_workload_info(pod, kind, owner_name, owner_ns)
+            base_anno_full = dict(pod["metadata"]["annotations"])
         else:
             # clone fast path: all replicas share the sanitized
             # template spec — nested structures are read-only after
@@ -180,11 +210,12 @@ def _expand_template(owner: dict, kind: str, count: int) -> list:
             # fully validated on the first clone; only the generated
             # name varies. At 100k pods the deepcopy+revalidate path
             # this replaces was ~16 s of host time.
-            if not meta.get("namespace"):
-                meta["namespace"] = "default"
+            meta = _meta_for_replica(
+                base_anno_full, namespace, owner_name, shared_refs
+            )
+            meta["labels"] = shared_labels
             pod = {"metadata": meta, "spec": dict(shared_spec)}
             validate_pod_name(pod)
-        add_workload_info(pod, kind, ometa.get("name", ""), ometa.get("namespace", ""))
         pods.append(pod)
     return pods
 

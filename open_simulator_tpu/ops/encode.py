@@ -33,7 +33,7 @@ import numpy as np
 from ..models import labels as lbl
 from ..models import requests as req
 from ..models import storage as stor
-from ..utils.memo import IdentityMemo
+from ..utils.memo import IdentityMemo, register_cache
 from .profiles import freeze as _freeze
 from .profiles import node_profiles as _shared_node_profiles
 from .profiles import uses_match_fields as _uses_match_fields
@@ -192,19 +192,73 @@ def _freeze_spec_parts(spec: dict):
     )
 
 
+class _InternedKey:
+    """A (spec_key, frozen_labels) pair with its deep hash computed
+    once. Canonicalized by content in _KEY_INTERN, so equal content —
+    even from distinct templates — is the SAME object and the classes
+    dict compares by the `is` fast path instead of re-hashing a nested
+    tuple per pod (the r4 capacity host-tail item)."""
+
+    __slots__ = ("key", "_hash")
+
+    def __init__(self, key):
+        self.key = key
+        self._hash = hash(key)
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return self is other or (
+            isinstance(other, _InternedKey) and self.key == other.key
+        )
+
+
+_KEY_INTERN: dict = {}
+_CLASS_PREFIX_MEMO = IdentityMemo()
+register_cache(_KEY_INTERN.clear)
+
+
+def _class_prefix(spec: dict, labels):
+    """Identity-memoized, content-interned heavy part of the class key.
+    The memo sources are every object `_spec_key`/`_freeze` read, so an
+    identity hit implies identical content; template-expanded replicas
+    share all of them (workloads._expand_template)."""
+
+    def make():
+        k = (_spec_key(spec), _freeze(labels))
+        tok = _KEY_INTERN.get(k)
+        if tok is None:
+            tok = _KEY_INTERN[k] = _InternedKey(k)
+        return tok
+
+    return _CLASS_PREFIX_MEMO.get(
+        (
+            spec.get("containers"),
+            spec.get("initContainers"),
+            spec.get("nodeSelector"),
+            spec.get("affinity"),
+            spec.get("topologySpreadConstraints"),
+            spec.get("tolerations"),
+            spec.get("overhead"),
+            labels,
+        ),
+        make,
+    )
+
+
 def _class_key(pod: dict):
     spec = pod.get("spec") or {}
     meta = pod.get("metadata") or {}
     anno = meta.get("annotations") or {}
     refs = meta.get("ownerReferences") or []
     ctrl = next((r for r in refs if r.get("controller")), None)
-    # content-based equality is preserved: the spec part is frozen per
-    # shared template (identical content from distinct templates still
-    # freezes to equal tuples), per-pod fields are frozen each time
+    # content-based equality is preserved: the interned prefix compares
+    # by content (identical content from distinct templates interns to
+    # one object), per-pod cheap fields ride alongside
     return (
-        _spec_key(spec),
+        _class_prefix(spec, meta.get("labels")),
         meta.get("namespace"),
-        _freeze(meta.get("labels")),
         spec.get("nodeName"),
         spec.get("hostNetwork"),
         anno.get(stor.GPU_MEM_ANNO),

@@ -132,6 +132,11 @@ class NodeState:
     req_scalar: Dict[str, int] = field(default_factory=dict)
     nz_mcpu: int = 0
     nz_mem: int = 0
+    # floor-semantics totals (PodRequestsAndLimits-based report code) —
+    # kept alongside the ceil accounting so report/caps aggregation can
+    # read node totals instead of re-walking 100k pods (r4 host-tail)
+    req_floor_mcpu: int = 0
+    req_floor_mem: int = 0
     used_ports: set = field(default_factory=set)  # (ip, proto, port)
     gpu: Optional[GpuState] = None
     storage: Optional[stor.NodeStorage] = None
@@ -1423,16 +1428,27 @@ class Oracle:
 
     def _commit(self, pod: dict, ns: NodeState):
         """NodeInfo.AddPod accounting."""
+        return self._commit_known(
+            pod, ns, req.pod_request_summary(pod), None
+        )
+
+    def _commit_known(self, pod: dict, ns: NodeState, s, ports):
+        """_commit with the pod's request summary (and optionally its
+        host-port tuple) already in hand — the capacity replay passes
+        per-CLASS values so the 100k-pod walk does only aggregate
+        arithmetic per pod (class members share request/port content by
+        class-key construction, ops/encode.py:_class_key)."""
         ns.pods.append(pod)
-        s = req.pod_request_summary(pod)
         ns.req_mcpu += s.mcpu
         ns.req_mem += s.mem
         ns.req_eph += s.eph
+        ns.req_floor_mcpu += s.floor_mcpu
+        ns.req_floor_mem += s.floor_mem
         for name, iv in s.scalars:
             ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
         ns.nz_mcpu += s.nz_mcpu
         ns.nz_mem += s.nz_mem
-        for port in _pod_host_ports(pod):
+        for port in _pod_host_ports(pod) if ports is None else ports:
             ns.used_ports.add(port)
         # priority bookkeeping for DefaultPreemption
         self._seq_counter += 1
@@ -1440,11 +1456,10 @@ class Oracle:
         prio = self.pod_priority(pod)
         if prio < self._min_prio:
             self._min_prio = prio
-        if not self.saw_priority:
-            from .preemption import pod_uses_priority
-
-            if pod_uses_priority(pod, self._prio_resolver):
-                self.saw_priority = True
+        # pod_uses_priority(pod) is exactly `effective priority != 0`
+        # (preemption.py:119) — reuse the value already resolved
+        if prio != 0 and not self.saw_priority:
+            self.saw_priority = True
 
     # -- pod removal (preemption) -------------------------------------------
 
@@ -1466,6 +1481,8 @@ class Oracle:
         ns.req_mcpu -= s.mcpu
         ns.req_mem -= s.mem
         ns.req_eph -= s.eph
+        ns.req_floor_mcpu -= s.floor_mcpu
+        ns.req_floor_mem -= s.floor_mem
         for name, iv in s.scalars:
             ns.req_scalar[name] = ns.req_scalar.get(name, 0) - iv
         ns.nz_mcpu -= s.nz_mcpu
@@ -1503,6 +1520,8 @@ class Oracle:
         ns.req_mcpu += s.mcpu
         ns.req_mem += s.mem
         ns.req_eph += s.eph
+        ns.req_floor_mcpu += s.floor_mcpu
+        ns.req_floor_mem += s.floor_mem
         for name, iv in s.scalars:
             ns.req_scalar[name] = ns.req_scalar.get(name, 0) + iv
         ns.nz_mcpu += s.nz_mcpu

@@ -185,7 +185,7 @@ def replay_scenario(sweep, count: int, placements):
     import numpy as np
 
     from ..scheduler.core import NodeStatus, SimulateResult, UnscheduledPod
-    from ..scheduler.oracle import Oracle
+    from ..scheduler.oracle import ClassCommitCache, Oracle, simple_commit_mask
 
     nodes = [ns.node for ns in sweep.oracle.nodes[: sweep.n_base + count]]
     oracle = Oracle(nodes)
@@ -194,16 +194,11 @@ def replay_scenario(sweep, count: int, placements):
     # _reserve_and_bind re-checks GPU/storage/extenders per pod, which
     # is most of the replay wall-clock at 100k pods
     batch = sweep.batch
-    simple_class = (
-        (np.asarray(batch.gpu_mem) <= 0) & ~np.asarray(batch.wants_storage)
-        if not sweep.oracle.extenders
-        else np.zeros(batch.u, bool)
-    )
+    simple_class = simple_commit_mask(batch, bool(sweep.oracle.extenders))
     class_of_pod = np.asarray(batch.class_of_pod)
     had_node_name = sweep.had_node_name
     failed = []
-    class_info: dict = {}
-    from ..models.requests import pod_request_summary as req_summary
+    commit_cache = ClassCommitCache()
     for p_i, (pod, idx) in enumerate(zip(sweep.pods, placements)):
         idx = int(idx)
         if idx == -2:  # inactive in this scenario (disabled-node ds pod)
@@ -236,22 +231,7 @@ def replay_scenario(sweep, count: int, placements):
                 )
             failed.append(UnscheduledPod(pod=pod, reason=reason))
         elif simple_class[class_of_pod[p_i]]:
-            ns = oracle.nodes[idx]
-            pod["spec"]["nodeName"] = ns.name
-            pod.setdefault("status", {})["phase"] = "Running"
-            # pods of one class share request/port content by class-key
-            # construction, so the summary walk runs once per class —
-            # the per-pod residue is pure aggregate arithmetic
-            cls = int(class_of_pod[p_i])
-            info = class_info.get(cls)
-            if info is None:
-                from ..scheduler.oracle import _pod_host_ports
-
-                info = class_info[cls] = (
-                    req_summary(pod),
-                    tuple(_pod_host_ports(pod)),
-                )
-            oracle._commit_known(pod, ns, info[0], info[1])
+            commit_cache.commit(oracle, pod, oracle.nodes[idx], int(class_of_pod[p_i]))
         else:
             oracle._reserve_and_bind(pod, oracle.nodes[idx])
     status = [NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes]

@@ -408,6 +408,7 @@ class Simulator:
                     escape_at = i
                     break
         by_idx = {i: int(idx) for (i, _), idx in zip(batch, placements)}
+        pos_of = {i: pos for pos, (i, _) in enumerate(batch)}
         failed: List[UnscheduledPod] = []
         stop = len(pods) if escape_at is None else escape_at
         for i in range(stop):
@@ -425,7 +426,7 @@ class Simulator:
                     UnscheduledPod(pod=pod, reason=Oracle._failure_message(pod, reasons))
                 )
             else:
-                self._engine.commit_host(pod, by_idx[i])
+                self._engine.commit_host_at(pod, by_idx[i], pos_of[i])
                 self.cluster_pods.append(pod)
         return failed, escape_at
 

@@ -38,6 +38,12 @@ class TpuEngine:
         self.oracle = oracle
         self._cluster: ClusterStatic = None
         self._cache_key = None
+        # per-schedule()-call replay fast path (class ids are batch
+        # scoped): classes with no GPU/storage/extender side effects
+        # commit via per-class summaries instead of the general bind
+        self._last_class_of = None
+        self._last_simple = None
+        self._class_commit_info = None
 
     def cluster_static(self) -> ClusterStatic:
         # keyed on (node count, alloc epoch): GPU-share Reserve mutates
@@ -67,6 +73,12 @@ class TpuEngine:
         with phase("engine/encode"):
             cluster = self.cluster_static()
             batch = encode_batch(oracle, cluster, pods)
+            # replay fast-path tables (commit_host_at): batch-scoped
+            from .oracle import ClassCommitCache, simple_commit_mask
+
+            self._last_class_of = np.asarray(batch.class_of_pod)
+            self._last_simple = simple_commit_mask(batch, bool(oracle.extenders))
+            self._class_commit_info = ClassCommitCache()
             dyn = encode_dynamic(oracle, cluster)
             features = features_of_batch(
                 cluster, batch, weights=getattr(oracle, "score_weights", None)
@@ -120,3 +132,20 @@ class TpuEngine:
         """Replay one placement into oracle state (same binding code the
         serial path uses, incl. GPU/storage side effects)."""
         self.oracle._reserve_and_bind(pod, self.oracle.nodes[int(node_idx)])
+
+    def commit_host_at(self, pod: dict, node_idx: int, batch_pos: int):
+        """commit_host with the pod's position in the last scheduled
+        batch: classes with no GPU/storage/extender side effects reduce
+        _reserve_and_bind to nodeName+phase+commit, and class members
+        share request/port content by class-key construction, so the
+        summary/port walk runs once per class (the same fast path the
+        capacity replay uses, applier.replay_scenario)."""
+        cls_of = self._last_class_of
+        if cls_of is not None and batch_pos < len(cls_of):
+            cls = int(cls_of[batch_pos])
+            if self._last_simple[cls]:
+                self._class_commit_info.commit(
+                    self.oracle, pod, self.oracle.nodes[int(node_idx)], cls
+                )
+                return
+        self.commit_host(pod, node_idx)

@@ -207,6 +207,39 @@ def _ports_conflict(want: List[Tuple[str, str, int]], used: set) -> bool:
 # ------------------------------------------------------------------- oracle
 
 
+def simple_commit_mask(batch, has_extenders: bool):
+    """Per-CLASS mask of pods whose bind has no GPU/storage/extender
+    side effects, so replay can use Oracle.commit_simple with a
+    per-class ClassCommitCache instead of the general _reserve_and_bind
+    (shared by engine.commit_host_at and applier.replay_scenario — the
+    eligibility rule must stay identical in both)."""
+    import numpy as np
+
+    if has_extenders:
+        return np.zeros(batch.u, bool)
+    return (np.asarray(batch.gpu_mem) <= 0) & ~np.asarray(batch.wants_storage)
+
+
+class ClassCommitCache:
+    """(request summary, host-port tuple) per batch-scoped pod class —
+    class members share request/port content by class-key construction
+    (ops/encode.py:_class_key), so the walk runs once per class."""
+
+    __slots__ = ("_info",)
+
+    def __init__(self):
+        self._info: Dict[int, tuple] = {}
+
+    def commit(self, oracle: "Oracle", pod: dict, ns: "NodeState", cls: int):
+        info = self._info.get(cls)
+        if info is None:
+            info = self._info[cls] = (
+                req.pod_request_summary(pod),
+                tuple(_pod_host_ports(pod)),
+            )
+        oracle.commit_simple(pod, ns, info[0], info[1])
+
+
 @dataclass
 class PreemptedPod:
     """One eviction performed by DefaultPreemption."""
@@ -1425,6 +1458,16 @@ class Oracle:
     def _pod_key(pod: dict) -> Tuple[str, str]:
         meta = pod.get("metadata") or {}
         return (meta.get("namespace") or "default", meta.get("name", ""))
+
+    def commit_simple(self, pod: dict, ns: NodeState, s, ports) -> None:
+        """The reduction of _reserve_and_bind for a pod with no
+        GPU/storage/extender side effects (see simple_commit_mask):
+        Simon Bind (nodeName + phase) + NodeInfo accounting, with the
+        request summary and port tuple supplied by the caller's
+        per-class cache."""
+        pod.setdefault("spec", {})["nodeName"] = ns.name
+        pod.setdefault("status", {})["phase"] = "Running"
+        self._commit_known(pod, ns, s, ports)
 
     def _commit(self, pod: dict, ns: NodeState):
         """NodeInfo.AddPod accounting."""

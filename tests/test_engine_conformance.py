@@ -376,3 +376,44 @@ def test_run_scan_callable_under_external_jit():
 
     jitted = wrapped(static, init, class_arr, pinned_arr)
     np.testing.assert_array_equal(np.asarray(direct), np.asarray(jitted))
+
+
+def test_storage_bench_scenario_conforms():
+    """The SIMON_BENCH=storage builder (bench.build_storage_scenario)
+    at toy scale: scan placements must match the serial oracle on the
+    open-local VG binpack + exclusive-device path, so the recorded
+    bench number is backed by the same conformance as the other
+    scenarios."""
+    import bench
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.scheduler.core import AppResource, simulate
+
+    nodes, pods = bench.build_storage_scenario(n_nodes=12, n_pods=40)
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    res = ResourceTypes()
+    res.pods = pods
+    apps = [AppResource("stor", res)]
+    serial = simulate(cluster, apps, engine="oracle")
+
+    nodes, pods = bench.build_storage_scenario(n_nodes=12, n_pods=40)
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    res = ResourceTypes()
+    res.pods = pods
+    tpu = simulate(cluster, [AppResource("stor", res)], engine="tpu")
+
+    def placements(r):
+        return {
+            p["metadata"]["name"]: ns.node["metadata"]["name"]
+            for ns in r.node_status
+            for p in ns.pods
+        }
+
+    assert placements(serial) == placements(tpu)
+    assert sorted(u.pod["metadata"]["name"] for u in serial.unscheduled_pods) == sorted(
+        u.pod["metadata"]["name"] for u in tpu.unscheduled_pods
+    )
+    # the toy scale still exercised both volume kinds
+    assert any("LVM" in str(p["metadata"]["annotations"]) for p in pods)
+    assert any("SSD" in str(p["metadata"]["annotations"]) for p in pods)

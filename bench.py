@@ -943,7 +943,7 @@ def main():
         r = _scan_rate(nodes, pods, "default")
         out = {
             "metric": f"pods scheduled/sec at {r['nodes']} nodes "
-            f"(default scenario, JAX scan, {r['scheduled']}/{r['total']} placed)",
+            f"(default scenario, {r['label']}, {r['scheduled']}/{r['total']} placed)",
             "value": round(r["pods_per_sec"], 1),
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
@@ -953,7 +953,7 @@ def main():
         r = _scan_rate(nodes, pods, "affinity")
         out = {
             "metric": f"pods scheduled/sec at {r['nodes']} nodes "
-            f"(affinity-stress scenario, JAX scan, {r['scheduled']}/{r['total']} placed)",
+            f"(affinity-stress scenario, {r['label']}, {r['scheduled']}/{r['total']} placed)",
             "value": round(r["pods_per_sec"], 1),
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
@@ -985,7 +985,7 @@ def main():
         r = _scan_rate(nodes, pods, "gpushare")
         out = {
             "metric": f"pods scheduled/sec at {r['nodes']} GPU nodes "
-            f"(gpushare fragmentation, {r['scheduled']}/{r['total']} placed)",
+            f"(gpushare fragmentation, {r['label']}, {r['scheduled']}/{r['total']} placed)",
             "value": round(r["pods_per_sec"], 1),
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
@@ -1093,7 +1093,7 @@ def main():
             f"incl. expansion+encode+probes+replay+report; median of "
             f"{c['spread']['runs']} runs, min {c['spread']['min_s']:.2f}s "
             f"max {c['spread']['max_s']:.2f}s; "
-            f"also: default scan {rd['pods_per_sec']:.0f} pods/s at 10k nodes "
+            f"also: default scan {rd['pods_per_sec']:.0f} pods/s at 10k nodes ({rd['label']}) "
             f"({rm['pods_per_sec']:.0f} with 1% hostPort+extended-resource pods), "
             f"affinity-stress {ra['pods_per_sec']:.0f} pods/s at 2k nodes "
             f"and {ra10['pods_per_sec']:.0f} pods/s at 10k nodes "

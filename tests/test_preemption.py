@@ -638,7 +638,14 @@ def test_hybrid_randomized_conformance(monkeypatch):
             for i in range(int(rng.randint(10, 24)))
         ]
         cluster = _cluster(nodes, pods=bound)
-        apps = [_app("a", pods)]
+        # seeds 0,3: one app; others: two apps (the second app's
+        # dispatch sees whatever _min_prio the first committed — the
+        # cross-app escape semantics, r4 priority-scan engine)
+        if seed % 3 == 0:
+            apps = [_app("a", pods)]
+        else:
+            cut = len(pods) // 2
+            apps = [_app("a", pods[:cut]), _app("b", pods[cut:])]
         serial = simulate(cluster, apps, engine="oracle")
         tpu = simulate(cluster, apps, engine="tpu")
 

@@ -520,6 +520,56 @@ def test_priority_scan_zero_pod_escapes_to_preempt_negative(monkeypatch):
     assert _summary(serial) == _summary(tpu)
 
 
+def test_priority_scan_escapes_respect_pdbs(monkeypatch):
+    # PDB-gated victim selection through the escape path: protected
+    # victims survive, the preemptors land where the unprotected
+    # victims were, and the whole run matches the serial oracle
+    from open_simulator_tpu.scheduler import core as core_mod
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    nodes = [make_fake_node(f"node-{i}", "1", "4Gi") for i in range(4)]
+    victims = []
+    for i in range(4):
+        app = "web" if i < 2 else "batch"
+        v = make_fake_pod(
+            f"victim-{i}", "default", "800m", "1Gi", with_labels({"app": app})
+        )
+        v["spec"]["nodeName"] = f"node-{i}"
+        victims.append(v)
+    pdb = {
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": "web-pdb", "namespace": "default"},
+        "spec": {"minAvailable": 2, "selector": {"matchLabels": {"app": "web"}}},
+    }
+    preemptors = [
+        make_fake_pod(f"pre-{i}", "default", "800m", "1Gi", with_priority(100))
+        for i in range(2)
+    ]
+    zeros = [
+        make_fake_pod(f"zero-{i}", "default", "50m", "8Mi", with_priority(0))
+        for i in range(8)
+    ]
+
+    def build():
+        return (
+            _cluster(nodes, pods=[dict(v, spec=dict(v["spec"])) for v in victims],
+                     pdbs=[pdb]),
+            [_app("a", preemptors + zeros)],
+        )
+
+    cluster, apps = build()
+    serial = simulate(cluster, apps, engine="oracle")
+    cluster, apps = build()
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 4)
+    GLOBAL.reset()
+    tpu = simulate(cluster, apps, engine="tpu")
+    assert GLOBAL.notes.get("engine") == "priority-scan"
+    assert GLOBAL.notes.get("priority-scan-escapes") == 2
+    assert _summary(serial) == _summary(tpu)
+    evicted = {ev.victim["metadata"]["name"] for ev in tpu.preemptions}
+    assert evicted == {"victim-2", "victim-3"}  # the unprotected pair
+
+
 def test_priority_scan_escape_cap_finishes_serially(monkeypatch):
     # past MAX_SCAN_ESCAPES the engine stops rescanning and hands the
     # remainder to the serial oracle in one pass — still exact

@@ -26,8 +26,10 @@ import copy
 import hashlib
 import itertools
 import json
+from typing import Optional
 
 from . import labels as lbl
+from .validation import validate_pod_name
 from ..utils.quantity import q_value
 
 # pkg/type/const.go
@@ -307,8 +309,57 @@ def _set_storage_annotation(pods: list, volume_claim_templates: list):
         pod["metadata"].setdefault("annotations", {})[ANNO_POD_LOCAL_STORAGE] = payload
 
 
-def pod_from_pod(pod: dict) -> dict:
-    return make_valid_pod(pod)
+def pod_from_pod(pod: dict, _interned: Optional[dict] = None) -> dict:
+    """MakeValidPod for a bare Pod resource. With `_interned` (a
+    per-batch dict the caller threads through), raw pods whose content
+    — minus name/generateName — is identical sanitize ONCE and clone
+    like workload-template replicas: shared sanitized spec and labels
+    (content-equal by key construction; the only post-expansion label
+    write stamps the same app-name for every pod), per-pod annotations
+    (the GPU binder writes a per-pod device index) and status (the
+    binder writes phase). A 20k-pod app built from a handful of pod
+    shapes costs a handful of deepcopy+validation passes instead of
+    20k, and the shared spec objects let the encode class-key memo hit
+    by identity (ops/encode.py). Non-JSON-serializable input falls
+    back to the full per-pod path."""
+    if _interned is None:
+        return make_valid_pod(pod)
+    meta = pod.get("metadata") or {}
+    try:
+        # everything except metadata.name participates in the key, so a
+        # clone can only differ from its first by name — generateName,
+        # apiVersion/kind, status etc. are all shared content
+        key = json.dumps(
+            {
+                "metadata": {k: v for k, v in meta.items() if k != "name"},
+                "rest": {k: v for k, v in pod.items() if k != "metadata"},
+            },
+            sort_keys=True,
+        )
+    except (TypeError, ValueError):
+        return make_valid_pod(pod)
+    first = _interned.get(key)
+    if first is None:
+        _interned[key] = first = make_valid_pod(pod)
+        return first
+    fmeta = first["metadata"]
+    clone_meta = dict(fmeta)
+    clone_meta["name"] = meta.get("name", "")
+    clone_meta["annotations"] = dict(fmeta.get("annotations") or {})
+    clone = {
+        k: v for k, v in first.items() if k not in ("metadata", "spec", "status")
+    }
+    clone["metadata"] = clone_meta
+    clone["spec"] = dict(first["spec"])
+    if "status" in first:
+        clone["status"] = copy.deepcopy(first["status"])
+    if clone_meta.get("name") or not clone_meta.get("generateName"):
+        # name present: format-validate it; name AND generateName both
+        # absent: raise the same required error the full path would.
+        # generateName-only clones skip: their generateName is part of
+        # the intern key, so the first's full validation covered it
+        validate_pod_name(clone)
+    return clone
 
 
 # ------------------------------------------------------------------ daemonset
@@ -376,8 +427,9 @@ def pods_from_daemon_set(ds: dict, nodes: list) -> list:
 def pods_excluding_daemon_sets(resources) -> list:
     """GetValidPodExcludeDaemonSet (pkg/simulator/utils.go:76-136)."""
     pods = []
+    interned: dict = {}
     for p in resources.pods:
-        pods.append(pod_from_pod(p))
+        pods.append(pod_from_pod(p, _interned=interned))
     for d in resources.deployments:
         pods.extend(pods_from_deployment(d))
     for rs in resources.replica_sets:

@@ -124,3 +124,78 @@ def test_daemonset_tolerations_allow_tainted_node():
         "spec": {"taints": [{"key": "dedicated", "effect": "NoSchedule"}]},
     }
     assert len(wl.pods_from_daemon_set(ds, [tainted])) == 1
+
+
+# ------------------------------------------------- raw-pod content interning
+
+
+def _raw_pod(name=None, generate_name=None, cpu="250m", extra=None):
+    p = {
+        "metadata": {"namespace": "default"},
+        "spec": {
+            "containers": [
+                {"name": "c", "image": "i", "resources": {"requests": {"cpu": cpu}}}
+            ]
+        },
+    }
+    if name:
+        p["metadata"]["name"] = name
+    if generate_name:
+        p["metadata"]["generateName"] = generate_name
+    if extra:
+        p.update(extra)
+    return p
+
+
+def test_raw_pod_interning_shares_spec_but_not_annotations():
+    from open_simulator_tpu.models.decode import ResourceTypes
+
+    res = ResourceTypes(pods=[_raw_pod(f"p-{i}") for i in range(4)])
+    pods = wl.pods_excluding_daemon_sets(res)
+    assert [p["metadata"]["name"] for p in pods] == [f"p-{i}" for i in range(4)]
+    # spec content shared by identity (the encode class-key memo relies
+    # on it), annotations per-pod (the GPU binder mutates them)
+    assert pods[1]["spec"]["containers"] is pods[0]["spec"]["containers"]
+    assert pods[1]["metadata"]["annotations"] is not pods[0]["metadata"]["annotations"]
+    # top-level spec dict is per-pod: a bind's nodeName write must not leak
+    pods[1]["spec"]["nodeName"] = "n1"
+    assert "nodeName" not in pods[0]["spec"]
+    assert "nodeName" not in pods[2]["spec"]
+
+
+def test_raw_pod_interning_generate_name_only():
+    from open_simulator_tpu.models.decode import ResourceTypes
+
+    res = ResourceTypes(
+        pods=[_raw_pod(generate_name="web-"), _raw_pod(generate_name="web-")]
+    )
+    pods = wl.pods_excluding_daemon_sets(res)
+    assert len(pods) == 2
+    for p in pods:
+        assert p["metadata"]["generateName"] == "web-"
+
+
+def test_raw_pod_interning_keys_on_all_top_level_fields():
+    from open_simulator_tpu.models.decode import ResourceTypes
+
+    a = _raw_pod("a")
+    b = _raw_pod("b", extra={"apiVersion": "v1", "kind": "Pod"})
+    res = ResourceTypes(pods=[a, b])
+    pods = wl.pods_excluding_daemon_sets(res)
+    by_name = {p["metadata"]["name"]: p for p in pods}
+    # differing top-level fields -> different intern groups; b keeps its own
+    assert by_name["b"].get("kind") == "Pod"
+    assert "kind" not in by_name["a"]
+
+
+def test_raw_pod_interning_rejects_nameless_duplicates():
+    import pytest as _pytest
+
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.models.validation import InputError
+
+    named = _raw_pod("ok")
+    nameless = _raw_pod()  # no name, no generateName
+    res = ResourceTypes(pods=[named, nameless])
+    with _pytest.raises(InputError):
+        wl.pods_excluding_daemon_sets(res)

@@ -116,3 +116,47 @@ def test_open_local_storage_allocation():
     ]
     if lvm_pods:
         assert requested > 0
+
+
+def test_failed_pods_are_never_retried():
+    """The reference's scheduling queue has backoff + an unschedulableQ
+    flush (vendor scheduling_queue.go:109-141), but its simulator
+    DELETES a failed pod from the fake cluster and collects it
+    (simulator.go:231-240) — a failed pod never re-enters the queue,
+    so the backoff machinery is unobservable. Pinned falsifiably on
+    both engines: after too-big fails, a later app's preemption FREES
+    enough capacity for it (asserted below), so an engine that
+    re-queued failures would place it and break this test."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.models.requests import pod_request_summary
+    from open_simulator_tpu.testing import make_fake_node, make_fake_pod, with_priority
+
+    def build():
+        nodes = [make_fake_node("n-0", "2", "8Gi")]
+        blocker = make_fake_pod("blocker", "default", "1900m", "1Gi")
+        blocker["spec"]["nodeName"] = "n-0"
+        too_big = make_fake_pod("too-big", "default", "1500m", "1Gi")
+        pre = make_fake_pod("pre", "default", "200m", "256Mi", with_priority(100))
+        cluster = ResourceTypes(nodes=nodes, pods=[blocker])
+        # app "a" fails too-big against the blocked node; app "b"'s
+        # preemptor then evicts the blocker, leaving 1800m free — more
+        # than too-big's 1500m ask
+        return cluster, [
+            AppResource("a", ResourceTypes(pods=[too_big])),
+            AppResource("b", ResourceTypes(pods=[pre])),
+        ]
+
+    for engine in ("oracle", "tpu"):
+        cluster, apps = build()
+        res = simulate(cluster, apps, engine=engine)
+        failed = sorted(u.pod["metadata"]["name"] for u in res.unscheduled_pods)
+        # blocker was evicted and could not re-place; too-big stays
+        # failed even though the end state would fit it
+        assert failed == ["blocker", "too-big"], engine
+        assert [ev.victim["metadata"]["name"] for ev in res.preemptions] == [
+            "blocker"
+        ], engine
+        (status,) = res.node_status
+        used = sum(pod_request_summary(p).mcpu for p in status.pods)
+        free_mcpu = 2000 - used
+        assert free_mcpu >= 1500, (engine, free_mcpu)  # the bait is real

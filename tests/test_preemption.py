@@ -570,6 +570,48 @@ def test_priority_scan_escapes_respect_pdbs(monkeypatch):
     assert evicted == {"victim-2", "victim-3"}  # the unprotected pair
 
 
+def test_priority_scan_never_policy_fails_in_scan_without_escape(monkeypatch):
+    # a preemptionPolicy=Never pod that fails stays IN-SCAN (the escape
+    # predicate mirrors run_preemption's policy gate): no serial
+    # round-trip, and the failure matches the serial cycle exactly
+    from open_simulator_tpu.scheduler import core as core_mod
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    nodes = [make_fake_node(f"node-{i}", "1", "4Gi") for i in range(2)]
+    victims = []
+    for i in range(2):
+        v = make_fake_pod(f"victim-{i}", "default", "800m", "1Gi")
+        v["spec"]["nodeName"] = f"node-{i}"
+        victims.append(v)
+    polite = make_fake_pod(
+        "polite", "default", "800m", "1Gi",
+        with_priority(300), with_preemption_policy("Never"),
+    )
+    zeros = [
+        make_fake_pod(f"zero-{i}", "default", "50m", "8Mi", with_priority(0))
+        for i in range(6)
+    ]
+
+    def build():
+        return (
+            _cluster(nodes, pods=[dict(v, spec=dict(v["spec"])) for v in victims]),
+            [_app("a", [polite] + zeros)],
+        )
+
+    cluster, apps = build()
+    serial = simulate(cluster, apps, engine="oracle")
+    cluster, apps = build()
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 4)
+    GLOBAL.reset()
+    tpu = simulate(cluster, apps, engine="tpu")
+    assert GLOBAL.notes.get("engine") == "priority-scan"
+    assert GLOBAL.notes.get("priority-scan-escapes") == 0
+    assert GLOBAL.notes.get("priority-scan-rounds") == 1
+    assert not tpu.preemptions
+    assert [u.pod["metadata"]["name"] for u in tpu.unscheduled_pods] == ["polite"]
+    assert _summary(serial) == _summary(tpu)
+
+
 def test_priority_scan_escape_cap_finishes_serially(monkeypatch):
     # past MAX_SCAN_ESCAPES the engine stops rescanning and hands the
     # remainder to the serial oracle in one pass — still exact
@@ -671,23 +713,50 @@ def test_hybrid_randomized_conformance(monkeypatch):
             )
             p["spec"]["nodeName"] = f"node-{int(rng.randint(0, n_nodes))}"
             bound.append(p)
-        # sparse flavor (~43% priority) on even seeds, DENSE flavor
-        # (every pod priority-bearing, incl. negatives) on odd seeds —
-        # the round-4 priority-scan engine must match serial on both
+        # sparse flavor (~60% priority-bearing: 30% via PriorityClass
+        # + the pool's 3-of-7 non-zero) on even seeds, DENSE flavor
+        # (every pool draw non-zero) on odd seeds — the round-4
+        # priority-scan engine must match serial on both
         prio_pool = (
             [0, 0, 0, 0, 100, 50, -5]
             if seed % 2 == 0
             else [1000, 500, 100, 50, 10, 1, -5, -100]
         )
-        pods = [
-            make_fake_pod(
-                f"p-{i:02d}", "default", f"{int(rng.choice([200, 500, 900]))}m",
-                "256Mi",
-                with_priority(int(rng.choice(prio_pool))),
-            )
-            for i in range(int(rng.randint(10, 24)))
+        # priority CLASSES exercise the resolver + the escape
+        # predicate's preemptionPolicy gate (a Never pod must fail
+        # in-scan exactly like the serial cycle records it)
+        priority_classes = [
+            {
+                "kind": "PriorityClass",
+                "metadata": {"name": "crit"},
+                "value": 700,
+            },
+            {
+                "kind": "PriorityClass",
+                "metadata": {"name": "polite"},
+                "value": 300,
+                "preemptionPolicy": "Never",
+            },
         ]
-        cluster = _cluster(nodes, pods=bound)
+
+        def make(i):
+            opts = []
+            r = rng.rand()
+            if r < 0.15:
+                opts.append(with_priority_class("crit"))
+            elif r < 0.3:
+                opts.append(with_priority_class("polite"))
+            else:
+                opts.append(with_priority(int(rng.choice(prio_pool))))
+                if rng.rand() < 0.15:
+                    opts.append(with_preemption_policy("Never"))
+            return make_fake_pod(
+                f"p-{i:02d}", "default", f"{int(rng.choice([200, 500, 900]))}m",
+                "256Mi", *opts,
+            )
+
+        pods = [make(i) for i in range(int(rng.randint(10, 24)))]
+        cluster = _cluster(nodes, pods=bound, priority_classes=priority_classes)
         # seeds 0,3: one app; others: two apps (the second app's
         # dispatch sees whatever _min_prio the first committed — the
         # cross-app escape semantics, r4 priority-scan engine)
@@ -699,14 +768,7 @@ def test_hybrid_randomized_conformance(monkeypatch):
         serial = simulate(cluster, apps, engine="oracle")
         tpu = simulate(cluster, apps, engine="tpu")
 
-        def summary(res):
-            return (
-                _placement(res),
-                sorted(u.pod["metadata"]["name"] for u in res.unscheduled_pods),
-                sorted(ev.victim["metadata"]["name"] for ev in res.preemptions),
-            )
-
-        assert summary(serial) == summary(tpu), f"seed {seed}"
+        assert _summary(serial) == _summary(tpu), f"seed {seed}"
 
 
 def test_priority_scan_after_negative_commit_from_earlier_app(monkeypatch):

@@ -357,6 +357,7 @@ class Applier:
         self.use_greed = use_greed
         self.extenders = []
         self.score_weights = None  # None = default profile weights
+        self.enable_preemption = True
         self.last_cluster = None
         if scheduler_config:
             # full KubeSchedulerConfiguration: extenders + score-plugin
@@ -366,6 +367,7 @@ class Applier:
             cfg = load_scheduler_config(scheduler_config)
             self.extenders = cfg.extenders
             self.score_weights = cfg.score_weights
+            self.enable_preemption = cfg.enable_preemption
             if self.extenders:
                 # extenders are host RPC per pod: no batched sweep
                 self.use_sweep = False
@@ -413,6 +415,7 @@ class Applier:
             use_greed=self.use_greed,
             extenders=self.extenders,
             score_weights=self.score_weights,
+            enable_preemption=self.enable_preemption,
         )
 
     def run(self, select_apps=None) -> ApplyResult:

@@ -101,12 +101,17 @@ class Simulator:
         extenders=None,
         score_weights=None,
         select_host: str = "first-max",
+        enable_preemption: bool = True,
     ):
         self.engine_kind = engine
         self.use_greed = use_greed
         # KubeSchedulerConfiguration score-plugin weights
         # (scheduler/schedconfig.py); None = default profile
         self.score_weights = score_weights
+        # KubeSchedulerConfiguration postFilter set: disabling
+        # DefaultPreemption turns the preemption stage off everywhere
+        # (the priority-scan escape predicate reads the same flag)
+        self.enable_preemption = enable_preemption
         # selectHost tie rule (oracle.py module docstring): "sample"
         # consumes a host RNG per tie, so it forces the serial path
         self.select_host = select_host
@@ -129,6 +134,7 @@ class Simulator:
             priority_classes=cluster.priority_classes,
             score_weights=self.score_weights,
             select_host=self.select_host,
+            enable_preemption=self.enable_preemption,
         )
         pods = wl.pods_excluding_daemon_sets(cluster)
         for ds in cluster.daemon_sets:
@@ -445,6 +451,7 @@ def simulate(
     extenders=None,
     score_weights=None,
     select_host: str = "first-max",
+    enable_preemption: bool = True,
 ) -> SimulateResult:
     """One-shot simulation (core.go:64-103)."""
     sim = Simulator(
@@ -453,6 +460,7 @@ def simulate(
         extenders=extenders,
         score_weights=score_weights,
         select_host=select_host,
+        enable_preemption=enable_preemption,
     )
     # NOTE: the identity memos are deliberately NOT cleared here — the
     # planner's serial bisection calls simulate() once per guess over

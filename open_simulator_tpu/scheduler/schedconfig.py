@@ -77,6 +77,9 @@ PLUGIN_FIELDS: Dict[str, str] = {
 class SchedulerConfig:
     score_weights: ScoreWeights = DEFAULT_SCORE_WEIGHTS
     extenders: List = field(default_factory=list)
+    # postFilter plugin set: disabling DefaultPreemption (or "*")
+    # turns the preemption stage off in both engines
+    enable_preemption: bool = True
 
 
 def _apply_score_set(plugins_score: dict, base: ScoreWeights) -> ScoreWeights:
@@ -156,6 +159,21 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
             )
         score = (profile.get("plugins") or {}).get("score") or {}
         cfg.score_weights = _apply_score_set(score, cfg.score_weights)
+        post = (profile.get("plugins") or {}).get("postFilter") or {}
+        for entry in post.get("disabled") or []:
+            name = (entry or {}).get("name", "")
+            if name in ("*", "DefaultPreemption"):
+                # the default profile's only PostFilter plugin
+                # (algorithmprovider/registry.go:106-109)
+                cfg.enable_preemption = False
+            # unknown disabled names are ignored, like upstream
+        for entry in post.get("enabled") or []:
+            name = (entry or {}).get("name", "")
+            if name != "DefaultPreemption":
+                raise ValueError(
+                    f"unknown postFilter plugin {name!r} in enabled set"
+                )
+            cfg.enable_preemption = True
 
     from .extender import extenders_from_config_doc
 

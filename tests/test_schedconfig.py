@@ -320,3 +320,73 @@ def test_load_invalid_content_mentions_path(tmp_path):
     path.write_text("kind: KubeSchedulerConfiguration\npercentageOfNodesToScore: 101\n")
     with pytest.raises(ValueError, match=str(path)):
         load_scheduler_config(str(path))
+
+
+def test_post_filter_disable_turns_preemption_off(monkeypatch):
+    """profiles[].plugins.postFilter.disabled: [DefaultPreemption] (or
+    "*") switches the preemption stage off — the default profile's only
+    PostFilter plugin (algorithmprovider/registry.go:106-109) — and the
+    flag reaches BOTH engines (the priority-scan escape predicate reads
+    it)."""
+    from open_simulator_tpu.testing import with_priority
+
+    doc = {
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [
+            {"plugins": {"postFilter": {"disabled": [{"name": "DefaultPreemption"}]}}}
+        ],
+    }
+    cfg = parse_scheduler_config(doc)
+    assert cfg.enable_preemption is False
+    star = parse_scheduler_config(
+        {
+            "kind": "KubeSchedulerConfiguration",
+            "profiles": [{"plugins": {"postFilter": {"disabled": [{"name": "*"}]}}}],
+        }
+    )
+    assert star.enable_preemption is False
+    # unknown enabled postFilter plugins are a startup error, like the
+    # reference's unregistered-plugin failure
+    with pytest.raises(ValueError, match="postFilter"):
+        parse_scheduler_config(
+            {
+                "kind": "KubeSchedulerConfiguration",
+                "profiles": [
+                    {"plugins": {"postFilter": {"enabled": [{"name": "Nope"}]}}}
+                ],
+            }
+        )
+
+    def build():
+        nodes = [make_fake_node("n-0", "1", "4Gi")]
+        victim = make_fake_pod("victim", "default", "800m", "1Gi")
+        victim["spec"]["nodeName"] = "n-0"
+        pre = make_fake_pod("pre", "default", "800m", "1Gi", with_priority(100))
+        bulk = [
+            make_fake_pod(f"z-{i}", "default", "20m", "8Mi") for i in range(6)
+        ]
+        return (
+            ResourceTypes(nodes=nodes, pods=[victim]),
+            [AppResource("a", ResourceTypes(pods=[pre] + bulk))],
+        )
+
+    from open_simulator_tpu.scheduler import core as core_mod
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 4)
+    for engine in ("oracle", "tpu"):
+        cluster, apps = build()
+        GLOBAL.reset()
+        res = simulate(
+            cluster, apps, engine=engine, enable_preemption=cfg.enable_preemption
+        )
+        if engine == "tpu":
+            # the batch rode the scan and the failing priority pod did
+            # NOT escape: with preemption off the serial cycle would
+            # just record the failure too
+            assert GLOBAL.notes.get("engine") == "priority-scan"
+            assert GLOBAL.notes.get("priority-scan-escapes") == 0
+        assert not res.preemptions, engine
+        assert [u.pod["metadata"]["name"] for u in res.unscheduled_pods] == [
+            "pre"
+        ], engine

@@ -18,10 +18,13 @@ reference the CLI flag is dead (never forwarded to Simulate; SURVEY.md
   then pinned to 100 exactly as utils.go:278 does — values other than
   100 are rejected loudly instead of silently un-pinned, because every
   engine here scores all nodes
-- filter/reserve/bind plugin sets stay fixed: the simulator owns them
-  (utils.go:241-277 rebuilds them unconditionally), so only score
-  customization is honored; pluginConfig args are not consumed by any
-  in-tree plugin the simulator registers
+- score and postFilter are the customizable plugin sets (postFilter
+  disables turn DefaultPreemption off in both engines); any OTHER set
+  carrying enable/disable entries is rejected loudly — the simulator
+  owns filter/reserve/bind (utils.go:241-277 rebuilds them
+  unconditionally), and silently ignoring a customization there would
+  return reference-divergent placements; pluginConfig args are not
+  consumed by any in-tree plugin the simulator registers
 
 Score weights flow into both engines: the serial oracle reads the
 mapping directly (oracle._prioritize) and the scan receives them as
@@ -157,9 +160,37 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
                 "scheduler; the simulator runs a single default profile "
                 "(utils.go:226)"
             )
-        score = (profile.get("plugins") or {}).get("score") or {}
+        plugins = profile.get("plugins") or {}
+        if not isinstance(plugins, dict):
+            raise ValueError(
+                f"profile plugins must be a mapping of plugin sets, "
+                f"got {type(plugins).__name__}"
+            )
+        # any plugin set this simulator does not model must fail LOUDLY:
+        # silently ignoring a filter/reserve/bind enable or disable
+        # would return placements that diverge from a reference
+        # scheduler running the same config
+        supported_sets = ("score", "postFilter")
+        for set_name, set_cfg in plugins.items():
+            if set_name in supported_sets:
+                continue
+            if not isinstance(set_cfg, dict):
+                if set_cfg:  # a malformed non-empty set is still a customization
+                    raise ValueError(
+                        f"plugin set {set_name!r} must be a "
+                        "{enabled, disabled} mapping"
+                    )
+                continue
+            if set_cfg.get("enabled") or set_cfg.get("disabled"):
+                raise ValueError(
+                    f"plugin set {set_name!r} enable/disable is not "
+                    "supported by the simulator (score and postFilter "
+                    "are); remove it or expect reference-divergent "
+                    "placements"
+                )
+        score = plugins.get("score") or {}
         cfg.score_weights = _apply_score_set(score, cfg.score_weights)
-        post = (profile.get("plugins") or {}).get("postFilter") or {}
+        post = plugins.get("postFilter") or {}
         for entry in post.get("disabled") or []:
             name = (entry or {}).get("name", "")
             if name in ("*", "DefaultPreemption"):

@@ -390,3 +390,29 @@ def test_post_filter_disable_turns_preemption_off(monkeypatch):
         assert [u.pod["metadata"]["name"] for u in res.unscheduled_pods] == [
             "pre"
         ], engine
+
+
+def test_unsupported_plugin_sets_are_rejected_loudly():
+    # silently ignoring a filter disable would return placements that
+    # diverge from a reference scheduler running the same config
+    with pytest.raises(ValueError, match="filter"):
+        parse_scheduler_config(
+            {
+                "kind": "KubeSchedulerConfiguration",
+                "profiles": [
+                    {
+                        "plugins": {
+                            "filter": {"disabled": [{"name": "NodeAffinity"}]}
+                        }
+                    }
+                ],
+            }
+        )
+    # empty sets (a config that merely mentions the key) stay valid
+    cfg = parse_scheduler_config(
+        {
+            "kind": "KubeSchedulerConfiguration",
+            "profiles": [{"plugins": {"filter": {}, "bind": {"enabled": []}}}],
+        }
+    )
+    assert cfg.enable_preemption is True

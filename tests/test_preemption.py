@@ -698,7 +698,7 @@ def test_hybrid_randomized_conformance(monkeypatch):
     from open_simulator_tpu.scheduler import core as core_mod
 
     monkeypatch.setattr(core_mod, "MIN_SCAN_RUN", 3)
-    for seed in range(5):
+    for seed in range(8):
         rng = np.random.RandomState(seed)
         n_nodes = int(rng.randint(3, 7))
         nodes = [

@@ -489,8 +489,36 @@ def run_conformance_fuzz(n_nodes=1000, n_pods=2000, seed=0) -> dict:
             f"placements differ (first at pods {idx.tolist()}: "
             f"kernel={place_k[idx].tolist()} xla={place_x[idx].tolist()})"
         )
+    # third flavor: the STREAMED term layout (HBM state + per-pod row
+    # gather — what the kernel auto-selects past the VMEM cliff),
+    # force-built on the same scenario so the compiled DMA path gets
+    # the same every-bench hardware check as the resident kernel
+    prev_force = pallas_scan.STREAM_FORCE
+    pallas_scan.STREAM_FORCE = True
+    try:
+        plan_s = pallas_scan.build_plan(cluster, batch, dyn, features)
+        if plan_s is None or not plan_s.terms.cfg.stream:
+            raise AssertionError(
+                "conformance fuzz could not build the streamed plan: "
+                f"{pallas_scan.last_reject() or 'rejected'}"
+            )
+        place_s, _ = pallas_scan.run_scan_pallas(
+            plan_s, batch.class_of_pod, ones_p, ones_n,
+            pinned=batch.pinned_node,
+        )
+    finally:
+        pallas_scan.STREAM_FORCE = prev_force
+    place_s = np.where(np.asarray(place_s) < 0, -1, place_s)
+    mism_s = int((place_s != place_x).sum())
+    if mism_s:
+        idx = np.nonzero(place_s != place_x)[0][:5]
+        raise AssertionError(
+            f"streamed-terms conformance fuzz FAILED: {mism_s} of "
+            f"{len(pods)} placements differ (first at pods {idx.tolist()}: "
+            f"stream={place_s[idx].tolist()} xla={place_x[idx].tolist()})"
+        )
     gpu = _gpu_conformance_fuzz(seed)
-    return {"checked": len(pods) + gpu["checked"], "mismatches": 0}
+    return {"checked": 2 * len(pods) + gpu["checked"], "mismatches": 0}
 
 
 def _gpu_conformance_fuzz(seed=0, n_nodes=500, n_pods=1500) -> dict:
@@ -862,7 +890,7 @@ def _scan_rate(nodes, pods, label: str) -> dict:
                 pinned=batch.pinned_node,
             )
         )
-        label += "/pallas"
+        label += "/" + pallas_scan.kernel_label(plan)
     else:
         static = to_scan_static(cluster, batch)
         init = to_scan_state(dyn, batch)
@@ -954,6 +982,19 @@ def main():
         out = {
             "metric": f"pods scheduled/sec at {r['nodes']} nodes "
             f"(affinity-stress scenario, {r['label']}, {r['scheduled']}/{r['total']} placed)",
+            "value": round(r["pods_per_sec"], 1),
+            "unit": "pods/s",
+            "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
+        }
+    elif scenario == "affinity-25k":
+        # past the ~12.3k-node resident VMEM cliff: auto-routes to the
+        # streamed-terms kernel (HBM state + per-pod row gather)
+        nodes, pods = build_affinity_scenario(n_nodes=25_000, replicas=100)
+        r = _scan_rate(nodes, pods, "affinity-25k")
+        out = {
+            "metric": f"pods scheduled/sec at {r['nodes']} nodes "
+            f"(affinity-stress past the VMEM cliff, {r['label']}, "
+            f"{r['scheduled']}/{r['total']} placed)",
             "value": round(r["pods_per_sec"], 1),
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
@@ -1077,6 +1118,8 @@ def main():
         ra = isolated(_scan_rate, nodes, pods, "affinity")
         nodes, pods = build_affinity_scenario(n_nodes=10_000, replicas=100)
         ra10 = isolated(_scan_rate, nodes, pods, "affinity-10k")
+        nodes, pods = build_affinity_scenario(n_nodes=25_000, replicas=100)
+        ra25 = isolated(_scan_rate, nodes, pods, "affinity-25k")
         nodes, pods = build_scenario(port_frac=0.01, scalar_frac=0.01)
         rm = isolated(_scan_rate, nodes, pods, "mixed")
         nodes, pods = build_gpushare_scenario()
@@ -1095,9 +1138,11 @@ def main():
             f"max {c['spread']['max_s']:.2f}s; "
             f"also: default scan {rd['pods_per_sec']:.0f} pods/s at 10k nodes ({rd['label']}) "
             f"({rm['pods_per_sec']:.0f} with 1% hostPort+extended-resource pods), "
-            f"affinity-stress {ra['pods_per_sec']:.0f} pods/s at 2k nodes "
-            f"and {ra10['pods_per_sec']:.0f} pods/s at 10k nodes "
-            f"(min-max {ra10['spread']['min_s']:.2f}-{ra10['spread']['max_s']:.2f}s), "
+            f"affinity-stress {ra['pods_per_sec']:.0f} pods/s at 2k nodes, "
+            f"{ra10['pods_per_sec']:.0f} pods/s at 10k nodes "
+            f"(min-max {ra10['spread']['min_s']:.2f}-{ra10['spread']['max_s']:.2f}s) "
+            f"and {ra25['pods_per_sec']:.0f} pods/s at 25k nodes past the "
+            f"VMEM cliff ({ra25['label']}), "
             f"gpushare {rg['pods_per_sec']:.0f} pods/s at {rg['nodes']} 8-GPU nodes, "
             f"open-local storage {rs['pods_per_sec']:.0f} pods/s at {rs['nodes']} "
             f"2-VG nodes ({rs['label']}), "

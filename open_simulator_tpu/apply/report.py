@@ -56,10 +56,22 @@ def node_state_index(oracle):
 def matched_node_state(by_node, status):
     """The NodeState backing `status`, or None when the fast path is
     unsound for it. Identity match proves the status was built from
-    this oracle's node; the pod-count check guards against a status
-    whose pod list was filtered or extended after the fact."""
+    this oracle's node; the pod-list check guards against a status
+    whose pod list was filtered or extended after the fact — length
+    alone would accept a same-length rewrite, so the endpoints must
+    also be the very same pod objects."""
     state = by_node.get(id(status.node))
-    if state is not None and len(state.pods) == len(status.pods):
+    if (
+        state is not None
+        and len(state.pods) == len(status.pods)
+        and (
+            not state.pods
+            or (
+                state.pods[0] is status.pods[0]
+                and state.pods[-1] is status.pods[-1]
+            )
+        )
+    ):
         return state
     return None
 

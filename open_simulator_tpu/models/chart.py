@@ -1074,23 +1074,13 @@ def _collect_charts(
             sub_path = os.path.join(charts_dir, entry)
             if os.path.isfile(sub_path) and entry.endswith((".tgz", ".tar.gz")):
                 # packaged dependency: the dependency key is the chart's
-                # metadata name (helm matches deps by name, the archive
-                # filename carries name-version). Cheap pre-check before
-                # extracting: a loaded name followed by "-X.Y.Z" and
-                # nothing else means this archive duplicates an unpacked
-                # sibling. Only the BARE three-part version is skipped:
-                # a digit-leading chart name ("app-2048") fails the
-                # fullmatch, and a prerelease/build tail is ambiguous
-                # (chart "childa" at 1.2.3-1.0.0 vs chart "childa-1.2.3"
-                # at 1.0.0), so those fall through to extraction and the
-                # metadata-name dedup below
-                base = entry[: entry.rindex(".tgz" if entry.endswith(".tgz") else ".tar.gz")]
-                if any(
-                    base.startswith(s + "-")
-                    and re.fullmatch(r"\d+\.\d+\.\d+", base[len(s) + 1 :])
-                    for s in seen_entries
-                ):
-                    continue
+                # metadata name (helm matches deps by name; the archive
+                # filename only carries name-version by convention, so
+                # dedup must come from the extracted Chart.yaml below,
+                # never from the filename — an archive hand-renamed to
+                # '<seen-chart>-X.Y.Z.tgz' may contain a different
+                # chart). Extraction of a duplicate is cheap: the
+                # archive cache keys on (path, mtime).
                 sub_path = _unpack_chart_archive(sub_path)
                 if sub_path is None:
                     continue

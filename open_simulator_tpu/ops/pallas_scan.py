@@ -105,6 +105,12 @@ class TermsCfg(NamedTuple):
     has_ipa: bool
     has_hard: bool
     has_soft: bool
+    # node-axis streaming (term state in HBM, per-pod row gather):
+    # the three fields below are 0/False on resident plans
+    stream: bool = False
+    kmax: int = 0  # per-class gather slots (max distinct rows fetched)
+    wmax: int = 0  # per-class write-back slots (max dirty rows)
+    srows: int = 0  # rows of the unified HBM state buffer
 
 
 class TermsPlan(NamedTuple):
@@ -303,7 +309,11 @@ def _pad_stack(tab: np.ndarray, r: int, fill=0) -> np.ndarray:
 # slot-count caps keep the kernel's static unrolled loops small; a batch
 # beyond them falls back to the XLA scan
 _MAX_SLOTS = dict(rmax=8, gmax=4, hmax=4, smax=4, a=8, gn=8, vs=32,
-                  cmax=8, scmax=4)
+                  cmax=8, scmax=4, kmax=64, wmax=32)
+# DMA semaphores the streamed-terms gather round-robins over: enough to
+# keep a pod step's row fetches in flight concurrently without paying a
+# serialized wait per row
+_STREAM_NSEM = 8
 _MAX_COUNT = 1 << 17  # cnt exact-split bound for the soft f64 emulation
 _MAX_T = 512
 # pod classes the term kernel accepts: class-column tables span
@@ -475,9 +485,12 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
 
     # early VMEM pre-gate: the scratch state alone is a lower bound on
     # the final tile count (build_plan re-checks exactly); rejecting
-    # here skips the O(U*T) slot-table construction for hopeless plans
+    # here skips the O(U*T) slot-table construction for hopeless plans.
+    # Only binding when streaming is disabled — a streamed plan keeps
+    # this state in HBM, so over-budget scratch is exactly the case
+    # build_plan's streaming rewrite exists for.
     scratch_tiles = tc_n + 2 * tp_n + 2 * bp_n + t.a
-    if scratch_tiles * r * LANES * 4 > 13 * 2**20:
+    if scratch_tiles * r * LANES * 4 > 13 * 2**20 and STREAM_FORCE is False:
         return _reject("terms: scratch state exceeds VMEM budget")
 
     # -- static dedup --------------------------------------------------
@@ -774,6 +787,12 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
 # ~0.1s/transfer latency floor); on by default, opt out for debugging
 TERMS_DEFAULT_ENABLE = True
 
+# streamed-terms routing: None = auto (stream only when the resident
+# term state exceeds the VMEM budget), True = force streaming for any
+# terms batch (conformance tests / bench A/B), False = never stream
+# (resident-or-XLA, the r4 behavior)
+STREAM_FORCE: Optional[bool] = None
+
 
 def build_plan(cluster, batch, dyn, features, weights=None,
                allow_terms: Optional[bool] = None) -> Optional[PallasPlan]:
@@ -1049,7 +1068,7 @@ def build_plan(cluster, batch, dyn, features, weights=None,
     # VMEM budget (~16MB/core): count the PERSISTENT (R, C) tiles
     # directly from the plan arrays. State-init INPUTS live in ANY
     # (HBM) and are DMAed into scratch, so scratch counts once.
-    tiles = (
+    base_tiles = (
         5  # alloc vectors
         + 6 * 2  # state inputs + output copies
         + 1  # valid
@@ -1062,6 +1081,7 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         + 2 * s_n  # scalar alloc + used scratch
         + pw  # port occupancy planes
     )
+    tiles = base_tiles
     if terms is not None:
         tc_ = terms.cfg
         tiles += (
@@ -1074,8 +1094,33 @@ def build_plan(cluster, batch, dyn, features, weights=None,
             + tc_.tc + 2 * tc_.tp + 2 * tc_.bp + tc_.a
             + (tc_.csn if tc_.has_soft else 0)
         )
-    if tiles * r * LANES * 4 > 13 * 2**20:
-        return _reject("cluster state exceeds VMEM budget")
+    budget = 13 * 2**20
+    rbytes = r * LANES * 4
+    if tiles * rbytes > budget or (STREAM_FORCE and terms is not None):
+        # resident term state does not fit: rewrite to the streamed
+        # layout (state in HBM, per-pod class-local row gather) before
+        # giving up on the fused kernel
+        if terms is None or STREAM_FORCE is False:
+            return _reject("cluster state exceeds VMEM budget")
+        sp = _stream_pack(terms, u, hk_map)
+        if sp is None:
+            return None  # _stream_pack recorded the reject reason
+        stream_bytes = (base_tiles + sp.cfg.kmax) * rbytes + 4 * (
+            sp.g_topo3.size + sp.g_match_au.size
+            + sp.group0.size + sp.gtot0.size
+        )
+        if stream_bytes > budget:
+            return _reject(
+                "cluster state exceeds VMEM budget even with streamed terms"
+            )
+        smem_entries = sum(
+            getattr(sp, nm).size
+            for nm, space in _STREAM_TERM_FIELDS
+            if space == "smem"
+        )
+        if smem_entries > _MAX_SMEM_ENTRIES:
+            return _reject("terms: streamed SMEM slot tables over budget")
+        plan = plan._replace(terms=sp)
     global _LAST_REJECT
     _LAST_REJECT = None
     return plan
@@ -1108,6 +1153,287 @@ _TERM_FIELDS = (
 )
 
 
+class StreamTermsPlan(NamedTuple):
+    """Streamed-terms variant of TermsPlan (cfg.stream=True).
+
+    Past the VMEM budget the resident design cannot hold the term
+    state on-chip, but each pod only ever touches the rows its CLASS's
+    slot tables reference — at most `kmax` distinct (R, C) node
+    vectors. So every T-proportional array (count/pref/bitplane/soft
+    state plus the deduplicated topo/cand/sq/haskeys statics) is
+    concatenated into ONE (S, R, C) HBM buffer, the slot tables are
+    rewritten host-side from array rows to per-class GATHER POSITIONS,
+    and the kernel's pod step DMAs the class's row set into a (Kmax,
+    R, C) VMEM scratch, runs the IDENTICAL eval/commit arithmetic on
+    positions, and DMAs the <= wmax dirty rows back. Per-pod HBM
+    traffic is kmax*R*512B (class-local), independent of the total
+    term count T — the ~12.3k-node VMEM cliff (docs/PERFORMANCE.md)
+    becomes a bandwidth slope instead.
+
+    Only the small required-affinity group machinery (A rows) stays
+    resident, because its eval reads every group row per pod.
+
+    pref and panti share one index in the resident tables (same row of
+    two arrays); in the unified buffer they are different global rows,
+    so this plan carries separate e_panti/c_panti position tables (the
+    resident kernel aliases them to e_pref/c_pref)."""
+
+    cfg: TermsCfg
+    state0: np.ndarray  # (S, R, C) i32 unified init state + statics (ANY)
+    g_topo3: np.ndarray  # (A, R, C) resident group-row topo values
+    g_match_au: np.ndarray  # (A, Ur_p, 128)
+    group0: np.ndarray  # (A, R, C) DMAed to scratch
+    gtot0: np.ndarray  # (A, 8, 128)
+    # SMEM slot tables — same semantics as TermsPlan but values are
+    # gather positions into the (Kmax, R, C) scratch
+    e_cnt: np.ndarray
+    e_pref: np.ndarray
+    e_panti: np.ndarray
+    e_cpd: np.ndarray
+    e_antip: np.ndarray
+    e_antib: np.ndarray
+    e_tposp: np.ndarray
+    e_tposb: np.ndarray
+    gid_u: np.ndarray
+    self_ok_u: np.ndarray
+    slot_grows: np.ndarray
+    h_topo: np.ndarray
+    h_cnt: np.ndarray
+    h_cand: np.ndarray
+    h_skew: np.ndarray
+    h_selfm: np.ndarray
+    s_topo_i: np.ndarray
+    s_ishost: np.ndarray
+    s_cnt: np.ndarray
+    s_nh: np.ndarray
+    s_skewm1: np.ndarray
+    c_topo: np.ndarray
+    c_cnt: np.ndarray
+    c_pref: np.ndarray
+    c_panti: np.ndarray
+    c_m: np.ndarray
+    c_prefc: np.ndarray
+    c_pantic: np.ndarray
+    c_antip: np.ndarray
+    c_antib: np.ndarray
+    c_tposp: np.ndarray
+    c_tposb: np.ndarray
+    sc_nh: np.ndarray
+    sc_topo: np.ndarray
+    sc_q: np.ndarray
+    sc_m: np.ndarray
+    w_hi: np.ndarray
+    w_lo: np.ndarray
+    w_h1: np.ndarray
+    w_h2: np.ndarray
+    # streaming tables: per-class gather row ids (-1 = unused slot),
+    # write-back (scratch position, global row) pairs (-1 = inactive),
+    # per-class haskeys gather position
+    gather: np.ndarray  # (U*Kmax,)
+    wb_pos: np.ndarray  # (U*Wmax,)
+    wb_gid: np.ndarray  # (U*Wmax,)
+    hk_pos: np.ndarray  # (U,)
+
+
+_STREAM_TERM_FIELDS = (
+    ("state0", "any"),
+    ("g_topo3", "vmem"), ("g_match_au", "vmem"),
+    ("group0", "any"), ("gtot0", "any"),
+    ("e_cnt", "smem"), ("e_pref", "smem"), ("e_panti", "smem"),
+    ("e_cpd", "smem"), ("e_antip", "smem"), ("e_antib", "smem"),
+    ("e_tposp", "smem"), ("e_tposb", "smem"),
+    ("gid_u", "smem"), ("self_ok_u", "smem"), ("slot_grows", "smem"),
+    ("h_topo", "smem"), ("h_cnt", "smem"), ("h_cand", "smem"),
+    ("h_skew", "smem"), ("h_selfm", "smem"),
+    ("s_topo_i", "smem"), ("s_ishost", "smem"), ("s_cnt", "smem"),
+    ("s_nh", "smem"), ("s_skewm1", "smem"),
+    ("c_topo", "smem"), ("c_cnt", "smem"), ("c_pref", "smem"),
+    ("c_panti", "smem"), ("c_m", "smem"), ("c_prefc", "smem"),
+    ("c_pantic", "smem"), ("c_antip", "smem"), ("c_antib", "smem"),
+    ("c_tposp", "smem"), ("c_tposb", "smem"),
+    ("sc_nh", "smem"), ("sc_topo", "smem"), ("sc_q", "smem"),
+    ("sc_m", "smem"),
+    ("w_hi", "smem"), ("w_lo", "smem"), ("w_h1", "smem"), ("w_h2", "smem"),
+    ("gather", "smem"), ("wb_pos", "smem"), ("wb_gid", "smem"),
+    ("hk_pos", "smem"),
+)
+
+
+def _stream_pack(terms: TermsPlan, u_n: int,
+                 hk_map: Optional[np.ndarray]) -> Optional[StreamTermsPlan]:
+    """Rewrite a resident TermsPlan into the streamed layout (see
+    StreamTermsPlan docstring), or None when a class's row set exceeds
+    the gather/write-back slot caps."""
+    cfg = terms.cfg
+    parts = [terms.tgt0_c, terms.pref0_p, terms.panti0_p, terms.antib0,
+             terms.tposb0, terms.soft0_nh, terms.topo_dist,
+             terms.cand_dist, terms.sq_dist, terms.hk_dist]
+    offs = np.cumsum([0] + [p.shape[0] for p in parts])
+    (b_tgt, b_pref, b_panti, b_anti, b_tpos, b_soft, b_topo, b_cand,
+     b_sq, b_hk) = (int(o) for o in offs[:10])
+    state0 = np.ascontiguousarray(np.concatenate(parts, axis=0))
+
+    def t2(name, m):
+        return np.asarray(getattr(terms, name)).reshape(u_n, m).copy()
+
+    e_cnt = t2("e_cnt", cfg.rmax)
+    e_pref = t2("e_pref", cfg.rmax)
+    e_antip = t2("e_antip", cfg.rmax)
+    e_antib = t2("e_antib", cfg.rmax)
+    e_tposp = t2("e_tposp", cfg.rmax)
+    e_tposb = t2("e_tposb", cfg.rmax)
+    h_topo = t2("h_topo", cfg.hmax)
+    h_cnt = t2("h_cnt", cfg.hmax)
+    h_cand = t2("h_cand", cfg.hmax)
+    s_topo_i = t2("s_topo_i", cfg.smax)
+    s_cnt = t2("s_cnt", cfg.smax)
+    s_nh = t2("s_nh", cfg.smax)
+    c_topo = t2("c_topo", cfg.cmax)
+    c_cnt = t2("c_cnt", cfg.cmax)
+    c_pref = t2("c_pref", cfg.cmax)
+    c_antip = t2("c_antip", cfg.cmax)
+    c_antib = t2("c_antib", cfg.cmax)
+    c_tposp = t2("c_tposp", cfg.cmax)
+    c_tposb = t2("c_tposb", cfg.cmax)
+    sc_nh = t2("sc_nh", cfg.scmax)
+    sc_topo = t2("sc_topo", cfg.scmax)
+    sc_q = t2("sc_q", cfg.scmax)
+    n_panti = np.full((u_n, cfg.rmax), -1, dtype=np.int32)
+    nc_panti = np.full((u_n, cfg.cmax), -1, dtype=np.int32)
+    hk_pos = np.zeros(u_n, dtype=np.int32)
+
+    glists: list = []
+    wlists: list = []
+    for u_i in range(u_n):
+        pos: dict = {}
+
+        def g(gid: int) -> int:
+            p = pos.get(gid)
+            if p is None:
+                p = len(pos)
+                pos[gid] = p
+            return p
+
+        for k in range(cfg.rmax):
+            if e_cnt[u_i, k] >= 0:
+                e_cnt[u_i, k] = g(b_tgt + e_cnt[u_i, k])
+            if e_pref[u_i, k] >= 0:
+                row = int(e_pref[u_i, k])
+                e_pref[u_i, k] = g(b_pref + row)
+                n_panti[u_i, k] = g(b_panti + row)
+            e_antip[u_i, k] = (
+                g(b_anti + e_antip[u_i, k]) if e_antib[u_i, k] != 0 else 0
+            )
+            e_tposp[u_i, k] = (
+                g(b_tpos + e_tposp[u_i, k]) if e_tposb[u_i, k] != 0 else 0
+            )
+        for k in range(cfg.hmax):
+            if h_topo[u_i, k] >= 0:
+                h_topo[u_i, k] = g(b_topo + h_topo[u_i, k])
+                h_cnt[u_i, k] = g(b_tgt + h_cnt[u_i, k])
+                h_cand[u_i, k] = g(b_cand + h_cand[u_i, k])
+        for k in range(cfg.smax):
+            if s_topo_i[u_i, k] >= 0:
+                s_topo_i[u_i, k] = g(b_topo + s_topo_i[u_i, k])
+                if s_cnt[u_i, k] >= 0:
+                    s_cnt[u_i, k] = g(b_tgt + s_cnt[u_i, k])
+                if s_nh[u_i, k] >= 0:
+                    s_nh[u_i, k] = g(b_soft + s_nh[u_i, k])
+        if cfg.has_soft and hk_map is not None:
+            hk_pos[u_i] = g(b_hk + int(hk_map[u_i]))
+        # write-backs: every position a commit slot mutates
+        wb: "OrderedDict" = OrderedDict()
+        for j in range(cfg.cmax):
+            if c_topo[u_i, j] >= 0:
+                c_topo[u_i, j] = g(b_topo + c_topo[u_i, j])
+            if c_cnt[u_i, j] >= 0:
+                gid = b_tgt + int(c_cnt[u_i, j])
+                p = g(gid)
+                c_cnt[u_i, j] = p
+                wb.setdefault(p, gid)
+            if c_pref[u_i, j] >= 0:
+                row = int(c_pref[u_i, j])
+                gp, ga = b_pref + row, b_panti + row
+                c_pref[u_i, j] = g(gp)
+                nc_panti[u_i, j] = g(ga)
+                wb.setdefault(g(gp), gp)
+                wb.setdefault(g(ga), ga)
+            if c_antib[u_i, j] != 0:
+                gid = b_anti + int(c_antip[u_i, j])
+                c_antip[u_i, j] = g(gid)
+                wb.setdefault(g(gid), gid)
+            else:
+                c_antip[u_i, j] = 0
+            if c_tposb[u_i, j] != 0:
+                gid = b_tpos + int(c_tposp[u_i, j])
+                c_tposp[u_i, j] = g(gid)
+                wb.setdefault(g(gid), gid)
+            else:
+                c_tposp[u_i, j] = 0
+        for j in range(cfg.scmax):
+            if sc_nh[u_i, j] >= 0:
+                gid = b_soft + int(sc_nh[u_i, j])
+                sc_nh[u_i, j] = g(gid)
+                wb.setdefault(g(gid), gid)
+                sc_topo[u_i, j] = g(b_topo + sc_topo[u_i, j])
+                sc_q[u_i, j] = g(b_sq + sc_q[u_i, j])
+        glists.append(list(pos.keys()))
+        wlists.append(list(wb.items()))
+
+    kmax = max((len(gl) for gl in glists), default=0)
+    kmax = max(kmax, 1)
+    wmax = max((len(wl) for wl in wlists), default=0)
+    wmax = max(wmax, 1)
+    if kmax > _MAX_SLOTS["kmax"] or wmax > _MAX_SLOTS["wmax"]:
+        return _reject("terms: per-class streamed row set over gather caps")
+    gather = np.full((u_n, kmax), -1, dtype=np.int32)
+    for u_i, gl in enumerate(glists):
+        gather[u_i, : len(gl)] = gl
+    wb_pos = np.zeros((u_n, wmax), dtype=np.int32)
+    wb_gid = np.full((u_n, wmax), -1, dtype=np.int32)
+    for u_i, wl in enumerate(wlists):
+        for j, (p, gid) in enumerate(wl):
+            wb_pos[u_i, j] = p
+            wb_gid[u_i, j] = gid
+
+    ncfg = cfg._replace(stream=True, kmax=kmax, wmax=wmax,
+                        srows=int(state0.shape[0]))
+    return StreamTermsPlan(
+        cfg=ncfg,
+        state0=state0,
+        g_topo3=terms.g_topo3,
+        g_match_au=terms.g_match_au,
+        group0=terms.group0,
+        gtot0=terms.gtot0,
+        e_cnt=e_cnt.reshape(-1), e_pref=e_pref.reshape(-1),
+        e_panti=n_panti.reshape(-1),
+        e_cpd=terms.e_cpd,
+        e_antip=e_antip.reshape(-1), e_antib=terms.e_antib,
+        e_tposp=e_tposp.reshape(-1), e_tposb=terms.e_tposb,
+        gid_u=terms.gid_u, self_ok_u=terms.self_ok_u,
+        slot_grows=terms.slot_grows,
+        h_topo=h_topo.reshape(-1), h_cnt=h_cnt.reshape(-1),
+        h_cand=h_cand.reshape(-1), h_skew=terms.h_skew,
+        h_selfm=terms.h_selfm,
+        s_topo_i=s_topo_i.reshape(-1), s_ishost=terms.s_ishost,
+        s_cnt=s_cnt.reshape(-1), s_nh=s_nh.reshape(-1),
+        s_skewm1=terms.s_skewm1,
+        c_topo=c_topo.reshape(-1), c_cnt=c_cnt.reshape(-1),
+        c_pref=c_pref.reshape(-1), c_panti=nc_panti.reshape(-1),
+        c_m=terms.c_m, c_prefc=terms.c_prefc, c_pantic=terms.c_pantic,
+        c_antip=c_antip.reshape(-1), c_antib=terms.c_antib,
+        c_tposp=c_tposp.reshape(-1), c_tposb=terms.c_tposb,
+        sc_nh=sc_nh.reshape(-1), sc_topo=sc_topo.reshape(-1),
+        sc_q=sc_q.reshape(-1), sc_m=terms.sc_m,
+        w_hi=terms.w_hi, w_lo=terms.w_lo, w_h1=terms.w_h1,
+        w_h2=terms.w_h2,
+        gather=gather.reshape(-1),
+        wb_pos=wb_pos.reshape(-1),
+        wb_gid=wb_gid.reshape(-1),
+        hk_pos=hk_pos,
+    )
+
+
 def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                  has_taint: bool, has_pins: bool, s_n: int, g_n: int,
                  pw: int, tc: Optional[TermsCfg]):
@@ -1124,8 +1450,12 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         18 + int(has_nodeaff) + int(has_taint)
         + (3 if s_n else 0) + (6 if g_n else 0) + (3 if pw else 0)
     )
-    TERM_IN = len(_TERM_FIELDS) if tc is not None else 0
-    N_OUT = 7
+    stream = tc is not None and tc.stream
+    term_fields = _STREAM_TERM_FIELDS if stream else _TERM_FIELDS
+    TERM_IN = len(term_fields) if tc is not None else 0
+    # streamed plans append the mutated HBM state buffer as an extra
+    # output (ANY space; never fetched to the host)
+    N_OUT = 7 + int(stream)
 
     def two_sum(a, b):
         # Knuth 2Sum (branch-free, round-to-nearest f32): s + err == a + b
@@ -1174,13 +1504,18 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             wantw_ref = next(it)  # (U*Pw,) SMEM
             conflw_ref = next(it)  # (U*Pw,) SMEM
         if tc is not None:
-            tr = dict(zip((nm for nm, _ in _TERM_FIELDS),
+            tr = dict(zip((nm for nm, _ in term_fields),
                           refs[BASE_IN : BASE_IN + TERM_IN]))
-            topo_ref = tr["topo_dist"]
+            if not stream:
+                topo_ref = tr["topo_dist"]
+                cand_ref = tr["cand_dist"]
+                sq_ref = tr["sq_dist"]
+                haskeys_ref = tr["hk_dist"]
+                # pref/panti share one index in the resident layout;
+                # the body reads the *_panti tables uniformly
+                tr["e_panti"] = tr["e_pref"]
+                tr["c_panti"] = tr["c_pref"]
             gtopo_ref = tr["g_topo3"]
-            cand_ref = tr["cand_dist"]
-            sq_ref = tr["sq_dist"]
-            haskeys_ref = tr["hk_dist"]
             gmatch_ref = tr["g_match_au"]
             gid_ref = tr["gid_u"]
             selfok_ref = tr["self_ok_u"]
@@ -1189,7 +1524,8 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             wh1_ref, wh2_ref = tr["w_h1"], tr["w_h2"]
         outs = refs[BASE_IN + TERM_IN : BASE_IN + TERM_IN + N_OUT]
         (place_ref, st_c_ref, st_m_ref, st_e_ref,
-         st_nzc_ref, st_nzm_ref, st_p_ref) = outs
+         st_nzc_ref, st_nzm_ref, st_p_ref) = outs[:7]
+        state_out_ref = outs[7] if stream else None
         extra = refs[BASE_IN + TERM_IN + N_OUT :]
         ei = 0
         if s_n:
@@ -1202,9 +1538,20 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             ports_pl = extra[ei]
             ei += 1
         if tc is not None:
-            (tgt_s, pref_s, panti_s, antib_s, tposb_s, group_s, gtot_s,
-             soft_s) = extra[ei : ei + 8]
-            ei += 8
+            if stream:
+                group_s, gtot_s, gath_s = extra[ei : ei + 3]
+                ei += 3
+                state_sem = extra[ei]
+                ei += 1
+                # every streamed array lives in the one gathered
+                # scratch; the body's reads/commits index POSITIONS
+                tgt_s = pref_s = panti_s = gath_s
+                antib_s = tposb_s = soft_s = gath_s
+                topo_ref = cand_ref = sq_ref = gath_s
+            else:
+                (tgt_s, pref_s, panti_s, antib_s, tposb_s, group_s,
+                 gtot_s, soft_s) = extra[ei : ei + 8]
+                ei += 8
         if s_n or g_n or pw or tc is not None:
             dma_sem = extra[ei]
 
@@ -1242,16 +1589,27 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             if pw:
                 copies.append((ports0_ref, ports_pl))
             if tc is not None:
-                copies += [
-                    (tr["tgt0_c"], tgt_s),
-                    (tr["pref0_p"], pref_s),
-                    (tr["panti0_p"], panti_s),
-                    (tr["antib0"], antib_s),
-                    (tr["tposb0"], tposb_s),
-                    (tr["group0"], group_s),
-                    (tr["gtot0"], gtot_s),
-                    (tr["soft0_nh"], soft_s),
-                ]
+                if stream:
+                    # the mutable HBM state starts as a copy of the
+                    # device-cached init buffer (one full-array DMA per
+                    # CALL, not per pod) so repeated calls on one plan
+                    # never re-upload from the host
+                    copies += [
+                        (tr["state0"], state_out_ref),
+                        (tr["group0"], group_s),
+                        (tr["gtot0"], gtot_s),
+                    ]
+                else:
+                    copies += [
+                        (tr["tgt0_c"], tgt_s),
+                        (tr["pref0_p"], pref_s),
+                        (tr["panti0_p"], panti_s),
+                        (tr["antib0"], antib_s),
+                        (tr["tposb0"], tposb_s),
+                        (tr["group0"], group_s),
+                        (tr["gtot0"], gtot_s),
+                        (tr["soft0_nh"], soft_s),
+                    ]
             for src_ref, dst_ref in copies:
                 cp = pltpu_mod.make_async_copy(src_ref, dst_ref, dma_sem)
                 cp.start()
@@ -1280,6 +1638,33 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             fu = clsmap_ref[u]
             su = clsmap_ref[u_n + u]
             bu = clsmap_ref[2 * u_n + u]
+
+            if stream:
+                # gather this class's term-state rows from HBM into the
+                # (Kmax, R, C) scratch: all fetches start first (round-
+                # robin over the semaphore array) so they overlap, then
+                # one wait pass. Positions beyond the class's row set
+                # (gid < 0) are skipped and never read by the tables.
+                for k in range(tc.kmax):
+                    g_k = tr["gather"][u * tc.kmax + k]
+
+                    @pl.when(g_k >= 0)
+                    def _(k=k, g_k=g_k):
+                        pltpu_mod.make_async_copy(
+                            state_out_ref.at[pl.ds(g_k, 1)],
+                            gath_s.at[pl.ds(k, 1)],
+                            state_sem.at[k % _STREAM_NSEM],
+                        ).start()
+                for k in range(tc.kmax):
+                    g_k = tr["gather"][u * tc.kmax + k]
+
+                    @pl.when(g_k >= 0)
+                    def _(k=k, g_k=g_k):
+                        pltpu_mod.make_async_copy(
+                            state_out_ref.at[pl.ds(g_k, 1)],
+                            gath_s.at[pl.ds(k, 1)],
+                            state_sem.at[k % _STREAM_NSEM],
+                        ).wait()
 
             used_c = st_c_ref[:]
             used_m = st_m_ref[:]
@@ -1373,12 +1758,14 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                     ci = tr["e_cnt"][u * tc.rmax + k]
                     tgtk = tgt_s[jnp.maximum(ci, 0)] * (ci >= 0)
                     pi = tr["e_pref"][u * tc.rmax + k]
+                    pa = tr["e_panti"][u * tc.rmax + k]
                     pv = (pi >= 0).astype(jnp.int32)
                     pix = jnp.maximum(pi, 0)
+                    pax = jnp.maximum(pa, 0)
                     ipa_raw = (
                         ipa_raw
                         + tr["e_cpd"][u * tc.rmax + k] * tgtk
-                        + (pref_s[pix] - panti_s[pix]) * pv
+                        + (pref_s[pix] - panti_s[pax]) * pv
                     )
                     ab = tr["e_antib"][u * tc.rmax + k]
                     fail_exist = fail_exist | (
@@ -1499,7 +1886,10 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 # so the product runs in double-single f32 (split tables
                 # w_h1/w_h2/w_lo, exact partial products, 2Sum chains) —
                 # ~2^-45 relative error, then integer truncation.
-                hkeys = haskeys_ref[clsmap_ref[5 * u_n + u]] != 0
+                if stream:
+                    hkeys = gath_s[tr["hk_pos"][u]] != 0
+                else:
+                    hkeys = haskeys_ref[clsmap_ref[5 * u_n + u]] != 0
                 eligible = feas & hkeys
                 acc_hi = jnp.zeros(shape, jnp.float32)
                 acc_lo = jnp.zeros(shape, jnp.float32)
@@ -1668,10 +2058,12 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                     tgt_s[cix] = tgt_s[cix] + tr["c_m"][u * tc.cmax + j] * upd * (ci >= 0)
                     if tc.has_ipa:
                         pi2 = tr["c_pref"][u * tc.cmax + j]
+                        pa2 = tr["c_panti"][u * tc.cmax + j]
                         pix = jnp.maximum(pi2, 0)
+                        pax = jnp.maximum(pa2, 0)
                         pfac = upd * (pi2 >= 0)
                         pref_s[pix] = pref_s[pix] + tr["c_prefc"][u * tc.cmax + j] * pfac
-                        panti_s[pix] = panti_s[pix] + tr["c_pantic"][u * tc.cmax + j] * pfac
+                        panti_s[pax] = panti_s[pax] + tr["c_pantic"][u * tc.cmax + j] * pfac
                         ap = tr["c_antip"][u * tc.cmax + j]
                         antib_s[ap] = antib_s[ap] | (tr["c_antib"][u * tc.cmax + j] * upd)
                         tp_ = tr["c_tposp"][u * tc.cmax + j]
@@ -1703,6 +2095,33 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                             & s_q_at
                         ).astype(jnp.int32) * inc
                         soft_s[six] = soft_s[six] + tr["sc_m"][u * tc.scmax + j] * s_upd
+
+                if stream:
+                    # persist the rows this class's commits mutated; the
+                    # waits below double as the ordering barrier against
+                    # the NEXT pod's gather of the same rows
+                    for j in range(tc.wmax):
+                        w_g = tr["wb_gid"][u * tc.wmax + j]
+                        w_p = tr["wb_pos"][u * tc.wmax + j]
+
+                        @pl.when(w_g >= 0)
+                        def _(j=j, w_g=w_g, w_p=w_p):
+                            pltpu_mod.make_async_copy(
+                                gath_s.at[pl.ds(jnp.maximum(w_p, 0), 1)],
+                                state_out_ref.at[pl.ds(w_g, 1)],
+                                state_sem.at[j % _STREAM_NSEM],
+                            ).start()
+                    for j in range(tc.wmax):
+                        w_g = tr["wb_gid"][u * tc.wmax + j]
+                        w_p = tr["wb_pos"][u * tc.wmax + j]
+
+                        @pl.when(w_g >= 0)
+                        def _(j=j, w_g=w_g, w_p=w_p):
+                            pltpu_mod.make_async_copy(
+                                gath_s.at[pl.ds(jnp.maximum(w_p, 0), 1)],
+                                state_out_ref.at[pl.ds(w_g, 1)],
+                                state_sem.at[j % _STREAM_NSEM],
+                            ).wait()
             return 0
 
         jax.lax.fori_loop(0, p_total, step, 0)
@@ -1767,7 +2186,12 @@ def _device_args(plan: PallasPlan) -> list:
     if plan.pw:
         args += [plan.ports0, plan.want_w, plan.confl_w]
     if plan.terms is not None:
-        args += [getattr(plan.terms, name) for name, _ in _TERM_FIELDS]
+        fields = (
+            _STREAM_TERM_FIELDS
+            if plan.terms.cfg.stream
+            else _TERM_FIELDS
+        )
+        args += [getattr(plan.terms, name) for name, _ in fields]
     with jax.enable_x64(False):
         dev = [jax.device_put(a) for a in args]
     if len(_DEVICE_PLAN_CACHE) >= 16:
@@ -1781,6 +2205,15 @@ def _device_args(plan: PallasPlan) -> list:
 # interpreter would crawl at bench scale on CPU); tests set True to
 # exercise the integration paths under interpret mode
 FORCE_ENABLE: Optional[bool] = None
+
+
+def kernel_label(plan: "PallasPlan") -> str:
+    """The trace/bench label for a built plan — one definition so the
+    engine's batch-kernel note and the bench's backend tag can never
+    disagree about which kernel layout ran."""
+    if plan.terms is not None and plan.terms.cfg.stream:
+        return "pallas-stream"
+    return "pallas"
 
 
 def should_use() -> bool:
@@ -1828,7 +2261,9 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
             + (3 if plan.s_n else 0) + (6 if plan.g_n else 0)
             + (3 if plan.pw else 0)
         )
-        n_in = base_n + (len(_TERM_FIELDS) if tc is not None else 0)
+        stream = tc is not None and tc.stream
+        term_fields = _STREAM_TERM_FIELDS if stream else _TERM_FIELDS
+        n_in = base_n + (len(term_fields) if tc is not None else 0)
         # memory spaces: clsmap (base idx 3) in SMEM; the scalar/port
         # blocks sit at the end of the base args (alloc VMEM, init ANY,
         # tables SMEM); term-block spaces come from _TERM_FIELDS
@@ -1848,7 +2283,7 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
             smem_idx.update((off + 1, off + 2))  # want/conflict words
             off += 3
         if tc is not None:
-            for toff, (_, space) in enumerate(_TERM_FIELDS):
+            for toff, (_, space) in enumerate(term_fields):
                 if space == "any":
                     any_idx.add(base_n + toff)
                 elif space == "smem":
@@ -1866,16 +2301,24 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
             if plan.pw:
                 scratch.append(_pltpu.VMEM((plan.pw,) + rl, jnp.int32))
             if tc is not None:
-                scratch += [
-                    _pltpu.VMEM((tc.tc,) + rl, jnp.int32),  # tgt counts
-                    _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # pref (combined)
-                    _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # panti
-                    _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # anti>0 bitplanes
-                    _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # tgt>0 bitplanes
-                    _pltpu.VMEM((tc.a,) + rl, jnp.int32),  # group
-                    _pltpu.VMEM((tc.a, SUBLANES, LANES), jnp.int32),  # gtot
-                    _pltpu.VMEM((tc.csn,) + rl, jnp.int32),  # soft non-host
-                ]
+                if stream:
+                    scratch += [
+                        _pltpu.VMEM((tc.a,) + rl, jnp.int32),  # group
+                        _pltpu.VMEM((tc.a, SUBLANES, LANES), jnp.int32),
+                        _pltpu.VMEM((tc.kmax,) + rl, jnp.int32),  # gather
+                        _pltpu.SemaphoreType.DMA((_STREAM_NSEM,)),
+                    ]
+                else:
+                    scratch += [
+                        _pltpu.VMEM((tc.tc,) + rl, jnp.int32),  # tgt counts
+                        _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # pref (combined)
+                        _pltpu.VMEM((tc.tp,) + rl, jnp.int32),  # panti
+                        _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # anti>0 bitplanes
+                        _pltpu.VMEM((tc.bp,) + rl, jnp.int32),  # tgt>0 bitplanes
+                        _pltpu.VMEM((tc.a,) + rl, jnp.int32),  # group
+                        _pltpu.VMEM((tc.a, SUBLANES, LANES), jnp.int32),  # gtot
+                        _pltpu.VMEM((tc.csn,) + rl, jnp.int32),  # soft non-host
+                    ]
             scratch.append(_pltpu.SemaphoreType.DMA)
 
         @jax.jit
@@ -1886,19 +2329,25 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
                 if i in smem_idx:
                     return pl.BlockSpec(memory_space=pltpu.SMEM)
                 return pl.BlockSpec(memory_space=pltpu.VMEM)
+            out_shape = [
+                jax.ShapeDtypeStruct((pr_rows, LANES), jnp.int32),
+            ] + [jax.ShapeDtypeStruct(rc, jnp.int32) for _ in range(6)]
+            out_specs = [
+                pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(7)
+            ]
+            if stream:
+                # the mutated term-state buffer stays in HBM (ANY) and
+                # is never fetched; listing it as an output gives the
+                # kernel a writable destination for the row DMAs
+                out_shape.append(
+                    jax.ShapeDtypeStruct((tc.srows, plan.r, LANES), jnp.int32)
+                )
+                out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
             outs = pl.pallas_call(
                 kernel,
-                out_shape=(
-                    jax.ShapeDtypeStruct((pr_rows, LANES), jnp.int32),
-                    jax.ShapeDtypeStruct(rc, jnp.int32),
-                    jax.ShapeDtypeStruct(rc, jnp.int32),
-                    jax.ShapeDtypeStruct(rc, jnp.int32),
-                    jax.ShapeDtypeStruct(rc, jnp.int32),
-                    jax.ShapeDtypeStruct(rc, jnp.int32),
-                    jax.ShapeDtypeStruct(rc, jnp.int32),
-                ),
+                out_shape=tuple(out_shape),
                 in_specs=[spec(i) for i in range(n_in)],
-                out_specs=tuple(pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(7)),
+                out_specs=tuple(out_specs),
                 scratch_shapes=scratch,
                 interpret=interpret,
             )(*arrays)
@@ -1906,7 +2355,7 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
             # the row axis): every host-blocking point on the relay
             # costs ~0.1s regardless of size, so the whole call must
             # have exactly one — the single fetch below
-            return jnp.concatenate(outs, axis=0)
+            return jnp.concatenate(outs[:7], axis=0)
 
         cached = _Compiled(fn=call)
         _COMPILED_CACHE[key] = cached

@@ -101,7 +101,7 @@ class TpuEngine:
         # scope or unavailable (pallas_scan.fallback_reason)
         GLOBAL.note(
             "batch-kernel",
-            "pallas"
+            pallas_scan.kernel_label(plan)
             if plan is not None
             else f"xla-scan ({pallas_scan.fallback_reason()})",
         )

@@ -109,6 +109,21 @@ class GoRand:
     def __init__(self, seed: int = 1, cooked: Optional[List[int]] = None):
         if cooked is None:
             cooked = _load_cooked_env()
+        if cooked is None:
+            # the zero-table fallback keeps the generator well-defined
+            # but breaks the feature's advertised contract (bit-matching
+            # a Go binary's stream), so degrading must be loud at every
+            # construction that will actually consume the stream — the
+            # packaged-table loader's one-time warning is easy to miss
+            # in a long run
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "GoRand falling back to a ZERO warm-up table (packaged "
+                "go_rng_cooked.txt missing or corrupt): select_host="
+                "'sample' placements will NOT bit-match a Go reference "
+                "binary"
+            )
         # store the warm-up table as uint64; Go's literals are int64
         self._cooked = [0] * _LEN if cooked is None else [
             v & _MASK64 for v in cooked

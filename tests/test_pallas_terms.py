@@ -27,6 +27,19 @@ from open_simulator_tpu.scheduler.oracle import Oracle
 ZONES = ["a", "b", "c", "d"]
 
 
+@pytest.fixture(params=["resident", "stream"], autouse=True)
+def _terms_layout(request):
+    """Every case in this module runs twice: once on the resident VMEM
+    term plan and once forcing the streamed-terms layout (HBM state +
+    per-pod row gather, pallas_scan.STREAM_FORCE) — the layout the
+    kernel auto-selects past the VMEM budget. check_case asserts the
+    requested layout was actually built."""
+    prev = pallas_scan.STREAM_FORCE
+    pallas_scan.STREAM_FORCE = request.param == "stream"
+    yield request.param
+    pallas_scan.STREAM_FORCE = prev
+
+
 def make_node(i, zone):
     return {
         "kind": "Node",
@@ -103,6 +116,7 @@ def check_case(
     if plan is None and skip_out_of_scope:
         pytest.skip("batch out of kernel scope")
     assert plan is not None and plan.terms is not None
+    assert plan.terms.cfg.stream == (pallas_scan.STREAM_FORCE is True)
     static = to_scan_static(cluster, batch)
     init = to_scan_state(dyn, batch)
     nv = np.ones(cluster.n, bool) if node_valid is None else node_valid

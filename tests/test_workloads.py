@@ -163,6 +163,49 @@ def test_raw_pod_interning_shares_spec_but_not_annotations():
     assert "nodeName" not in pods[2]["spec"]
 
 
+def test_template_replicas_share_one_labels_dict():
+    """Template replicas deliberately share ONE labels dict (and one
+    ownerReferences list): correctness rests on the invariant that the
+    only post-expansion label write is the uniform app-name stamp
+    (generate_valid_pods_from_app). This test pins the shared identity
+    so a future per-pod label writer fails here loudly instead of
+    silently aliasing across 100k pods (workloads._expand_template)."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+
+    res = ResourceTypes()
+    res.deployments = [
+        {
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "d"},
+            "spec": {
+                "replicas": 4,
+                "template": {
+                    "metadata": {"labels": {"app": "web"}},
+                    "spec": {"containers": [{"name": "c", "image": "i"}]},
+                },
+            },
+        }
+    ]
+    nodes = [
+        {
+            "kind": "Node",
+            "metadata": {"name": "n0", "labels": {}},
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}
+            },
+        }
+    ]
+    pods = wl.generate_valid_pods_from_app("demo", res, nodes)
+    assert len(pods) == 4
+    first = pods[0]["metadata"]
+    for p in pods[1:]:
+        meta = p["metadata"]
+        assert meta["labels"] is first["labels"]
+        assert meta["ownerReferences"] is first["ownerReferences"]
+    # the one sanctioned post-expansion write landed uniformly
+    assert first["labels"][wl.LABEL_APP_NAME] == "demo"
+
+
 def test_raw_pod_interning_generate_name_only():
     from open_simulator_tpu.models.decode import ResourceTypes
 

@@ -1,0 +1,25 @@
+# Container image for the `simon` CLI (CPU JAX backend).
+# Mirrors the reference's test-then-build image (reference
+# Dockerfile:1-11: golang builder, `make test`, `make build`); here the
+# build is a pip install and the gate is `make check`.
+FROM python:3.12-slim AS builder
+
+WORKDIR /src/open-simulator-tpu
+COPY . .
+RUN pip install --no-cache-dir "jax[cpu]" pyyaml pytest \
+    && pip install --no-cache-dir .
+# the full gate: first-party lint + the whole suite on the CPU backend
+# (tests force JAX_PLATFORMS=cpu with a virtual device mesh themselves)
+RUN make check
+
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY --from=builder /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages
+COPY --from=builder /usr/local/bin/simon /usr/local/bin/simon
+# quickstart configs ship in the image so `simon apply -f
+# example/simon-config.yaml` works out of the box
+COPY example /app/example
+
+ENTRYPOINT ["simon"]
+CMD ["--help"]

@@ -3,9 +3,9 @@
 # Mirrors the reference's Makefile test target (reference Makefile:20-26).
 #
 #   make test      run the full suite (the end-of-round gate)
-#   make lint      syntax-compile every source file (no linters are
-#                  shipped in this image; compileall catches syntax and
-#                  tab errors)
+#   make lint      syntax-compile every source file, then the
+#                  first-party AST linter (tools/lint.py: unused
+#                  imports, mutable defaults, bare except, ...)
 #   make check     lint + test
 #   make examples  run both quickstart configs end to end
 #   make bench     one bench line (SIMON_BENCH selects the scenario)
@@ -19,6 +19,7 @@ test:
 
 lint:
 	$(PY) -m compileall -q open_simulator_tpu tools tests bench.py __graft_entry__.py
+	$(PY) tools/lint.py
 
 check: lint test
 

@@ -305,10 +305,11 @@ def run_whatif(n_base=500, n_pods=5000) -> dict:
     """SIMON_BENCH=whatif: what-if capacity sweep over 8 candidate
     newnode specs (BASELINE config #5): per spec, find the minimal
     feasible new-node count; report total wall-clock for all 8."""
-    from open_simulator_tpu.apply.applier import probe_plan
+    from open_simulator_tpu.apply.applier import probe_plan, probe_plan_multi
     from open_simulator_tpu.models.decode import ResourceTypes
     from open_simulator_tpu.models.workloads import reset_name_counter
     from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.utils.trace import GLOBAL
 
     nodes = []
     for i in range(n_base):
@@ -356,12 +357,12 @@ def run_whatif(n_base=500, n_pods=5000) -> dict:
     probe_plan(cluster, apps, templates[0])
 
     def sweep():
-        counts = []
-        for tpl in templates:
-            reset_name_counter()
-            r = probe_plan(cluster, apps, tpl)
-            counts.append(r.new_node_count if r.success else -1)
-        return counts
+        # all 8 specs in lockstep: each search round's probes dispatch
+        # across specs in ONE device sync (probe_plan_multi; the r4
+        # version paid ~23 sequential ~150ms relay round-trips)
+        reset_name_counter()
+        results = probe_plan_multi(cluster, apps, templates)
+        return [r.new_node_count if r.success else -1 for r in results]
 
     elapsed, spread, counts = _timed(sweep)
     return {
@@ -371,6 +372,53 @@ def run_whatif(n_base=500, n_pods=5000) -> dict:
         "counts": counts,
         "pods": n_pods,
         "nodes": n_base,
+        "rounds": GLOBAL.notes.get("whatif-rounds"),
+        "syncs": GLOBAL.notes.get("whatif-syncs"),
+    }
+
+
+def run_sample() -> dict:
+    """SIMON_BENCH=sample: select_host="sample" (reservoir sampling
+    with the Go math/rand stream carried in the scan state, r5) vs the
+    first-max default on the SAME XLA-scan path — sample mode is
+    XLA-scan-only (the Pallas kernel rejects it), so the honest
+    comparison holds the engine constant. e2e simulate() wall-clock on
+    the default 20k-pod x 10k-node scenario."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.models.workloads import reset_name_counter
+    from open_simulator_tpu.ops import pallas_scan
+    from open_simulator_tpu.scheduler.core import AppResource, simulate
+
+    nodes, pods = build_scenario()
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    res = ResourceTypes()
+    res.pods = pods
+    apps = [AppResource("bench", res)]
+
+    def run(select_host):
+        reset_name_counter()
+        return simulate(cluster, apps, engine="tpu", select_host=select_host)
+
+    run("sample")  # compile/warm
+    elapsed_s, spread_s, result = _timed(lambda: run("sample"))
+    # first-max on the same XLA path (kernel disabled) for the ratio
+    prev = pallas_scan.FORCE_ENABLE
+    pallas_scan.FORCE_ENABLE = False
+    try:
+        run("first-max")
+        elapsed_f, spread_f, _ = _timed(lambda: run("first-max"))
+    finally:
+        pallas_scan.FORCE_ENABLE = prev
+    return {
+        "elapsed_s": elapsed_s,
+        "spread": spread_s,
+        "pods_per_sec": len(pods) / elapsed_s,
+        "firstmax_pods_per_sec": len(pods) / elapsed_f,
+        "ratio": elapsed_s / elapsed_f,
+        "scheduled": len(pods) - len(result.unscheduled_pods),
+        "total": len(pods),
+        "nodes": len(nodes),
     }
 
 
@@ -407,14 +455,36 @@ def run_conformance_fuzz(n_nodes=1000, n_pods=2000, seed=0) -> dict:
     res = ResourceTypes()
     res.stateful_sets = stss
     pods = _sort_app_pods(wl.generate_valid_pods_from_app("fuzz", res, nodes))
-    # mix in the non-term feature surface: ports, scalars, pins
+    # mix in the non-term feature surface: ports, scalars, pins, and
+    # open-local storage (r5: the storage block rides the kernel too)
+    import json as _json
+
     for node in nodes[: n_nodes // 2]:
         node["status"]["allocatable"]["example.com/accel"] = "4"
+    gi = 1 << 30
+    for node in nodes[: n_nodes // 3]:
+        node["metadata"].setdefault("annotations", {})[
+            "simon/node-local-storage"
+        ] = _json.dumps(
+            {
+                "vgs": [
+                    {"name": "a", "capacity": str(100 * gi), "requested": "0"}
+                ],
+                "devices": [
+                    {
+                        "name": "/dev/vdb",
+                        "capacity": str(120 * gi),
+                        "mediaType": "ssd",
+                        "isAllocated": "false",
+                    }
+                ],
+            }
+        )
     import copy
 
     for i, pod in enumerate(pods[:n_pods]):
         k = rng.randint(0, 40)
-        if k > 2:
+        if k > 3:
             continue
         # replica clones share nested spec objects (workloads.py
         # _expand_template, read-only-after-expansion contract): give
@@ -434,8 +504,21 @@ def run_conformance_fuzz(n_nodes=1000, n_pods=2000, seed=0) -> dict:
             spec["containers"][0]["resources"]["requests"][
                 "example.com/accel"
             ] = str(1 + i % 4)
-        else:
+        elif k == 2:
             spec["nodeName"] = nodes[int(rng.randint(0, n_nodes))]["metadata"]["name"]
+        else:
+            vols = (
+                [{"kind": "LVM", "size": str((1 + i % 8) * gi),
+                  "scName": "open-local-lvm"}]
+                if i % 3
+                else [{"kind": "SSD", "size": str(60 * gi),
+                       "scName": "open-local-device-ssd"}]
+            )
+            pod["metadata"] = meta = dict(pod["metadata"])
+            meta["annotations"] = dict(meta.get("annotations") or {})
+            meta["annotations"]["simon/pod-local-storage"] = _json.dumps(
+                {"volumes": vols}
+            )
     pods = pods[:n_pods]
 
     oracle = Oracle(nodes)
@@ -1043,6 +1126,19 @@ def main():
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
         }
+    elif scenario == "sample":
+        z = run_sample()
+        out = {
+            "metric": f"pods scheduled/sec at {z['nodes']} nodes, e2e "
+            f"simulate with select_host=sample (Go-RNG reservoir in the "
+            f"scan carry; first-max on the same XLA path: "
+            f"{z['firstmax_pods_per_sec']:.0f} pods/s -> "
+            f"{z['ratio']:.2f}x its wall-clock; "
+            f"{z['scheduled']}/{z['total']} placed)",
+            "value": round(z["pods_per_sec"], 1),
+            "unit": "pods/s",
+            "vs_baseline": round(z["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
+        }
     elif scenario == "fuzz":
         z = run_conformance_fuzz()
         skipped = z["checked"] == 0
@@ -1130,6 +1226,7 @@ def main():
         w = isolated(run_whatif)
         p = isolated(run_priority)
         pd = isolated(run_priority_dense)
+        sm = isolated(run_sample)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -1152,7 +1249,9 @@ def main():
             f"({p['priority_pods']} priority pods), "
             f"priority-dense e2e {pd['pods_per_sec']:.0f} pods/s "
             f"({pd['priority_pods']}/{pd['total']} priority-bearing, "
-            f"{pd['scan_rounds']} rounds/{pd['escapes']} escapes); "
+            f"{pd['scan_rounds']} rounds/{pd['escapes']} escapes), "
+            f"sample-mode e2e {sm['pods_per_sec']:.0f} pods/s "
+            f"({sm['ratio']:.2f}x first-max on the same XLA path); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"

@@ -274,21 +274,7 @@ def probe_plan(
             gc.collect()
 
 
-def _probe_plan_inner(
-    cluster, apps, new_node, use_greed, extended_resources,
-    max_count, score_weights,
-):
-    from ..parallel.sweep import CapacitySweep
-    from ..utils.trace import phase
-
-    sweep = CapacitySweep(
-        cluster,
-        apps,
-        new_node,
-        max_count,
-        use_greed=use_greed,
-        score_weights=score_weights,
-    )
+def _capacity_feasible():
     max_cpu, max_mem, max_vg = _resource_caps()
 
     def feasible(res) -> bool:
@@ -300,10 +286,15 @@ def _probe_plan_inner(
             and int(res.vg_util) <= max_vg
         )
 
-    with phase("apply/lower-bound"):
-        start = sweep.lower_bound(max_cpu, max_mem, max_vg)
-    with phase("apply/probe-search"):
-        best = sweep.find_min_count(feasible, start=start)
+    return feasible, (max_cpu, max_mem, max_vg)
+
+
+def _finish_plan(sweep, best, max_count, extended_resources) -> ApplyResult:
+    """Replay the winning probe into host state, re-check the caps on
+    real state, and render the report — the tail shared by the
+    single-spec plan and the multi-spec what-if."""
+    from ..utils.trace import phase
+
     if best is None:
         res = sweep.probe(max_count)
         result, _ = replay_scenario(sweep, max_count, res.placements)
@@ -335,6 +326,103 @@ def _probe_plan_inner(
         result=result,
         report_text=report_text,
     )
+
+
+def _probe_plan_inner(
+    cluster, apps, new_node, use_greed, extended_resources,
+    max_count, score_weights,
+):
+    from ..parallel.sweep import CapacitySweep
+    from ..utils.trace import phase
+
+    sweep = CapacitySweep(
+        cluster,
+        apps,
+        new_node,
+        max_count,
+        use_greed=use_greed,
+        score_weights=score_weights,
+    )
+    feasible, (max_cpu, max_mem, max_vg) = _capacity_feasible()
+    with phase("apply/lower-bound"):
+        start = sweep.lower_bound(max_cpu, max_mem, max_vg)
+    with phase("apply/probe-search"):
+        best = sweep.find_min_count(feasible, start=start)
+    return _finish_plan(sweep, best, max_count, extended_resources)
+
+
+def probe_plan_multi(
+    cluster,
+    apps,
+    new_nodes: List[dict],
+    use_greed: bool = False,
+    extended_resources: Optional[List[str]] = None,
+    max_count: int = MAX_NUM_NEW_NODE,
+    score_weights=None,
+) -> List[ApplyResult]:
+    """What-if capacity plan over MANY candidate newnode specs: every
+    spec's min-count search runs in lockstep and each round's probes
+    across ALL specs dispatch in one device sync
+    (parallel/sweep.find_min_count_multi) — replacing K sequential
+    probe_plan calls whose ~23 relay round-trips dominated the r4
+    8-spec bench. Returns one ApplyResult per spec, identical to what
+    probe_plan would produce for it."""
+    import gc
+
+    gc_was_enabled = gc.isenabled()
+    if gc_was_enabled:
+        gc.disable()
+    try:
+        from ..parallel.sweep import CapacitySweep, find_min_count_multi
+        from ..utils.trace import phase
+
+        feasible, (max_cpu, max_mem, max_vg) = _capacity_feasible()
+        jobs = []
+        for new_node in new_nodes:
+            sweep = CapacitySweep(
+                cluster,
+                apps,
+                new_node,
+                max_count,
+                use_greed=use_greed,
+                score_weights=score_weights,
+                # expansion is spec-independent without daemonsets /
+                # greed ordering: later sweeps reuse the first's pods
+                share_pods_from=jobs[0][0] if jobs else None,
+            )
+            with phase("apply/lower-bound"):
+                start = sweep.lower_bound(max_cpu, max_mem, max_vg)
+            jobs.append((sweep, feasible, start))
+        with phase("apply/probe-search"):
+            bests = find_min_count_multi(jobs)
+        # replay mutates pod dicts (bind writes nodeName/phase and may
+        # touch annotations): sweeps that shared the first sweep's
+        # expanded pods get their OWN shallow copies from the still-
+        # pristine originals before ANY spec replays, so every spec's
+        # ApplyResult embeds dicts no later replay rewrites (review r5)
+        def own_pod(p):
+            q = dict(p)
+            q["spec"] = dict(p["spec"])
+            meta = dict(p.get("metadata") or {})
+            if meta.get("annotations") is not None:
+                meta["annotations"] = dict(meta["annotations"])
+            q["metadata"] = meta
+            if isinstance(q.get("status"), dict):
+                q["status"] = dict(q["status"])
+            return q
+
+        for sweep, _, _ in jobs:
+            if sweep.pods_shared:
+                sweep.pods = [own_pod(p) for p in sweep.pods]
+        return [
+            _finish_plan(sweep, best, max_count, extended_resources)
+            for (sweep, _, _), best in zip(jobs, bests)
+        ]
+    finally:
+        clear_all_memos()
+        if gc_was_enabled:
+            gc.enable()
+            gc.collect()
 
 
 class Applier:

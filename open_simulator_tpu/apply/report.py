@@ -8,7 +8,6 @@ ASCII table writer (the reference uses olekukonko/tablewriter).
 
 from __future__ import annotations
 
-import json
 from typing import List, Optional
 
 from ..models import requests as req

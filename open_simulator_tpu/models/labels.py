@@ -18,7 +18,7 @@ encoder (which precomputes match matrices for the JAX scan).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 

@@ -23,10 +23,9 @@ lives in the scan carry (ops/scan.py).
 
 from __future__ import annotations
 
-import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from fractions import Fraction
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
@@ -39,8 +38,6 @@ from .profiles import node_profiles as _shared_node_profiles
 from .profiles import uses_match_fields as _uses_match_fields
 from .terms import TermTables, build_term_tables, combined_pref_carry, combined_pref_init
 from ..scheduler.oracle import (
-    GpuState,
-    NodeState,
     Oracle,
     _pod_host_ports,
     IMG_MIN_THRESHOLD,
@@ -729,15 +726,19 @@ def encode_batch(oracle: Oracle, cluster: ClusterStatic, pods: List[dict]) -> Po
     )
 
 
-def features_of_batch(cluster: ClusterStatic, batch: PodBatch, weights=None):
+def features_of_batch(cluster: ClusterStatic, batch: PodBatch, weights=None,
+                      sample: bool = False):
     """ScanFeatures from the host-side encodings — same result as
     scan.features_of(static, pinned) but without device->host transfers
     (the arrays are still numpy here). `weights` is an optional
-    schedconfig.ScoreWeights overlay (static per compile)."""
+    schedconfig.ScoreWeights overlay (static per compile); `sample`
+    routes selectHost through the carried Go RNG (oracle
+    select_host="sample")."""
     from .scan import ScanFeatures
 
     t = batch.terms
     return ScanFeatures(
+        sample=sample,
         weights=weights,
         gpu=bool(batch.gpu_mem.max(initial=0) > 0),
         storage=bool(batch.wants_storage.any()),

@@ -8,17 +8,17 @@ cluster state resident in VMEM as (R, 128) int32 tiles — per-step cost
 collapses to pure VPU arithmetic with zero kernel-launch overhead.
 
 Scope (automatic fallback to the XLA scan otherwise):
-- no open-local / custom-plugin machinery (features gates, same
-  contract as ScanFeatures). Open-local stays out deliberately: its
-  ScoreLVM/ScoreDevice fractions are f64 under the engine's global
-  x64 (sizes are byte counts), and matching them bit-exactly in a
-  f32 kernel would need double-single division emulation — the XLA
-  scan carries those batches instead. nodeName pins
-  (`run_scan_pallas(pinned=...)`), hostPorts (per-(ip,proto,port)
-  vocab bitmask tiles), extended scalar resources, and open-gpu-share
-  device packing (per-device (G, R, 128) memory tiles, tightest-fit /
-  two-pointer allocation mirroring scan.py _gpu_allocate; gpu+pins
-  falls back) ARE in scope,
+- no custom-plugin machinery (features gates, same contract as
+  ScanFeatures). nodeName pins (`run_scan_pallas(pinned=...)`),
+  hostPorts (per-(ip,proto,port) vocab bitmask tiles), extended
+  scalar resources, and open-gpu-share device packing (per-device
+  (G, R, 128) memory tiles, tightest-fit / two-pointer allocation
+  mirroring scan.py _gpu_allocate; gpu+pins falls back) ARE in scope,
+- open-local storage IS in scope (r5): the VG Binpack and device
+  first-fit run in GCD-scaled int32, and the f64 ScoreLVM/ScoreDevice
+  truncations — r4's measured reason for staying off the kernel —
+  ride as host-precomputed SMEM tables indexed by the in-kernel
+  assignment pattern (StorePlan docstring),
 - inter-pod affinity + hard/soft topology spread ARE in scope: term
   count state rides in VMEM scratch as node-space (T, R, 128) i32
   tiles (ops/scan.py ScanState docstring), per-(class, slot) eval
@@ -256,6 +256,11 @@ class PallasPlan(NamedTuple):
     igpu0: Optional[np.ndarray] = None  # (G, R, C) init used (ANY)
     gpu_mem_u: Optional[np.ndarray] = None  # (U,) SMEM per-GPU request
     gpu_cnt_u: Optional[np.ndarray] = None  # (U,) SMEM device count
+    # open-local storage: VG binpack + exclusive-device fit in GCD-
+    # scaled int32; the f64 ScoreLVM/ScoreDevice values ride as host-
+    # precomputed SMEM tables indexed by (class, distinct node storage
+    # config, in-kernel assignment pattern) — see _build_storage
+    store: Optional["StorePlan"] = None
 
 
 def _pad_nodes(vec: np.ndarray, r: int, fill=0) -> np.ndarray:
@@ -799,9 +804,11 @@ def build_plan(cluster, batch, dyn, features, weights=None,
     """Build a kernel plan from the (numpy) ClusterStatic + PodBatch +
     DynamicState, or None when the batch is outside the fast path's
     scope."""
-    if features.storage or features.custom:
+    if features.custom:
+        return _reject("custom-plugin machinery (XLA scan carries it)")
+    if getattr(features, "sample", False):
         return _reject(
-            "storage/custom-plugin machinery (XLA scan carries it)"
+            "sample-mode selectHost (XLA scan carries the Go RNG)"
         )
     if features.gpu and features.pins:
         # forced gpu commits would need device allocation outside the
@@ -982,6 +989,12 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         want_w = pack_words(want_p)
         confl_w = pack_words(confl_p)
 
+    store = None
+    if features.storage:
+        store = _build_storage(cluster, batch, dyn, r)
+        if store is None:
+            return None
+
     terms = None
     hk_map = None
     if features.ipa or features.hard_spread or features.soft_spread:
@@ -1043,7 +1056,8 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         s_eph=s_eph,
         s_nzmem=s_nzmem,
         w=(int(w.least), int(w.balanced), int(w.simon) + int(w.gpushare),
-           int(w.nodeaff), int(w.tainttol), int(w.spread), int(w.ipa)),
+           int(w.nodeaff), int(w.tainttol), int(w.spread), int(w.ipa),
+           int(w.openlocal)),
         has_nodeaff=bool(nodeaff_raw.any()),
         has_taint=bool(taint_intol.any()),
         has_pins=bool(features.pins),
@@ -1063,6 +1077,7 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         igpu0=igpu0,
         gpu_mem_u=gpu_mem_u,
         gpu_cnt_u=gpu_cnt_u,
+        store=store,
     )
 
     # VMEM budget (~16MB/core): count the PERSISTENT (R, C) tiles
@@ -1080,6 +1095,12 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         + (3 + plan.g_n if plan.g_n else 0)  # gpu statics + used scratch
         + 2 * s_n  # scalar alloc + used scratch
         + pw  # port occupancy planes
+        + (
+            # caps + storow/has_store + used scratch per slot
+            2 * (store.cfg.v + store.cfg.ds + store.cfg.dh) + 2
+            if store is not None
+            else 0
+        )
     )
     tiles = base_tiles
     if terms is not None:
@@ -1151,6 +1172,212 @@ _TERM_FIELDS = (
     ("sc_m", "smem"),
     ("w_hi", "smem"), ("w_lo", "smem"), ("w_h1", "smem"), ("w_h2", "smem"),
 )
+
+
+class StoreCfg(NamedTuple):
+    """Static shape configuration of the open-local storage block
+    (part of the compiled-kernel cache key)."""
+
+    v: int  # VG slots per node
+    ds: int  # SSD device slots
+    dh: int  # HDD device slots
+    lv: int  # LVM volume slots per class
+    sv: int  # SSD volume slots per class
+    hv: int  # HDD volume slots per class
+    sd: int  # distinct node storage-config rows
+    plvm: int  # v ** lv assignment patterns
+    pdev: int  # ds**sv * dh**hv assignment patterns
+
+
+class StorePlan(NamedTuple):
+    """Open-local storage arrays for the fused kernel.
+
+    The VG Binpack choice and the device first-fit are exact integer
+    comparisons once every byte quantity is divided by the collective
+    GCD (_gcd_scale), so the FILTER and the hypothetical ALLOCATION run
+    in int32 bit-identically to the XLA path (ops/scan.py
+    _local_storage_eval, open-local algo.go:487,574). The SCORES are
+    f64 with truncation in the reference (take/cap means x 10) — the
+    r4 measured reason the plugin stayed off the kernel. Instead of
+    emulating f64 in-kernel, the score of every reachable outcome is
+    precomputed ON THE HOST in real f64: an outcome is fully described
+    by (pod class, the node's distinct storage config row, which
+    VG/device slot each volume landed on), so the kernel computes the
+    assignment PATTERN (a base-V / base-D digit string) during the
+    integer binpack and looks the score up from an SMEM table —
+    bit-exact against the XLA scan because IEEE division of the
+    GCD-scaled integers rounds the same real quotient.
+    """
+
+    cfg: StoreCfg
+    # VMEM node tiles (caps are GCD-scaled, invalid slots folded to 0)
+    vg_cap_s: np.ndarray  # (V, R, C)
+    ssd_cap_s: np.ndarray  # (Ds, R, C)
+    hdd_cap_s: np.ndarray  # (Dh, R, C)
+    has_store: np.ndarray  # (R, C) 0/1
+    storow: np.ndarray  # (R, C) distinct storage-config row per node
+    # init state (ANY -> scratch)
+    ivg0: np.ndarray  # (V, R, C) scaled init requested
+    issd0: np.ndarray  # (Ds, R, C) 0/1 allocated
+    ihdd0: np.ndarray  # (Dh, R, C) 0/1
+    # SMEM class tables (scaled volume sizes; 0 = inactive slot)
+    lvm_mi: np.ndarray  # (U*Lv,)
+    ssd_mi: np.ndarray  # (U*Sv,)
+    hdd_mi: np.ndarray  # (U*Hv,)
+    wants_u: np.ndarray  # (U,)
+    # SMEM score tables: host-f64 ScoreLVM / ScoreDevice per
+    # (class, storage row, assignment pattern)
+    lvm_sc: np.ndarray  # (U*Sd*Plvm,)
+    dev_sc: np.ndarray  # (U*Sd*Pdev,)
+    # the collective GCD dividing every byte quantity — decode uses it
+    # to return the exported final VG usage in true bytes
+    scale: int = 1
+
+
+# ordered (StorePlan field, memory space) spec — shared by the arg
+# packer, BlockSpec assignment, and kernel unpacking (same contract as
+# _TERM_FIELDS)
+_STORE_FIELDS = (
+    ("vg_cap_s", "vmem"), ("ssd_cap_s", "vmem"), ("hdd_cap_s", "vmem"),
+    ("has_store", "vmem"), ("storow", "vmem"),
+    ("ivg0", "any"), ("issd0", "any"), ("ihdd0", "any"),
+    ("lvm_mi", "smem"), ("ssd_mi", "smem"), ("hdd_mi", "smem"),
+    ("wants_u", "smem"), ("lvm_sc", "smem"), ("dev_sc", "smem"),
+)
+
+_MAX_STORE = dict(v=4, ds=4, dh=4, lv=4, sv=2, hv=2, sd=16, pat=256)
+
+
+def _build_storage(cluster, batch, dyn, r: int) -> Optional[StorePlan]:
+    """Open-local storage block for the fused kernel, or None (with the
+    reject reason recorded) when out of scope."""
+    a = np.asarray
+    vg_cap = a(cluster.vg_cap, dtype=np.int64) * a(cluster.vg_valid, dtype=np.int64)
+    ssd_cap = a(cluster.ssd_cap, dtype=np.int64) * a(cluster.ssd_valid, dtype=np.int64)
+    hdd_cap = a(cluster.hdd_cap, dtype=np.int64) * a(cluster.hdd_valid, dtype=np.int64)
+    vg_used0 = a(dyn.vg_used, dtype=np.int64)
+    ssd_used0 = a(dyn.ssd_used).astype(np.int64)
+    hdd_used0 = a(dyn.hdd_used).astype(np.int64)
+    lvm = a(batch.lvm_sizes, dtype=np.int64)
+    ssd = a(batch.ssd_sizes, dtype=np.int64)
+    hdd = a(batch.hdd_sizes, dtype=np.int64)
+    wants = a(batch.wants_storage).astype(np.int32)
+
+    v = vg_cap.shape[1]
+    ds_n = ssd_cap.shape[1]
+    dh_n = hdd_cap.shape[1]
+    lv = lvm.shape[1]
+    sv = ssd.shape[1]
+    hv = hdd.shape[1]
+    if (v > _MAX_STORE["v"] or ds_n > _MAX_STORE["ds"]
+            or dh_n > _MAX_STORE["dh"] or lv > _MAX_STORE["lv"]
+            or sv > _MAX_STORE["sv"] or hv > _MAX_STORE["hv"]):
+        return _reject("storage: VG/device/volume slot count over kernel scope")
+    plvm = v ** lv
+    pdev = (ds_n ** sv) * (dh_n ** hv)
+    if plvm > _MAX_STORE["pat"] or pdev > _MAX_STORE["pat"]:
+        return _reject("storage: assignment pattern space over kernel scope")
+
+    s = _gcd_scale(vg_cap, ssd_cap, hdd_cap, vg_used0, lvm, ssd, hdd)
+    vg_s = vg_cap // s
+    ssd_s = ssd_cap // s
+    hdd_s = hdd_cap // s
+    vgu_s = vg_used0 // s
+    lvm_s = lvm // s
+    ssd_vs = ssd // s
+    hdd_vs = hdd // s
+    if max(vg_s.max(initial=0), ssd_s.max(initial=0),
+           hdd_s.max(initial=0), vgu_s.max(initial=0)) > _MAX_SCALED:
+        return _reject("storage: scaled capacities exceed int32 exactness")
+
+    # distinct storage-config rows: caps alone determine every score
+    # outcome (the dynamic part — takes — is the pattern)
+    rows = np.hstack([vg_s, ssd_s, hdd_s])
+    dist, storow = _dedup_rows(rows.astype(np.int32))
+    sd = max(dist.shape[0], 1)
+    if sd > _MAX_STORE["sd"]:
+        return _reject("storage: distinct node storage configs over kernel scope")
+    if sd * (plvm + pdev) > 256:
+        # the in-kernel score lookup unrolls sd*(plvm+pdev) masked
+        # selects per pod step; keep the instruction budget bounded
+        return _reject("storage: score lookup unroll over kernel budget")
+
+    u_n = lvm.shape[0]
+    smem_entries = u_n * (lv + sv + hv + 1) + u_n * sd * (plvm + pdev)
+    if smem_entries > _MAX_SMEM_ENTRIES // 2:
+        return _reject("storage: score tables over SMEM budget")
+
+    # host-f64 score tables, replicating _local_storage_eval's float
+    # op order exactly (scaled values divide to the same real quotient
+    # as the raw byte values, so IEEE rounding matches)
+    lvm_sc = np.zeros((u_n, sd, plvm), dtype=np.int32)
+    dev_sc = np.zeros((u_n, sd, pdev), dtype=np.int32)
+    for u_i in range(u_n):
+        if not wants[u_i]:
+            continue
+        for s_i in range(dist.shape[0]):
+            caps = dist[s_i]
+            vcaps = caps[:v].astype(np.float64)
+            scaps = caps[v : v + ds_n].astype(np.float64)
+            hcaps = caps[v + ds_n :].astype(np.float64)
+            for p in range(plvm):
+                takes = [0] * v
+                digits = p
+                for i in range(lv):
+                    j = digits % v if v else 0
+                    digits //= max(v, 1)
+                    if lvm_s[u_i, i] > 0:
+                        takes[j] += int(lvm_s[u_i, i])
+                frac = np.float64(0.0)
+                cnt = 0
+                for j in range(v):
+                    if takes[j] > 0:
+                        frac += np.float64(takes[j]) / max(vcaps[j], 1.0)
+                        cnt += 1
+                if cnt > 0:
+                    lvm_sc[u_i, s_i, p] = int(frac / max(cnt, 1) * 10.0)
+            for q in range(pdev):
+                sfrac = np.float64(0.0)
+                hfrac = np.float64(0.0)
+                cnt = 0
+                digits = q
+                for i in range(sv):
+                    d = digits % ds_n if ds_n else 0
+                    digits //= max(ds_n, 1)
+                    if ssd_vs[u_i, i] > 0:
+                        sfrac += np.float64(ssd_vs[u_i, i]) / max(scaps[d], 1.0)
+                        cnt += 1
+                for i in range(hv):
+                    d = digits % dh_n if dh_n else 0
+                    digits //= max(dh_n, 1)
+                    if hdd_vs[u_i, i] > 0:
+                        hfrac += np.float64(hdd_vs[u_i, i]) / max(hcaps[d], 1.0)
+                        cnt += 1
+                if cnt > 0:
+                    dev_sc[u_i, s_i, q] = int((sfrac + hfrac) / max(cnt, 1) * 10.0)
+
+    cfg = StoreCfg(v=v, ds=ds_n, dh=dh_n, lv=lv, sv=sv, hv=hv, sd=sd,
+                   plvm=plvm, pdev=pdev)
+    return StorePlan(
+        cfg=cfg,
+        vg_cap_s=_pad_stack(np.ascontiguousarray(vg_s.T), r),
+        ssd_cap_s=_pad_stack(np.ascontiguousarray(ssd_s.T), r),
+        hdd_cap_s=_pad_stack(np.ascontiguousarray(hdd_s.T), r),
+        has_store=_pad_nodes(
+            a(cluster.has_storage).astype(np.int32), r
+        ),
+        storow=_pad_nodes(storow, r),
+        ivg0=_pad_stack(np.ascontiguousarray(vgu_s.T), r),
+        issd0=_pad_stack(np.ascontiguousarray(ssd_used0.T), r),
+        ihdd0=_pad_stack(np.ascontiguousarray(hdd_used0.T), r),
+        lvm_mi=lvm_s.astype(np.int32).reshape(-1),
+        ssd_mi=ssd_vs.astype(np.int32).reshape(-1),
+        hdd_mi=hdd_vs.astype(np.int32).reshape(-1),
+        wants_u=wants,
+        lvm_sc=lvm_sc.reshape(-1),
+        dev_sc=dev_sc.reshape(-1),
+        scale=int(s),
+    )
 
 
 class StreamTermsPlan(NamedTuple):
@@ -1436,12 +1663,12 @@ def _stream_pack(terms: TermsPlan, u_n: int,
 
 def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                  has_taint: bool, has_pins: bool, s_n: int, g_n: int,
-                 pw: int, tc: Optional[TermsCfg]):
+                 pw: int, sc: Optional[StoreCfg], tc: Optional[TermsCfg]):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    w_least, w_bal, w_simon, w_na, w_tt, w_spread, w_ipa = w
+    w_least, w_bal, w_simon, w_na, w_tt, w_spread, w_ipa, w_ol = w
 
     # ---- ref layout: base inputs, term inputs, outputs, term scratch.
     # The na/tt class tables ride along only when their scores are live
@@ -1449,13 +1676,15 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
     BASE_IN = (
         18 + int(has_nodeaff) + int(has_taint)
         + (3 if s_n else 0) + (6 if g_n else 0) + (3 if pw else 0)
+        + (len(_STORE_FIELDS) if sc is not None else 0)
     )
     stream = tc is not None and tc.stream
     term_fields = _STREAM_TERM_FIELDS if stream else _TERM_FIELDS
     TERM_IN = len(term_fields) if tc is not None else 0
-    # streamed plans append the mutated HBM state buffer as an extra
-    # output (ANY space; never fetched to the host)
-    N_OUT = 7 + int(stream)
+    # storage plans export the final VG usage (capacity vg_util reads
+    # it); streamed plans append the mutated HBM state buffer as an
+    # extra output (ANY space; never fetched to the host)
+    N_OUT = 7 + int(sc is not None) + int(stream)
 
     def two_sum(a, b):
         # Knuth 2Sum (branch-free, round-to-nearest f32): s + err == a + b
@@ -1503,6 +1732,8 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
             ports0_ref = next(it)  # (Pw, R, C) ANY, DMAed to scratch
             wantw_ref = next(it)  # (U*Pw,) SMEM
             conflw_ref = next(it)  # (U*Pw,) SMEM
+        if sc is not None:
+            srf = {nm: next(it) for nm, _ in _STORE_FIELDS}
         if tc is not None:
             tr = dict(zip((nm for nm, _ in term_fields),
                           refs[BASE_IN : BASE_IN + TERM_IN]))
@@ -1525,7 +1756,11 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         outs = refs[BASE_IN + TERM_IN : BASE_IN + TERM_IN + N_OUT]
         (place_ref, st_c_ref, st_m_ref, st_e_ref,
          st_nzc_ref, st_nzm_ref, st_p_ref) = outs[:7]
-        state_out_ref = outs[7] if stream else None
+        oi = 7
+        if sc is not None:
+            vg_out_ref = outs[oi]
+            oi += 1
+        state_out_ref = outs[oi] if stream else None
         extra = refs[BASE_IN + TERM_IN + N_OUT :]
         ei = 0
         if s_n:
@@ -1537,6 +1772,9 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         if pw:
             ports_pl = extra[ei]
             ei += 1
+        if sc is not None:
+            vgu_s, ssdu_s, hddu_s = extra[ei : ei + 3]
+            ei += 3
         if tc is not None:
             if stream:
                 group_s, gtot_s, gath_s = extra[ei : ei + 3]
@@ -1552,7 +1790,7 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 (tgt_s, pref_s, panti_s, antib_s, tposb_s, group_s,
                  gtot_s, soft_s) = extra[ei : ei + 8]
                 ei += 8
-        if s_n or g_n or pw or tc is not None:
+        if s_n or g_n or pw or sc is not None or tc is not None:
             dma_sem = extra[ei]
 
         shape = valid_ref.shape
@@ -1576,7 +1814,7 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
         st_nzc_ref[:] = inzc_ref[:]
         st_nzm_ref[:] = inzm_ref[:]
         st_p_ref[:] = ipc_ref[:]
-        if s_n or g_n or pw or tc is not None:
+        if s_n or g_n or pw or sc is not None or tc is not None:
             # init states arrive in ANY (HBM) so they do not double the
             # VMEM footprint of their scratch copies; one DMA each
             from jax.experimental.pallas import tpu as pltpu_mod
@@ -1588,6 +1826,12 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 copies.append((igpu0_ref, ugpu_s))
             if pw:
                 copies.append((ports0_ref, ports_pl))
+            if sc is not None:
+                copies += [
+                    (srf["ivg0"], vgu_s),
+                    (srf["issd0"], ssdu_s),
+                    (srf["ihdd0"], hddu_s),
+                ]
             if tc is not None:
                 if stream:
                     # the mutable HBM state starts as a copy of the
@@ -1615,9 +1859,11 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 cp.start()
                 cp.wait()
 
-        def step(p, _):
-            # dynamic lane-dim loads are unsupported on TPU: read the
-            # pod's 128-lane row and extract via a masked reduce
+        def step(p, prev_u):
+            # carry = previous pod's class (streamed-terms gather skip;
+            # -1 before the first pod). Dynamic lane-dim loads are
+            # unsupported on TPU: read the pod's 128-lane row and
+            # extract via a masked reduce
             pr = p // LANES
             pc = p % LANES
             lane = lane_iota == pc
@@ -1641,30 +1887,38 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
 
             if stream:
                 # gather this class's term-state rows from HBM into the
-                # (Kmax, R, C) scratch: all fetches start first (round-
-                # robin over the semaphore array) so they overlap, then
-                # one wait pass. Positions beyond the class's row set
-                # (gid < 0) are skipped and never read by the tables.
-                for k in range(tc.kmax):
-                    g_k = tr["gather"][u * tc.kmax + k]
+                # (Kmax, R, C) scratch — ONLY on a class switch. While
+                # consecutive pods share a class the scratch stays
+                # authoritative (commits land in-scratch) and the dirty
+                # rows of the PREVIOUS class are flushed back here, so
+                # replica runs pay one gather+flush per class, not per
+                # pod. All fetches start first (round-robin over the
+                # semaphore array) so they overlap, then one wait pass;
+                # positions beyond a class's row set (gid < 0) are
+                # skipped and never read by the tables.
+                @pl.when(u != prev_u)
+                def _switch():
+                    _flush_class(jnp.maximum(prev_u, 0), prev_u >= 0)
+                    for k in range(tc.kmax):
+                        g_k = tr["gather"][u * tc.kmax + k]
 
-                    @pl.when(g_k >= 0)
-                    def _(k=k, g_k=g_k):
-                        pltpu_mod.make_async_copy(
-                            state_out_ref.at[pl.ds(g_k, 1)],
-                            gath_s.at[pl.ds(k, 1)],
-                            state_sem.at[k % _STREAM_NSEM],
-                        ).start()
-                for k in range(tc.kmax):
-                    g_k = tr["gather"][u * tc.kmax + k]
+                        @pl.when(g_k >= 0)
+                        def _(k=k, g_k=g_k):
+                            pltpu_mod.make_async_copy(
+                                state_out_ref.at[pl.ds(g_k, 1)],
+                                gath_s.at[pl.ds(k, 1)],
+                                state_sem.at[k % _STREAM_NSEM],
+                            ).start()
+                    for k in range(tc.kmax):
+                        g_k = tr["gather"][u * tc.kmax + k]
 
-                    @pl.when(g_k >= 0)
-                    def _(k=k, g_k=g_k):
-                        pltpu_mod.make_async_copy(
-                            state_out_ref.at[pl.ds(g_k, 1)],
-                            gath_s.at[pl.ds(k, 1)],
-                            state_sem.at[k % _STREAM_NSEM],
-                        ).wait()
+                        @pl.when(g_k >= 0)
+                        def _(k=k, g_k=g_k):
+                            pltpu_mod.make_async_copy(
+                                state_out_ref.at[pl.ds(g_k, 1)],
+                                gath_s.at[pl.ds(k, 1)],
+                                state_sem.at[k % _STREAM_NSEM],
+                            ).wait()
 
             used_c = st_c_ref[:]
             used_m = st_m_ref[:]
@@ -1744,6 +1998,84 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                         (ports_pl[w_i] & conflw_ref[u * pw + w_i]) != 0
                     )
                 feas = feas & ~clash
+
+            if sc is not None:
+                # open-local: VG Binpack + exclusive-device first-fit,
+                # mirroring ops/scan.py _local_storage_eval in scaled
+                # int32. The assignment PATTERN (base-V/base-D digit
+                # string) indexes the host-f64 score tables later.
+                wants_s = srf["wants_u"][u]
+                lvm_ok = jnp.ones(shape, bool)
+                pat_lvm = jnp.zeros(shape, jnp.int32)
+                take_vg = [jnp.zeros(shape, jnp.int32) for _ in range(sc.v)]
+                vg_free = [
+                    srf["vg_cap_s"][j] - vgu_s[j] for j in range(sc.v)
+                ]
+                for i in range(sc.lv):
+                    vsz = srf["lvm_mi"][u * sc.lv + i]
+                    act = (vsz > 0).astype(jnp.int32)
+                    best_free = jnp.full(shape, BIG, jnp.int32)
+                    best_j = jnp.zeros(shape, jnp.int32)
+                    for j in range(sc.v):
+                        fj = vg_free[j] - take_vg[j]
+                        # cap=0 (invalid VG) keeps fj <= 0 < vsz for any
+                        # active volume, so validity needs no extra mask
+                        keyj = jnp.where(fj >= vsz, fj, BIG)
+                        better = keyj < best_free  # strict: ties keep lowest j
+                        best_free = jnp.where(better, keyj, best_free)
+                        best_j = jnp.where(better, j, best_j)
+                    ok_i = best_free < BIG
+                    for j in range(sc.v):
+                        selj = ok_i & (best_j == j)
+                        take_vg[j] = take_vg[j] + jnp.where(selj, vsz, 0)
+                    lvm_ok = lvm_ok & (ok_i | (act == 0))
+                    pat_lvm = pat_lvm + (
+                        jnp.where(ok_i, best_j, 0) * ((sc.v ** i) * act)
+                    )
+
+                def fit_dev(d_n, vol_n, cap_nm, used_s, mi_nm, mult0):
+                    """First-fit ascending sizes onto the first free
+                    device with room (scan.py fit_devices); returns
+                    (ok, taken per slot, pattern contribution)."""
+                    d_ok = jnp.ones(shape, bool)
+                    pat = jnp.zeros(shape, jnp.int32)
+                    taken = [jnp.zeros(shape, bool) for _ in range(d_n)]
+                    mult = mult0
+                    for i in range(vol_n):
+                        dsz = srf[mi_nm][u * vol_n + i]
+                        act_d = (dsz > 0).astype(jnp.int32)
+                        found = jnp.zeros(shape, bool)
+                        chosen = jnp.zeros(shape, jnp.int32)
+                        for d in range(d_n):
+                            cd = srf[cap_nm][d]
+                            elig = (
+                                (used_s[d] == 0)
+                                & ~taken[d]
+                                & (cd >= dsz)
+                                & (cd > 0)
+                            )
+                            newly = elig & ~found
+                            chosen = jnp.where(newly, d, chosen)
+                            found = found | elig
+                        for d in range(d_n):
+                            seld = found & (chosen == d) & (act_d != 0)
+                            taken[d] = taken[d] | seld
+                        d_ok = d_ok & (found | (act_d == 0))
+                        pat = pat + jnp.where(found, chosen, 0) * (mult * act_d)
+                        mult *= d_n
+                    return d_ok, taken, pat
+
+                ssd_okv, taken_ssd, pat_s = fit_dev(
+                    sc.ds, sc.sv, "ssd_cap_s", ssdu_s, "ssd_mi", 1
+                )
+                hdd_okv, taken_hdd, pat_h = fit_dev(
+                    sc.dh, sc.hv, "hdd_cap_s", hddu_s, "hdd_mi",
+                    sc.ds ** sc.sv,
+                )
+                pat_dev = pat_s + pat_h
+                has_s = srf["has_store"][:] != 0
+                store_ok = has_s & lvm_ok & ssd_okv & hdd_okv
+                feas = feas & (store_ok | (wants_s == 0))
 
             # ---- inter-pod affinity + topology spread ----
             # Eval reads state directly: count/pref state is zero at
@@ -1956,6 +2288,37 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                 # constant that cannot change the argmax; omitted
                 pass
 
+            if sc is not None and w_ol:
+                # Open-Local raw score: host-f64 table value at (class,
+                # storage row, assignment pattern), then the same
+                # min-max normalize as Simon (scan.py _minmax_normalize)
+                raw_st = jnp.zeros(shape, jnp.int32)
+                srow = srf["storow"][:]
+                for s_i in range(sc.sd):
+                    srm = srow == s_i
+                    base_l = (u * sc.sd + s_i) * sc.plvm
+                    for p in range(sc.plvm):
+                        msk = srm & (pat_lvm == p)
+                        raw_st = raw_st + jnp.where(
+                            msk, srf["lvm_sc"][base_l + p], 0
+                        )
+                    base_d = (u * sc.sd + s_i) * sc.pdev
+                    for q in range(sc.pdev):
+                        msk = srm & (pat_dev == q)
+                        raw_st = raw_st + jnp.where(
+                            msk, srf["dev_sc"][base_d + q], 0
+                        )
+                raw_st = jnp.where(has_s & (wants_s != 0), raw_st, 0)
+                hi_st = jnp.max(jnp.where(feas, raw_st, NEG))
+                lo_st = jnp.min(jnp.where(feas, raw_st, BIG))
+                rng_st = hi_st - lo_st
+                ol_sc = jnp.where(
+                    rng_st > 0,
+                    (raw_st - lo_st) * MAX_SCORE // jnp.maximum(rng_st, 1),
+                    0,
+                )
+                total = total + ol_sc * w_ol
+
             masked = jnp.where(feas, total, NEG)
             m = jnp.max(masked)
             found = m > NEG
@@ -2011,6 +2374,16 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                     ports_pl[w_i] = ports_pl[w_i] | (
                         wantw_ref[u * pw + w_i] * sel_i
                     )
+            if sc is not None:
+                # commit the hypothetical allocation at the placed node
+                # (scan.py: vg_used += onehot*vg_take, ssd/hdd_used |=
+                # onehot & take)
+                for j in range(sc.v):
+                    vgu_s[j] = vgu_s[j] + jnp.where(sel, take_vg[j], 0)
+                for d in range(sc.ds):
+                    ssdu_s[d] = jnp.where(sel & taken_ssd[d], 1, ssdu_s[d])
+                for d in range(sc.dh):
+                    hddu_s[d] = jnp.where(sel & taken_hdd[d], 1, hddu_s[d])
 
             if tc is not None:
                 inc = do.astype(jnp.int32)
@@ -2096,35 +2469,45 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                         ).astype(jnp.int32) * inc
                         soft_s[six] = soft_s[six] + tr["sc_m"][u * tc.scmax + j] * s_upd
 
-                if stream:
-                    # persist the rows this class's commits mutated; the
-                    # waits below double as the ordering barrier against
-                    # the NEXT pod's gather of the same rows
-                    for j in range(tc.wmax):
-                        w_g = tr["wb_gid"][u * tc.wmax + j]
-                        w_p = tr["wb_pos"][u * tc.wmax + j]
+            return u
 
-                        @pl.when(w_g >= 0)
-                        def _(j=j, w_g=w_g, w_p=w_p):
-                            pltpu_mod.make_async_copy(
-                                gath_s.at[pl.ds(jnp.maximum(w_p, 0), 1)],
-                                state_out_ref.at[pl.ds(w_g, 1)],
-                                state_sem.at[j % _STREAM_NSEM],
-                            ).start()
-                    for j in range(tc.wmax):
-                        w_g = tr["wb_gid"][u * tc.wmax + j]
-                        w_p = tr["wb_pos"][u * tc.wmax + j]
+        if stream:
+            # flush the dirty rows of class `cu` back to HBM (no-op
+            # when `valid` is False, i.e. before the first pod). The
+            # waits double as the ordering barrier against the next
+            # class's gather of the same rows.
+            def _flush_class(cu, valid_c):
+                for j in range(tc.wmax):
+                    w_g = tr["wb_gid"][cu * tc.wmax + j]
+                    w_p = tr["wb_pos"][cu * tc.wmax + j]
 
-                        @pl.when(w_g >= 0)
-                        def _(j=j, w_g=w_g, w_p=w_p):
-                            pltpu_mod.make_async_copy(
-                                gath_s.at[pl.ds(jnp.maximum(w_p, 0), 1)],
-                                state_out_ref.at[pl.ds(w_g, 1)],
-                                state_sem.at[j % _STREAM_NSEM],
-                            ).wait()
-            return 0
+                    @pl.when(valid_c & (w_g >= 0))
+                    def _(j=j, w_g=w_g, w_p=w_p):
+                        pltpu_mod.make_async_copy(
+                            gath_s.at[pl.ds(jnp.maximum(w_p, 0), 1)],
+                            state_out_ref.at[pl.ds(w_g, 1)],
+                            state_sem.at[j % _STREAM_NSEM],
+                        ).start()
+                for j in range(tc.wmax):
+                    w_g = tr["wb_gid"][cu * tc.wmax + j]
+                    w_p = tr["wb_pos"][cu * tc.wmax + j]
 
-        jax.lax.fori_loop(0, p_total, step, 0)
+                    @pl.when(valid_c & (w_g >= 0))
+                    def _(j=j, w_g=w_g, w_p=w_p):
+                        pltpu_mod.make_async_copy(
+                            gath_s.at[pl.ds(jnp.maximum(w_p, 0), 1)],
+                            state_out_ref.at[pl.ds(w_g, 1)],
+                            state_sem.at[j % _STREAM_NSEM],
+                        ).wait()
+
+        last_u = jax.lax.fori_loop(0, p_total, step, jnp.int32(-1))
+        if sc is not None:
+            # export the final VG usage (scaled) for the capacity
+            # sweep's vg_util (decode_scan_output converts to bytes)
+            vg_out_ref[:] = vgu_s[:]
+        if stream:
+            # the final class's commits live only in scratch until here
+            _flush_class(jnp.maximum(last_u, 0), last_u >= 0)
 
     return kernel
 
@@ -2154,13 +2537,8 @@ _register_cache(_DEVICE_PLAN_CACHE.clear)
 _register_cache(_POD_SCAL_CACHE.clear)
 
 
-def _device_args(plan: PallasPlan) -> list:
-    import jax
-
-    hit = _DEVICE_PLAN_CACHE.get(id(plan))
-    if hit is not None and hit[0] is plan:
-        _DEVICE_PLAN_CACHE.move_to_end(id(plan))
-        return hit[1]
+def _plan_args_np(plan: PallasPlan) -> list:
+    """The plan's kernel-input arrays, in ref order (host numpy)."""
     args = [
         plan.clsmap,
         plan.alloc_mcpu, plan.alloc_mem_s, plan.alloc_eph_s, plan.alloc_pods,
@@ -2185,6 +2563,8 @@ def _device_args(plan: PallasPlan) -> list:
         ]
     if plan.pw:
         args += [plan.ports0, plan.want_w, plan.confl_w]
+    if plan.store is not None:
+        args += [getattr(plan.store, name) for name, _ in _STORE_FIELDS]
     if plan.terms is not None:
         fields = (
             _STREAM_TERM_FIELDS
@@ -2192,14 +2572,102 @@ def _device_args(plan: PallasPlan) -> list:
             else _TERM_FIELDS
         )
         args += [getattr(plan.terms, name) for name, _ in fields]
+    return args
+
+
+def _plan_metas(args: list) -> tuple:
+    """(shape, dtype) layout of the packed plan buffer — part of the
+    compiled-call cache key (dedup-table row counts vary per plan even
+    at one TermsCfg, so the layout is not derivable from the cfg)."""
+    return tuple((a.shape, str(np.asarray(a).dtype)) for a in args)
+
+
+def _unpack_flat(flat, metas, off=None):
+    """Traced inverse of the host-side pack: slice/reshape/bitcast the
+    single flat int32 buffer back into the kernel's input arrays.
+    Runs INSIDE the compiled call so the slices fuse into the one XLA
+    program — no intermediate device buffers materialize (the axon
+    relay pays ~25ms of serialized latency per buffer it touches,
+    which made per-array plan shipping cost 0.5-1.4s per plan). With
+    `off` (a traced scalar) the plan sits at a dynamic offset inside a
+    GROUP buffer holding many plans (preload_plan_group)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    outs = []
+    o = 0
+    for shape, dt in metas:
+        n = int(np.prod(shape)) if shape else 1
+        if off is None:
+            seg = flat[o : o + n]
+        else:
+            seg = lax.dynamic_slice_in_dim(flat, off + o, n)
+        seg = seg.reshape(shape)
+        if dt == "float32":
+            seg = lax.bitcast_convert_type(seg, jnp.float32)
+        outs.append(seg)
+        o += n
+    return outs
+
+
+def preload_plan_group(plans: list) -> None:
+    """Ship MANY plans' packed buffers in ONE host->device transfer:
+    the group concatenates into a single flat array, and each plan's
+    cache entry records its offset — the compiled call then slices at
+    a traced offset (_unpack_flat off). A multi-spec what-if's first
+    round otherwise pays one serialized relay message per plan."""
+    import jax
+
+    entries = []
+    flats = []
+    o = 0
+    for plan in plans:
+        hit = _DEVICE_PLAN_CACHE.get(id(plan))
+        if (hit is not None and hit[0] is plan) or any(
+            e[0] is plan for e in entries
+        ):
+            continue  # already shipped
+        args = _plan_args_np(plan)
+        metas = _plan_metas(args)
+        flat = np.concatenate(
+            [np.ascontiguousarray(a).view(np.int32).reshape(-1) for a in args]
+        )
+        entries.append((plan, o, metas))
+        flats.append(flat)
+        o += int(flat.size)
+    if not flats:
+        return
+    big = np.concatenate(flats)
     with jax.enable_x64(False):
-        dev = [jax.device_put(a) for a in args]
+        big_dev = jax.device_put(big)
+    for plan, off, metas in entries:
+        if len(_DEVICE_PLAN_CACHE) >= 16:
+            _DEVICE_PLAN_CACHE.popitem(last=False)
+        _DEVICE_PLAN_CACHE[id(plan)] = (plan, (big_dev, off), metas)
+
+
+def _device_args(plan: PallasPlan):
+    """The plan's packed device buffer (ONE flat int32 array, ONE
+    host->device transfer, cached per plan) plus its layout metas."""
+    import jax
+
+    hit = _DEVICE_PLAN_CACHE.get(id(plan))
+    if hit is not None and hit[0] is plan:
+        _DEVICE_PLAN_CACHE.move_to_end(id(plan))
+        return hit[1], hit[2]
+    args = _plan_args_np(plan)
+    metas = _plan_metas(args)
+    flat = np.concatenate(
+        [np.ascontiguousarray(a).view(np.int32).reshape(-1) for a in args]
+    )
+    with jax.enable_x64(False):
+        dev = jax.device_put(flat)
     if len(_DEVICE_PLAN_CACHE) >= 16:
         # evict the least-recently-used entry; a wholesale clear would
         # drop the device copies of plans still in active use
         _DEVICE_PLAN_CACHE.popitem(last=False)
-    _DEVICE_PLAN_CACHE[id(plan)] = (plan, dev)
-    return dev
+    _DEVICE_PLAN_CACHE[id(plan)] = (plan, dev, metas)
+    return dev, metas
 
 # None = auto (use the kernel only on a real TPU backend — the Pallas
 # interpreter would crawl at bench scale on CPU); tests set True to
@@ -2248,18 +2716,23 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     tc = plan.terms.cfg if plan.terms is not None else None
+    sc = plan.store.cfg if plan.store is not None else None
+    flat_dev, metas = _device_args(plan)
+    grouped = isinstance(flat_dev, tuple)
     key = (p_total, plan.r, plan.u, plan.w, plan.has_nodeaff, plan.has_taint,
-           plan.has_pins, plan.s_n, plan.g_n, plan.pw, tc, interpret)
+           plan.has_pins, plan.s_n, plan.g_n, plan.pw, sc, tc, metas,
+           grouped, interpret)
     cached = _COMPILED_CACHE.get(key)
     if cached is None:
         kernel = _make_kernel(p_total, plan.u, plan.w, plan.has_nodeaff,
                               plan.has_taint, plan.has_pins, plan.s_n,
-                              plan.g_n, plan.pw, tc)
+                              plan.g_n, plan.pw, sc, tc)
         rc = (plan.r, LANES)
         base_n = (
             18 + int(plan.has_nodeaff) + int(plan.has_taint)
             + (3 if plan.s_n else 0) + (6 if plan.g_n else 0)
             + (3 if plan.pw else 0)
+            + (len(_STORE_FIELDS) if sc is not None else 0)
         )
         stream = tc is not None and tc.stream
         term_fields = _STREAM_TERM_FIELDS if stream else _TERM_FIELDS
@@ -2282,6 +2755,13 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
             any_idx.add(off)  # ports0
             smem_idx.update((off + 1, off + 2))  # want/conflict words
             off += 3
+        if sc is not None:
+            for soff, (_, space) in enumerate(_STORE_FIELDS):
+                if space == "any":
+                    any_idx.add(off + soff)
+                elif space == "smem":
+                    smem_idx.add(off + soff)
+            off += len(_STORE_FIELDS)
         if tc is not None:
             for toff, (_, space) in enumerate(term_fields):
                 if space == "any":
@@ -2290,7 +2770,7 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
                     smem_idx.add(base_n + toff)
 
         scratch = []
-        if plan.s_n or plan.g_n or plan.pw or tc is not None:
+        if plan.s_n or plan.g_n or plan.pw or sc is not None or tc is not None:
             from jax.experimental.pallas import tpu as _pltpu
 
             rl = (plan.r, LANES)
@@ -2300,6 +2780,12 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
                 scratch.append(_pltpu.VMEM((plan.g_n,) + rl, jnp.int32))
             if plan.pw:
                 scratch.append(_pltpu.VMEM((plan.pw,) + rl, jnp.int32))
+            if sc is not None:
+                scratch += [
+                    _pltpu.VMEM((sc.v,) + rl, jnp.int32),  # vg used
+                    _pltpu.VMEM((sc.ds,) + rl, jnp.int32),  # ssd used
+                    _pltpu.VMEM((sc.dh,) + rl, jnp.int32),  # hdd used
+                ]
             if tc is not None:
                 if stream:
                     scratch += [
@@ -2321,8 +2807,27 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
                     ]
             scratch.append(_pltpu.SemaphoreType.DMA)
 
+        n_ps = 8 * pr_rows * LANES
+        n_act = pr_rows * LANES
+        n_val = plan.r * LANES
+
         @jax.jit
-        def call(*arrays):
+        def call(percall, flat_plan):
+            # both the per-call inputs and the plan ship as ONE packed
+            # buffer each; the slices fuse into this program
+            # (_unpack_flat) so no per-array device buffers ever
+            # materialize — the relay pays ~25ms of serialized latency
+            # per buffer it touches. Grouped plans add their offset as
+            # the trailing percall element.
+            off = percall[n_ps + n_act + n_val] if grouped else None
+            arrays = [
+                percall[:n_ps].reshape(8, pr_rows, LANES),
+                percall[n_ps : n_ps + n_act].reshape(pr_rows, LANES),
+                percall[n_ps + n_act : n_ps + n_act + n_val].reshape(
+                    plan.r, LANES
+                ),
+            ] + _unpack_flat(flat_plan, metas, off)
+
             def spec(i):
                 if i in any_idx:
                     return pl.BlockSpec(memory_space=pl.ANY)
@@ -2335,6 +2840,12 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
             out_specs = [
                 pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(7)
             ]
+            if sc is not None:
+                # final VG usage (capacity vg_util)
+                out_shape.append(
+                    jax.ShapeDtypeStruct((sc.v,) + rc, jnp.int32)
+                )
+                out_specs.append(pl.BlockSpec(memory_space=pltpu.VMEM))
             if stream:
                 # the mutated term-state buffer stays in HBM (ANY) and
                 # is never fetched; listing it as an output gives the
@@ -2351,11 +2862,14 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
                 scratch_shapes=scratch,
                 interpret=interpret,
             )(*arrays)
-            # ONE output array (placements + 6 states concatenated on
-            # the row axis): every host-blocking point on the relay
-            # costs ~0.1s regardless of size, so the whole call must
-            # have exactly one — the single fetch below
-            return jnp.concatenate(outs[:7], axis=0)
+            # ONE output array (placements + 6 states + any VG usage
+            # concatenated on the row axis): every host-blocking point
+            # on the relay costs ~0.1s regardless of size, so the whole
+            # call must have exactly one — the single fetch below
+            fetched = list(outs[:7])
+            if sc is not None:
+                fetched.append(outs[7].reshape(sc.v * plan.r, LANES))
+            return jnp.concatenate(fetched, axis=0)
 
         cached = _Compiled(fn=call)
         _COMPILED_CACHE[key] = cached
@@ -2399,11 +2913,16 @@ def run_scan_pallas(plan: PallasPlan, class_of_pod, pod_active, node_valid,
     # and Mosaic's convert rules recurse on x64-promoted loop indices —
     # trace and run with x64 off
     with jax.enable_x64(False):
-        # per-call inputs ride as numpy straight into the dispatch: an
-        # explicit device_put is a second host-blocking relay roundtrip
-        # (~0.1s); the implicit transfer pipelines with the dispatch so
-        # the single np.asarray fetch is the call's only sync point
-        out_d = cached.fn(pod_scal, active_2d, valid, *_device_args(plan))
+        # per-call inputs ride as ONE packed numpy buffer straight into
+        # the dispatch: the implicit transfer pipelines with the
+        # dispatch so the single np.asarray fetch is the call's only
+        # sync point
+        parts = [pod_scal.reshape(-1), active_2d.reshape(-1), valid.reshape(-1)]
+        if grouped:
+            flat_dev, off_v = flat_dev
+            parts.append(np.array([off_v], dtype=np.int32))
+        percall = np.concatenate(parts)
+        out_d = cached.fn(percall, flat_dev)
         if defer:
             # caller batches several scans (e.g. defrag depths) and
             # fetches them stacked in ONE sync via decode_scan_output
@@ -2439,7 +2958,7 @@ def decode_scan_output(plan: PallasPlan, out: np.ndarray, p_total: int):
     (stacked-fetch) callers."""
     pr_rows = _pr_rows(p_total)
     place = out[:pr_rows]
-    states = out[pr_rows:]
+    states = out[pr_rows : pr_rows + 6 * plan.r]
     place = place.reshape(-1)[:p_total]
     # map padded slots: any placement index beyond n means "no node"
     place = np.where((place >= 0) & (place >= plan.n), -1, place)
@@ -2451,4 +2970,12 @@ def decode_scan_output(plan: PallasPlan, out: np.ndarray, p_total: int):
         "nz_mem": st[4] * plan.s_nzmem,
         "pod_cnt": st[5],
     }
+    if plan.store is not None:
+        v = plan.store.cfg.v
+        vg_rows = out[pr_rows + 6 * plan.r : pr_rows + (6 + v) * plan.r]
+        # (V, R*C) scaled -> [N, V] bytes, the XLA final-state layout
+        final["vg_used"] = (
+            vg_rows.reshape(v, -1)[:, : plan.n].T.astype(np.int64)
+            * plan.store.scale
+        )
     return place, final

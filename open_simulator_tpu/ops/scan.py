@@ -68,6 +68,10 @@ class ScanFeatures(NamedTuple):
     # XLA constant-folds zero-weight plugins out of the step entirely;
     # None = the default profile weights.
     weights: tuple = None
+    # selectHost="sample": reservoir sampling over score ties with the
+    # Go math/rand stream carried in the scan state (_sample_select);
+    # requires init.rng_hist (the GoRand 607-output history)
+    sample: bool = False
 
     @property
     def terms(self) -> bool:
@@ -78,7 +82,8 @@ class ScanFeatures(NamedTuple):
 ALL_FEATURES = ScanFeatures(*([True] * 9))
 
 
-def features_of(static: "ScanStatic", pinned_node, weights=None) -> ScanFeatures:
+def features_of(static: "ScanStatic", pinned_node, weights=None,
+                sample: bool = False) -> ScanFeatures:
     """Derive the feature set host-side.
 
     Inputs are normally concrete arrays; when called from inside a
@@ -94,10 +99,11 @@ def features_of(static: "ScanStatic", pinned_node, weights=None) -> ScanFeatures
         isinstance(x, jax.core.Tracer)
         for x in (static.gpu_mem, static.wants_storage, pinned_node)
     ):
-        return ALL_FEATURES._replace(weights=weights)
+        return ALL_FEATURES._replace(weights=weights, sample=sample)
 
     a = np.asarray
     return ScanFeatures(
+        sample=sample,
         weights=weights,
         gpu=bool(a(static.gpu_mem).max(initial=0) > 0),
         storage=bool(a(static.wants_storage).any()),
@@ -231,6 +237,15 @@ class ScanState(NamedTuple):
     group_counts: jnp.ndarray  # [A, N] all-terms-match counts per group row
     group_total: jnp.ndarray  # [A] total matching pods per group row
     soft_counts: jnp.ndarray  # [Cs, N] qualifying-node-restricted counts
+    # sample-mode Go math/rand state: the last 607 outputs of the
+    # ALFG(607,273) recurrence in order (utils/gorand.py history()),
+    # plus a sticky flag set if a draw ever needs more than
+    # _RNG_KMAX consecutive rejection retries (p < 1e-17 per draw; the
+    # engine raises SampleRngOverflow before committing anything and
+    # core reruns the batch on the serial oracle).
+    # None (the default) on non-sample batches keeps the pytree stable.
+    rng_hist: jnp.ndarray = None  # [607] uint64
+    rng_overflow: jnp.ndarray = None  # [] bool
 
 
 def _default_normalize(raw, feasible, reverse: bool):
@@ -348,6 +363,127 @@ def _local_storage_eval(static: "ScanStatic", state: "ScanState", u):
 
 
 HARD_POD_AFFINITY_WEIGHT = 1  # interpodaffinity args default
+
+# sample-mode rejection-retry bound per Intn draw: Go's Int31n rejects
+# values above 2^31-1 - 2^31%n (probability < n/2^31 ~ 5e-6 at bench
+# node counts), so >4 consecutive rejections has probability < 1e-17
+# per draw — if it ever happens the overflow flag trips and the engine
+# reruns the batch serially instead of diverging from the Go stream
+_RNG_KMAX = 4
+_MASK63 = (1 << 63) - 1
+
+
+def _rng_gen_words(hist, wbuf: int):
+    """The next `wbuf` outputs of the ALFG(607,273) recurrence from an
+    ordered 607-output history, vectorized in blocks: outputs
+    n..n+272 depend only on the current history (y_n = y_{n-607} +
+    y_{n-273}), so each block is one uint64 vector add."""
+    outs = []
+    h = hist
+    for _ in range(-(-wbuf // 273)):
+        nw = h[:273] + h[334:607]  # uint64 wraps mod 2^64
+        outs.append(nw)
+        h = jnp.concatenate([h[273:], nw])
+    return jnp.concatenate(outs)[:wbuf]
+
+
+def _sample_select(masked, feasible, consume, rng_hist, n: int):
+    """selectHost reservoir sampling (generic_scheduler.go:186-209)
+    with bit-exact Go math/rand consumption, vectorized over nodes.
+
+    The serial walk keeps a running max and, at every node TYING it,
+    draws Intn(cnt) (replacing the candidate on 0). Vectorized:
+    - running max = cummax; a node is an IMPROVEMENT when it strictly
+      exceeds the previous prefix max, a TIE when it equals the
+      current one without improving,
+    - cnt at a tie = ties since the last improvement + 1 (segmented
+      count via the cumsum-at-last-improvement trick),
+    - the j-th tie in node order consumes the j-th Intn draw; each
+      draw takes 1 + (#rejections) int31 words (Rand.Int31n's
+      modulo-bias rejection loop; power-of-two n never rejects), so
+      word offsets are a fixpoint of the per-draw consumption —
+      iterated to convergence (rejections are ~1e-6 rare),
+    - the selected node is the LAST improvement-or-winning-draw.
+
+    Returns (best index, new history, overflow flag). `consume` gates
+    the whole thing (inactive/pinned/unschedulable pods draw nothing).
+    """
+    i64 = jnp.int64
+    neg = jnp.iinfo(i64).min
+    cm = jax.lax.cummax(masked)
+    prev = jnp.concatenate([jnp.array([neg], masked.dtype), cm[:-1]])
+    imp = feasible & (masked > prev)
+    tie = feasible & ~imp & (masked == cm)
+    tie = tie & consume
+    imp = imp & consume
+    tie_i = tie.astype(i64)
+    cumt = jnp.cumsum(tie_i)
+    cumt_excl = cumt - tie_i
+    # ties before the current run started (cumt_excl at the last
+    # improvement; cumt_excl is nondecreasing so cummax works)
+    base = jax.lax.cummax(jnp.where(imp, cumt_excl, -1))
+    cnt = jnp.where(tie, cumt - base + 1, 2)
+    pow2 = (cnt & (cnt - 1)) == 0
+    maxv = (2**31 - 1) - (2**31) % cnt
+
+    idx = jnp.arange(n, dtype=i64)
+    wbuf = n + 64
+    words = _rng_gen_words(rng_hist, wbuf)
+    w31 = ((words & jnp.uint64(_MASK63)) >> jnp.uint64(32)).astype(i64)
+
+    # fast path: the N-index gathers are the dominant cost (~55us per
+    # gather at 4k nodes) and a draw REJECTS with probability < 5e-6,
+    # so resolve all draws with ONE gather assuming no rejections and
+    # take the fixpoint branch only when one actually occurred
+    o0 = jnp.cumsum(tie_i) - tie_i
+    w0 = w31[jnp.clip(o0, 0, wbuf - 1)]
+    rej0 = tie & ~pow2 & (w0 > maxv)
+
+    def no_rejections(_):
+        return tie_i, w0, jnp.zeros((), bool)
+
+    def with_rejections(_):
+        def consumption(c):
+            o = jnp.cumsum(c) - c
+            cc = tie_i
+            lead = tie
+            for k in range(_RNG_KMAX):
+                w = w31[jnp.clip(o + k, 0, wbuf - 1)]
+                rej = lead & ~pow2 & (w > maxv)
+                cc = cc + rej.astype(i64)
+                lead = rej
+            return cc, lead
+
+        def cond(st):
+            c, prev_c, _, it = st
+            return jnp.any(c != prev_c) & (it < 16)
+
+        def body(st):
+            c, _, ovf, it = st
+            cc, lead = consumption(c)
+            return cc, c, ovf | jnp.any(lead), it + 1
+
+        c0, lead0 = consumption(tie_i)
+        c, _, overflow, iters = jax.lax.while_loop(
+            cond, body, (c0, tie_i, jnp.any(lead0), jnp.int32(0))
+        )
+        overflow = overflow | (iters >= 16)
+        o = jnp.cumsum(c) - c
+        acc = w31[jnp.clip(o + c - 1, 0, wbuf - 1)]
+        return c, acc, overflow
+
+    c, acc, overflow = jax.lax.cond(
+        jnp.any(rej0), with_rejections, no_rejections, None
+    )
+    r = jnp.where(pow2, acc & (cnt - 1), acc % cnt)
+    hit = tie & (r == 0)
+    event = imp | hit
+    best = jnp.max(jnp.where(event, idx, -1))
+    t_used = jnp.sum(c)
+    overflow = overflow | (t_used > wbuf - _RNG_KMAX)
+    ext = jnp.concatenate([rng_hist, words])
+    new_hist = jax.lax.dynamic_slice(ext, (t_used,), (607,))
+    return best, new_hist, overflow
 
 
 def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, features):
@@ -650,6 +786,14 @@ def run_scan_masked(
         )
     if features is None:
         features = features_of(static, pinned_node, weights=weights)
+    if features.sample:
+        if init.rng_hist is None:
+            raise ValueError(
+                "features.sample needs init.rng_hist (the GoRand "
+                "607-output history; gorand.GoRand.history())"
+            )
+        if init.rng_overflow is None:
+            init = init._replace(rng_overflow=jnp.zeros((), bool))
     return _run_scan_compiled(
         features, static, init, class_of_pod, pinned_node, node_valid, pod_active
     )
@@ -810,8 +954,23 @@ def _run_scan_compiled(
         # ---- select: first max over feasible; pinned overrides ----
         neg = jnp.iinfo(jnp.int64).min
         masked = jnp.where(feasible, total, neg)
-        best = jnp.argmax(masked)
         found = jnp.any(feasible)
+        if features.sample:
+            # reservoir sampling over ties with the Go math/rand
+            # stream in the carry; pinned/inactive/unschedulable pods
+            # consume nothing (the oracle never runs selectHost for
+            # them)
+            consume = active & found
+            if features.pins:
+                consume = consume & (pin < 0)
+            best, new_rng_hist, step_ovf = _sample_select(
+                masked, feasible, consume, state.rng_hist, n
+            )
+            new_rng_overflow = state.rng_overflow | step_ovf
+        else:
+            best = jnp.argmax(masked)
+            new_rng_hist = state.rng_hist
+            new_rng_overflow = state.rng_overflow
         placement = jnp.where(found, best, -1)
         if features.pins:
             placement = jnp.where(pin >= 0, pin, placement)
@@ -877,6 +1036,8 @@ def _run_scan_compiled(
             group_counts=group_counts,
             group_total=group_total,
             soft_counts=soft_counts,
+            rng_hist=new_rng_hist,
+            rng_overflow=new_rng_overflow,
         )
         return new_state, placement
 

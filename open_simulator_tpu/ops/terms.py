@@ -27,8 +27,8 @@ kubernetes.io/hostname use the node index itself as the value id, so V
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
 import numpy as np
 

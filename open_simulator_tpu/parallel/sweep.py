@@ -11,10 +11,11 @@ every candidate count is one scenario of a single batched computation:
   drops daemonset pods that belong to disabled nodes via a pod-activity
   mask (the reference regenerates them per run)
 - `vmap(run_scan_masked)` evaluates all scenarios at once; over a
-  `jax.sharding.Mesh` the scenario axis is sharded across devices with
-  `shard_map` — scenarios are independent, so the only communication is
-  the result gather (this is the "distributed backend": XLA collectives
-  over ICI, not a port of anything — the reference is single-process)
+  `jax.sharding.Mesh` the scenario axis is sharded across devices via
+  `jit` with NamedSharding in_shardings (probe_many below) — scenarios
+  are independent, so XLA's only communication is the result gather
+  (this is the "distributed backend": XLA collectives over ICI, not a
+  port of anything — the reference is single-process)
 
 Returns per-scenario unscheduled counts and cluster utilization, from
 which the planner picks the minimal feasible count
@@ -107,6 +108,7 @@ class CapacitySweep:
         max_count: int,
         use_greed: bool = False,
         score_weights=None,
+        share_pods_from: "Optional[CapacitySweep]" = None,
     ):
         from ..ops.encode import (
             encode_batch,
@@ -124,44 +126,69 @@ class CapacitySweep:
 
         # Build oracle at full padding; generate the full pod sequence
         # the serial path would see (cluster pods, then apps in order).
+        # A multi-spec what-if (probe_plan_multi) reuses a sibling
+        # sweep's expanded pod list when expansion is provably
+        # spec-INDEPENDENT: the only node-dependent expansions are
+        # daemonsets (one pod per node) and greed_sort ordering. The
+        # shared dicts follow the same repeated-replay contract as one
+        # sweep replayed at several counts (had_node_name below).
+        if (
+            share_pods_from is None
+            or use_greed
+            or padded.daemon_sets
+            or any(app.resource.daemon_sets for app in apps)
+            or share_pods_from.max_count != self.max_count
+            or share_pods_from.n_base != len(cluster.nodes)
+        ):
+            share_pods_from = None
+        # replays MUTATE pod dicts (bind writes nodeName/phase), so a
+        # multi-spec caller must give each sweep its own copies before
+        # replaying (applier.probe_plan_multi checks this flag)
+        self.pods_shared = share_pods_from is not None
         with phase("sweep/expand"):
             self.oracle = Oracle(padded.nodes)
-            pods: List[dict] = []
-            pods.extend(wl.pods_excluding_daemon_sets(padded))
-            for ds in padded.daemon_sets:
-                pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
-            for app in apps:
-                app_pods = wl.generate_valid_pods_from_app(
-                    app.name, app.resource, padded.nodes
-                )
-                if use_greed:
-                    # same ordering the authoritative serial run will
-                    # use (scheduler/core.py schedule_app): greed_sort
-                    # ignores simon new nodes, so max-count padding and
-                    # the per-count serial cluster sort pods identically
-                    from ..scheduler.queues import greed_sort
+            if share_pods_from is not None:
+                # expansion (and its priority/plugin checks) shared
+                pods = share_pods_from.pods
+            else:
+                pods: List[dict] = []
+                pods.extend(wl.pods_excluding_daemon_sets(padded))
+                for ds in padded.daemon_sets:
+                    pods.extend(wl.pods_from_daemon_set(ds, padded.nodes))
+                for app in apps:
+                    app_pods = wl.generate_valid_pods_from_app(
+                        app.name, app.resource, padded.nodes
+                    )
+                    if use_greed:
+                        # same ordering the authoritative serial run
+                        # will use (scheduler/core.py schedule_app):
+                        # greed_sort ignores simon new nodes, so
+                        # max-count padding and the per-count serial
+                        # cluster sort pods identically
+                        from ..scheduler.queues import greed_sort
 
-                    app_pods = greed_sort(padded.nodes, app_pods)
-                pods.extend(_sort_app_pods(app_pods))
-            from ..scheduler.preemption import (
-                build_priority_resolver,
-                pod_uses_priority,
-            )
+                        app_pods = greed_sort(padded.nodes, app_pods)
+                    pods.extend(_sort_app_pods(app_pods))
+                from ..scheduler.preemption import (
+                    build_priority_resolver,
+                    pod_uses_priority,
+                )
 
-            resolver = build_priority_resolver(cluster.priority_classes)
-            if any(pod_uses_priority(p, resolver) for p in pods):
-                raise PrioritySignalError(
-                    "workload carries priority/priorityClassName; the batched "
-                    "scan has no priority/preemption semantics — use the "
-                    "serial engine (scheduler/core.py falls back automatically)"
-                )
-            if self.oracle.registry.needs_serial:
-                raise PrioritySignalError(
-                    "a registered plugin defines permit() or a stateful hook "
-                    "(reserve/prebind); the batched scan cannot honor per-pod "
-                    "host callbacks — use the serial engine "
-                    "(scheduler/core.py falls back automatically)"
-                )
+                resolver = build_priority_resolver(cluster.priority_classes)
+                if any(pod_uses_priority(p, resolver) for p in pods):
+                    raise PrioritySignalError(
+                        "workload carries priority/priorityClassName; the "
+                        "batched scan has no priority/preemption semantics — "
+                        "use the serial engine (scheduler/core.py falls back "
+                        "automatically)"
+                    )
+                if self.oracle.registry.needs_serial:
+                    raise PrioritySignalError(
+                        "a registered plugin defines permit() or a stateful "
+                        "hook (reserve/prebind); the batched scan cannot "
+                        "honor per-pod host callbacks — use the serial "
+                        "engine (scheduler/core.py falls back automatically)"
+                    )
         self.pods = pods
         self.n = len(padded.nodes)
         self.n_base = self.n - self.max_count
@@ -307,7 +334,10 @@ class CapacitySweep:
         cpu_util = 100.0 * float(final["used_mcpu"][v].sum()) / denom_c
         mem_util = 100.0 * float(final["used_mem"][v].sum()) / denom_m
         vg_cap = np.asarray(self.cluster_enc.vg_cap)
-        vg_used = np.asarray(self.dyn.vg_used)
+        # final VG usage exported by the kernel (storage batches ride
+        # the Pallas path since r5); storage-free batches never grow
+        # it, so the init state is exact for them
+        vg_used = np.asarray(final.get("vg_used", self.dyn.vg_used))
         denom_vg = max(int(vg_cap[v].sum()), 1)
         vg_util = 100.0 * float(vg_used[v].sum()) / denom_vg
         return ProbeResult(
@@ -467,34 +497,30 @@ class CapacitySweep:
                 extra = max(extra, -(-need // alloc))
         return extra
 
-    def find_min_count(
-        self,
-        feasible,
-        start: int = 0,
-        on_probe=None,
-    ) -> Optional[ProbeResult]:
-        """Smallest count whose probe satisfies `feasible(ProbeResult)`,
-        exploiting monotonicity (more nodes never schedule fewer pods,
-        asserted by tests/test_capacity.py): probe `start`; on failure
+    def _search_gen(self, feasible, start: int = 0, widen: bool = False):
+        """The min-count search as a COROUTINE: yields lists of counts
+        to probe, receives {count: ProbeResult}, and returns the best
+        result (or None) via StopIteration. Extracting the control flow
+        from the probe transport lets find_min_count fulfil requests
+        one spec at a time while find_min_count_multi batches the
+        requests of MANY specs into one device sync per round.
+
+        Search shape (unchanged from r3/r4): probe `start`; on failure
         escalate by the unscheduled-request estimate (with a doubling
-        backstop), then bisect the bracket. Typically 1 scan when the
-        resource lower bound is tight, O(log max) otherwise."""
+        backstop) — asking for (hi-1, hi) together on the Pallas path
+        since the estimate usually lands exactly — then bisect the
+        bracket, confirming hi-1 first. Monotonicity (more nodes never
+        schedule fewer pods) is asserted by tests/test_capacity.py."""
         probes: dict = {}
 
-        def probe(c: int) -> ProbeResult:
-            if c not in probes:
-                probes[c] = self.probe(c)
-                if on_probe is not None:
-                    on_probe(probes[c])
-            return probes[c]
-
-        res = probe(start)
+        probes.update((yield [start]))
+        res = probes[start]
         if feasible(res):
             return res
         # grow bracket: (lo known-infeasible, hi candidate]
         lo, escalations = start, 0
         while True:
-            step = max(self.estimate_extra(probe(lo)), 1 << escalations)
+            step = max(self.estimate_extra(probes[lo]), 1 << escalations)
             hi = min(lo + step, self.max_count)
             if (
                 hi - lo > 1
@@ -502,45 +528,197 @@ class CapacitySweep:
                 and hi - 1 not in probes
                 and self._pallas_plan is not None
             ):
-                # the estimate usually lands exactly, making hi-1 the
-                # bisection's very next question — dispatch both scans
-                # in one device sync (probe_pair) and seed the cache.
-                # Pallas path only: the XLA fallback would pay two full
-                # sequential scans for a speculative answer
-                r_minus, r_hi = self.probe_pair(hi - 1, hi)
-                for r in (r_minus, r_hi):
-                    if r.count not in probes:
-                        probes[r.count] = r
-                        if on_probe is not None:
-                            on_probe(r)
-                res = r_hi
-            else:
-                res = probe(hi)
+                # hi-1 is usually the bisection's very next question:
+                # ask for both in one round
+                probes.update((yield [hi - 1, hi]))
+            elif hi not in probes:
+                probes.update((yield [hi]))
+            res = probes[hi]
             if feasible(res):
                 break
             lo = hi
             if hi == self.max_count:
                 return None  # infeasible even at max
             escalations += 1
-        # bisect (lo infeasible, hi feasible]; the estimate usually
-        # lands exactly, so confirm hi-1 first — one probe instead of a
-        # full bisection when it is infeasible
+        # bisect (lo infeasible, hi feasible]. In the MULTI driver
+        # (widen=True) a small bracket probes every interior count in
+        # one round instead of log2 sequential rounds — extra scans
+        # are cheap at what-if scale and each saved round saves a
+        # relay round-trip; the single-spec path keeps pure bisection
+        # (a 100k-pod capacity probe costs ~1s of scan, so extra
+        # probes would dominate the saved latency)
         best = res
         lo_b, hi_b = lo, best.count
+        if widen and 2 < hi_b - lo_b <= 16 and self._pallas_plan is not None:
+            need = [c for c in range(lo_b + 1, hi_b) if c not in probes]
+            if need:
+                probes.update((yield need))
+            for c in range(lo_b + 1, hi_b):
+                if feasible(probes[c]):
+                    return probes[c]
+            return best
         if hi_b - lo_b > 1:
-            res = probe(hi_b - 1)
+            c = hi_b - 1
+            if c not in probes:
+                probes.update((yield [c]))
+            res = probes[c]
             if feasible(res):
-                best, hi_b = res, hi_b - 1
+                best, hi_b = res, c
             else:
-                lo_b = hi_b - 1
+                lo_b = c
         while hi_b - lo_b > 1:
             mid = (lo_b + hi_b) // 2
-            res = probe(mid)
+            if mid not in probes:
+                probes.update((yield [mid]))
+            res = probes[mid]
             if feasible(res):
                 best, hi_b = res, mid
             else:
                 lo_b = mid
         return best
+
+    def _fulfill(self, req: List[int], on_probe=None) -> dict:
+        """Probe the requested counts — paired into one device sync on
+        the Pallas path when the search asks for two."""
+        if len(req) == 2 and self._pallas_plan is not None:
+            r1, r2 = self.probe_pair(req[0], req[1])
+            out = {r1.count: r1, r2.count: r2}
+        else:
+            out = {c: self.probe(c) for c in req}
+        if on_probe is not None:
+            for r in out.values():
+                on_probe(r)
+        return out
+
+    def find_min_count(
+        self,
+        feasible,
+        start: int = 0,
+        on_probe=None,
+    ) -> Optional[ProbeResult]:
+        """Smallest count whose probe satisfies `feasible(ProbeResult)`
+        (one spec; see _search_gen for the search shape)."""
+        gen = self._search_gen(feasible, start)
+        try:
+            req = next(gen)
+            while True:
+                req = gen.send(self._fulfill(req, on_probe))
+        except StopIteration as stop:
+            return stop.value
+
+
+def find_min_count_multi(jobs, on_probe=None) -> List[Optional[ProbeResult]]:
+    """Drive MANY specs' min-count searches in lockstep: `jobs` is a
+    list of (CapacitySweep, feasible, start). Each round collects every
+    live spec's requested probe counts, dispatches ALL of them deferred
+    on the Pallas path, and fetches the stacked outputs in ONE device
+    sync — so a what-if sweep over K newnode specs pays the relay's
+    per-sync latency once per ROUND (~3-4 rounds total) instead of once
+    per probe (~23 for the 8-spec bench; the r4 RTT bound,
+    docs/PERFORMANCE.md). Sweeps on the XLA fallback path fulfil their
+    requests individually inside the round.
+
+    Replaces the per-guess re-simulation loop of the reference's
+    interactive Applier (pkg/apply/apply.go:186-239) across candidate
+    node SPECS, not just counts."""
+    import jax.numpy as jnp
+
+    from ..ops import pallas_scan
+    from ..utils.trace import GLOBAL, phase
+
+    # ship every spec's plan in ONE grouped transfer before round 1
+    # (otherwise the first round pays one serialized relay message per
+    # plan buffer)
+    pallas_scan.preload_plan_group(
+        [s._pallas_plan for s, _, _ in jobs if s._pallas_plan is not None]
+    )
+    gens = []
+    pending: List[Optional[List[int]]] = []
+    results: List[Optional[ProbeResult]] = []
+    for sweep, feasible, start in jobs:
+        g = sweep._search_gen(feasible, start, widen=True)
+        gens.append(g)
+        results.append(None)
+        pending.append(next(g))
+    live = list(range(len(jobs)))
+    rounds = dispatches = syncs = 0
+    round_log = []
+    while live:
+        import time as _time
+
+        _t0 = _time.time()
+        _n0 = dispatches
+        rounds += 1
+        answers: List[dict] = [dict() for _ in jobs]
+        deferred = []  # (job index, count, valid, device out)
+        with phase("sweep/probe-multi"):
+            for i in live:
+                sweep = jobs[i][0]
+                for c in pending[i]:
+                    dispatches += 1
+                    if sweep._pallas_plan is not None:
+                        valid = sweep.node_valid(c)
+                        out_d = pallas_scan.run_scan_pallas(
+                            sweep._pallas_plan,
+                            sweep.batch.class_of_pod,
+                            sweep.pod_active(valid),
+                            valid,
+                            pinned=sweep.batch.pinned_node,
+                            defer=True,
+                        )
+                        deferred.append((i, c, valid, out_d))
+                    else:
+                        answers[i][c] = sweep.probe(c)
+                        syncs += 1
+            # ONE host-blocking point per round and shape: the round's
+            # outputs stack on-device and fetch as a single array (on
+            # the relay every blocking fetch costs ~0.1-0.15s
+            # REGARDLESS of size, and per-array async host copies do
+            # NOT pipeline — jax.device_get of 44 arrays measured 6s).
+            # The stack is padded to a power-of-two row count so the
+            # concatenate compiles for O(log max) distinct shapes ever,
+            # all hits in the persistent compilation cache after the
+            # first encounter.
+            by_shape: dict = {}
+            for item in deferred:
+                by_shape.setdefault(item[3].shape, []).append(item)
+            for items in by_shape.values():
+                k = len(items)
+                bucket = 1 << (k - 1).bit_length()
+                rows_d = [it[3] for it in items]
+                rows_d += [rows_d[0]] * (bucket - k)
+                stacked = np.asarray(jnp.stack(rows_d))
+                syncs += 1
+                for row, (i, c, valid, _) in zip(stacked, items):
+                    sweep = jobs[i][0]
+                    placements, final = pallas_scan.decode_scan_output(
+                        sweep._pallas_plan,
+                        row,
+                        int(np.asarray(sweep.batch.class_of_pod).shape[0]),
+                    )
+                    answers[i][c] = sweep._pallas_result(
+                        c, valid, placements, final
+                    )
+        nxt = []
+        for i in live:
+            if on_probe is not None:
+                for r in answers[i].values():
+                    on_probe(r)
+            try:
+                pending[i] = gens[i].send(answers[i])
+                nxt.append(i)
+            except StopIteration as stop:
+                results[i] = stop.value
+                pending[i] = None
+        live = nxt
+        round_log.append(
+            f"{dispatches - _n0}p/{_time.time() - _t0:.2f}s"
+        )
+    GLOBAL.note("whatif-rounds", rounds)
+    GLOBAL.note("whatif-dispatches", dispatches)
+    GLOBAL.note("whatif-syncs", syncs)
+    GLOBAL.note("whatif-round-log", ",".join(round_log))
+    return results
 
 
 def sweep_node_counts(

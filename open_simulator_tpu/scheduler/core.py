@@ -24,7 +24,7 @@ The `engine` argument selects the scheduling backend:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from ..models.decode import ResourceTypes
 from ..models import workloads as wl
@@ -113,12 +113,15 @@ class Simulator:
         # (the priority-scan escape predicate reads the same flag)
         self.enable_preemption = enable_preemption
         # selectHost tie rule (oracle.py module docstring): "sample"
-        # consumes a host RNG per tie, so it forces the serial path
+        # rides the XLA scan since r5 — the Go math/rand stream is
+        # carried in the scan state (ops/scan.py _sample_select) and
+        # handed back to the oracle after each batch, so serial
+        # fallbacks (priority escapes) continue the exact sequence
         self.select_host = select_host
         # HTTP extenders are host RPC per pod: they force the serial
         # oracle path (SURVEY.md §2.3 host-callback escape hatch)
         self.extenders = list(extenders or [])
-        if self.extenders or select_host == "sample":
+        if self.extenders:
             self.engine_kind = "oracle"
         self.oracle: Optional[Oracle] = None
         self.cluster_pods: List[dict] = []
@@ -199,6 +202,7 @@ class Simulator:
         # per batch (_schedule_pods_priority). Dense-priority workloads
         # that place cleanly cost one scan, same as zero-priority ones.
         from .preemption import pod_uses_priority
+        from .engine import SampleRngOverflow
         from ..utils.trace import GLOBAL
 
         # a permit reject or a stateful plugin hook on the selected node
@@ -214,8 +218,23 @@ class Simulator:
         )
         if priority_free:
             GLOBAL.note("engine", "batch")
-            failed = self._schedule_pods_tpu(pods)
-        elif tpu_ok and len(pods) >= MIN_SCAN_RUN:
+            try:
+                failed = self._schedule_pods_tpu(pods)
+            except SampleRngOverflow:
+                # a sample-mode draw exceeded the in-scan rejection
+                # bound (p < 1e-17 per draw); nothing was committed, so
+                # the serial oracle reruns the batch with exact
+                # unbounded rejection semantics
+                GLOBAL.note("engine", "serial-oracle (sample rng overflow)")
+                failed, _ = self._schedule_pods_oracle(pods)
+        elif tpu_ok and len(pods) >= MIN_SCAN_RUN and (
+            self.oracle.select_host != "sample"
+        ):
+            # sample mode stays off the priority-scan engine: an escape
+            # DISCARDS the scanned tail and rescans it, but the scan
+            # already consumed those pods' Go-RNG draws — the rescan
+            # would double-consume the stream and diverge from the
+            # serial walk (review r5); serial is exact for this corner
             failed = self._schedule_pods_priority(pods)
         else:
             GLOBAL.note("engine", "serial-oracle")

@@ -11,13 +11,12 @@ conformance tests (tests/test_engine_conformance.py).
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List
 
 import numpy as np
 
 from ..ops.encode import (
     ClusterStatic,
-    PodBatch,
     encode_batch,
     encode_cluster,
     encode_dynamic,
@@ -25,7 +24,15 @@ from ..ops.encode import (
 )
 from .oracle import Oracle
 
-__all__ = ["TpuEngine"]
+__all__ = ["SampleRngOverflow", "TpuEngine"]
+
+
+class SampleRngOverflow(RuntimeError):
+    """A sample-mode Intn draw needed more rejection retries than the
+    in-scan bound (ops/scan.py _RNG_KMAX; p < 1e-17 per draw). Raised
+    BEFORE any commit is replayed, so the caller (core._schedule_pods)
+    can rerun the batch on the serial oracle, whose rejection loop is
+    unbounded."""
 
 
 class TpuEngine:
@@ -80,8 +87,11 @@ class TpuEngine:
             self._last_simple = simple_commit_mask(batch, bool(oracle.extenders))
             self._class_commit_info = ClassCommitCache()
             dyn = encode_dynamic(oracle, cluster)
+            sample = getattr(oracle, "select_host", "first-max") == "sample"
             features = features_of_batch(
-                cluster, batch, weights=getattr(oracle, "score_weights", None)
+                cluster, batch,
+                weights=getattr(oracle, "score_weights", None),
+                sample=sample,
             )
             from ..ops import pallas_scan
 
@@ -95,6 +105,16 @@ class TpuEngine:
             if plan is None:
                 static = to_scan_static(cluster, batch)
                 init = to_scan_state(dyn, batch)
+                if sample:
+                    # the scan consumes the oracle's Go RNG stream: hand
+                    # its 607-output history in via the carry, and (after
+                    # the scan) write the advanced stream back so serial
+                    # fallbacks continue the exact sequence
+                    init = init._replace(
+                        rng_hist=jnp.asarray(
+                            np.array(oracle._rng.history(), dtype=np.uint64)
+                        )
+                    )
         from ..utils.trace import GLOBAL
 
         # never a silent fallback: name why the fused kernel was out of
@@ -118,7 +138,7 @@ class TpuEngine:
                 )
             return out
         with profiled("engine/scan"):
-            placements, _ = scan_ops.run_scan(
+            placements, final_state = scan_ops.run_scan(
                 static,
                 init,
                 jnp.asarray(batch.class_of_pod),
@@ -126,6 +146,17 @@ class TpuEngine:
                 features=features,
             )
             out = np.asarray(placements)  # blocks on device completion
+        if sample:
+            if bool(np.asarray(final_state.rng_overflow)):
+                # oracle state is untouched (commits replay only after
+                # this returns); core catches this and reruns serially
+                raise SampleRngOverflow(
+                    "sample-mode RNG rejection overflow; rerunning the "
+                    "batch on the serial oracle"
+                )
+            oracle._rng.set_history(
+                [int(x) for x in np.asarray(final_state.rng_hist)]
+            )
         return out
 
     def commit_host(self, pod: dict, node_idx: int):

@@ -49,7 +49,6 @@ from typing import Dict, List, Optional, Tuple
 from ..models import labels as lbl
 from ..models import requests as req
 from ..models import storage as stor
-from ..models.workloads import DEFAULT_SCHEDULER_NAME
 from ..utils.memo import IdentityMemo
 
 MAX_NODE_SCORE = 100

@@ -35,7 +35,7 @@ constant-folds disabled plugins out of the step entirely.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, NamedTuple, Optional
+from typing import Dict, List, NamedTuple
 
 import yaml
 

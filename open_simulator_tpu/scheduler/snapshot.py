@@ -14,7 +14,6 @@ annotations + placed pods) round-trips through one JSON file, enabling
 from __future__ import annotations
 
 import json
-from typing import List
 
 from ..models.decode import ResourceTypes
 from .core import NodeStatus, SimulateResult, Simulator

@@ -157,6 +157,35 @@ class GoRand:
                 u ^= self._cooked[i]
                 self.vec[i] = u & _MASK64
 
+    def history(self) -> List[int]:
+        """The last 607 outputs of the recurrence in ORDER (oldest
+        first) — the flat representation the TPU scan carries (the
+        sequence y_n = y_{n-607} + y_{n-273} is fully determined by
+        any 607 consecutive outputs; the seed expansion IS the first
+        607 outputs). Round-trips through set_history."""
+        # label the NEXT output y_0. Call k (k=1..) reads and then
+        # overwrites vec[(feed-k)%L] as y_{k-608}, so at the current
+        # state vec[(feed-k)%L] still holds y_{k-608}; with m = k-1,
+        # hist[m] = y_{m-607} = vec[(feed-m-1)%L]. (Verified against
+        # the recurrence: the first word _rng_gen_words produces from
+        # this history equals the next uint64() — test_gorand.)
+        out = [0] * _LEN
+        for m in range(_LEN):
+            out[m] = self.vec[(self.feed - m - 1) % _LEN]
+        return out
+
+    def set_history(self, hist: List[int]) -> None:
+        """Restore the generator from an ordered last-607-outputs
+        history (the inverse of history()) — used by the TPU engine to
+        hand the device-advanced sample-mode stream back to the
+        oracle so serial fallbacks continue the exact sequence."""
+        if len(hist) != _LEN:
+            raise ValueError(f"history must have {_LEN} entries")
+        self.tap = 0
+        self.feed = _LEN - _TAP
+        for m in range(_LEN):
+            self.vec[(self.feed - m - 1) % _LEN] = hist[m] & _MASK64
+
     def uint64(self) -> int:
         """rngSource.Uint64: x[n] = x[n-607] + x[n-273] mod 2^64."""
         self.tap -= 1

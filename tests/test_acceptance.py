@@ -29,7 +29,6 @@ import yaml
 from open_simulator_tpu.apply.applier import Applier, SimonConfig
 from open_simulator_tpu.models import workloads as wl
 from open_simulator_tpu.models.chart import process_chart
-from open_simulator_tpu.models.decode import decode_yaml_content
 
 REF = Path("/root/reference/example")
 PINNED_NEW_NODE_COUNT = 18

@@ -370,3 +370,62 @@ def test_sweep_with_hostname_spread_matches_serial():
             int(res.unscheduled[s]),
             len(serial.unscheduled_pods),
         )
+
+
+def test_probe_plan_multi_matches_probe_plan_and_isolates_results():
+    """The multi-spec what-if must return, per spec, the SAME plan as a
+    standalone probe_plan — and later specs' replays must not rewrite
+    the pod dicts embedded in earlier specs' results (the sweeps share
+    one expanded pod list; review r5)."""
+    from open_simulator_tpu.apply.applier import probe_plan, probe_plan_multi
+    from open_simulator_tpu.models.workloads import reset_name_counter
+    from open_simulator_tpu.testing import make_fake_node
+
+    nodes = [make_fake_node(f"base-{i}", "4", "8Gi") for i in range(6)]
+    res = ResourceTypes()
+    res.deployments = [
+        {
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "d"},
+            "spec": {
+                "replicas": 40,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "i",
+                                "resources": {
+                                    "requests": {"cpu": "1", "memory": "1Gi"}
+                                },
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    apps = [AppResource("a", res)]
+    big = make_fake_node("tpl-big", "16", "64Gi")
+    small = make_fake_node("tpl-small", "4", "8Gi")
+
+    reset_name_counter()
+    solo = [probe_plan(cluster, apps, tpl) for tpl in (big, small)]
+    reset_name_counter()
+    multi = probe_plan_multi(cluster, apps, [big, small])
+    assert [r.new_node_count for r in multi] == [
+        r.new_node_count for r in solo
+    ]
+    # isolation: every pod dict embedded in a result's node_status must
+    # carry THAT result's binding, not a later spec's
+    for r in multi:
+        for ns in r.result.node_status:
+            node_name = ns.node["metadata"]["name"]
+            for p in ns.pods:
+                bound = (p.get("spec") or {}).get("nodeName")
+                assert bound == node_name, (
+                    f"pod {p['metadata']['name']} grouped under "
+                    f"{node_name} but bound to {bound}"
+                )

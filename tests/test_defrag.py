@@ -10,7 +10,7 @@ import numpy as np
 
 from open_simulator_tpu.models.decode import ResourceTypes
 from open_simulator_tpu.parallel.defrag import plan_defrag, rank_nodes_for_drain
-from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.scheduler.core import simulate
 from open_simulator_tpu.testing import make_fake_node, make_fake_pod
 
 

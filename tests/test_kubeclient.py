@@ -2,7 +2,6 @@
 apiserver, mirroring CreateClusterResourceFromClient
 (pkg/simulator/simulator.go:369-441)."""
 
-import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, HTTPServer

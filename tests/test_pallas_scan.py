@@ -142,8 +142,9 @@ def test_final_state_matches_placements():
 
 
 def test_build_plan_rejects_out_of_scope():
-    """Open-local storage stays outside the kernel; a gpu batch with no
-    gpu capacity anywhere also falls back (the scan handles both)."""
+    """Custom out-of-tree plugin machinery stays outside the kernel
+    (the XLA scan carries it); storage joined the kernel in r5, so the
+    reject path is pinned on the custom flag."""
     reset_name_counter()
     nodes = [make_fake_node("g-0", "8", "32Gi")]
     oracle = Oracle(nodes)
@@ -153,10 +154,10 @@ def test_build_plan_rejects_out_of_scope():
     dyn = encode_dynamic(oracle, cluster)
     features = features_of_batch(cluster, batch)
     plan = pallas_scan.build_plan(
-        cluster, batch, dyn, features._replace(storage=True)
+        cluster, batch, dyn, features._replace(custom=True)
     )
     assert plan is None
-    assert "storage" in (pallas_scan.last_reject() or "")
+    assert "custom" in (pallas_scan.last_reject() or "")
 
 
 def test_engine_and_sweep_integration_forced(monkeypatch):

@@ -1,0 +1,197 @@
+"""First-party AST linter (`make lint`).
+
+No third-party linter ships in this environment, so the lint gate is a
+small pyflakes-class checker built on the stdlib `ast`:
+
+- F401 unused imports (module scope; `__init__.py` re-exports and
+  `# noqa` lines are exempt)
+- F811 duplicate function/class definitions in one scope
+- B006 mutable default arguments (list/dict/set literals)
+- E722 bare `except:`
+- E711 comparisons to None with ==/!=
+- F541 f-strings without any placeholder
+- B011/assert-tuple: `assert (x, y)` is always true
+- W605 invalid escape sequences surface as SyntaxWarning at compile
+  time and are promoted to errors by `compileall` in `make lint`
+
+Checks that need full scope resolution (undefined names) are out of
+scope — `compileall` plus the test suite carry those.
+
+Exit status 1 when any finding is reported (CI gate).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOTS = ["open_simulator_tpu", "tools", "tests", "bench.py", "__graft_entry__.py"]
+
+
+def _is_noqa(source_lines, lineno: int) -> bool:
+    if 1 <= lineno <= len(source_lines):
+        return "noqa" in source_lines[lineno - 1]
+    return False
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: Path, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.findings: list = []
+        self.is_init = path.name == "__init__.py"
+
+    def report(self, lineno: int, code: str, msg: str):
+        if not _is_noqa(self.lines, lineno):
+            self.findings.append((self.path, lineno, code, msg))
+
+    # -- unused imports (module scope only, conservative) --------------
+    def check_unused_imports(self):
+        if self.is_init:
+            return  # __init__ re-exports are intentional
+        imported: dict = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    imported[name] = node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imported[a.asname or a.name] = node.lineno
+        if not imported:
+            return
+        used: set = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # base Name is visited separately
+        # names referenced in __all__ strings count as used
+        for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        used.add(elt.value)
+        for name, lineno in imported.items():
+            if name not in used:
+                self.report(lineno, "F401", f"'{name}' imported but unused")
+
+    # -- visitors ------------------------------------------------------
+    def visit_scope_body(self, body, scope: str):
+        seen: dict = {}
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                prev = seen.get(node.name)
+                # a def directly following its namesake is a redefinition
+                # bug; separated defs behind ifs are dispatch patterns
+                if prev is not None and not any(
+                    isinstance(n, (ast.If, ast.Try)) for n in body
+                ):
+                    self.report(
+                        node.lineno,
+                        "F811",
+                        f"redefinition of '{node.name}' from line {prev}",
+                    )
+                seen[node.name] = node.lineno
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.visit_scope_body(node.body, node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_defaults(self, node):
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.report(
+                    default.lineno,
+                    "B006",
+                    f"mutable default argument in '{node.name}'",
+                )
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.report(node.lineno, "E722", "bare 'except:'")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                (isinstance(comp, ast.Constant) and comp.value is None)
+                or (
+                    isinstance(node.left, ast.Constant)
+                    and node.left.value is None
+                )
+            ):
+                self.report(
+                    node.lineno, "E711", "comparison to None with ==/!="
+                )
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.report(node.lineno, "F541", "f-string without placeholders")
+        # do NOT generic_visit: a format spec (":05d") is itself a
+        # placeholder-free JoinedStr child and must not be flagged
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                self.visit(v.value)
+
+    def visit_Assert(self, node):
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.report(
+                node.lineno,
+                "B011",
+                "assert on a non-empty tuple is always true",
+            )
+        self.generic_visit(node)
+
+
+def lint_file(path: Path) -> list:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+    checker = _Checker(path, tree, source)
+    checker.check_unused_imports()
+    checker.visit_scope_body(tree.body, "<module>")
+    checker.visit(tree)
+    return checker.findings
+
+
+def main() -> int:
+    repo = Path(__file__).resolve().parent.parent
+    findings = []
+    for root in ROOTS:
+        p = repo / root
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            findings.extend(lint_file(f))
+    for path, lineno, code, msg in findings:
+        print(f"{path.relative_to(repo)}:{lineno}: {code} {msg}")
+    if findings:
+        print(f"{len(findings)} finding(s)")
+        return 1
+    print("lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,7 @@ import numpy as np
 
 sys.path.insert(0, ".")
 
-from tools.gen_rng_cooked import LEN, TAP, FEED0, _polymul
+from tools.gen_rng_cooked import LEN, FEED0, _polymul
 
 MASK64 = (1 << 64) - 1
 MASK63 = (1 << 63) - 1

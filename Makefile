@@ -29,3 +29,9 @@ examples:
 
 bench:
 	$(PY) bench.py
+
+# heavier after-kernel-change sweep on real TPU (compiled kernel vs XLA
+# scan across randomized mixed-feature scenarios incl. storage and the
+# streamed term layout)
+deep-conformance:
+	$(PY) tools/deep_conformance.py

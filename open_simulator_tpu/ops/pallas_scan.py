@@ -1287,7 +1287,12 @@ def _build_storage(cluster, batch, dyn, r: int) -> Optional[StorePlan]:
     ssd_vs = ssd // s
     hdd_vs = hdd // s
     if max(vg_s.max(initial=0), ssd_s.max(initial=0),
-           hdd_s.max(initial=0), vgu_s.max(initial=0)) > _MAX_SCALED:
+           hdd_s.max(initial=0), vgu_s.max(initial=0),
+           # volume sizes must fit int32 too: a size sharing no large
+           # GCD with the capacities (scale ~1) would otherwise WRAP in
+           # the int32 cast and silently diverge from the XLA scan
+           lvm_s.max(initial=0), ssd_vs.max(initial=0),
+           hdd_vs.max(initial=0)) > _MAX_SCALED:
         return _reject("storage: scaled capacities exceed int32 exactness")
 
     # distinct storage-config rows: caps alone determine every score

@@ -435,7 +435,7 @@ def _sample_select(masked, feasible, consume, rng_hist, n: int):
     # gather at 4k nodes) and a draw REJECTS with probability < 5e-6,
     # so resolve all draws with ONE gather assuming no rejections and
     # take the fixpoint branch only when one actually occurred
-    o0 = jnp.cumsum(tie_i) - tie_i
+    o0 = cumt_excl
     w0 = w31[jnp.clip(o0, 0, wbuf - 1)]
     rej0 = tie & ~pow2 & (w0 > maxv)
 

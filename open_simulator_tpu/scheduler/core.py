@@ -102,6 +102,7 @@ class Simulator:
         score_weights=None,
         select_host: str = "first-max",
         enable_preemption: bool = True,
+        rng=None,
     ):
         self.engine_kind = engine
         self.use_greed = use_greed
@@ -118,6 +119,7 @@ class Simulator:
         # handed back to the oracle after each batch, so serial
         # fallbacks (priority escapes) continue the exact sequence
         self.select_host = select_host
+        self.rng = rng  # custom sample-mode rng (oracle.py contract)
         # HTTP extenders are host RPC per pod: they force the serial
         # oracle path (SURVEY.md §2.3 host-callback escape hatch)
         self.extenders = list(extenders or [])
@@ -138,6 +140,7 @@ class Simulator:
             score_weights=self.score_weights,
             select_host=self.select_host,
             enable_preemption=self.enable_preemption,
+            rng=self.rng,
         )
         pods = wl.pods_excluding_daemon_sets(cluster)
         for ds in cluster.daemon_sets:
@@ -209,6 +212,14 @@ class Simulator:
         # would invalidate / miss every later placement the batched scan
         # committed (plugins.py: needs_serial)
         tpu_ok = self.engine_kind == "tpu" and not self.oracle.registry.needs_serial
+        if tpu_ok and self.oracle.select_host == "sample":
+            # the scan carries the Go ALFG stream via the rng's
+            # history()/set_history(); a CUSTOM rng satisfying only the
+            # documented `.intn(n)` contract (oracle.py) cannot ride it
+            # — and a non-Go generator would diverge from the scan's
+            # hard-coded recurrence — so those stay on the serial path
+            rng = self.oracle._rng
+            tpu_ok = hasattr(rng, "history") and hasattr(rng, "set_history")
         # a custom post_filter plugin can act on ANY failed pod, so
         # such batches take the priority-scan path with every failure
         # escaping to the serial cycle (escape_if below)
@@ -471,6 +482,7 @@ def simulate(
     score_weights=None,
     select_host: str = "first-max",
     enable_preemption: bool = True,
+    rng=None,
 ) -> SimulateResult:
     """One-shot simulation (core.go:64-103)."""
     sim = Simulator(
@@ -480,6 +492,7 @@ def simulate(
         score_weights=score_weights,
         select_host=select_host,
         enable_preemption=enable_preemption,
+        rng=rng,
     )
     # NOTE: the identity memos are deliberately NOT cleared here — the
     # planner's serial bisection calls simulate() once per guess over

@@ -283,3 +283,94 @@ def test_randomized_mixed_conformance(seed):
         for p in range(200)
     ]
     check_case(nodes, pods)
+
+
+def test_unscalable_volume_size_rejects_to_xla():
+    """A volume size sharing no useful GCD with the capacities (scale
+    ~1) would overflow the kernel's int32 encoding; the plan must
+    REJECT (XLA scan carries the batch) rather than wrap and diverge."""
+    nodes = [make_node(0)]
+    pods = [make_pod("p0", [("LVM", (1 << 31) + 1)])]  # odd byte count
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features)
+    assert plan is None
+    assert "int32" in (pallas_scan.last_reject() or "")
+
+
+def test_gpu_and_storage_and_terms_in_one_kernel():
+    """All three optional kernel blocks together — gpu device packing,
+    the storage block, and affinity terms — in ONE compiled plan (the
+    fuzz flavors exercise gpu XOR storage; this pins their coexistence)."""
+    from open_simulator_tpu.models import workloads as wl
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.models.workloads import reset_name_counter
+    from open_simulator_tpu.scheduler.core import _sort_app_pods
+    from open_simulator_tpu.testing import build_affinity_stress, with_node_gpu
+
+    reset_name_counter()
+    nodes, stss = build_affinity_stress(
+        n_nodes=48, n_sts=6, replicas=10, zones=4
+    )
+    rng = np.random.RandomState(7)
+    for i, node in enumerate(nodes):
+        with_node_gpu(2, "32")(node)
+        if i % 2 == 0:
+            node["metadata"].setdefault("annotations", {})[
+                "simon/node-local-storage"
+            ] = json.dumps(
+                {
+                    "vgs": [
+                        {"name": "a", "capacity": str(100 * GI),
+                         "requested": "0"}
+                    ],
+                    "devices": [],
+                }
+            )
+    res = ResourceTypes()
+    res.stateful_sets = stss
+    pods = _sort_app_pods(wl.generate_valid_pods_from_app("m", res, nodes))
+    import copy
+
+    for i, pod in enumerate(pods):
+        k = rng.randint(0, 6)
+        if k == 0:
+            pod["metadata"] = copy.deepcopy(pod["metadata"])
+            pod["metadata"].setdefault("annotations", {})[
+                "alibabacloud.com/gpu-mem"
+            ] = "8"
+        elif k == 1:
+            pod["metadata"] = copy.deepcopy(pod["metadata"])
+            pod["metadata"].setdefault("annotations", {})[
+                "simon/pod-local-storage"
+            ] = json.dumps(
+                {"volumes": [{"kind": "LVM", "size": str(5 * GI),
+                              "scName": "open-local-lvm"}]}
+            )
+    oracle = Oracle(nodes)
+    cluster = encode_cluster(oracle)
+    batch = encode_batch(oracle, cluster, pods)
+    dyn = encode_dynamic(oracle, cluster)
+    features = features_of_batch(cluster, batch)
+    assert features.gpu and features.storage and features.terms
+    plan = pallas_scan.build_plan(cluster, batch, dyn, features)
+    assert plan is not None, pallas_scan.last_reject()
+    assert plan.store is not None and plan.g_n and plan.terms is not None
+    nv = np.ones(cluster.n, bool)
+    pa = np.ones(len(pods), bool)
+    static = to_scan_static(cluster, batch)
+    init = to_scan_state(dyn, batch)
+    ref, _ = scan_ops.run_scan_masked(
+        static, init, jnp.asarray(batch.class_of_pod),
+        jnp.asarray(batch.pinned_node), jnp.asarray(nv), jnp.asarray(pa),
+        features=features,
+    )
+    got, _ = pallas_scan.run_scan_pallas(
+        plan, batch.class_of_pod, pa, nv, pinned=batch.pinned_node,
+        interpret=True,
+    )
+    ref = np.asarray(ref)
+    assert (np.where(ref < 0, -1, ref) == np.where(got < 0, -1, got)).all()

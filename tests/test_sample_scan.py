@@ -250,3 +250,35 @@ def test_priority_batch_with_sample_stays_serial():
     assert GLOBAL.notes.get("engine") == "serial-oracle"
     assert _placements(r_o) == _placements(r_t)
     assert r_t.preemptions  # the scenario actually preempted
+
+
+def test_custom_rng_with_only_intn_stays_serial():
+    """The documented Oracle rng contract is just `.intn(n)`; a custom
+    rng without history()/set_history() cannot ride the scan (and a
+    non-Go generator would diverge from its hard-coded ALFG), so the
+    tpu engine must route those batches to the serial oracle."""
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    class CountingRng:
+        def __init__(self):
+            self.k = 0
+
+        def intn(self, n):
+            self.k = (self.k + 1) % n
+            return self.k
+
+    nodes = [_node(i) for i in range(12)]
+    pods = [_pod(f"p{i:03d}") for i in range(80)]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    reset_name_counter()
+    r_o = simulate(cluster, _apps([pods]), engine="oracle",
+                   select_host="sample", rng=CountingRng())
+    reset_name_counter()
+    GLOBAL.reset()
+    r_t = simulate(cluster, _apps([pods]), engine="tpu",
+                   select_host="sample", rng=CountingRng())
+    assert GLOBAL.notes.get("engine") == "serial-oracle"
+    assert _placements(r_o) == _placements(r_t)

@@ -8,8 +8,10 @@ Pallas kernel vs XLA scan on the real TPU. Heavier than the bench fuzz
 Exits non-zero on the first placement mismatch, when no TPU backend is
 present, or when every scenario skips. SIMON_BENCH=fuzz (bench.py) is
 the lighter per-bench-run gate; keep kernel-scope changes reflected in
-both. Last full run:
-6448 placements over 12 scenarios, 0 mismatches.
+both. Last full run (end of r5, after storage-in-kernel + streamed
+terms + packed plan transfer): 6448 placements over 12 scenarios —
+4 gpu+terms, 4 terms+ports+scalars+pins+storage, 4 of those with the
+STREAMED term layout forced — 0 mismatches, 0 skipped.
 """
 import os
 import sys
@@ -57,12 +59,49 @@ for seed in range(12):
         zones=int(rng.choice([4, 8, 16])),
     )
     use_gpu = seed % 3 == 0
+    # r5: a third of the non-gpu seeds force the STREAMED terms layout
+    # (HBM state + per-pod row gather) and also mix open-local storage
+    # into the batch, so both r5 kernel subsystems get the same
+    # hardware sweep as the resident kernel
+    use_stream = not use_gpu and seed % 3 == 1
+    use_storage = not use_gpu
     if use_gpu:
         for node in nodes:
             with_node_gpu(int(rng.randint(1, 5)), "32")(node)
     else:
         for node in nodes[: n_nodes // 2]:
             node["status"]["allocatable"]["example.com/accel"] = "4"
+    if use_storage:
+        import json as _json
+
+        gi = 1 << 30
+        for node in nodes[: (2 * n_nodes) // 3]:
+            node["metadata"].setdefault("annotations", {})[
+                "simon/node-local-storage"
+            ] = _json.dumps(
+                {
+                    "vgs": [
+                        {
+                            "name": "a",
+                            "capacity": str(int(rng.choice([50, 100])) * gi),
+                            "requested": str(int(rng.randint(0, 8)) * gi),
+                        },
+                        {
+                            "name": "b",
+                            "capacity": str(200 * gi),
+                            "requested": "0",
+                        },
+                    ],
+                    "devices": [
+                        {
+                            "name": "/dev/vdb",
+                            "capacity": str(120 * gi),
+                            "mediaType": "ssd",
+                            "isAllocated": "false",
+                        }
+                    ],
+                }
+            )
     res = ResourceTypes()
     res.stateful_sets = stss
     pods = _sort_app_pods(wl.generate_valid_pods_from_app("d", res, nodes))
@@ -78,7 +117,7 @@ for seed in range(12):
                     }
                 )
             continue
-        if k > 2:
+        if k > 3:
             continue
         pod["spec"] = spec = copy.deepcopy(pod["spec"])
         if k == 0:
@@ -90,18 +129,45 @@ for seed in range(12):
             spec["containers"][0]["resources"]["requests"]["example.com/accel"] = str(
                 1 + i % 3
             )
-        else:
+        elif k == 2:
             spec["nodeName"] = nodes[int(rng.randint(0, n_nodes))]["metadata"]["name"]
+        else:
+            gi = 1 << 30
+            vols = (
+                [
+                    {
+                        "kind": "LVM",
+                        "size": str(int(rng.choice([1, 5, 12])) * gi),
+                        "scName": "open-local-lvm",
+                    }
+                ]
+                if i % 3
+                else [
+                    {
+                        "kind": "SSD",
+                        "size": str(60 * gi),
+                        "scName": "open-local-device-ssd",
+                    }
+                ]
+            )
+            pod["metadata"] = copy.deepcopy(pod["metadata"])
+            pod["metadata"].setdefault("annotations", {})[
+                "simon/pod-local-storage"
+            ] = _json.dumps({"volumes": vols})
     oracle = Oracle(nodes)
     c = encode_cluster(oracle)
     b = encode_batch(oracle, c, pods)
     d = encode_dynamic(oracle, c)
     f = features_of_batch(c, b)
+    pallas_scan.STREAM_FORCE = True if use_stream else None
     plan = pallas_scan.build_plan(c, b, d, f)
+    pallas_scan.STREAM_FORCE = None
     if plan is None:
         skipped += 1
         print(f"seed {seed}: skipped ({pallas_scan.last_reject()})")
         continue
+    if use_stream:
+        assert plan.terms is not None and plan.terms.cfg.stream
     # scenario masks too: random node subset + inactive pods
     nv = np.ones(c.n, bool)
     nv[rng.rand(c.n) < 0.1] = False
@@ -124,7 +190,9 @@ for seed in range(12):
     ref = np.asarray(ref)
     got = np.asarray(got)
     mism = int((got != ref).sum())
-    tag = "gpu+terms" if use_gpu else "terms+ports+scalars+pins"
+    tag = "gpu+terms" if use_gpu else "terms+ports+scalars+pins+storage"
+    if use_stream:
+        tag += "+STREAMED"
     print(f"seed {seed}: {len(pods)} pods, u={b.u}, {tag}: {mism} mismatches")
     if mism:
         idx = np.nonzero(got != ref)[0][:5]

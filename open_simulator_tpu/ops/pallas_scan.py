@@ -184,8 +184,11 @@ class TermsPlan(NamedTuple):
     sc_m: np.ndarray  # match increment
     # f64 log-weight tables split for double-single arithmetic:
     # w = log(sz+2) computed in f64 on host; hi/lo f32 split, hi further
-    # split into 12-bit halves h1+h2 for exact f32 products; 1-D SMEM
-    w_hi: np.ndarray  # (Wn,) f32
+    # split into 12-bit halves h1+h2 for exact f32 products. (Wr, 128)
+    # f32 VMEM tiles — the tables are node-count sized (sz ranges
+    # 0..n+1), so SMEM placement capped term plans at ~50k nodes; the
+    # kernel reads them by dynamic sublane row + lane mask (wval)
+    w_hi: np.ndarray  # (Wr, 128) f32
     w_lo: np.ndarray
     w_h1: np.ndarray
     w_h2: np.ndarray
@@ -686,8 +689,11 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
     tposb0 = _pack_bitplanes(tgt0_all > 0)
     group0 = _value_to_node_space(t.init_group_counts, tv[t.group_rows])
 
-    # f64 log weights, double-single split (sz ranges over 0..n+1)
-    wn = n + 2
+    # f64 log weights, double-single split (sz ranges over 0..n+1) —
+    # node-count sized, so they live as (Wr, 128) VMEM tiles read by
+    # dynamic sublane row (SMEM placement capped plans at ~50k nodes);
+    # soft-free batches carry a 1-row dummy
+    wn = n + 2 if has_soft else 1
     szv = np.arange(wn, dtype=np.float64)
     w64 = np.log(szv + 2.0)
     w_hi = w64.astype(np.float32)
@@ -697,6 +703,13 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
     tmp = w_hi * scale
     w_h1 = (tmp - (tmp - w_hi)).astype(np.float32)  # Veltkamp split
     w_h2 = (w_hi - w_h1).astype(np.float32)
+
+    def wpack(v: np.ndarray) -> np.ndarray:
+        r_w = -(-v.shape[0] // LANES)
+        r_w = -(-r_w // SUBLANES) * SUBLANES
+        out = np.zeros(r_w * LANES, dtype=np.float32)
+        out[: v.shape[0]] = v
+        return out.reshape(r_w, LANES)
 
     # class-column tables: ceil(U/128) sublane rows of 128 lanes each,
     # padded to the (8, 128) tile grain; the kernel's col_u selects row
@@ -768,10 +781,10 @@ def _build_terms(batch, features, r: int, p_total: int, n: int):
         c_tposp=c_tposp.reshape(-1), c_tposb=c_tposb.reshape(-1),
         sc_nh=sc_nh.reshape(-1), sc_topo=sc_topo.reshape(-1),
         sc_q=sc_q.reshape(-1), sc_m=sc_m.reshape(-1),
-        w_hi=w_hi,
-        w_lo=w_lo,
-        w_h1=w_h1,
-        w_h2=w_h2,
+        w_hi=wpack(w_hi),
+        w_lo=wpack(w_lo),
+        w_h1=wpack(w_h1),
+        w_h2=wpack(w_h2),
     )
     smem_entries = sum(
         getattr(plan, name).size
@@ -1117,7 +1130,11 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         )
     budget = 13 * 2**20
     rbytes = r * LANES * 4
-    if tiles * rbytes > budget or (STREAM_FORCE and terms is not None):
+    # the (Wr, 128) f32 log-weight tables are node-count sized VMEM
+    w_bytes = 4 * terms.w_hi.size * 4 if terms is not None else 0
+    if tiles * rbytes + w_bytes > budget or (
+        STREAM_FORCE and terms is not None
+    ):
         # resident term state does not fit: rewrite to the streamed
         # layout (state in HBM, per-pod class-local row gather) before
         # giving up on the fused kernel
@@ -1126,7 +1143,7 @@ def build_plan(cluster, batch, dyn, features, weights=None,
         sp = _stream_pack(terms, u, hk_map)
         if sp is None:
             return None  # _stream_pack recorded the reject reason
-        stream_bytes = (base_tiles + sp.cfg.kmax) * rbytes + 4 * (
+        stream_bytes = (base_tiles + sp.cfg.kmax) * rbytes + w_bytes + 4 * (
             sp.g_topo3.size + sp.g_match_au.size
             + sp.group0.size + sp.gtot0.size
         )
@@ -1170,7 +1187,7 @@ _TERM_FIELDS = (
     ("c_tposp", "smem"), ("c_tposb", "smem"),
     ("sc_nh", "smem"), ("sc_topo", "smem"), ("sc_q", "smem"),
     ("sc_m", "smem"),
-    ("w_hi", "smem"), ("w_lo", "smem"), ("w_h1", "smem"), ("w_h2", "smem"),
+    ("w_hi", "vmem"), ("w_lo", "vmem"), ("w_h1", "vmem"), ("w_h2", "vmem"),
 )
 
 
@@ -1485,7 +1502,7 @@ _STREAM_TERM_FIELDS = (
     ("c_tposp", "smem"), ("c_tposb", "smem"),
     ("sc_nh", "smem"), ("sc_topo", "smem"), ("sc_q", "smem"),
     ("sc_m", "smem"),
-    ("w_hi", "smem"), ("w_lo", "smem"), ("w_h1", "smem"), ("w_h2", "smem"),
+    ("w_hi", "vmem"), ("w_lo", "vmem"), ("w_h1", "vmem"), ("w_h2", "vmem"),
     ("gather", "smem"), ("wb_pos", "smem"), ("wb_gid", "smem"),
     ("hk_pos", "smem"),
 )
@@ -2244,10 +2261,20 @@ def _make_kernel(p_total: int, u_n: int, w: tuple, has_nodeaff: bool,
                             jnp.int32
                         )
                     sz = jnp.where(is_host, sz_host, sz_nh)
-                    whi = whi_ref[sz]
-                    wlo = wlo_ref[sz]
-                    wh1 = wh1_ref[sz]
-                    wh2 = wh2_ref[sz]
+
+                    def wval(ref, idx=sz):
+                        # (Wr, 128) f32 VMEM table read at a traced
+                        # scalar index: dynamic sublane row + lane mask
+                        # (same pattern as pod_scalar)
+                        row = ref[pl.ds(idx // LANES, 1), :]
+                        return jnp.sum(
+                            jnp.where(lane_iota == idx % LANES, row, 0.0)
+                        )
+
+                    whi = wval(whi_ref)
+                    wlo = wval(wlo_ref)
+                    wh1 = wval(wh1_ref)
+                    wh2 = wval(wh2_ref)
                     ci_s = tr["s_cnt"][u * tc.smax + k]
                     cnt_host = tgt_s[jnp.maximum(ci_s, 0)]
                     cnt_soft = soft_s[jnp.maximum(tr["s_nh"][u * tc.smax + k], 0)]

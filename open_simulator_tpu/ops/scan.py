@@ -483,7 +483,7 @@ def _sample_select(masked, feasible, consume, rng_hist, n: int):
     overflow = overflow | (t_used > wbuf - _RNG_KMAX)
     ext = jnp.concatenate([rng_hist, words])
     new_hist = jax.lax.dynamic_slice(ext, (t_used,), (607,))
-    return best, new_hist, overflow
+    return best, new_hist, overflow, t_used
 
 
 def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, features):
@@ -740,7 +740,11 @@ def run_scan(
 ):
     """Schedule every pod in order; returns (placements[P], final state).
 
-    placements[p] = node index, or -1 when unschedulable.
+    placements[p] = node index, or -1 when unschedulable. With
+    features.sample the first element is a (placements[P],
+    consumed_words[P]) PAIR — per-pod Go-RNG consumption, which the
+    priority-scan engine uses to rewind the stream to an escape point
+    (engine.rewind_sample_rng).
     """
     n = static.alloc_mcpu.shape[0]
     p = class_of_pod.shape[0]
@@ -778,6 +782,10 @@ def run_scan_masked(
     `weights` (custom score weights) only applies when `features` is
     derived here; explicit `features` already carry theirs, so passing
     both is a caller bug.
+
+    With features.sample the returned placements are a (placements,
+    consumed_words) PAIR (see run_scan) and init.rng_hist must carry
+    the GoRand 607-output history.
     """
     if features is not None and weights is not None:
         raise ValueError(
@@ -963,7 +971,7 @@ def _run_scan_compiled(
             consume = active & found
             if features.pins:
                 consume = consume & (pin < 0)
-            best, new_rng_hist, step_ovf = _sample_select(
+            best, new_rng_hist, step_ovf, consumed = _sample_select(
                 masked, feasible, consume, state.rng_hist, n
             )
             new_rng_overflow = state.rng_overflow | step_ovf
@@ -1039,9 +1047,17 @@ def _run_scan_compiled(
             rng_hist=new_rng_hist,
             rng_overflow=new_rng_overflow,
         )
+        if features.sample:
+            # per-pod word consumption rides along so the priority-scan
+            # engine can REWIND the stream to an escape point (the scan
+            # consumed draws for the whole batch, but escaped tails are
+            # discarded and rescheduled)
+            return new_state, (placement, consumed)
         return new_state, placement
 
     final_state, placements = jax.lax.scan(
         step, init, (class_of_pod, pinned_node, pod_active)
     )
+    # sample mode: placements is a (placements[P], consumed_words[P])
+    # pair — the engine unpacks it (no other caller runs sample)
     return placements, final_state

@@ -238,14 +238,12 @@ class Simulator:
                 # unbounded rejection semantics
                 GLOBAL.note("engine", "serial-oracle (sample rng overflow)")
                 failed, _ = self._schedule_pods_oracle(pods)
-        elif tpu_ok and len(pods) >= MIN_SCAN_RUN and (
-            self.oracle.select_host != "sample"
-        ):
-            # sample mode stays off the priority-scan engine: an escape
-            # DISCARDS the scanned tail and rescans it, but the scan
-            # already consumed those pods' Go-RNG draws — the rescan
-            # would double-consume the stream and diverge from the
-            # serial walk (review r5); serial is exact for this corner
+        elif tpu_ok and len(pods) >= MIN_SCAN_RUN:
+            # (sample mode included: an escape DISCARDS the scanned
+            # tail, whose Go-RNG draws the scan already consumed — the
+            # scan exports per-pod consumption and _scan_and_commit
+            # REWINDS the stream to the escape point, so the serial
+            # escape and the rescan continue the exact serial sequence)
             failed = self._schedule_pods_priority(pods)
         else:
             GLOBAL.note("engine", "serial-oracle")
@@ -299,6 +297,7 @@ class Simulator:
         handshake)."""
         import math
 
+        from .engine import SampleRngOverflow
         from ..utils.trace import GLOBAL
 
         failed: List[UnscheduledPod] = []
@@ -326,7 +325,14 @@ class Simulator:
                     and self.oracle.pod_preemption_policy(p) != "Never"
                 )
 
-            f, escape_at = self._scan_and_commit(rest, escape_if=escape_if)
+            try:
+                f, escape_at = self._scan_and_commit(rest, escape_if=escape_if)
+            except SampleRngOverflow:
+                # nothing from this round committed (the engine raises
+                # before replay); the remainder drops to the serial
+                # tail below, whose rejection loop is unbounded
+                GLOBAL.note("priority-scan-sample-overflow", len(rest))
+                break
             failed.extend(f)
             if escape_at is None:
                 rest = []
@@ -445,6 +451,12 @@ class Simulator:
                     break
         by_idx = {i: int(idx) for (i, _), idx in zip(batch, placements)}
         pos_of = {i: pos for pos, (i, _) in enumerate(batch)}
+        if escape_at is not None and self.oracle.select_host == "sample":
+            # the scan consumed Go-RNG draws for the DISCARDED tail
+            # too: rewind the stream to just before the escaped pod so
+            # its serial cycle (and the rescan after it) continue the
+            # exact serial sequence
+            self._engine.rewind_sample_rng(pos_of[escape_at])
         failed: List[UnscheduledPod] = []
         stop = len(pods) if escape_at is None else escape_at
         for i in range(stop):

@@ -51,6 +51,10 @@ class TpuEngine:
         self._last_class_of = None
         self._last_simple = None
         self._class_commit_info = None
+        # sample mode: (pre-batch rng history, per-pod consumed-word
+        # cumsum) of the last scanned batch — rewind_sample_rng uses it
+        # when a priority-scan escape discards the scanned tail
+        self._last_rng = None
 
     def cluster_static(self) -> ClusterStatic:
         # keyed on (node count, alloc epoch): GPU-share Reserve mutates
@@ -110,9 +114,10 @@ class TpuEngine:
                     # its 607-output history in via the carry, and (after
                     # the scan) write the advanced stream back so serial
                     # fallbacks continue the exact sequence
+                    hist0 = oracle._rng.history()
                     init = init._replace(
                         rng_hist=jnp.asarray(
-                            np.array(oracle._rng.history(), dtype=np.uint64)
+                            np.array(hist0, dtype=np.uint64)
                         )
                     )
         from ..utils.trace import GLOBAL
@@ -145,6 +150,8 @@ class TpuEngine:
                 jnp.asarray(batch.pinned_node),
                 features=features,
             )
+            if sample:
+                placements, consumed = placements
             out = np.asarray(placements)  # blocks on device completion
         if sample:
             if bool(np.asarray(final_state.rng_overflow)):
@@ -154,10 +161,27 @@ class TpuEngine:
                     "sample-mode RNG rejection overflow; rerunning the "
                     "batch on the serial oracle"
                 )
+            self._last_rng = (hist0, np.cumsum(np.asarray(consumed)))
             oracle._rng.set_history(
                 [int(x) for x in np.asarray(final_state.rng_hist)]
             )
         return out
+
+    def rewind_sample_rng(self, batch_pos: int) -> None:
+        """Reposition the oracle's sample-mode stream to where it stood
+        BEFORE the last scanned batch's pod at `batch_pos` consumed its
+        draws. A priority-scan escape discards every scanned placement
+        from the escape point on and reschedules those pods (serially,
+        then by rescanning), so their draws must be un-consumed — the
+        pre-batch history advanced by the consumed-word prefix is
+        exactly that position (gorand.advance_history)."""
+        if self._last_rng is None:
+            return
+        from ..utils.gorand import advance_history
+
+        hist0, consumed_cum = self._last_rng
+        k = int(consumed_cum[batch_pos - 1]) if batch_pos > 0 else 0
+        self.oracle._rng.set_history(advance_history(hist0, k))
 
     def commit_host(self, pod: dict, node_idx: int):
         """Replay one placement into oracle state (same binding code the

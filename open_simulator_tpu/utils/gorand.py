@@ -102,6 +102,28 @@ def _load_cooked_packaged() -> Optional[List[int]]:
     return _PACKAGED_COOKED or None
 
 
+def advance_history(hist: List[int], k: int) -> List[int]:
+    """Advance an ordered 607-output history (GoRand.history()) by k
+    recurrence steps WITHOUT a generator object — vectorized numpy
+    blocks of up to 273 outputs (y_n = y_{n-607} + y_{n-273} depends
+    only on the current window for n < 273 ahead). The priority-scan
+    engine uses this to rewind a scan batch's stream to an escape
+    point: re-advancing the pre-batch history by the consumed-word
+    prefix is equivalent to never having scanned the tail."""
+    import numpy as np
+
+    h = np.array(hist, dtype=np.uint64)
+    if h.shape[0] != _LEN:
+        raise ValueError(f"history must have {_LEN} entries")
+    k = int(k)
+    while k > 0:
+        step = min(k, _TAP)  # up to 273 outputs per vectorized block
+        nw = h[:step] + h[_LEN - _TAP : _LEN - _TAP + step]
+        h = np.concatenate([h[step:], nw])
+        k -= step
+    return [int(x) for x in h]
+
+
 class GoRand:
     """Go math/rand `*Rand` over an `rngSource`, defaulting to seed 1 —
     the stream the reference's unseeded global source produces."""

@@ -201,7 +201,7 @@ def test_rejection_path_matches_host_walk():
 
     g2 = GoRand(1)
     g2.set_history(hist)
-    best, new_hist, ovf = _sample_select(
+    best, new_hist, ovf, consumed = _sample_select(
         jnp.asarray(scores),
         jnp.asarray(feas),
         jnp.asarray(True),
@@ -209,17 +209,21 @@ def test_rejection_path_matches_host_walk():
         3,
     )
     assert not bool(ovf)
+    assert int(consumed) == 3  # 1 (Intn(2)) + 2 (rejected Intn(3))
     assert int(best) == best_host
     # the rejection consumed an extra word: 3 words total, and the
     # device stream position matches the host's
     assert [int(x) for x in np.asarray(new_hist)] == g.history()
 
 
-def test_priority_batch_with_sample_stays_serial():
-    """Sample + priority routes to the serial oracle (review r5): the
-    priority-scan engine's escapes DISCARD and rescan the tail, which
-    would double-consume the Go stream — the reproduced failure was
-    83/116 divergent placements. Serial is exact for this corner."""
+def test_priority_batch_with_sample_rides_priority_scan():
+    """Sample + priority rides the priority-scan engine EXACTLY: an
+    escape discards the scanned tail whose Go-RNG draws the scan
+    already consumed, so the engine rewinds the stream to the escape
+    point (per-pod consumption exported by the scan +
+    gorand.advance_history) before the serial cycle and the rescan —
+    the naive version double-consumed and diverged on 83/116
+    placements (review r5)."""
     from open_simulator_tpu.utils.trace import GLOBAL
 
     nodes = [_node(i, cpu="1", mem="4Gi") for i in range(16)]
@@ -247,9 +251,13 @@ def test_priority_batch_with_sample_stays_serial():
     GLOBAL.reset()
     r_t = simulate(cluster, _apps([pre + ties]), engine="tpu",
                    select_host="sample")
-    assert GLOBAL.notes.get("engine") == "serial-oracle"
+    assert GLOBAL.notes.get("engine") == "priority-scan"
+    assert GLOBAL.notes.get("priority-scan-escapes", 0) >= 1
     assert _placements(r_o) == _placements(r_t)
     assert r_t.preemptions  # the scenario actually preempted
+    assert sorted(
+        u.pod["metadata"]["name"] for u in r_o.unscheduled_pods
+    ) == sorted(u.pod["metadata"]["name"] for u in r_t.unscheduled_pods)
 
 
 def test_custom_rng_with_only_intn_stays_serial():
@@ -282,3 +290,82 @@ def test_custom_rng_with_only_intn_stays_serial():
                    select_host="sample", rng=CountingRng())
     assert GLOBAL.notes.get("engine") == "serial-oracle"
     assert _placements(r_o) == _placements(r_t)
+
+
+def _flaky_schedule(monkeypatch, fail_calls=1):
+    """Make the first `fail_calls` TpuEngine.schedule calls raise
+    SampleRngOverflow (the real trigger — a draw exceeding the in-scan
+    rejection bound — has probability < 1e-17 per draw, so the
+    fallback paths are exercised by forcing the raise; the real raise
+    also happens before any commit or rng mutation)."""
+    from open_simulator_tpu.scheduler import engine as eng_mod
+
+    calls = {"n": 0}
+    orig = eng_mod.TpuEngine.schedule
+
+    def flaky(self, pods):
+        calls["n"] += 1
+        if calls["n"] <= fail_calls:
+            raise eng_mod.SampleRngOverflow("forced by test")
+        return orig(self, pods)
+
+    monkeypatch.setattr(eng_mod.TpuEngine, "schedule", flaky)
+    return calls
+
+
+def test_sample_overflow_falls_back_serially_on_batch_path(monkeypatch):
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    nodes = [_node(i) for i in range(12)]
+    pods = [_pod(f"p{i:03d}") for i in range(80)]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    reset_name_counter()
+    r_o = simulate(cluster, _apps([pods]), engine="oracle",
+                   select_host="sample")
+    reset_name_counter()
+    GLOBAL.reset()
+    _flaky_schedule(monkeypatch)
+    r_t = simulate(cluster, _apps([pods]), engine="tpu",
+                   select_host="sample")
+    assert "serial-oracle" in str(GLOBAL.notes.get("engine"))
+    assert _placements(r_o) == _placements(r_t)
+
+
+def test_sample_overflow_on_priority_path_falls_back_serially(monkeypatch):
+    """An overflow raised mid-priority-scan drops the REMAINDER to the
+    serial tail (nothing from that round committed), still bit-matching
+    the all-serial run."""
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    nodes = [_node(i, cpu="1", mem="4Gi") for i in range(8)]
+    victims = []
+    for i in range(8):
+        v = _pod(f"victim-{i}", cpu="800m", mem="1Gi")
+        v["spec"]["nodeName"] = f"n{i:03d}"
+        victims.append(v)
+    pre = _pod("pre-0", cpu="800m", mem="1Gi")
+    pre["spec"]["priority"] = 100
+    ties = [_pod(f"tie-{i:03d}", cpu="50m", mem="8Mi") for i in range(70)]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    cluster.pods = victims
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    reset_name_counter()
+    r_o = simulate(cluster, _apps([[pre] + ties]), engine="oracle",
+                   select_host="sample")
+    reset_name_counter()
+    GLOBAL.reset()
+    # call 1 is the pinned-victims cluster batch; call 2 is the
+    # priority batch's first scan round — fail both so the overflow
+    # lands on the priority path
+    _flaky_schedule(monkeypatch, fail_calls=2)
+    r_t = simulate(cluster, _apps([[pre] + ties]), engine="tpu",
+                   select_host="sample")
+    assert GLOBAL.notes.get("engine") == "priority-scan"
+    assert GLOBAL.notes.get("priority-scan-sample-overflow")
+    assert _placements(r_o) == _placements(r_t)
+    assert r_t.preemptions

@@ -256,3 +256,21 @@ def test_no_divergence_when_scores_are_unique():
 # test_divergence_pinned_on_tie_heavy_cluster): 45 of 48 placements
 # land on a different (equal-score) node than first-max picks
 DIVERGED_TIE_HEAVY = 45
+
+
+def test_advance_history_matches_generator_steps():
+    """gorand.advance_history (the priority-scan rewind primitive) must
+    advance an ordered history exactly like k generator steps, across
+    block boundaries (273-output blocks) and for k=0."""
+    from open_simulator_tpu.utils.gorand import GoRand, advance_history
+
+    g = GoRand(1)
+    for _ in range(100):
+        g.uint64()
+    h = g.history()
+    for k in (0, 1, 272, 273, 274, 607, 1000):
+        g2 = GoRand(9)
+        g2.set_history(h)
+        for _ in range(k):
+            g2.uint64()
+        assert advance_history(h, k) == g2.history(), k

@@ -15,8 +15,12 @@ RUN make check
 FROM python:3.12-slim
 
 WORKDIR /app
-COPY --from=builder /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages
-COPY --from=builder /usr/local/bin/simon /usr/local/bin/simon
+# install only the RUNTIME dependencies + the package (the builder's
+# site-packages also carries pytest, which the shipped CLI never
+# imports); the builder stage already proved `make check` green
+COPY --from=builder /src/open-simulator-tpu /tmp/src
+RUN pip install --no-cache-dir "jax[cpu]" pyyaml /tmp/src \
+    && rm -rf /tmp/src
 # quickstart configs ship in the image so `simon apply -f
 # example/simon-config.yaml` works out of the box
 COPY example /app/example

@@ -2672,10 +2672,14 @@ def preload_plan_group(plans: list) -> None:
     big = np.concatenate(flats)
     with jax.enable_x64(False):
         big_dev = jax.device_put(big)
+    # insert the whole group first, THEN trim: per-insert eviction
+    # could evict this group's own earlier entries when the group
+    # exceeds the cap (freeing nothing — they share one buffer) and
+    # silently re-serialize those plans' transfers
     for plan, off, metas in entries:
-        if len(_DEVICE_PLAN_CACHE) >= 16:
-            _DEVICE_PLAN_CACHE.popitem(last=False)
         _DEVICE_PLAN_CACHE[id(plan)] = (plan, (big_dev, off), metas)
+    while len(_DEVICE_PLAN_CACHE) > max(16, len(entries)):
+        _DEVICE_PLAN_CACHE.popitem(last=False)
 
 
 def _device_args(plan: PallasPlan):

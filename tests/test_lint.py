@@ -1,0 +1,74 @@
+"""The first-party AST linter (tools/lint.py, `make lint`) — pin its
+checks so they cannot silently go dead (review r5: the F811 check once
+suppressed itself whenever the scope contained ANY `if`)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.lint import lint_file  # noqa: E402
+
+
+def _lint_src(tmp_path, src: str):
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    return [(code, line) for _, line, code, _ in lint_file(p)]
+
+
+def test_duplicate_defs_flagged_despite_unrelated_if(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def foo():\n    pass\n\ndef foo():\n    pass\n\n"
+        "if True:\n    pass\n",
+    )
+    assert ("F811", 4) in findings
+
+
+def test_duplicate_methods_in_class_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "class T:\n"
+        "    def test_a(self):\n        pass\n"
+        "    def test_a(self):\n        pass\n",
+    )
+    assert any(c == "F811" for c, _ in findings)
+
+
+def test_conditional_dispatch_not_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import sys\n\n"
+        "def impl():\n    pass\n\n"
+        "if sys.platform == 'linux':\n    pass\n\n"
+        "def impl():\n    pass\n\n"
+        "print(sys, impl)\n",
+    )
+    assert not any(c == "F811" for c, _ in findings)
+
+
+def test_unused_import_and_noqa(tmp_path):
+    findings = _lint_src(tmp_path, "import os\nimport json  # noqa\n")
+    assert any(c == "F401" for c, _ in findings)
+    assert sum(1 for c, _ in findings if c == "F401") == 1  # noqa exempt
+
+
+def test_mutable_default_and_bare_except(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f(x=[]):\n"
+        "    try:\n        pass\n"
+        "    except:\n        pass\n"
+        "    return x\n",
+    )
+    codes = [c for c, _ in findings]
+    assert "B006" in codes and "E722" in codes
+
+
+def test_format_spec_fstring_not_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "x = 3\nprint(f'{x:05d}')\nprint(f'plain')\n",
+    )
+    codes_lines = [(c, l) for c, l in findings if c == "F541"]
+    assert codes_lines == [("F541", 3)]

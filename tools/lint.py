@@ -92,20 +92,29 @@ class _Checker(ast.NodeVisitor):
     # -- visitors ------------------------------------------------------
     def visit_scope_body(self, body, scope: str):
         seen: dict = {}
-        for node in body:
+        for idx, node in enumerate(body):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
                 prev = seen.get(node.name)
-                # a def directly following its namesake is a redefinition
-                # bug; separated defs behind ifs are dispatch patterns
+                # a redefinition is a bug unless an If/Try stands
+                # BETWEEN the two defs (conditional dispatch pattern) —
+                # scanning the whole body would let any unrelated `if`
+                # suppress the check
                 if prev is not None and not any(
-                    isinstance(n, (ast.If, ast.Try)) for n in body
+                    isinstance(n, (ast.If, ast.Try))
+                    for n in body[prev[0] + 1 : idx]
                 ):
                     self.report(
                         node.lineno,
                         "F811",
-                        f"redefinition of '{node.name}' from line {prev}",
+                        f"redefinition of '{node.name}' from line {prev[1]}",
                     )
-                seen[node.name] = node.lineno
+                seen[node.name] = (idx, node.lineno)
+
+    def visit_ClassDef(self, node):
+        # duplicate METHOD definitions are the classic copy-paste bug
+        # in test classes; check class bodies like any other scope
+        self.visit_scope_body(node.body, node.name)
+        self.generic_visit(node)
 
     def visit_FunctionDef(self, node):
         self._check_defaults(node)

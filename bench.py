@@ -11,12 +11,16 @@ Scenarios (SIMON_BENCH env):
 - `default`: raw scan throughput, 20k pods over 10k nodes.
 - `affinity`: the 100-StatefulSet anti-affinity + topology-spread
   stress (term-table machinery).
+- `affinity-25k`: the same stress at 25k nodes — past the resident
+  VMEM cliff, auto-routed to the STREAMED terms kernel (r5).
 - `mixed`: the default scenario with 1% hostPort and 1% extended-
   resource pods — proves mixed batches stay on the fused kernel.
 - `gpushare`: per-device GPU-memory fragmentation scoring at 1k 8-GPU
   nodes (simon-gpushare-config.yaml at scale).
 - `storage`: the open-local VG binpack + exclusive-device path at 10k
-  2-VG nodes (XLA scan — the one plugin kept off the fused kernel).
+  2-VG nodes — on the fused kernel since r5 (host-f64 score tables).
+- `sample`: select_host="sample" e2e (Go-RNG reservoir in the scan
+  carry, r5) vs first-max on the same XLA path.
 - `priority`: the default batch with a few high-priority pods — the
   priority-scan engine keeps the bulk on the fused scan.
 - `priority-dense`: 75% of the 20k pods carry non-zero priorities over
@@ -24,9 +28,10 @@ Scenarios (SIMON_BENCH env):
   priority-scan engine places it in one optimistic ordered scan per
   preemption escape.
 - `fuzz`: on-device Pallas-vs-XLA placement conformance over a
-  mixed-feature scenario (terms+ports+scalars+pins); `all` runs it
-  first and aborts on any mismatch, so every recorded number is backed
-  by a fresh hardware numerics check.
+  mixed-feature scenario (terms+ports+scalars+pins+storage, plus a
+  forced STREAMED-terms pass); `all` runs it first and aborts on any
+  mismatch, so every recorded number is backed by a fresh hardware
+  numerics check.
 - `defrag`: pod-migration defragmentation sweep on a cluster snapshot.
 - `whatif`: minimal-count capacity plan over 8 candidate newnode specs.
 - `all`: capacity headline with the others embedded in the metric

@@ -24,7 +24,11 @@ Scope (automatic fallback to the XLA scan otherwise):
   tiles (ops/scan.py ScanState docstring), per-(class, slot) eval
   scalars are prefolded host-side into SMEM tables, init states stream
   in from ANY/HBM by DMA, and commits are masked broadcasts over
-  (topo_val == placed value),
+  (topo_val == placed value). Past the VMEM budget the plan
+  auto-rewrites to the STREAMED layout (r5): term state lives in one
+  HBM buffer and each pod step DMA-gathers only its class's rows
+  (StreamTermsPlan docstring) — the ~12.3k-node cliff becomes a
+  bandwidth slope (50k nodes measured),
 - all quantities must fit exactness-preserving int32 encodings:
   memory/ephemeral values are divided by their collective GCD
   (floor-division identities keep every score and fit comparison

@@ -172,10 +172,19 @@ MAX_DETAILED_REASONS = 50
 
 
 def replay_scenario(sweep, count: int, placements):
-    """Rebuild host-side oracle state from one scenario's scan
+    """Rebuild host-side oracle state from one capacity scenario's scan
+    placements (the first `count` candidate nodes enabled). See
+    replay_masked for the general form."""
+    return replay_masked(sweep, sweep.node_valid(count), placements)
+
+
+def replay_masked(sweep, valid, placements):
+    """Rebuild host-side oracle state from one masked scenario's scan
     placements (the same binding code the serial path uses — the
     engine-replay contract of scheduler/engine.py), producing the
-    SimulateResult for reports. Returns (result, oracle).
+    SimulateResult for reports. `valid[n]` names the nodes that exist
+    in the scenario — a capacity prefix for the planner, an arbitrary
+    outage mask for the resilience engine. Returns (result, oracle).
 
     Exact per-node failure reasons cost a full serial filter pass per
     failed pod (O(nodes) Python), so only the first MAX_DETAILED_REASONS
@@ -187,8 +196,11 @@ def replay_scenario(sweep, count: int, placements):
     from ..scheduler.core import NodeStatus, SimulateResult, UnscheduledPod
     from ..scheduler.oracle import ClassCommitCache, Oracle, simple_commit_mask
 
-    nodes = [ns.node for ns in sweep.oracle.nodes[: sweep.n_base + count]]
+    valid = np.asarray(valid)
+    kept = [i for i in range(len(sweep.oracle.nodes)) if valid[i]]
+    nodes = [sweep.oracle.nodes[i].node for i in kept]
     oracle = Oracle(nodes)
+    local_of = {sweep_i: local_i for local_i, sweep_i in enumerate(kept)}
     # classes with no GPU/storage side effects take a minimal bind
     # (nodeName + phase + NodeInfo accounting) — the general
     # _reserve_and_bind re-checks GPU/storage/extenders per pod, which
@@ -231,9 +243,11 @@ def replay_scenario(sweep, count: int, placements):
                 )
             failed.append(UnscheduledPod(pod=pod, reason=reason))
         elif simple_class[class_of_pod[p_i]]:
-            commit_cache.commit(oracle, pod, oracle.nodes[idx], int(class_of_pod[p_i]))
+            commit_cache.commit(
+                oracle, pod, oracle.nodes[local_of[idx]], int(class_of_pod[p_i])
+            )
         else:
-            oracle._reserve_and_bind(pod, oracle.nodes[idx])
+            oracle._reserve_and_bind(pod, oracle.nodes[local_of[idx]])
     status = [NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes]
     return SimulateResult(unscheduled_pods=failed, node_status=status), oracle
 
@@ -246,13 +260,18 @@ def probe_plan(
     extended_resources: Optional[List[str]] = None,
     max_count: int = MAX_NUM_NEW_NODE,
     score_weights=None,
+    tolerate_failures: int = 0,
+    chaos_seed: int = 1,
+    chaos_trials: int = 32,
 ) -> ApplyResult:
     """Fast capacity plan: encode the padded cluster once, start at the
     aggregate-resource lower bound, bisect over candidate counts (each
     probe = one masked scan), and replay the winning scan's placements
     into host state for the report — no second full simulation
     (replaces the reference's per-guess re-simulation loop,
-    pkg/apply/apply.go:186-239)."""
+    pkg/apply/apply.go:186-239). With `tolerate_failures` > 0 the plan
+    additionally escalates until it is N+K survivable
+    (resilience/chaos.py raise_plan_to_nplusk)."""
     import gc
 
     # the plan allocates millions of short-lived dicts (pod expansion,
@@ -265,7 +284,8 @@ def probe_plan(
     try:
         return _probe_plan_inner(
             cluster, apps, new_node, use_greed, extended_resources,
-            max_count, score_weights,
+            max_count, score_weights, tolerate_failures, chaos_seed,
+            chaos_trials,
         )
     finally:
         clear_all_memos()
@@ -289,7 +309,9 @@ def _capacity_feasible():
     return feasible, (max_cpu, max_mem, max_vg)
 
 
-def _finish_plan(sweep, best, max_count, extended_resources) -> ApplyResult:
+def _finish_plan(
+    sweep, best, max_count, extended_resources, fail_message: str = ""
+) -> ApplyResult:
     """Replay the winning probe into host state, re-check the caps on
     real state, and render the report — the tail shared by the
     single-spec plan and the multi-spec what-if."""
@@ -298,7 +320,7 @@ def _finish_plan(sweep, best, max_count, extended_resources) -> ApplyResult:
     if best is None:
         res = sweep.probe(max_count)
         result, _ = replay_scenario(sweep, max_count, res.placements)
-        message = (
+        message = fail_message or (
             f"{len(result.unscheduled_pods)} pod(s) cannot be scheduled "
             f"even with {max_count} new node(s)"
             if result.unscheduled_pods
@@ -330,7 +352,8 @@ def _finish_plan(sweep, best, max_count, extended_resources) -> ApplyResult:
 
 def _probe_plan_inner(
     cluster, apps, new_node, use_greed, extended_resources,
-    max_count, score_weights,
+    max_count, score_weights, tolerate_failures=0, chaos_seed=1,
+    chaos_trials=32,
 ):
     from ..parallel.sweep import CapacitySweep
     from ..utils.trace import phase
@@ -348,7 +371,27 @@ def _probe_plan_inner(
         start = sweep.lower_bound(max_cpu, max_mem, max_vg)
     with phase("apply/probe-search"):
         best = sweep.find_min_count(feasible, start=start)
-    return _finish_plan(sweep, best, max_count, extended_resources)
+    fail_message = ""
+    if best is not None and tolerate_failures > 0:
+        from ..resilience.chaos import raise_plan_to_nplusk
+
+        with phase("apply/nplusk"):
+            best, _chaos = raise_plan_to_nplusk(
+                sweep,
+                best,
+                feasible,
+                tolerate_failures,
+                seed=chaos_seed,
+                trials=chaos_trials,
+            )
+        if best is None:
+            fail_message = (
+                f"plan cannot tolerate {tolerate_failures} node failure(s) "
+                f"within {max_count} new node(s)"
+            )
+    return _finish_plan(
+        sweep, best, max_count, extended_resources, fail_message=fail_message
+    )
 
 
 def probe_plan_multi(
@@ -435,6 +478,9 @@ class Applier:
         use_sweep: bool = True,
         use_greed: bool = False,
         scheduler_config: str = "",
+        tolerate_node_failures: int = 0,
+        chaos_seed: int = 1,
+        chaos_trials: int = 32,
     ):
         config.validate()
         self.config = config
@@ -443,6 +489,9 @@ class Applier:
         self.engine = engine
         self.use_sweep = use_sweep
         self.use_greed = use_greed
+        self.tolerate_node_failures = tolerate_node_failures
+        self.chaos_seed = chaos_seed
+        self.chaos_trials = chaos_trials
         self.extenders = []
         self.score_weights = None  # None = default profile weights
         self.enable_preemption = True
@@ -529,10 +578,34 @@ class Applier:
         # PriorityClasses ride along so a resume behaves identically)
         self.last_cluster = cluster
 
-        if self.use_sweep and new_node is not None and self.engine == "tpu":
+        # N+K needs the batched plan path: the committed placement, the
+        # outage sweep, and the escalation all live on the encoded
+        # sweep — the serial escalation loop has none of it
+        batched_path = (
+            self.use_sweep and new_node is not None and self.engine == "tpu"
+        )
+        if self.tolerate_node_failures > 0 and not batched_path:
+            from ..models.validation import InputError
+
+            raise InputError(
+                "--tolerate-node-failures requires the batched plan "
+                "path: engine tpu, the sweep enabled, and a newNode "
+                "spec to escalate with"
+            )
+        if batched_path:
             fast = self._plan_with_probes(cluster, apps, new_node)
             if fast is not None:
                 return fast
+            if self.tolerate_node_failures > 0:
+                from ..models.validation import InputError
+
+                raise InputError(
+                    "--tolerate-node-failures requires the batched plan, "
+                    "but this workload fell back to the serial engine — "
+                    "priority/extender workloads cannot ride the sweep, "
+                    "and a failed batched plan degrades the same way "
+                    "(the logged warning has the underlying cause)"
+                )
 
         start_count = 0
         if self.use_sweep and new_node is not None:
@@ -580,6 +653,7 @@ class Applier:
         batched path cannot encode the input)."""
         import logging
 
+        from ..models.validation import InputError
         from ..parallel.sweep import PrioritySignalError
 
         try:
@@ -590,12 +664,20 @@ class Applier:
                 use_greed=self.use_greed,
                 extended_resources=self.extended_resources,
                 score_weights=self.score_weights,
+                tolerate_failures=self.tolerate_node_failures,
+                chaos_seed=self.chaos_seed,
+                chaos_trials=self.chaos_trials,
             )
         except PrioritySignalError as e:
             logging.getLogger(__name__).info(
                 "priority workload: planning with the serial engine (%s)", e
             )
             return None
+        except InputError:
+            # malformed user input (e.g. --tolerate-node-failures larger
+            # than the node pool): a clean CLI error, not a silent
+            # serial fallback
+            raise
         except Exception as e:  # pragma: no cover - diagnostic path
             logging.getLogger(__name__).warning(
                 "batched capacity plan failed, falling back to serial escalation: %s", e

@@ -135,7 +135,10 @@ class _SerialEvaluator:
 
 def _make_evaluator(applier, cluster, apps, new_node):
     if new_node is not None and applier.engine == "tpu" and applier.use_sweep:
-        from ..parallel.sweep import CapacitySweep
+        import logging
+
+        from ..parallel.sweep import CapacitySweep, PrioritySignalError
+        from ..utils.trace import GLOBAL
         from .applier import MAX_NUM_NEW_NODE
 
         try:
@@ -149,8 +152,19 @@ def _make_evaluator(applier, cluster, apps, new_node):
                     score_weights=applier.score_weights,
                 )
             )
-        except Exception:
-            pass  # PrioritySignalError etc. -> serial per guess
+        except PrioritySignalError as e:
+            # expected: priority workloads / stateful plugins plan
+            # serially per guess, the reference's cost model
+            GLOBAL.note("interactive-evaluator", f"serial per guess: {e}")
+        except Exception as e:
+            # unexpected encode failure: degrade the same way, loudly
+            GLOBAL.note(
+                "interactive-evaluator", f"serial per guess (encode failed: {e})"
+            )
+            logging.getLogger(__name__).warning(
+                "batched sweep unavailable for the interactive loop, "
+                "planning serially per guess: %s", e
+            )
     return _SerialEvaluator(applier, cluster, apps, new_node)
 
 
@@ -159,6 +173,16 @@ def run_interactive(applier, shell: Optional[Shell] = None, max_iterations: int 
     from .applier import ApplyResult, satisfy_resource_setting
     from .report import report
 
+    if getattr(applier, "tolerate_node_failures", 0) > 0:
+        from ..models.validation import InputError
+
+        # the guess-a-count loop has no N+K escalation; silently
+        # returning an unvetted plan would let the user believe it
+        # survives K failures
+        raise InputError(
+            "--tolerate-node-failures is not available in interactive "
+            "mode; run the one-shot plan (drop -i) or `simon chaos`"
+        )
     shell = shell or Shell()
 
     cluster = applier.load_cluster()

@@ -94,6 +94,9 @@ def cmd_apply(args) -> int:
             use_sweep=not args.no_sweep,
             use_greed=args.use_greed,
             scheduler_config=args.default_scheduler_config,
+            tolerate_node_failures=args.tolerate_node_failures,
+            chaos_seed=args.chaos_seed,
+            chaos_trials=args.chaos_trials,
         )
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -141,6 +144,133 @@ def cmd_apply(args) -> int:
         print(f"new nodes added: {result.new_node_count}")
     print(result.report_text)
     return 0
+
+
+def _parse_taint(spec: str):
+    """`key[=value]:Effect[@node1,node2]` -> (names_or_None, taint)."""
+    body, _, nodes = spec.partition("@")
+    kv, sep, effect = body.rpartition(":")
+    if not sep or not kv or not effect:
+        raise ValueError(
+            f"taint {spec!r}: expected key[=value]:Effect[@node1,node2]"
+        )
+    key, _, value = kv.partition("=")
+    taint = {"key": key, "effect": effect}
+    if value:
+        taint["value"] = value
+    return ([n for n in nodes.split(",") if n] or None) if nodes else None, taint
+
+
+def _parse_degrade(spec: str):
+    """`PCT[@node1,node2]` -> (percent, names_or_None)."""
+    body, _, nodes = spec.partition("@")
+    pct = int(body)
+    return pct, ([n for n in nodes.split(",") if n] or None) if nodes else None
+
+
+def cmd_chaos(args) -> int:
+    """Fault-injection survivability of a committed plan
+    (resilience/chaos.py; docs/RESILIENCE.md)."""
+    import json
+
+    from .apply.applier import (
+        MAX_NUM_NEW_NODE,
+        Applier,
+        SimonConfig,
+        _capacity_feasible,
+    )
+    from .models.validation import InputError
+    from .parallel.sweep import CapacitySweep, PrioritySignalError
+    from .resilience.chaos import ChaosEngine, perturbed_scenario_sweep
+    from .utils.trace import GLOBAL
+
+    _force_platform()
+    try:
+        config = SimonConfig.from_file(args.simon_config)
+        applier = Applier(config, use_greed=args.use_greed)
+        cluster = applier.load_cluster()
+        apps = applier.load_apps()
+        new_node = applier.load_new_node()
+        taints = [_parse_taint(t) for t in args.taint or []]
+        degrade = _parse_degrade(args.degrade) if args.degrade else None
+        cordon = [n for n in (args.cordon or "").split(",") if n]
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    GLOBAL.reset()
+    try:
+        # expansion names pods from a process-global counter; reset so
+        # repeated in-process runs (and the perturbed re-encoding
+        # below) expand the identical pod sequence
+        from .models.workloads import reset_name_counter
+
+        reset_name_counter()
+        if args.new_node_count is not None:
+            count = args.new_node_count
+            if count < 0:
+                raise InputError("--new-node-count must be >= 0")
+            if count > 0 and new_node is None:
+                # CapacitySweep would silently clamp to 0 and the
+                # report would describe capacity that was never there
+                raise InputError(
+                    f"--new-node-count {count} needs a newNode spec in "
+                    "the config, which has none"
+                )
+            sweep = CapacitySweep(
+                cluster, apps, new_node, count, use_greed=args.use_greed
+            )
+            baseline = sweep.probe(count).placements
+        else:
+            # plan first: the chaos sweep evaluates the committed plan
+            max_count = 0 if new_node is None else MAX_NUM_NEW_NODE
+            sweep = CapacitySweep(
+                cluster, apps, new_node, max_count, use_greed=args.use_greed
+            )
+            feasible, (mc, mm, mv) = _capacity_feasible()
+            best = sweep.find_min_count(
+                feasible, start=sweep.lower_bound(mc, mm, mv)
+            )
+            if best is None:
+                print(
+                    "error: no feasible plan to inject faults into "
+                    f"(infeasible even with {max_count} new node(s)); "
+                    "pass --new-node-count to analyze an infeasible "
+                    "placement anyway",
+                    file=sys.stderr,
+                )
+                return 1
+            count, baseline = best.count, best.placements
+        scen_sweep = perturbed_scenario_sweep(
+            cluster,
+            apps,
+            new_node,
+            sweep.max_count,
+            cordon=cordon,
+            taints=taints,
+            degrade=degrade,
+            use_greed=args.use_greed,
+        )
+        engine = ChaosEngine(sweep, count, baseline, scenario_sweep=scen_sweep)
+        report = engine.run(
+            failures=args.failures, seed=args.seed, trials=args.trials
+        )
+    except PrioritySignalError as e:
+        print(
+            f"error: chaos analysis needs the batched scan path: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    except (OSError, InputError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.trace:
+        print(GLOBAL.as_json(), file=sys.stderr)
+    if args.format == "json":
+        print(json.dumps(report.as_dict()))
+    else:
+        print(report.render_text())
+    return 0 if report.all_survived else 2
 
 
 def cmd_defrag(args) -> int:
@@ -358,6 +488,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-sweep", action="store_true", help="disable the batched capacity sweep"
     )
     p_apply.add_argument(
+        "--tolerate-node-failures",
+        type=int,
+        default=0,
+        metavar="K",
+        help="raise the plan until it survives any K node failures "
+        "(N+K; outage scenarios per docs/RESILIENCE.md, confirmed by a "
+        "serial re-simulation of one sampled outage)",
+    )
+    p_apply.add_argument(
+        "--chaos-seed",
+        type=int,
+        default=1,
+        help="seed for the deterministic K-failure scenario sampling",
+    )
+    p_apply.add_argument(
+        "--chaos-trials",
+        type=int,
+        default=32,
+        help="sampled K-failure scenarios per escalation (K >= 2)",
+    )
+    p_apply.add_argument(
         "--format", choices=["table", "json"], default="table", help="result output format"
     )
     p_apply.add_argument(
@@ -393,6 +544,70 @@ def build_parser() -> argparse.ArgumentParser:
         "--format", choices=["table", "json"], default="table", help="result output format"
     )
     p_defrag.set_defaults(func=cmd_defrag)
+
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="fault-injection survivability report for a committed plan",
+        description="Plan (or take --new-node-count as committed), then "
+        "evaluate node-outage scenarios against the committed placement: "
+        "surviving pods stay put, displaced pods reschedule on the "
+        "residual capacity, and the report states which pods fail to "
+        "reschedule and why (docs/RESILIENCE.md). Exit 0 when every "
+        "scenario survives, 2 otherwise.",
+    )
+    p_chaos.add_argument("-f", "--simon-config", required=True, help="simon config file path")
+    p_chaos.add_argument(
+        "--failures",
+        type=int,
+        default=1,
+        metavar="K",
+        help="simultaneous node failures: 1 = exhaustive singles; K >= 2 "
+        "adds seeded-sampled K-subsets; 0 = replacement study (no outage)",
+    )
+    p_chaos.add_argument(
+        "--seed", type=int, default=1, help="scenario-sampling seed (deterministic)"
+    )
+    p_chaos.add_argument(
+        "--trials", type=int, default=32, help="sampled K-subset scenarios (K >= 2)"
+    )
+    p_chaos.add_argument(
+        "--new-node-count",
+        type=int,
+        default=None,
+        metavar="N",
+        help="treat N new nodes as the committed plan instead of planning first",
+    )
+    p_chaos.add_argument(
+        "--cordon",
+        default="",
+        metavar="NODE[,NODE]",
+        help="evaluate scenarios with these nodes cordoned (unschedulable "
+        "for rescheduling; their pods stay)",
+    )
+    p_chaos.add_argument(
+        "--taint",
+        action="append",
+        metavar="key[=value]:Effect[@node1,node2]",
+        help="evaluate scenarios with this taint applied (repeatable; no "
+        "@nodes = every cluster node)",
+    )
+    p_chaos.add_argument(
+        "--degrade",
+        default="",
+        metavar="PCT[@node1,node2]",
+        help="evaluate scenarios with allocatable cpu/memory reduced PCT%% "
+        "on the named nodes (default all)",
+    )
+    p_chaos.add_argument("--use-greed", action="store_true", help=argparse.SUPPRESS)
+    p_chaos.add_argument(
+        "--format", choices=["table", "json"], default="table", help="result output format"
+    )
+    p_chaos.add_argument(
+        "--trace",
+        action="store_true",
+        help="print per-phase wall-clock JSON to stderr",
+    )
+    p_chaos.set_defaults(func=cmd_chaos)
 
     p_version = sub.add_parser("version", help="print version")
     p_version.set_defaults(func=cmd_version)

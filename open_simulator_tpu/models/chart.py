@@ -428,7 +428,17 @@ def _eval_command(atoms: List, env: _Env, piped=None):
         args = [_eval_atom(a, env) for a in atoms[1:]] + extra
         try:
             return value(*args)
-        except Exception:
+        except (TypeError, ValueError, KeyError, IndexError, AttributeError) as e:
+            # a failed method/value call renders as "<no value>" like a
+            # Go template error-less miss, but the swallowed reason is
+            # kept for `--trace` output — a chart that silently renders
+            # wrong must be diagnosable without a debugger
+            from ..utils.trace import GLOBAL
+
+            GLOBAL.append_note(
+                "chart-template-call",
+                f"{head!r}: {type(e).__name__}: {e}",
+            )
             return MISSING
     return value
 

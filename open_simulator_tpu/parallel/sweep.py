@@ -35,6 +35,12 @@ from ..models.decode import ResourceTypes
 from ..scheduler.core import AppResource, _sort_app_pods
 from ..scheduler.oracle import Oracle
 
+# pod not present in this scenario. Duplicates the ops/scan.py and
+# ops/pallas_scan.py sentinel because importing either here would pull
+# jax in at module-import time (cli._force_platform must run first);
+# CapacitySweep.__init__ asserts the three stay equal.
+INACTIVE = -2
+
 
 class PrioritySignalError(ValueError):
     """Raised when a batched sweep is asked to plan a priority-bearing
@@ -42,6 +48,93 @@ class PrioritySignalError(ValueError):
     silent non-preemptive plan would diverge from simulate() on the
     same input. Callers (apply/applier.py) catch this and fall back to
     the serial escalation loop, whose simulate() handles priority."""
+
+
+# test hook: callable(chunk_len) invoked before each device chunk is
+# evaluated; tests make it raise a fake RESOURCE_EXHAUSTED to exercise
+# the halving-retry / serial-fallback paths without a real OOM
+_OOM_INJECT = None
+
+
+def _is_resource_exhausted(e: BaseException) -> bool:
+    """Device-memory exhaustion, as XLA reports it (XlaRuntimeError is
+    a RuntimeError whose message carries the RESOURCE_EXHAUSTED status
+    code; some backends phrase it as an allocation failure)."""
+    if isinstance(e, MemoryError):
+        return True
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+def run_chunked(evaluate, n_items: int, *, label: str, serial_fallback=None,
+                trace=None):
+    """Evaluate scenarios [0, n_items) in device batches with bounded
+    halving-retry on device OOM (the batched sweep's hardening: a
+    10k-scenario vmap that exhausts device memory used to kill the
+    whole plan).
+
+    `evaluate(lo, hi)` runs one contiguous chunk on the device and
+    returns a list of per-item results; on RESOURCE_EXHAUSTED the chunk
+    is split in half and each half retried, bottoming out at single-
+    item chunks; a single item that still OOMs goes through
+    `serial_fallback(i)` (the deterministic host-oracle path). Every
+    degradation is trace-noted with its reason and logged — mirroring
+    the fallback_reason() discipline of ops/pallas_scan.py, no silent
+    paths. Exceptions that are not memory exhaustion propagate."""
+    import logging
+
+    from ..utils.trace import GLOBAL
+
+    tr = trace or GLOBAL
+    log = logging.getLogger(__name__)
+    out = [None] * n_items
+    pending = [(0, n_items)] if n_items else []
+    halvings = serial = 0
+    while pending:
+        lo, hi = pending.pop()
+        try:
+            if _OOM_INJECT is not None:
+                _OOM_INJECT(hi - lo)
+            results = evaluate(lo, hi)
+        except (RuntimeError, MemoryError) as e:
+            if not _is_resource_exhausted(e):
+                raise
+            reason = str(e).split("\n", 1)[0][:120]
+            if hi - lo == 1:
+                if serial_fallback is None:
+                    raise
+                serial += 1
+                tr.append_note(
+                    f"{label}-serial-fallback",
+                    f"scenario {lo} via serial oracle after {reason}",
+                )
+                log.warning(
+                    "%s: scenario %d exhausted device memory even alone; "
+                    "falling back to the serial oracle (%s)", label, lo, reason
+                )
+                out[lo] = serial_fallback(lo)
+                continue
+            mid = (lo + hi) // 2
+            halvings += 1
+            tr.append_note(
+                f"{label}-chunk-halving",
+                f"[{lo},{hi}) -> [{lo},{mid})+[{mid},{hi}) after {reason}",
+            )
+            log.warning(
+                "%s: chunk [%d,%d) exhausted device memory; retrying as "
+                "two halves (%s)", label, lo, hi, reason
+            )
+            # LIFO: push the upper half first so the lower half runs next
+            pending.append((mid, hi))
+            pending.append((lo, mid))
+            continue
+        out[lo:hi] = results
+    if halvings or serial:
+        tr.note(
+            f"{label}-degraded",
+            f"{halvings} chunk-halving(s), {serial} serial fallback(s)",
+        )
+    return out
 
 
 @dataclass
@@ -221,10 +314,13 @@ class CapacitySweep:
             if target is not None and target in name_to_idx:
                 self._ds_target[p_i] = name_to_idx[target]
         self._probe_jit = None
+        self._chaos_jit = None
         # fused single-kernel fast path (ops/pallas_scan.py); None when
         # the batch uses machinery outside its scope or the backend is
         # not a real TPU (the interpreter would crawl at bench scale)
-        from ..ops import pallas_scan
+        from ..ops import pallas_scan, scan as scan_ops
+
+        assert INACTIVE == scan_ops.INACTIVE == pallas_scan.INACTIVE
 
         self._pallas_plan = (
             pallas_scan.build_plan(
@@ -262,18 +358,60 @@ class CapacitySweep:
     def _scenario(self, valid, active):
         import jax.numpy as jnp
 
+        return self._scenario_impl(
+            valid, active, jnp.asarray(self.batch.pinned_node), self.features
+        )
+
+    def _scenario_pinned(self, valid, active, pinned):
+        """TWO chained masked scans with a PER-SCENARIO pin vector —
+        the resilience engine's substrate (outage scenario = node mask
+        + surviving pods pinned at their committed nodes, displaced
+        pods free to reschedule). The passes model reality: surviving
+        pods never unbind, so ALL pins commit before any displaced pod
+        reschedules — a single interleaved scan would let an early
+        displaced pod take capacity a later survivor's unconditional
+        pin then overcommits. Pins are force-enabled in the features:
+        the original batch may have carried none."""
+        import jax.numpy as jnp
+
+        from ..ops import scan as scan_ops
+
+        features = self.features._replace(pins=True)
+        cls = jnp.asarray(self.batch.class_of_pod)
+        p1, state1 = scan_ops.run_scan_masked(
+            self.static, self.init, cls, pinned, valid,
+            active & (pinned >= 0), features=features,
+        )
+        p2, final = scan_ops.run_scan_masked(
+            self.static, state1, cls, pinned, valid,
+            active & (pinned < 0), features=features,
+        )
+        placements = jnp.where(pinned >= 0, p1, p2)
+        unsched = jnp.sum(placements == -1)
+        cpu_util, mem_util, _vg = self._utilization(valid, final)
+        return placements, unsched, cpu_util, mem_util
+
+    def _scenario_impl(self, valid, active, pinned, features):
+        import jax.numpy as jnp
+
         from ..ops import scan as scan_ops
 
         placements, final = scan_ops.run_scan_masked(
             self.static,
             self.init,
             jnp.asarray(self.batch.class_of_pod),
-            jnp.asarray(self.batch.pinned_node),
+            pinned,
             valid,
             active,
-            features=self.features,
+            features=features,
         )
         unsched = jnp.sum(placements == -1)
+        cpu_util, mem_util, vg_util = self._utilization(valid, final)
+        return placements, unsched, cpu_util, mem_util, vg_util
+
+    def _utilization(self, valid, final):
+        import jax.numpy as jnp
+
         denom_cpu = jnp.sum(jnp.where(valid, self.static.alloc_mcpu, 0))
         denom_mem = jnp.sum(jnp.where(valid, self.static.alloc_mem, 0))
         cpu_util = (
@@ -286,7 +424,7 @@ class CapacitySweep:
         vg_util = (
             100.0 * jnp.sum(jnp.where(valid[:, None], final.vg_used, 0)) / jnp.maximum(denom_vg, 1)
         )
-        return placements, unsched, cpu_util, mem_util, vg_util
+        return cpu_util, mem_util, vg_util
 
     def probe(self, count: int) -> ProbeResult:
         """Evaluate one candidate count (one masked scan)."""
@@ -376,7 +514,10 @@ class CapacitySweep:
 
     def probe_many(self, counts: List[int], mesh=None) -> SweepResult:
         """Evaluate many counts batched (vmap; scenario-sharded over a
-        device mesh when one is given)."""
+        device mesh when one is given). Chunked with OOM halving-retry
+        (run_chunked): a scenario batch that exhausts device memory is
+        split and retried, bottoming out in the deterministic serial
+        oracle — every degradation trace-noted, never silent."""
         import jax
         import jax.numpy as jnp
 
@@ -384,28 +525,45 @@ class CapacitySweep:
         node_valid = np.stack([self.node_valid(c) for c in counts])
         pod_active = np.stack([self.pod_active(v) for v in node_valid])
         sweep_fn = jax.vmap(self._scenario)
-        valid_j = jnp.asarray(node_valid)
-        active_j = jnp.asarray(pod_active)
 
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        def evaluate(lo, hi):
+            valid_j = jnp.asarray(node_valid[lo:hi])
+            active_j = jnp.asarray(pod_active[lo:hi])
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
 
-            axis = mesh.axis_names[0]
-            n_dev = mesh.devices.size
-            pad = (-sc) % n_dev
-            if pad:
-                valid_j = jnp.concatenate([valid_j, jnp.repeat(valid_j[-1:], pad, 0)])
-                active_j = jnp.concatenate([active_j, jnp.repeat(active_j[-1:], pad, 0)])
-            sharding = NamedSharding(mesh, P(axis))
-            valid_j = jax.device_put(valid_j, sharding)
-            active_j = jax.device_put(active_j, sharding)
-            out = jax.jit(sweep_fn, in_shardings=(sharding, sharding))(valid_j, active_j)
-            placements, unsched, cpu_util, mem_util, vg_util = (
-                np.asarray(o)[:sc] for o in out
-            )
-        else:
-            out = jax.jit(sweep_fn)(valid_j, active_j)
-            placements, unsched, cpu_util, mem_util, vg_util = (np.asarray(o) for o in out)
+                axis = mesh.axis_names[0]
+                n_dev = mesh.devices.size
+                pad = (-(hi - lo)) % n_dev
+                if pad:
+                    valid_j = jnp.concatenate(
+                        [valid_j, jnp.repeat(valid_j[-1:], pad, 0)]
+                    )
+                    active_j = jnp.concatenate(
+                        [active_j, jnp.repeat(active_j[-1:], pad, 0)]
+                    )
+                sharding = NamedSharding(mesh, P(axis))
+                valid_j = jax.device_put(valid_j, sharding)
+                active_j = jax.device_put(active_j, sharding)
+                out = jax.jit(sweep_fn, in_shardings=(sharding, sharding))(
+                    valid_j, active_j
+                )
+                arrays = [np.asarray(o)[: hi - lo] for o in out]
+            else:
+                out = jax.jit(sweep_fn)(valid_j, active_j)
+                arrays = [np.asarray(o) for o in out]
+            return list(zip(*arrays))
+
+        def serial_fallback(i):
+            placements, _ = self.serial_scenario(node_valid[i], pod_active[i])
+            return self._host_scenario_stats(node_valid[i], placements)
+
+        rows = run_chunked(
+            evaluate, sc, label="sweep", serial_fallback=serial_fallback
+        )
+        placements, unsched, cpu_util, mem_util, vg_util = (
+            np.stack([np.asarray(r[k]) for r in rows]) for k in range(5)
+        )
 
         return SweepResult(
             counts=list(counts),
@@ -417,6 +575,155 @@ class CapacitySweep:
             node_names=[ns.name for ns in self.oracle.nodes],
             vg_util=vg_util,
         )
+
+    # -- serial (host-oracle) scenario evaluation ---------------------------
+
+    def serial_scenario(self, valid, active, pinned=None, pins_first=False):
+        """Deterministic host-side evaluation of ONE masked scenario
+        through the serial oracle (scheduler/oracle.py) — the sweep's
+        last resort when even a single-scenario device batch exhausts
+        memory, and the resilience engine's independent confirmation
+        path (an N+K verdict is only trusted after one sampled outage
+        re-simulates serially to the same answer).
+
+        `pinned[p]` >= 0 force-binds the pod to that sweep node index
+        (committed placements / original spec.nodeName); -1 schedules
+        through the full filter+score cycle. Defaults to the batch's
+        original pins. `pins_first` commits every pinned pod before any
+        free pod schedules — the chaos model's two-pass order
+        (_scenario_pinned); the default interleaves in pod order like
+        the single-pass capacity scan. Returns (placements[P] in SWEEP
+        node indices with the scan's -1/-2 conventions,
+        {pod_index: reason} for unscheduled pods)."""
+        from ..scheduler.oracle import Oracle
+
+        if pinned is None:
+            pinned = np.asarray(self.batch.pinned_node)
+        valid = np.asarray(valid)
+        active = np.asarray(active)
+        kept = [i for i in range(self.n) if valid[i]]
+        oracle = Oracle(
+            [self.oracle.nodes[i].node for i in kept],
+            score_weights=self.features.weights,
+        )
+        local_of = {sweep_i: local_i for local_i, sweep_i in enumerate(kept)}
+        sweep_index = self.oracle.node_index
+        placements = np.full(len(self.pods), -1, dtype=np.int64)
+        reasons: dict = {}
+
+        def handle(p_i, pod, pins_only):
+            if not active[p_i]:
+                placements[p_i] = INACTIVE
+                return
+            pin = int(pinned[p_i])
+            if pins_only is not None and pins_only != (pin >= 0):
+                return
+            # repeated-replay contract (replay_scenario): a previous
+            # replay may have bound this shared dict — only original
+            # spec.nodeName pins survive into this scenario
+            if not self.had_node_name[p_i]:
+                (pod.get("spec") or {}).pop("nodeName", None)
+                (pod.get("status") or {}).pop("phase", None)
+            if pin >= 0:
+                if not valid[pin]:
+                    # pinned to a masked-out node: does not exist in
+                    # this scenario (scan INACTIVE convention)
+                    placements[p_i] = INACTIVE
+                    return
+                if self.had_node_name[p_i]:
+                    # original spec.nodeName: admit exactly like the
+                    # replay (GPU-index annotations honored)
+                    oracle.place_existing_pod(pod)
+                else:
+                    oracle._reserve_and_bind(pod, oracle.nodes[local_of[pin]])
+                placements[p_i] = pin
+                return
+            name, reason = oracle.schedule_pod(pod)
+            if name is None:
+                placements[p_i] = -1
+                reasons[p_i] = reason
+            else:
+                placements[p_i] = sweep_index[name]
+
+        if pins_first:
+            for p_i, pod in enumerate(self.pods):
+                handle(p_i, pod, pins_only=True)
+            for p_i, pod in enumerate(self.pods):
+                if active[p_i] and int(pinned[p_i]) < 0:
+                    handle(p_i, pod, pins_only=False)
+        else:
+            for p_i, pod in enumerate(self.pods):
+                handle(p_i, pod, pins_only=None)
+        return placements, reasons
+
+    def _host_scenario_stats(self, valid, placements):
+        """The (placements, unscheduled, cpu/mem/vg utilization) tuple
+        of _scenario, recomputed on the host from serial placements —
+        same arithmetic, aggregate form (committed requests add onto
+        the encoded base usage; placements only land on valid nodes)."""
+        b, d, c_enc = self.batch, self.dyn, self.cluster_enc
+        v = np.asarray(valid)
+        placed = np.asarray(placements) >= 0
+        cls = np.asarray(b.class_of_pod)[placed]
+        used_c = int(d.used_mcpu[v].sum()) + int(b.req_mcpu[cls].sum())
+        used_m = int(d.used_mem[v].sum()) + int(b.req_mem[cls].sum())
+        used_v = int(d.vg_used[v].sum()) + int(b.lvm_sizes[cls].sum())
+        denom_c = max(int(c_enc.alloc_mcpu[v].sum()), 1)
+        denom_m = max(int(c_enc.alloc_mem[v].sum()), 1)
+        denom_v = max(int(c_enc.vg_cap[v].sum()), 1)
+        return (
+            np.asarray(placements),
+            np.int64((np.asarray(placements) == -1).sum()),
+            np.float64(100.0 * used_c / denom_c),
+            np.float64(100.0 * used_m / denom_m),
+            np.float64(100.0 * used_v / denom_v),
+        )
+
+    def probe_scenarios(self, node_valid, pod_active, pinned):
+        """Batched masked scans with PER-SCENARIO pin vectors — the
+        fault-injection substrate (resilience/chaos.py). Each row of
+        `node_valid` [Sc, N] / `pod_active` [Sc, P] / `pinned` [Sc, P]
+        is one outage scenario; rides the same chunked executor as
+        probe_many (OOM halving-retry, serial-oracle floor). Returns
+        (placements [Sc, P], unscheduled [Sc], cpu_util [Sc],
+        mem_util [Sc]) as numpy arrays.
+
+        Runs on the XLA masked scan (the Pallas plan is compiled for
+        the batch's original pin feature set); chaos batches are
+        scenario-bound, not pod-throughput-bound, so this is the
+        latency-appropriate path."""
+        import jax
+        import jax.numpy as jnp
+
+        node_valid = np.asarray(node_valid)
+        pod_active = np.asarray(pod_active)
+        pinned = np.asarray(pinned)
+        sc = node_valid.shape[0]
+        if self._chaos_jit is None:
+            self._chaos_jit = jax.jit(jax.vmap(self._scenario_pinned))
+
+        def evaluate(lo, hi):
+            out = self._chaos_jit(
+                jnp.asarray(node_valid[lo:hi]),
+                jnp.asarray(pod_active[lo:hi]),
+                jnp.asarray(pinned[lo:hi]),
+            )
+            return list(zip(*(np.asarray(o) for o in out)))
+
+        def serial_fallback(i):
+            placements, _ = self.serial_scenario(
+                node_valid[i], pod_active[i], pinned[i], pins_first=True
+            )
+            return self._host_scenario_stats(node_valid[i], placements)[:4]
+
+        rows = run_chunked(
+            evaluate, sc, label="chaos", serial_fallback=serial_fallback
+        )
+        placements = np.stack([np.asarray(r[0]) for r in rows])
+        unsched = np.array([int(r[1]) for r in rows], dtype=np.int64)
+        cpu = np.array([float(r[2]) for r in rows])
+        mem = np.array([float(r[3]) for r in rows])
+        return placements, unsched, cpu, mem
 
     # -- resource lower bound ----------------------------------------------
 

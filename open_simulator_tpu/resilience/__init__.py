@@ -1,0 +1,18 @@
+"""Fault-injection resilience: batched node-outage sweeps, N+K
+capacity planning, and perturbation (cordon/taint/degrade) studies.
+
+The reference answers "does this plan fit?"; this package answers
+"does this plan *survive*?" — see docs/RESILIENCE.md for the chaos
+model and resilience/chaos.py for the engine.
+"""
+
+from .chaos import (  # noqa: F401
+    ChaosEngine,
+    ChaosReport,
+    OutageScenario,
+    ScenarioOutcome,
+    perturbed_cluster,
+    perturbed_scenario_sweep,
+    raise_plan_to_nplusk,
+    sampled_failure_sets,
+)

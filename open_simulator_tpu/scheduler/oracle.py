@@ -491,6 +491,8 @@ class Oracle:
         (None, 'phase plugin "name"') on a plugin veto; any veto after
         Reserve unreserves in reverse order first. May raise
         ExtenderError from a binder extender."""
+        from .extender import ExtenderError
+
         scores = self._prioritize(pod, feasible)
         best = feasible[0]
         best_score = scores[0]
@@ -546,9 +548,13 @@ class Oracle:
                 return None, f'bind plugin "{plugin.name}"'
         try:
             self._reserve_and_bind(pod, best)
-        except Exception:
+        except ExtenderError:
             # a binder-extender failure aborts the bind after Reserve —
-            # the framework runs Unreserve then (scheduler.go:597-608)
+            # the framework runs Unreserve then (scheduler.go:597-608);
+            # the caller (schedule_pod) attaches the extender's message
+            # to the pod's unschedulable event ("failed to bind pod").
+            # Anything else is an internal bug and stays loud: no
+            # unreserve, the whole simulation dies with the traceback
             unreserve_all()
             raise
         for plugin in self.registry.plugins:

@@ -34,6 +34,9 @@ class Trace:
     phases: Dict[str, PhaseRecord] = field(default_factory=dict)
     order: List[str] = field(default_factory=list)
     notes: Dict[str, str] = field(default_factory=dict)
+    # entries accumulated per append_note name (values may themselves
+    # contain ';', so the cap tracks a real count, not a character scan)
+    appended: Dict[str, int] = field(default_factory=dict)
 
     def add(self, name: str, seconds: float):
         with _lock:
@@ -50,12 +53,30 @@ class Trace:
         `engine=pallas` vs `engine=xla-scan`) for `--trace` output."""
         with _lock:
             self.notes[name] = value
+            self.appended.pop(name, None)
+
+    def append_note(self, name: str, value: str):
+        """Accumulate under one note name ('; '-joined). Degradation
+        events (chunk-halving, serial fallback, swallowed template
+        errors) append rather than overwrite so every occurrence keeps
+        its reason in `--trace` output; capped at 50 entries so a
+        pathological run cannot grow the trace without bound."""
+        with _lock:
+            n = self.appended.get(name, 0)
+            self.appended[name] = n + 1
+            if n == 0:
+                self.notes[name] = str(value)
+            elif n < 50:
+                self.notes[name] = f"{self.notes[name]}; {value}"
+            elif n == 50:
+                self.notes[name] = self.notes[name] + "; ..."
 
     def reset(self):
         with _lock:
             self.phases.clear()
             self.order.clear()
             self.notes.clear()
+            self.appended.clear()
 
     def as_dict(self) -> dict:
         out = {

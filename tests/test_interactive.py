@@ -134,3 +134,93 @@ def test_serial_evaluator_used_for_priority_workloads(tmp_path):
     result, out = _run(config, script)
     assert result.success
     assert result.new_node_count == 2
+
+
+# -- degenerate input: EOF, junk selections, the cap deviation loop -----
+
+
+def test_eof_mid_menu_exits_cleanly(tmp_path):
+    """stdin ending at the unschedulable menu behaves like choosing
+    exit (survey ^C semantics) — no traceback, a failed ApplyResult."""
+    config = _setup(tmp_path)
+    result, out = _run(config, [""])  # app select, then EOF at the menu
+    assert not result.success
+    assert "exited by user" in result.message
+    assert result.new_node_count == 0
+
+
+def test_unparseable_selection_falls_back_to_exit(tmp_path):
+    """A selection that is neither an index nor an option text selects
+    the last option (exit) instead of crashing or looping."""
+    config = _setup(tmp_path)
+    result, out = _run(config, ["", "zzz"])
+    assert not result.success
+    assert "exited by user" in result.message
+
+
+def test_unparseable_node_number_reprompts(tmp_path):
+    """Junk at the node-number prompt leaves the count unchanged and
+    re-enters the menu instead of crashing."""
+    config = _setup(tmp_path)
+    script = [
+        "",  # app multi-select: all
+        "1",  # add node(s)
+        "abc",  # unparseable count: ignored, menu reappears at count 0
+        "1",  # add node(s) again
+        "2",  # now a real count
+        "",  # node multi-select before report
+    ]
+    result, out = _run(config, script)
+    assert result.success
+    assert result.new_node_count == 2
+    # the menu was shown twice (the junk input did not advance state)
+    assert out.count("can not be scheduled when add 0 nodes") == 2
+
+
+def _cap_setup(tmp_path):
+    """Workload that FITS but violates a low MaxCPU cap: 0.5 cpu on a
+    1-cpu node = 50% utilization."""
+    config = _setup(tmp_path)
+    appdir = config.app_list[0].path
+    doc = yaml.safe_load(open(os.path.join(appdir, "deploy.yaml")))
+    doc["spec"]["replicas"] = 1
+    _write_yaml(os.path.join(appdir, "deploy.yaml"), doc)
+    return config
+
+
+def test_cap_deviation_loop_add_nodes_until_under_cap(tmp_path, monkeypatch):
+    """The documented deviation from the reference: a plan whose pods
+    all fit but whose utilization caps fail re-prompts {add node(s) |
+    exit} instead of looping forever re-printing the reason
+    (apply.go:230-238 has no prompt on that path)."""
+    monkeypatch.setenv("MaxCPU", "10")
+    config = _cap_setup(tmp_path)
+    script = [
+        "",  # app multi-select
+        "0",  # caps menu: add node(s)
+        "2",  # 2 new 2-cpu nodes -> 0.5/5 cpu = 10% <= cap
+        "",  # node multi-select
+    ]
+    result, out = _run(config, script)
+    assert result.success
+    assert result.new_node_count == 2
+    assert "occupancy rate" in out  # the reason was printed first
+    assert "utilization caps not met with 0 new node(s)" in out
+
+
+def test_cap_deviation_loop_exit_returns_reason(tmp_path, monkeypatch):
+    monkeypatch.setenv("MaxCPU", "10")
+    config = _cap_setup(tmp_path)
+    result, out = _run(config, ["", "1"])  # caps menu: exit
+    assert not result.success
+    assert "occupancy rate" in result.message
+    assert "cpu" in result.message
+
+
+def test_cap_deviation_loop_eof_exits(tmp_path, monkeypatch):
+    """EOF at the cap menu takes the exit arm, like every other menu."""
+    monkeypatch.setenv("MaxCPU", "10")
+    config = _cap_setup(tmp_path)
+    result, out = _run(config, [""])
+    assert not result.success
+    assert "occupancy rate" in result.message

@@ -72,3 +72,126 @@ def test_format_spec_fstring_not_flagged(tmp_path):
     )
     codes_lines = [(c, l) for c, l in findings if c == "F541"]
     assert codes_lines == [("F541", 3)]
+
+
+def test_broad_except_exception_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except Exception:\n        return None\n",
+    )
+    assert ("BLE001", 4) in findings
+
+
+def test_broad_except_in_tuple_and_baseexception_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except (ValueError, Exception):\n        return None\n"
+        "def h():\n"
+        "    try:\n        g()\n"
+        "    except BaseException:\n        raise\n",
+    )
+    codes = [(c, l) for c, l in findings if c == "BLE001"]
+    assert ("BLE001", 4) in codes and ("BLE001", 9) in codes
+
+
+def test_silent_pass_handler_flagged_even_when_narrow(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except ValueError:\n        pass\n",
+    )
+    assert ("S110", 4) in findings
+
+
+def test_handler_with_logging_not_s110_and_narrow_not_ble(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import logging\n"
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except ValueError as e:\n"
+        "        logging.warning('skipped: %s', e)\n",
+    )
+    assert not any(c in ("BLE001", "S110") for c, _ in findings)
+
+
+def test_broad_except_rules_exempt_tests_and_tools_trees(tmp_path):
+    src = (
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except Exception:\n        pass\n"
+    )
+    for sub in ("tests", "tools"):
+        p = tmp_path / sub
+        p.mkdir()
+        # make the exempt dir the file's top-level path component the
+        # way _relpath sees out-of-repo files (by name only), so this
+        # exercises the in-repo exemption logic via monkeypatching the
+        # repo root
+        import tools.lint as lint_mod
+
+        old_root = lint_mod._REPO_ROOT
+        lint_mod._REPO_ROOT = tmp_path
+        try:
+            f = p / "mod.py"
+            f.write_text(src)
+            findings = [
+                (code, line) for _, line, code, _ in lint_file(f)
+            ]
+        finally:
+            lint_mod._REPO_ROOT = old_root
+        assert not any(c in ("BLE001", "S110") for c, _ in findings), sub
+
+
+def test_broad_except_allowlist_and_noqa(tmp_path):
+    src = (
+        "def audited():\n"
+        "    try:\n        g()\n"
+        "    except Exception:\n        return None\n"
+    )
+    import tools.lint as lint_mod
+
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    rel = lint_mod._relpath(p)
+    lint_mod.BROAD_EXCEPT_ALLOW.add((rel, "audited"))
+    try:
+        findings = [(c, l) for _, l, c, _ in lint_file(p)]
+    finally:
+        lint_mod.BROAD_EXCEPT_ALLOW.discard((rel, "audited"))
+    assert not any(c == "BLE001" for c, _ in findings)
+    # noqa exempts like every other rule
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except Exception:  # noqa\n        return None\n",
+    )
+    assert not any(c == "BLE001" for c, _ in findings)
+
+
+def test_first_party_package_is_policed():
+    """The audited-survivor allowlist matches reality: linting the real
+    package yields zero BLE001/S110 findings (new broad handlers must
+    be narrowed or audited), and every allowlist entry still names an
+    existing file."""
+    from pathlib import Path
+
+    import tools.lint as lint_mod
+
+    pkg = Path(lint_mod._REPO_ROOT) / "open_simulator_tpu"
+    findings = []
+    for f in sorted(pkg.rglob("*.py")):
+        findings.extend(
+            (str(f), line, code)
+            for _, line, code, _ in lint_file(f)
+            if code in ("BLE001", "S110")
+        )
+    assert findings == []
+    for rel, _fn in lint_mod.BROAD_EXCEPT_ALLOW:
+        assert (Path(lint_mod._REPO_ROOT) / rel).exists(), rel

@@ -1,0 +1,564 @@
+"""Fault-injection resilience subsystem (resilience/chaos.py): outage
+sweeps over a committed placement, deterministic seeded K-failure
+sampling, N+K capacity planning with serial confirmation, perturbation
+helpers, and the OOM-hardened chunked sweep executor."""
+
+import numpy as np
+import pytest
+import yaml as _yaml
+
+import open_simulator_tpu.parallel.sweep as sweep_mod
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.parallel.sweep import CapacitySweep, run_chunked
+from open_simulator_tpu.resilience.chaos import (
+    ChaosEngine,
+    perturbed_cluster,
+    raise_plan_to_nplusk,
+    sampled_failure_sets,
+)
+from open_simulator_tpu.scheduler.core import AppResource
+from open_simulator_tpu.utils.trace import GLOBAL
+
+
+def _node(name, cpu="4", mem="8Gi", labels=None):
+    node = {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}},
+    }
+    if labels:
+        node["metadata"]["labels"].update(labels)
+    return node
+
+
+def _deploy(name, replicas, cpu="1", mem="1Gi", node_selector=None):
+    spec = {
+        "containers": [
+            {
+                "name": "c",
+                "image": "i",
+                "resources": {"requests": {"cpu": cpu, "memory": mem}},
+            }
+        ]
+    }
+    if node_selector:
+        spec["nodeSelector"] = dict(node_selector)
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "rz", "labels": {"app": name}},
+        "spec": {"replicas": replicas, "template": {"spec": spec}},
+    }
+
+
+def _cluster(n_nodes, cpu="4"):
+    cluster = ResourceTypes()
+    cluster.nodes = [_node(f"base-{i}", cpu=cpu) for i in range(n_nodes)]
+    return cluster
+
+
+def _apps(replicas, cpu="1", node_selector=None):
+    resources = ResourceTypes()
+    resources.deployments = [
+        _deploy("web", replicas, cpu=cpu, node_selector=node_selector)
+    ]
+    return [AppResource("rz", resources)]
+
+
+# ---------------------------------------------------------------- chaos
+
+
+def test_single_node_outages_survivable():
+    """3x4cpu nodes, 6x1cpu pods: any single failure reschedules every
+    displaced pod onto the survivors."""
+    engine = ChaosEngine.from_cluster(_cluster(3), _apps(6))
+    report = engine.run(failures=1)
+    assert report.total == 3
+    assert report.all_survived
+    assert report.baseline_unscheduled == 0
+    for o in report.outcomes:
+        assert o.rescheduled == o.displaced
+        assert o.unschedulable == 0 and not o.reasons
+
+
+def test_single_node_outage_failures_carry_reasons():
+    """2x4cpu nodes, 6x1cpu pods: losing a node strands 2 pods, and the
+    report explains each through the oracle."""
+    engine = ChaosEngine.from_cluster(_cluster(2), _apps(6))
+    report = engine.run(failures=1)
+    assert report.total == 2
+    assert report.survived == 0
+    worst = report.worst()
+    assert worst.unschedulable >= 1
+    assert worst.reasons
+    assert "Insufficient cpu" in worst.reasons[0][1]
+    # failed pods are identified by index into the sweep's pod list
+    assert len(worst.unschedulable_pods) == worst.unschedulable
+
+
+def test_chaos_survivors_stay_put():
+    """Pods on surviving nodes must not move: the scenario placements
+    equal the baseline wherever the baseline node survived."""
+    engine = ChaosEngine.from_cluster(_cluster(3), _apps(6))
+    scens, _ = engine.build_scenarios(1)
+    for scen in scens:
+        valid, active, pinned, displaced = engine._masks(scen)
+        placements, unsched, _cpu, _mem = engine.scen.probe_scenarios(
+            valid[None], active[None], pinned[None]
+        )
+        row = placements[0]
+        keep = (engine.baseline >= 0) & ~displaced
+        assert (row[keep] == engine.baseline[keep]).all()
+
+
+def test_serial_scenario_matches_batched_scan():
+    """The serial oracle fallback is conformance-identical to the
+    batched masked scan on every outage scenario."""
+    engine = ChaosEngine.from_cluster(_cluster(3), _apps(7))
+    scens, _ = engine.build_scenarios(1)
+    for scen in scens:
+        valid, active, pinned, _ = engine._masks(scen)
+        batched, _, _, _ = engine.scen.probe_scenarios(
+            valid[None], active[None], pinned[None]
+        )
+        serial, reasons = engine.scen.serial_scenario(
+            valid, active, pinned, pins_first=True
+        )
+        assert (serial == batched[0]).all()
+        for p_i in np.flatnonzero(serial == -1):
+            assert int(p_i) in reasons
+
+
+def test_sampled_failure_sets_deterministic_and_exhaustive():
+    # small space: exhaustive enumeration regardless of seed
+    combos, mode = sampled_failure_sets(range(4), 2, trials=10, seed=1)
+    assert mode == "exhaustive" and len(combos) == 6
+    # large space: seeded sampling is reproducible and seed-sensitive
+    a1, mode1 = sampled_failure_sets(range(12), 3, trials=8, seed=7)
+    a2, _ = sampled_failure_sets(range(12), 3, trials=8, seed=7)
+    assert mode1 == "sampled" and a1 == a2 and 0 < len(a1) <= 8
+    assert all(len(set(c)) == 3 for c in a1)
+    b1, _ = sampled_failure_sets(range(12), 3, trials=8, seed=8)
+    assert a1 != b1  # ALFG streams for adjacent seeds diverge at once
+
+
+def test_k2_scenarios_include_singles_and_are_deterministic():
+    engine = ChaosEngine.from_cluster(_cluster(4), _apps(4))
+    r1 = engine.run(failures=2, seed=5, trials=4)
+    r2 = engine.run(failures=2, seed=5, trials=4)
+    kinds = [o.scenario.kind for o in r1.outcomes]
+    assert kinds.count("single") == 4
+    assert any(k in ("multi", "sampled") for k in kinds)
+    assert [o.scenario.failed for o in r1.outcomes] == [
+        o.scenario.failed for o in r2.outcomes
+    ]
+    assert [o.unschedulable for o in r1.outcomes] == [
+        o.unschedulable for o in r2.outcomes
+    ]
+
+
+def test_daemonset_pods_die_with_node_not_displaced():
+    cluster = _cluster(3)
+    cluster.daemon_sets = [
+        {
+            "kind": "DaemonSet",
+            "metadata": {"name": "agent", "namespace": "rz", "labels": {"app": "agent"}},
+            "spec": {
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "i",
+                                "resources": {"requests": {"cpu": "100m"}},
+                            }
+                        ]
+                    }
+                }
+            },
+        }
+    ]
+    engine = ChaosEngine.from_cluster(cluster, _apps(3))
+    report = engine.run(failures=1)
+    assert report.all_survived
+    for o in report.outcomes:
+        assert o.lost_daemonset == 1  # the failed node's agent pod
+
+
+def test_replacement_study_and_cordon_perturbation():
+    """--failures 0 answers "can the workload be re-placed at all" on
+    the perturbed cluster: cordoning one of three nodes leaves 8 cpu
+    for 6 pods (fits); cordoning two leaves 4 cpu (cannot)."""
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    cluster = _cluster(3)
+    apps = _apps(6)
+    reset_name_counter()
+    sweep = CapacitySweep(cluster, apps, None, 0)
+    reset_name_counter()
+    scen_sweep = CapacitySweep(
+        perturbed_cluster(cluster, cordon=["base-0"]), apps, None, 0
+    )
+    engine = ChaosEngine(
+        sweep, 0, sweep.probe(0).placements, scenario_sweep=scen_sweep
+    )
+    report = engine.run(failures=0)
+    assert report.total == 1 and report.all_survived
+
+    engine2 = ChaosEngine.from_cluster(
+        cluster, apps, cordon=["base-0", "base-1"]
+    )
+    report2 = engine2.run(failures=0)
+    assert not report2.all_survived
+    assert report2.outcomes[0].unschedulable >= 2
+
+
+def test_cordoned_node_keeps_pods_but_rejects_displaced():
+    """Cordon + outage: the cordoned node's own pods stay (it did not
+    fail), but displaced pods may not land there."""
+    cluster = _cluster(3)
+    apps = _apps(6)
+    engine = ChaosEngine.from_cluster(cluster, apps, cordon=["base-1"])
+    report = engine.run(failures=1)
+    by_name = {o.scenario.failed_names[0]: o for o in report.outcomes}
+    # base-1's own pods survive in the base-0/base-2 outage scenarios
+    # (they are pinned), but when base-0 fails its displaced pods have
+    # only base-2 to go to: 4cpu for ~4 pods, so some strand depending
+    # on the baseline split — assert the cordon shows up as failures
+    # that the un-perturbed cluster would not have
+    clean = ChaosEngine.from_cluster(cluster, apps).run(failures=1)
+    assert sum(o.unschedulable for o in report.outcomes) >= sum(
+        o.unschedulable for o in clean.outcomes
+    )
+    assert by_name["base-1"].displaced >= 0  # scenario set unchanged
+
+
+def test_degrade_perturbation_scales_allocatable():
+    cluster = _cluster(2, cpu="4")
+    out = perturbed_cluster(cluster, degrade=(50, ["base-0"]))
+    assert out.nodes[0]["status"]["allocatable"]["cpu"] == "2000m"
+    assert out.nodes[1]["status"]["allocatable"]["cpu"] == "4"
+    mem0 = int(out.nodes[0]["status"]["allocatable"]["memory"])
+    assert mem0 == 4 * 1024**3  # half of 8Gi
+    with pytest.raises(ValueError, match="unknown node"):
+        perturbed_cluster(cluster, cordon=["nope"])
+    with pytest.raises(ValueError, match="percent"):
+        perturbed_cluster(cluster, degrade=(150, None))
+
+
+def test_taint_perturbation_blocks_rescheduling():
+    """With every node tainted NoSchedule, survivors stay put (pins
+    bypass scheduling) but no displaced pod can reschedule anywhere —
+    each outage strands exactly its displaced pods."""
+    cluster = _cluster(3)
+    apps = _apps(6)
+    engine = ChaosEngine.from_cluster(
+        cluster,
+        apps,
+        taints=[(None, {"key": "chaos", "effect": "NoSchedule"})],
+    )
+    report = engine.run(failures=1)
+    assert not report.all_survived
+    for o in report.outcomes:
+        assert o.unschedulable == o.displaced > 0
+        assert o.rescheduled == 0
+    assert "taint" in report.worst().reasons[0][1]
+    # the same outages on the clean cluster all reschedule
+    clean = ChaosEngine.from_cluster(cluster, apps).run(failures=1)
+    assert clean.all_survived
+
+
+# ---------------------------------------------------------------- N+K
+
+
+def test_nplusk_raises_plan_until_survivable():
+    """2x4cpu base, 6x1cpu pods: feasible at +0, but N+1 needs one
+    4-cpu spare — raise_plan_to_nplusk escalates to count 1 and
+    serially confirms a sampled outage."""
+    cluster = _cluster(2)
+    apps = _apps(6)
+    sweep = CapacitySweep(cluster, apps, _node("template"), 6)
+    best = sweep.find_min_count(lambda r: r.unscheduled == 0, start=0)
+    assert best.count == 0
+    GLOBAL.reset()
+    probe, report = raise_plan_to_nplusk(
+        sweep, best, lambda r: r.unscheduled == 0, failures=1
+    )
+    assert probe is not None and probe.count == 1
+    assert report.all_survived
+    assert report.serial_confirmed  # the acceptance-criterion check
+    assert "chaos-serial-confirm" in GLOBAL.notes
+    assert "ok" in GLOBAL.notes["chaos-serial-confirm"]
+
+
+def test_nplusk_via_probe_plan_end_to_end():
+    from open_simulator_tpu.apply.applier import probe_plan
+
+    result = probe_plan(
+        _cluster(2), _apps(6), _node("template"), tolerate_failures=1
+    )
+    assert result.success
+    assert result.new_node_count == 1
+    # the serial re-simulation of a sampled outage scenario signed off
+    assert "chaos-serial-confirm" in GLOBAL.notes
+
+
+def test_nplusk_unreachable_bails_fast_with_reason():
+    """A pod only schedulable on one doomed node can never be rescued
+    by adding template nodes; the escalation proves it and stops
+    instead of walking to max_count."""
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        _node("special", labels={"disk": "ssd"}),
+        _node("plain"),
+    ]
+    apps = _apps(4, node_selector={"disk": "ssd"})
+    sweep = CapacitySweep(cluster, apps, _node("template"), 20)
+    best = sweep.find_min_count(lambda r: r.unscheduled == 0, start=0)
+    assert best is not None
+    GLOBAL.reset()
+    probe, report = raise_plan_to_nplusk(
+        sweep, best, lambda r: r.unscheduled == 0, failures=1
+    )
+    assert probe is None
+    assert "nplusk-unreachable" in GLOBAL.notes
+    assert "statically rejected" in GLOBAL.notes["nplusk-unreachable"]
+    # bailed on the first escalation, not after 20
+    assert GLOBAL.notes["nplusk-escalation"].count(";") == 0
+
+
+def test_nplusk_escalation_uses_indirect_relief():
+    """A pod the newNode spec statically rejects can still be rescued
+    by escalation when unconstrained pods migrate to the new nodes and
+    free a surviving node it IS allowed on — the unreachability proof
+    must not bail on such workloads."""
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        _node("base-0", labels={"disk": "ssd"}),
+        _node("base-1", labels={"disk": "ssd"}),
+    ]
+    resources = ResourceTypes()
+    resources.deployments = [
+        _deploy("pinnedish", 4, node_selector={"disk": "ssd"}),
+        _deploy("floaty", 4),
+    ]
+    apps = [AppResource("rz", resources)]
+    sweep = CapacitySweep(cluster, apps, _node("template"), 6)
+    best = sweep.find_min_count(lambda r: r.unscheduled == 0, start=0)
+    assert best.count == 0  # 8 pods fit 8 cpu exactly
+    GLOBAL.reset()
+    probe, report = raise_plan_to_nplusk(
+        sweep, best, lambda r: r.unscheduled == 0, failures=1
+    )
+    assert "nplusk-unreachable" not in GLOBAL.notes
+    assert probe is not None and report.all_survived
+    assert probe.count >= 2  # floaty pods off the base nodes + headroom
+
+
+# ------------------------------------------------- OOM-hardened sweep
+
+
+def _counting_injector(fail_above, log):
+    def inject(chunk_len):
+        log.append(chunk_len)
+        if chunk_len > fail_above:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: fake out of device memory (test)"
+            )
+
+    return inject
+
+
+def test_run_chunked_halves_on_oom_and_notes(monkeypatch):
+    calls = []
+
+    def evaluate(lo, hi):
+        return [i * 10 for i in range(lo, hi)]
+
+    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(2, calls))
+    GLOBAL.reset()
+    out = run_chunked(evaluate, 8, label="sweep")
+    assert out == [i * 10 for i in range(8)]
+    # 8 -> 4+4 -> 2x4 halvings -> chunks of 2 succeed
+    assert max(calls) == 8 and calls.count(2) == 4
+    assert "sweep-chunk-halving" in GLOBAL.notes
+    assert "RESOURCE_EXHAUSTED" in GLOBAL.notes["sweep-chunk-halving"]
+    assert GLOBAL.notes["sweep-degraded"] == "3 chunk-halving(s), 0 serial fallback(s)"
+
+
+def test_run_chunked_serial_floor_and_non_oom_propagates(monkeypatch):
+    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(0, []))
+    GLOBAL.reset()
+    out = run_chunked(
+        lambda lo, hi: list(range(lo, hi)),
+        3,
+        label="sweep",
+        serial_fallback=lambda i: -i,
+    )
+    assert out == [0, -1, -2]
+    assert "sweep-serial-fallback" in GLOBAL.notes
+    # without a serial floor the OOM propagates once chunks reach 1
+    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(0, []))
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        run_chunked(lambda lo, hi: list(range(lo, hi)), 2, label="sweep")
+    # a non-OOM error is never swallowed
+
+    def boom(chunk_len):
+        raise RuntimeError("shape mismatch (not memory)")
+
+    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", boom)
+    with pytest.raises(RuntimeError, match="shape mismatch"):
+        run_chunked(lambda lo, hi: [], 4, label="sweep", serial_fallback=id)
+
+
+def test_probe_many_oom_chunking_matches_clean_run(monkeypatch):
+    """Tier-1 acceptance: a fake RESOURCE_EXHAUSTED in the sweep
+    executor degrades to halved chunks (and the serial oracle at the
+    floor) with identical results and loud trace notes."""
+    cluster = _cluster(2)
+    apps = _apps(12)
+    new_node = _node("template")
+    counts = list(range(0, 6))
+
+    sweep_clean = CapacitySweep(cluster, apps, new_node, max(counts))
+    clean = sweep_clean.probe_many(counts)
+
+    sweep_oom = CapacitySweep(cluster, apps, new_node, max(counts))
+    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(2, []))
+    GLOBAL.reset()
+    chunked = sweep_oom.probe_many(counts)
+    assert "sweep-chunk-halving" in GLOBAL.notes
+    assert (chunked.unscheduled == clean.unscheduled).all()
+    assert (chunked.placements == clean.placements).all()
+    np.testing.assert_allclose(chunked.cpu_util, clean.cpu_util, atol=1e-6)
+
+    # chunking bottoms out: every scenario through the serial oracle,
+    # still bit-identical to the batched scan
+    sweep_serial = CapacitySweep(cluster, apps, new_node, max(counts))
+    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(0, []))
+    GLOBAL.reset()
+    serial = sweep_serial.probe_many(counts)
+    assert "sweep-serial-fallback" in GLOBAL.notes
+    assert "serial oracle" in GLOBAL.notes["sweep-serial-fallback"]
+    assert (serial.unscheduled == clean.unscheduled).all()
+    assert (serial.placements == clean.placements).all()
+    np.testing.assert_allclose(serial.cpu_util, clean.cpu_util, atol=1e-6)
+    np.testing.assert_allclose(serial.mem_util, clean.mem_util, atol=1e-6)
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def _write_cli_config(tmp_path, n_nodes=2, replicas=6, with_new_node=True):
+    tmp_path = tmp_path / f"c{n_nodes}-{replicas}-{int(with_new_node)}"
+    tmp_path.mkdir()
+    cluster_dir = tmp_path / "cluster"
+    cluster_dir.mkdir()
+    for i in range(n_nodes):
+        (cluster_dir / f"n{i}.yaml").write_text(
+            _yaml.safe_dump(_node(f"base-{i}"))
+        )
+    app_dir = tmp_path / "app"
+    app_dir.mkdir()
+    (app_dir / "deploy.yaml").write_text(_yaml.safe_dump(_deploy("web", replicas)))
+    spec = {
+        "cluster": {"customConfig": str(cluster_dir)},
+        "appList": [{"name": "web", "path": str(app_dir)}],
+    }
+    if with_new_node:
+        newnode_dir = tmp_path / "newnode"
+        newnode_dir.mkdir()
+        (newnode_dir / "node.yaml").write_text(_yaml.safe_dump(_node("template")))
+        spec["newNode"] = str(newnode_dir)
+    cfg = tmp_path / f"cfg-{n_nodes}-{replicas}-{with_new_node}.yaml"
+    cfg.write_text(
+        _yaml.safe_dump(
+            {
+                "apiVersion": "simon/v1alpha1",
+                "kind": "Config",
+                "metadata": {"name": "t"},
+                "spec": spec,
+            }
+        )
+    )
+    return str(cfg)
+
+
+def test_cli_chaos_json_deterministic(tmp_path, capsys):
+    import json
+
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path)
+    # the planner picks +0 (6 cpu fits 8); chaos over a fixed count
+    # shows single failures stranding pods -> exit 2
+    rc = main(["chaos", "-f", cfg, "--failures", "1", "--format", "json"])
+    out1 = capsys.readouterr().out
+    assert rc == 2
+    doc = json.loads(out1)
+    assert doc["failures"] == 1 and doc["total"] == doc["survived"] + 2
+    assert all(
+        s["displaced"] >= s["rescheduled"] for s in doc["scenarios"]
+    )
+    rc2 = main(["chaos", "-f", cfg, "--failures", "1", "--format", "json"])
+    assert json.loads(capsys.readouterr().out) == doc and rc2 == rc
+
+
+def test_cli_chaos_table_counts_and_exit_zero(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path, n_nodes=3, replicas=6)
+    rc = main(["chaos", "-f", cfg, "--failures", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SURVIVED 3/3" in out
+    assert "Failed Node(s)" in out
+
+
+def test_cli_apply_tolerate_node_failures(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path)
+    rc = main(["apply", "-f", cfg, "--tolerate-node-failures", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Simulation success!" in out
+    assert "new nodes added: 1" in out
+
+
+def test_cli_bad_input_errors_cleanly_not_tracebacks(tmp_path, capsys):
+    """User-input mistakes exit with `error: ...`, never a traceback:
+    oversized --failures, out-of-range --degrade, unknown perturbation
+    nodes, and --tolerate-node-failures under -i (which has no N+K
+    escalation to run)."""
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path)
+    cases = [
+        (["chaos", "-f", cfg, "--failures", "99"], "cannot fail 99"),
+        (["chaos", "-f", cfg, "--degrade", "150"], "degrade percent"),
+        (["chaos", "-f", cfg, "--cordon", "nope"], "unknown node"),
+        (
+            ["apply", "-f", cfg, "--tolerate-node-failures", "99"],
+            "cannot fail 99",
+        ),
+        (
+            ["apply", "-f", cfg, "-i", "--tolerate-node-failures", "1"],
+            "not available in interactive mode",
+        ),
+        (["chaos", "-f", cfg, "--new-node-count", "-1"], "must be >= 0"),
+        (
+            [
+                "chaos",
+                "-f",
+                _write_cli_config(tmp_path, with_new_node=False),
+                "--new-node-count",
+                "3",
+            ],
+            "needs a newNode spec",
+        ),
+    ]
+    for argv, expect in cases:
+        rc = main(argv)
+        captured = capsys.readouterr()
+        assert rc == 1, argv
+        assert expect in captured.err, (argv, captured.err)

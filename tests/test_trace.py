@@ -21,6 +21,22 @@ def test_phase_accumulates():
     json.loads(tr.as_json())
 
 
+def test_append_note_accumulates_and_caps_by_entry_count():
+    tr = Trace()
+    # values containing ';' must not eat into the 50-entry cap
+    for i in range(60):
+        tr.append_note("deg", f"event {i}: RESOURCE_EXHAUSTED; retrying")
+    note = tr.notes["deg"]
+    assert note.startswith("event 0:") and "event 49" in note
+    assert note.endswith("; ...") and "event 50" not in note
+    # note() overwrites and resets the accumulation
+    tr.note("deg", "fresh")
+    tr.append_note("deg", "after")
+    assert tr.notes["deg"] == "after"
+    tr.reset()
+    assert tr.appended == {} and tr.notes == {}
+
+
 def test_engine_records_phases():
     GLOBAL.reset()
     from open_simulator_tpu.models.decode import ResourceTypes

@@ -8,6 +8,13 @@ small pyflakes-class checker built on the stdlib `ast`:
 - F811 duplicate function/class definitions in one scope
 - B006 mutable default arguments (list/dict/set literals)
 - E722 bare `except:`
+- BLE001 broad `except Exception:` / `except BaseException:` in
+  first-party runtime code (open_simulator_tpu/; tests and tools are
+  exempt) — catch the specific expected errors so real bugs stay loud.
+  Audited survivors (logged + trace-noted, never silent) are
+  allowlisted by (file, enclosing function) in BROAD_EXCEPT_ALLOW
+- S110 silent `except ...: pass` handlers in the same scope — a
+  swallowed exception must at least record why (trace note / log)
 - E711 comparisons to None with ==/!=
 - F541 f-strings without any placeholder
 - B011/assert-tuple: `assert (x, y)` is always true
@@ -28,6 +35,48 @@ from pathlib import Path
 
 ROOTS = ["open_simulator_tpu", "tools", "tests", "bench.py", "__graft_entry__.py"]
 
+# Broad handlers audited as legitimate last-resort degradations: each
+# logs a warning and/or records a trace note, then falls back to a
+# correct (slower) path — never a silent swallow. Keyed by
+# (repo-relative path, enclosing function) so line drift cannot rot
+# the allowlist. Anything new must catch specific exception types or
+# earn an entry here with the same audit.
+BROAD_EXCEPT_ALLOW = {
+    ("open_simulator_tpu/apply/applier.py", "_plan_with_probes"),
+    ("open_simulator_tpu/apply/applier.py", "_sweep_min_count"),
+    ("open_simulator_tpu/apply/interactive.py", "_make_evaluator"),
+    # narrow-typed parse cascade (int -> float -> MISSING is the
+    # template grammar, not a swallowed error) and best-effort tempfile
+    # cleanup on close — audited silent-pass survivors
+    ("open_simulator_tpu/models/chart.py", "_eval_atom"),
+    ("open_simulator_tpu/models/kubeclient.py", "close"),
+}
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_EXEMPT_TOPDIRS = {"tests", "tools"}
+_EXEMPT_FILES = {"bench.py", "__graft_entry__.py"}
+
+
+def _relpath(path: Path) -> str:
+    try:
+        return str(path.resolve().relative_to(_REPO_ROOT))
+    except ValueError:
+        return path.name
+
+
+def _broad_except_applies(path: Path) -> bool:
+    """The BLE001/S110 rules police first-party runtime code: inside
+    the repo that means open_simulator_tpu/ (tests/tools/bench are
+    exempt); outside the repo (the lint test suite's tmp files) the
+    rules are live so they can be exercised directly."""
+    rel = _relpath(path)
+    parts = Path(rel).parts
+    if parts and parts[0] in _EXEMPT_TOPDIRS:
+        return False
+    if rel in _EXEMPT_FILES:
+        return False
+    return True
+
 
 def _is_noqa(source_lines, lineno: int) -> bool:
     if 1 <= lineno <= len(source_lines):
@@ -42,6 +91,9 @@ class _Checker(ast.NodeVisitor):
         self.lines = source.splitlines()
         self.findings: list = []
         self.is_init = path.name == "__init__.py"
+        self.police_broad_except = _broad_except_applies(path)
+        self.rel = _relpath(path)
+        self._func_stack: list = []
 
     def report(self, lineno: int, code: str, msg: str):
         if not _is_noqa(self.lines, lineno):
@@ -119,7 +171,9 @@ class _Checker(ast.NodeVisitor):
     def visit_FunctionDef(self, node):
         self._check_defaults(node)
         self.visit_scope_body(node.body, node.name)
+        self._func_stack.append(node.name)
         self.generic_visit(node)
+        self._func_stack.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -134,9 +188,46 @@ class _Checker(ast.NodeVisitor):
                     f"mutable default argument in '{node.name}'",
                 )
 
+    @staticmethod
+    def _handler_type_names(node) -> list:
+        types = []
+        if isinstance(node.type, ast.Tuple):
+            types = list(node.type.elts)
+        elif node.type is not None:
+            types = [node.type]
+        return [t.id for t in types if isinstance(t, ast.Name)]
+
     def visit_ExceptHandler(self, node):
         if node.type is None:
             self.report(node.lineno, "E722", "bare 'except:'")
+        if self.police_broad_except:
+            ctx = self._func_stack[-1] if self._func_stack else "<module>"
+            allowed = (self.rel, ctx) in BROAD_EXCEPT_ALLOW
+            broad = [
+                n
+                for n in self._handler_type_names(node)
+                if n in ("Exception", "BaseException")
+            ]
+            if broad and not allowed:
+                self.report(
+                    node.lineno,
+                    "BLE001",
+                    f"broad 'except {broad[0]}:' in '{ctx}' — catch the "
+                    "specific expected errors (audited degradation paths "
+                    "go in tools/lint.py BROAD_EXCEPT_ALLOW)",
+                )
+            if (
+                not allowed
+                and len(node.body) == 1
+                and isinstance(node.body[0], ast.Pass)
+            ):
+                self.report(
+                    node.lineno,
+                    "S110",
+                    f"silent 'except: pass' in '{ctx}' — record why the "
+                    "exception is safe to swallow (trace note / log) or "
+                    "narrow it away",
+                )
         self.generic_visit(node)
 
     def visit_Compare(self, node):

@@ -5,7 +5,8 @@
 #   make test      run the full suite (the end-of-round gate)
 #   make lint      syntax-compile every source file, then the
 #                  first-party AST linter (tools/lint.py: unused
-#                  imports, mutable defaults, bare except, ...)
+#                  imports, mutable defaults, bare except, broad/silent
+#                  except, I/O calls without an explicit timeout, ...)
 #   make check     lint + test
 #   make examples  run both quickstart configs end to end
 #   make bench     one bench line (SIMON_BENCH selects the scenario)

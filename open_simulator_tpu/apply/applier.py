@@ -252,6 +252,27 @@ def replay_masked(sweep, valid, placements):
     return SimulateResult(unscheduled_pods=failed, node_status=status), oracle
 
 
+def plan_fingerprint(cluster, apps, new_node, **flags) -> str:
+    """Journal fingerprint of one planning problem: the LOADED inputs
+    (cluster objects, expanded app resources, newnode spec) plus every
+    flag that shapes the work. A resumed journal must describe exactly
+    this problem (runtime/journal.py)."""
+    from ..runtime.journal import config_fingerprint
+
+    return config_fingerprint(
+        {k: getattr(cluster, k) for k in sorted(vars(cluster))},
+        [
+            (
+                a.name,
+                {k: getattr(a.resource, k) for k in sorted(vars(a.resource))},
+            )
+            for a in apps
+        ],
+        new_node,
+        flags,
+    )
+
+
 def probe_plan(
     cluster,
     apps,
@@ -263,6 +284,8 @@ def probe_plan(
     tolerate_failures: int = 0,
     chaos_seed: int = 1,
     chaos_trials: int = 32,
+    budget=None,
+    journal=None,
 ) -> ApplyResult:
     """Fast capacity plan: encode the padded cluster once, start at the
     aggregate-resource lower bound, bisect over candidate counts (each
@@ -271,7 +294,9 @@ def probe_plan(
     (replaces the reference's per-guess re-simulation loop,
     pkg/apply/apply.go:186-239). With `tolerate_failures` > 0 the plan
     additionally escalates until it is N+K survivable
-    (resilience/chaos.py raise_plan_to_nplusk)."""
+    (resilience/chaos.py raise_plan_to_nplusk). `budget` halts the
+    search at safe boundaries with a partial payload (runtime/budget);
+    `journal` makes probes and scenario verdicts resumable."""
     import gc
 
     # the plan allocates millions of short-lived dicts (pod expansion,
@@ -285,7 +310,7 @@ def probe_plan(
         return _probe_plan_inner(
             cluster, apps, new_node, use_greed, extended_resources,
             max_count, score_weights, tolerate_failures, chaos_seed,
-            chaos_trials,
+            chaos_trials, budget, journal,
         )
     finally:
         clear_all_memos()
@@ -353,7 +378,7 @@ def _finish_plan(
 def _probe_plan_inner(
     cluster, apps, new_node, use_greed, extended_resources,
     max_count, score_weights, tolerate_failures=0, chaos_seed=1,
-    chaos_trials=32,
+    chaos_trials=32, budget=None, journal=None,
 ):
     from ..parallel.sweep import CapacitySweep
     from ..utils.trace import phase
@@ -366,11 +391,13 @@ def _probe_plan_inner(
         use_greed=use_greed,
         score_weights=score_weights,
     )
+    if journal is not None:
+        sweep.attach_journal(journal)
     feasible, (max_cpu, max_mem, max_vg) = _capacity_feasible()
     with phase("apply/lower-bound"):
         start = sweep.lower_bound(max_cpu, max_mem, max_vg)
     with phase("apply/probe-search"):
-        best = sweep.find_min_count(feasible, start=start)
+        best = sweep.find_min_count(feasible, start=start, budget=budget)
     fail_message = ""
     if best is not None and tolerate_failures > 0:
         from ..resilience.chaos import raise_plan_to_nplusk
@@ -383,6 +410,8 @@ def _probe_plan_inner(
                 tolerate_failures,
                 seed=chaos_seed,
                 trials=chaos_trials,
+                budget=budget,
+                journal=journal,
             )
         if best is None:
             fail_message = (
@@ -402,6 +431,7 @@ def probe_plan_multi(
     extended_resources: Optional[List[str]] = None,
     max_count: int = MAX_NUM_NEW_NODE,
     score_weights=None,
+    budget=None,
 ) -> List[ApplyResult]:
     """What-if capacity plan over MANY candidate newnode specs: every
     spec's min-count search runs in lockstep and each round's probes
@@ -437,7 +467,7 @@ def probe_plan_multi(
                 start = sweep.lower_bound(max_cpu, max_mem, max_vg)
             jobs.append((sweep, feasible, start))
         with phase("apply/probe-search"):
-            bests = find_min_count_multi(jobs)
+            bests = find_min_count_multi(jobs, budget=budget)
         # replay mutates pod dicts (bind writes nodeName/phase and may
         # touch annotations): sweeps that shared the first sweep's
         # expanded pods get their OWN shallow copies from the still-
@@ -481,6 +511,8 @@ class Applier:
         tolerate_node_failures: int = 0,
         chaos_seed: int = 1,
         chaos_trials: int = 32,
+        journal_path: str = "",
+        resume_path: str = "",
     ):
         config.validate()
         self.config = config
@@ -492,6 +524,11 @@ class Applier:
         self.tolerate_node_failures = tolerate_node_failures
         self.chaos_seed = chaos_seed
         self.chaos_trials = chaos_trials
+        # resumable planning journal (runtime/journal.py): --journal
+        # appends (creating or continuing), --resume requires the file
+        # and refuses a fingerprint mismatch; resume wins when both set
+        self.journal_path = journal_path
+        self.resume_path = resume_path
         self.extenders = []
         self.score_weights = None  # None = default profile weights
         self.enable_preemption = True
@@ -539,7 +576,9 @@ class Applier:
 
     # -- planning -----------------------------------------------------------
 
-    def _simulate_with_count(self, cluster, apps, new_node, count) -> SimulateResult:
+    def _simulate_with_count(
+        self, cluster, apps, new_node, count, budget=None
+    ) -> SimulateResult:
         padded = cluster.copy()
         if new_node is not None and count > 0:
             from ..parallel.sweep import _new_nodes
@@ -553,17 +592,39 @@ class Applier:
             extenders=self.extenders,
             score_weights=self.score_weights,
             enable_preemption=self.enable_preemption,
+            budget=budget,
         )
 
-    def run(self, select_apps=None) -> ApplyResult:
+    def open_journal(self, cluster, apps, new_node):
+        """Open the planning journal when configured (None otherwise),
+        keyed by the fingerprint of the loaded inputs + flags."""
+        if not (self.journal_path or self.resume_path):
+            return None
+        from ..runtime.journal import Journal
+
+        fp = plan_fingerprint(
+            cluster,
+            apps,
+            new_node,
+            engine=self.engine,
+            use_greed=self.use_greed,
+            tolerate_node_failures=self.tolerate_node_failures,
+            chaos_seed=self.chaos_seed,
+            chaos_trials=self.chaos_trials,
+        )
+        if self.resume_path:
+            return Journal.resume(self.resume_path, fp)
+        return Journal.open(self.journal_path, fp)
+
+    def run(self, select_apps=None, budget=None) -> ApplyResult:
         # release the identity memos' strong refs to this run's object
         # graph at exit (the serial guesses inside rely on them warm)
         try:
-            return self._run_inner(select_apps)
+            return self._run_inner(select_apps, budget=budget)
         finally:
             clear_all_memos()
 
-    def _run_inner(self, select_apps=None) -> ApplyResult:
+    def _run_inner(self, select_apps=None, budget=None) -> ApplyResult:
         from ..utils.trace import GLOBAL, phase
 
         # per-run phase times, not cumulative across runs in one process
@@ -577,6 +638,21 @@ class Applier:
         # kept for callers that snapshot the result (cli.py: PDBs and
         # PriorityClasses ride along so a resume behaves identically)
         self.last_cluster = cluster
+        journal = self.open_journal(cluster, apps, new_node)
+        if journal is not None and journal.replayed:
+            GLOBAL.note(
+                "journal-resume",
+                f"{journal.replayed} record(s) replayed"
+                + (f", {journal.dropped} torn record dropped" if journal.dropped else ""),
+            )
+        try:
+            return self._plan(cluster, apps, new_node, budget, journal)
+        finally:
+            if journal is not None:
+                journal.close()
+
+    def _plan(self, cluster, apps, new_node, budget, journal) -> ApplyResult:
+        from ..utils.trace import phase
 
         # N+K needs the batched plan path: the committed placement, the
         # outage sweep, and the escalation all live on the encoded
@@ -593,7 +669,9 @@ class Applier:
                 "spec to escalate with"
             )
         if batched_path:
-            fast = self._plan_with_probes(cluster, apps, new_node)
+            fast = self._plan_with_probes(
+                cluster, apps, new_node, budget=budget, journal=journal
+            )
             if fast is not None:
                 return fast
             if self.tolerate_node_failures > 0:
@@ -620,8 +698,12 @@ class Applier:
         max_count = 0 if new_node is None else MAX_NUM_NEW_NODE
         result = None
         for count in range(start_count, max_count + 1):
+            if budget is not None:
+                budget.check(f"serial escalation (count {count})")
             with phase("apply/simulate"):
-                result = self._simulate_with_count(cluster, apps, new_node, count)
+                result = self._simulate_with_count(
+                    cluster, apps, new_node, count, budget=budget
+                )
             if result.unscheduled_pods:
                 continue
             ok, reason = satisfy_resource_setting(result.node_status)
@@ -648,13 +730,16 @@ class Applier:
             success=False, new_node_count=max_count, result=result, message=message
         )
 
-    def _plan_with_probes(self, cluster, apps, new_node) -> Optional[ApplyResult]:
+    def _plan_with_probes(
+        self, cluster, apps, new_node, budget=None, journal=None
+    ) -> Optional[ApplyResult]:
         """Returns None to fall back to the serial loop (e.g. when the
         batched path cannot encode the input)."""
         import logging
 
         from ..models.validation import InputError
         from ..parallel.sweep import PrioritySignalError
+        from ..runtime.errors import ExecutionHalted
 
         try:
             return probe_plan(
@@ -667,12 +752,18 @@ class Applier:
                 tolerate_failures=self.tolerate_node_failures,
                 chaos_seed=self.chaos_seed,
                 chaos_trials=self.chaos_trials,
+                budget=budget,
+                journal=journal,
             )
         except PrioritySignalError as e:
             logging.getLogger(__name__).info(
                 "priority workload: planning with the serial engine (%s)", e
             )
             return None
+        except ExecutionHalted:
+            # the deadline/SIGINT halt carries the partial report up to
+            # the CLI — NEVER a silent serial fallback
+            raise
         except InputError:
             # malformed user input (e.g. --tolerate-node-failures larger
             # than the node pool): a clean CLI error, not a silent

@@ -79,12 +79,53 @@ def _force_platform():
         os.environ["JAX_PLATFORMS"] = "cpu"
 
 
+def _emit_partial(e, args, journal_path: str) -> int:
+    """Render an ExecutionHalted (deadline / SIGINT at a safe boundary)
+    as a well-formed machine-readable partial report, never a
+    traceback, and return its distinct exit code (runtime/errors.py:
+    3 deadline, 4 interrupt; docs/ROBUSTNESS.md)."""
+    import json
+
+    payload = {
+        "partial": True,
+        "reason": e.reason,
+        "message": str(e),
+        "exitCode": e.exit_code,
+        "journal": journal_path or None,
+        "detail": e.partial,
+    }
+    if getattr(args, "format", "table") == "json":
+        print(json.dumps(payload))
+    else:
+        print(f"PARTIAL RESULT ({e.reason}): {e}")
+        if journal_path:
+            print(
+                f"completed work journaled in {journal_path}; rerun with "
+                f"--resume {journal_path} to continue"
+            )
+        if e.partial is not None:
+            print(json.dumps(e.partial, indent=2))
+    return e.exit_code
+
+
 def cmd_apply(args) -> int:
     from .apply.applier import Applier, SimonConfig
     from .models.validation import InputError
+    from .runtime import (
+        Budget,
+        ExecutionHalted,
+        ExternalIOError,
+        Interrupted,
+        sigint_to_budget,
+    )
 
     _force_platform()
     try:
+        if args.interactive and args.deadline is not None:
+            raise InputError(
+                "--deadline is not available in interactive mode (the "
+                "shell blocks on user input; press ^C to leave it)"
+            )
         config = SimonConfig.from_file(args.simon_config)
         applier = Applier(
             config,
@@ -97,28 +138,51 @@ def cmd_apply(args) -> int:
             tolerate_node_failures=args.tolerate_node_failures,
             chaos_seed=args.chaos_seed,
             chaos_trials=args.chaos_trials,
+            journal_path=args.journal,
+            resume_path=args.resume,
         )
+        budget = Budget(args.deadline)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return 2
 
+    journal_path = args.resume or args.journal
     try:
         if args.interactive:
             # the reference's survey shell: app multi-select, then a
-            # per-iteration {show reasons | add node(s) | exit} loop, then
-            # node multi-select before the report (apply.go:157-239, 510-530)
+            # per-iteration {show reasons | add node(s) | exit} loop,
+            # then node multi-select before the report
+            # (apply.go:157-239, 510-530). NOT budget-guarded: the
+            # shell blocks on stdin, so ^C must interrupt immediately
+            # (KeyboardInterrupt below), not wait for a safe boundary
             from .apply.interactive import run_interactive
 
             result = run_interactive(applier)
         else:
-            result = applier.run()
+            with sigint_to_budget(budget):
+                result = applier.run(budget=budget)
+    except ExecutionHalted as e:
+        return _emit_partial(e, args, journal_path)
+    except KeyboardInterrupt:
+        # SIGINT outside a guarded boundary (interactive shell, or
+        # during load): still a clean partial exit, nothing to report
+        return _emit_partial(
+            Interrupted("interrupted before any safe boundary"),
+            args,
+            journal_path,
+        )
+    except ExternalIOError as e:
+        # an external dependency (apiserver, credential plugin,
+        # extender) failed after retries: clean typed error, exit 2
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except (OSError, InputError) as e:
         # malformed input discovered while loading/expanding (e.g. a
         # pod failing k8s validation) exits cleanly like the
         # reference's log.Fatalf path; internal errors (e.g. a JAX
         # shape bug, which also raises ValueError) stay loud
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return 2
     if args.trace:
         from .utils.trace import GLOBAL
 
@@ -131,14 +195,14 @@ def cmd_apply(args) -> int:
         )
     if args.format == "json":
         print(_result_json(result))
-        return 0 if result.success else 2
+        return 0 if result.success else 1
     if not result.success:
         print(result.message)
         if result.result is not None:
             for i, up in enumerate(result.result.unscheduled_pods):
                 meta = up.pod.get("metadata") or {}
                 print(f"{i:4d} {meta.get('namespace')}/{meta.get('name')}: {up.reason}")
-        return 2
+        return 1
     print("Simulation success!")
     if result.new_node_count:
         print(f"new nodes added: {result.new_node_count}")
@@ -178,10 +242,19 @@ def cmd_chaos(args) -> int:
         Applier,
         SimonConfig,
         _capacity_feasible,
+        plan_fingerprint,
     )
     from .models.validation import InputError
     from .parallel.sweep import CapacitySweep, PrioritySignalError
     from .resilience.chaos import ChaosEngine, perturbed_scenario_sweep
+    from .runtime import (
+        Budget,
+        ExecutionHalted,
+        ExternalIOError,
+        Interrupted,
+        Journal,
+        sigint_to_budget,
+    )
     from .utils.trace import GLOBAL
 
     _force_platform()
@@ -194,83 +267,131 @@ def cmd_chaos(args) -> int:
         taints = [_parse_taint(t) for t in args.taint or []]
         degrade = _parse_degrade(args.degrade) if args.degrade else None
         cordon = [n for n in (args.cordon or "").split(",") if n]
-    except (OSError, ValueError) as e:
+        budget = Budget(args.deadline)
+    except (OSError, ValueError, ExternalIOError) as e:
+        # ExternalIOError: a live-cluster import (kubeConfig) whose
+        # apiserver/credential plugin failed after retries — typed,
+        # clean, exit 2
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return 2
 
+    journal = None
+    journal_path = args.resume or args.journal
     GLOBAL.reset()
     try:
+        if journal_path:
+            fp = plan_fingerprint(
+                cluster,
+                apps,
+                new_node,
+                command="chaos",
+                use_greed=args.use_greed,
+                failures=args.failures,
+                seed=args.seed,
+                trials=args.trials,
+                new_node_count=args.new_node_count,
+                cordon=cordon,
+                taints=taints,
+                degrade=degrade,
+            )
+            journal = (
+                Journal.resume(args.resume, fp)
+                if args.resume
+                else Journal.open(args.journal, fp)
+            )
         # expansion names pods from a process-global counter; reset so
         # repeated in-process runs (and the perturbed re-encoding
         # below) expand the identical pod sequence
         from .models.workloads import reset_name_counter
 
         reset_name_counter()
-        if args.new_node_count is not None:
-            count = args.new_node_count
-            if count < 0:
-                raise InputError("--new-node-count must be >= 0")
-            if count > 0 and new_node is None:
-                # CapacitySweep would silently clamp to 0 and the
-                # report would describe capacity that was never there
-                raise InputError(
-                    f"--new-node-count {count} needs a newNode spec in "
-                    "the config, which has none"
+        with sigint_to_budget(budget):
+            if args.new_node_count is not None:
+                count = args.new_node_count
+                if count < 0:
+                    raise InputError("--new-node-count must be >= 0")
+                if count > 0 and new_node is None:
+                    # CapacitySweep would silently clamp to 0 and the
+                    # report would describe capacity that was never there
+                    raise InputError(
+                        f"--new-node-count {count} needs a newNode spec in "
+                        "the config, which has none"
+                    )
+                sweep = CapacitySweep(
+                    cluster, apps, new_node, count, use_greed=args.use_greed
                 )
-            sweep = CapacitySweep(
-                cluster, apps, new_node, count, use_greed=args.use_greed
-            )
-            baseline = sweep.probe(count).placements
-        else:
-            # plan first: the chaos sweep evaluates the committed plan
-            max_count = 0 if new_node is None else MAX_NUM_NEW_NODE
-            sweep = CapacitySweep(
-                cluster, apps, new_node, max_count, use_greed=args.use_greed
-            )
-            feasible, (mc, mm, mv) = _capacity_feasible()
-            best = sweep.find_min_count(
-                feasible, start=sweep.lower_bound(mc, mm, mv)
-            )
-            if best is None:
-                print(
-                    "error: no feasible plan to inject faults into "
-                    f"(infeasible even with {max_count} new node(s)); "
-                    "pass --new-node-count to analyze an infeasible "
-                    "placement anyway",
-                    file=sys.stderr,
+                if journal is not None:
+                    sweep.attach_journal(journal)
+                baseline = sweep.probe(count).placements
+            else:
+                # plan first: the chaos sweep evaluates the committed plan
+                max_count = 0 if new_node is None else MAX_NUM_NEW_NODE
+                sweep = CapacitySweep(
+                    cluster, apps, new_node, max_count, use_greed=args.use_greed
                 )
-                return 1
-            count, baseline = best.count, best.placements
-        scen_sweep = perturbed_scenario_sweep(
-            cluster,
-            apps,
-            new_node,
-            sweep.max_count,
-            cordon=cordon,
-            taints=taints,
-            degrade=degrade,
-            use_greed=args.use_greed,
-        )
-        engine = ChaosEngine(sweep, count, baseline, scenario_sweep=scen_sweep)
-        report = engine.run(
-            failures=args.failures, seed=args.seed, trials=args.trials
+                if journal is not None:
+                    sweep.attach_journal(journal)
+                feasible, (mc, mm, mv) = _capacity_feasible()
+                best = sweep.find_min_count(
+                    feasible, start=sweep.lower_bound(mc, mm, mv), budget=budget
+                )
+                if best is None:
+                    print(
+                        "error: no feasible plan to inject faults into "
+                        f"(infeasible even with {max_count} new node(s)); "
+                        "pass --new-node-count to analyze an infeasible "
+                        "placement anyway",
+                        file=sys.stderr,
+                    )
+                    return 1
+                count, baseline = best.count, best.placements
+            scen_sweep = perturbed_scenario_sweep(
+                cluster,
+                apps,
+                new_node,
+                sweep.max_count,
+                cordon=cordon,
+                taints=taints,
+                degrade=degrade,
+                use_greed=args.use_greed,
+            )
+            engine = ChaosEngine(
+                sweep, count, baseline, scenario_sweep=scen_sweep
+            )
+            report = engine.run(
+                failures=args.failures,
+                seed=args.seed,
+                trials=args.trials,
+                budget=budget,
+                journal=journal,
+            )
+    except ExecutionHalted as e:
+        return _emit_partial(e, args, journal_path)
+    except KeyboardInterrupt:
+        return _emit_partial(
+            Interrupted("interrupted before any safe boundary"),
+            args,
+            journal_path,
         )
     except PrioritySignalError as e:
         print(
             f"error: chaos analysis needs the batched scan path: {e}",
             file=sys.stderr,
         )
-        return 1
-    except (OSError, InputError) as e:
+        return 2
+    except (OSError, InputError, ExternalIOError) as e:
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return 2
+    finally:
+        if journal is not None:
+            journal.close()
     if args.trace:
         print(GLOBAL.as_json(), file=sys.stderr)
     if args.format == "json":
         print(json.dumps(report.as_dict()))
     else:
         print(report.render_text())
-    return 0 if report.all_survived else 2
+    return 0 if report.all_survived else 1
 
 
 def cmd_defrag(args) -> int:
@@ -284,7 +405,7 @@ def cmd_defrag(args) -> int:
         snapshot = load_snapshot(args.snapshot)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return 2
 
     protect = None
     if args.keep_new_nodes:
@@ -458,6 +579,36 @@ def cmd_gen_doc(args) -> int:
     return 0
 
 
+def _add_guard_flags(p: argparse.ArgumentParser):
+    """Execution-guard flags shared by the long-running commands
+    (docs/ROBUSTNESS.md): wall-clock budget + resumable journal."""
+    p.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="wall-clock budget: on expiry (or SIGINT) the run stops at "
+        "the next safe boundary and emits a machine-readable PARTIAL "
+        "report (exit 3 deadline / 4 interrupt) instead of a traceback",
+    )
+    p.add_argument(
+        "--journal",
+        default="",
+        metavar="PATH",
+        help="append completed probe results and scenario verdicts to "
+        "this crash-safe JSONL journal (created when missing, continued "
+        "when it matches this run's config fingerprint)",
+    )
+    p.add_argument(
+        "--resume",
+        default="",
+        metavar="PATH",
+        help="resume from a journal written by --journal: validates the "
+        "config fingerprint (mismatch refuses loudly), replays complete "
+        "records, re-executes zero journaled work, and keeps appending",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="simon", description="TPU-native cluster simulator")
     sub = parser.add_subparsers(dest="command")
@@ -508,6 +659,7 @@ def build_parser() -> argparse.ArgumentParser:
         default=32,
         help="sampled K-failure scenarios per escalation (K >= 2)",
     )
+    _add_guard_flags(p_apply)
     p_apply.add_argument(
         "--format", choices=["table", "json"], default="table", help="result output format"
     )
@@ -599,6 +751,7 @@ def build_parser() -> argparse.ArgumentParser:
         "on the named nodes (default all)",
     )
     p_chaos.add_argument("--use-greed", action="store_true", help=argparse.SUPPRESS)
+    _add_guard_flags(p_chaos)
     p_chaos.add_argument(
         "--format", choices=["table", "json"], default="table", help="result output format"
     )

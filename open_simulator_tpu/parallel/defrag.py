@@ -223,21 +223,31 @@ def plan_defrag(
         else f"xla-scan ({pallas_scan.fallback_reason()})",
     )
     if plan is not None:
-        # one sync for every depth's scan (run_scan_pallas_batch)
-        decoded = pallas_scan.run_scan_pallas_batch(
-            plan,
-            batch.class_of_pod,
-            [(pod_active[s_i], node_valid[s_i], pinned[s_i]) for s_i in range(sc)],
-        )
-        unsched = np.zeros(sc, dtype=np.int64)
-        place_by_depth = {}
-        for s_i, (placements, _final) in enumerate(decoded):
-            place_by_depth[s_i] = placements
-            unsched[s_i] = int((placements == -1).sum())
-        return _pick_depth(
-            snapshot, ranked, ranked_names, depths, unsched, entries,
-            place_by_depth.get,
-        )
+        try:
+            # one sync for every depth's scan (run_scan_pallas_batch)
+            decoded = pallas_scan.run_scan_pallas_batch(
+                plan,
+                batch.class_of_pod,
+                [(pod_active[s_i], node_valid[s_i], pinned[s_i]) for s_i in range(sc)],
+            )
+            unsched = np.zeros(sc, dtype=np.int64)
+            place_by_depth = {}
+            for s_i, (placements, _final) in enumerate(decoded):
+                place_by_depth[s_i] = placements
+                unsched[s_i] = int((placements == -1).sum())
+            return _pick_depth(
+                snapshot, ranked, ranked_names, depths, unsched, entries,
+                place_by_depth.get,
+            )
+        except (RuntimeError, MemoryError, OSError) as e:
+            # unified ladder (runtime/guard.py): a classified device
+            # fault downgrades to the XLA scan path below; anything
+            # else stays loud
+            from ..runtime.guard import try_downgrade
+
+            if not try_downgrade(e, label="defrag", frm="pallas", to="xla-scan"):
+                raise
+            plan = None
 
     def one_scenario(pin, valid, active):
         placements, _final = scan_ops.run_scan_masked(
@@ -271,7 +281,20 @@ def plan_defrag(
         )
         unsched = np.asarray(unsched)[:sc]
     else:
-        unsched = np.asarray(jax.jit(sweep_fn)(pin_j, valid_j, active_j))
+        # OOM-halving chunked executor (runtime/guard.py): a depth
+        # batch that exhausts device memory splits and retries instead
+        # of killing the defrag plan
+        from ..runtime.guard import run_chunked
+
+        def evaluate(lo, hi):
+            out = jax.jit(sweep_fn)(
+                pin_j[lo:hi], valid_j[lo:hi], active_j[lo:hi]
+            )
+            return [int(x) for x in np.asarray(out)]
+
+        unsched = np.asarray(
+            run_chunked(evaluate, sc, label="defrag"), dtype=np.int64
+        )
 
     def placements_for(depth):
         placements, _ = scan_ops.run_scan_masked(

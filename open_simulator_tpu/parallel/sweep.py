@@ -35,6 +35,8 @@ from ..models.decode import ResourceTypes
 from ..scheduler.core import AppResource, _sort_app_pods
 from ..scheduler.oracle import Oracle
 
+from ..runtime.guard import run_chunked, run_laddered
+
 # pod not present in this scenario. Duplicates the ops/scan.py and
 # ops/pallas_scan.py sentinel because importing either here would pull
 # jax in at module-import time (cli._force_platform must run first);
@@ -50,91 +52,10 @@ class PrioritySignalError(ValueError):
     the serial escalation loop, whose simulate() handles priority."""
 
 
-# test hook: callable(chunk_len) invoked before each device chunk is
-# evaluated; tests make it raise a fake RESOURCE_EXHAUSTED to exercise
-# the halving-retry / serial-fallback paths without a real OOM
-_OOM_INJECT = None
-
-
-def _is_resource_exhausted(e: BaseException) -> bool:
-    """Device-memory exhaustion, as XLA reports it (XlaRuntimeError is
-    a RuntimeError whose message carries the RESOURCE_EXHAUSTED status
-    code; some backends phrase it as an allocation failure)."""
-    if isinstance(e, MemoryError):
-        return True
-    msg = str(e)
-    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
-
-
-def run_chunked(evaluate, n_items: int, *, label: str, serial_fallback=None,
-                trace=None):
-    """Evaluate scenarios [0, n_items) in device batches with bounded
-    halving-retry on device OOM (the batched sweep's hardening: a
-    10k-scenario vmap that exhausts device memory used to kill the
-    whole plan).
-
-    `evaluate(lo, hi)` runs one contiguous chunk on the device and
-    returns a list of per-item results; on RESOURCE_EXHAUSTED the chunk
-    is split in half and each half retried, bottoming out at single-
-    item chunks; a single item that still OOMs goes through
-    `serial_fallback(i)` (the deterministic host-oracle path). Every
-    degradation is trace-noted with its reason and logged — mirroring
-    the fallback_reason() discipline of ops/pallas_scan.py, no silent
-    paths. Exceptions that are not memory exhaustion propagate."""
-    import logging
-
-    from ..utils.trace import GLOBAL
-
-    tr = trace or GLOBAL
-    log = logging.getLogger(__name__)
-    out = [None] * n_items
-    pending = [(0, n_items)] if n_items else []
-    halvings = serial = 0
-    while pending:
-        lo, hi = pending.pop()
-        try:
-            if _OOM_INJECT is not None:
-                _OOM_INJECT(hi - lo)
-            results = evaluate(lo, hi)
-        except (RuntimeError, MemoryError) as e:
-            if not _is_resource_exhausted(e):
-                raise
-            reason = str(e).split("\n", 1)[0][:120]
-            if hi - lo == 1:
-                if serial_fallback is None:
-                    raise
-                serial += 1
-                tr.append_note(
-                    f"{label}-serial-fallback",
-                    f"scenario {lo} via serial oracle after {reason}",
-                )
-                log.warning(
-                    "%s: scenario %d exhausted device memory even alone; "
-                    "falling back to the serial oracle (%s)", label, lo, reason
-                )
-                out[lo] = serial_fallback(lo)
-                continue
-            mid = (lo + hi) // 2
-            halvings += 1
-            tr.append_note(
-                f"{label}-chunk-halving",
-                f"[{lo},{hi}) -> [{lo},{mid})+[{mid},{hi}) after {reason}",
-            )
-            log.warning(
-                "%s: chunk [%d,%d) exhausted device memory; retrying as "
-                "two halves (%s)", label, lo, hi, reason
-            )
-            # LIFO: push the upper half first so the lower half runs next
-            pending.append((mid, hi))
-            pending.append((lo, mid))
-            continue
-        out[lo:hi] = results
-    if halvings or serial:
-        tr.note(
-            f"{label}-degraded",
-            f"{halvings} chunk-halving(s), {serial} serial fallback(s)",
-        )
-    return out
+# The PR-1 sweep-local OOM machinery (_is_oom / halving-retry /
+# serial-fallback executor and its _OOM_INJECT test hook) moved to
+# runtime/guard.py (run_chunked) so the sweep, chaos, and defrag paths
+# share one audited degradation ladder.
 
 
 @dataclass
@@ -159,6 +80,29 @@ class ProbeResult:
     mem_util: float
     vg_util: float
     placements: np.ndarray  # [P] node index / -1 / -2(inactive)
+
+
+def _probe_to_record(res: ProbeResult) -> dict:
+    """JSON-serializable journal record of one probe (runtime/journal)."""
+    return {
+        "count": int(res.count),
+        "unscheduled": int(res.unscheduled),
+        "cpuUtil": float(res.cpu_util),
+        "memUtil": float(res.mem_util),
+        "vgUtil": float(res.vg_util),
+        "placements": [int(x) for x in np.asarray(res.placements)],
+    }
+
+
+def _probe_from_record(rec: dict) -> ProbeResult:
+    return ProbeResult(
+        count=int(rec["count"]),
+        unscheduled=int(rec["unscheduled"]),
+        cpu_util=float(rec["cpuUtil"]),
+        mem_util=float(rec["memUtil"]),
+        vg_util=float(rec["vgUtil"]),
+        placements=np.asarray(rec["placements"], dtype=np.int64),
+    )
 
 
 def _new_nodes(spec: dict, count: int) -> List[dict]:
@@ -315,6 +259,10 @@ class CapacitySweep:
                 self._ds_target[p_i] = name_to_idx[target]
         self._probe_jit = None
         self._chaos_jit = None
+        # optional resumable journal (runtime/journal.py): probe()
+        # serves journaled counts without touching the device and
+        # appends every fresh result (attach_journal)
+        self.journal = None
         # fused single-kernel fast path (ops/pallas_scan.py); None when
         # the batch uses machinery outside its scope or the backend is
         # not a real TPU (the interpreter would crawl at bench scale)
@@ -426,26 +374,61 @@ class CapacitySweep:
         )
         return cpu_util, mem_util, vg_util
 
+    def attach_journal(self, journal):
+        """Serve journaled probes without device work; append fresh
+        ones (runtime/journal.py, `--journal` / `--resume`)."""
+        self.journal = journal
+
     def probe(self, count: int) -> ProbeResult:
-        """Evaluate one candidate count (one masked scan)."""
+        """Evaluate one candidate count (one masked scan), through the
+        engine ladder (runtime/guard.py): the fused Pallas kernel when
+        a plan exists, the jitted XLA scan, and — after a classified
+        device fault at each rung — the serial host oracle. A Pallas
+        rung failure retires the plan so later probes skip it. Counts
+        already in the attached journal never touch the device."""
+        if self.journal is not None:
+            cached = self.journal.get_probe(count)
+            if cached is not None:
+                return _probe_from_record(cached)
+        res = self._probe_device(count)
+        if self.journal is not None:
+            self.journal.record_probe(_probe_to_record(res))
+        return res
+
+    def _probe_device(self, count: int) -> ProbeResult:
+        valid = self.node_valid(count)
+        steps = []
+        if self._pallas_plan is not None:
+            steps.append(("pallas", lambda: self._probe_pallas(count, valid)))
+        steps.append(("xla-scan", lambda: self._probe_xla(count, valid)))
+        steps.append(("serial-oracle", lambda: self._probe_serial(count, valid)))
+
+        def on_downgrade(rung, _e):
+            if rung == "pallas":
+                self._pallas_plan = None  # retire the dead rung
+
+        return run_laddered(steps, label="sweep-probe", on_downgrade=on_downgrade)
+
+    def _probe_pallas(self, count: int, valid) -> ProbeResult:
+        from ..ops import pallas_scan
+        from ..utils.trace import phase
+
+        with phase("sweep/probe"):
+            placements, final = pallas_scan.run_scan_pallas(
+                self._pallas_plan,
+                self.batch.class_of_pod,
+                self.pod_active(valid),
+                valid,
+                pinned=self.batch.pinned_node,
+            )
+        return self._pallas_result(count, valid, placements, final)
+
+    def _probe_xla(self, count: int, valid) -> ProbeResult:
         import jax
         import jax.numpy as jnp
 
         from ..utils.trace import phase
 
-        valid = self.node_valid(count)
-        if self._pallas_plan is not None:
-            from ..ops import pallas_scan
-
-            with phase("sweep/probe"):
-                placements, final = pallas_scan.run_scan_pallas(
-                    self._pallas_plan,
-                    self.batch.class_of_pod,
-                    self.pod_active(valid),
-                    valid,
-                    pinned=self.batch.pinned_node,
-                )
-            return self._pallas_result(count, valid, placements, final)
         if self._probe_jit is None:
             self._probe_jit = jax.jit(self._scenario)
         with phase("sweep/probe"):
@@ -460,6 +443,20 @@ class CapacitySweep:
             mem_util=float(mem),
             vg_util=float(vg),
             placements=placements,
+        )
+
+    def _probe_serial(self, count: int, valid) -> ProbeResult:
+        """Last ladder rung: the deterministic host oracle, no device."""
+        active = self.pod_active(valid)
+        placements, _reasons = self.serial_scenario(valid, active)
+        pl, unsched, cpu, mem, vg = self._host_scenario_stats(valid, placements)
+        return ProbeResult(
+            count=count,
+            unscheduled=int(unsched),
+            cpu_util=float(cpu),
+            mem_util=float(mem),
+            vg_util=float(vg),
+            placements=pl,
         )
 
     def _pallas_result(self, count, valid, placements, final) -> ProbeResult:
@@ -492,7 +489,15 @@ class CapacitySweep:
         path both scans dispatch deferred and fetch stacked (the defrag
         batching pattern) — the relay's per-sync latency is paid once.
         Falls back to two sequential probes on the XLA path."""
-        if self._pallas_plan is None:
+        if self._pallas_plan is None or (
+            self.journal is not None
+            and (
+                self.journal.get_probe(c1) is not None
+                or self.journal.get_probe(c2) is not None
+            )
+        ):
+            # journaled counts must not ride the paired dispatch: probe()
+            # serves them from the journal, so pairing would re-run them
             return self.probe(c1), self.probe(c2)
         from ..ops import pallas_scan
         from ..utils.trace import phase
@@ -507,17 +512,23 @@ class CapacitySweep:
                     for v in valids
                 ],
             )
-        return tuple(
+        out = tuple(
             self._pallas_result(c, valid, placements, final)
             for c, valid, (placements, final) in zip((c1, c2), valids, decoded)
         )
+        if self.journal is not None:
+            for r in out:
+                self.journal.record_probe(_probe_to_record(r))
+        return out
 
-    def probe_many(self, counts: List[int], mesh=None) -> SweepResult:
+    def probe_many(self, counts: List[int], mesh=None, budget=None) -> SweepResult:
         """Evaluate many counts batched (vmap; scenario-sharded over a
         device mesh when one is given). Chunked with OOM halving-retry
-        (run_chunked): a scenario batch that exhausts device memory is
-        split and retried, bottoming out in the deterministic serial
-        oracle — every degradation trace-noted, never silent."""
+        (runtime/guard.py run_chunked): a scenario batch that exhausts
+        device memory is split and retried, bottoming out in the
+        deterministic serial oracle — every degradation trace-noted,
+        never silent. `budget` halts between chunks (ExecutionHalted
+        with the completed prefix attached)."""
         import jax
         import jax.numpy as jnp
 
@@ -559,7 +570,8 @@ class CapacitySweep:
             return self._host_scenario_stats(node_valid[i], placements)
 
         rows = run_chunked(
-            evaluate, sc, label="sweep", serial_fallback=serial_fallback
+            evaluate, sc, label="sweep", serial_fallback=serial_fallback,
+            budget=budget,
         )
         placements, unsched, cpu_util, mem_util, vg_util = (
             np.stack([np.asarray(r[k]) for r in rows]) for k in range(5)
@@ -679,7 +691,7 @@ class CapacitySweep:
             np.float64(100.0 * used_v / denom_v),
         )
 
-    def probe_scenarios(self, node_valid, pod_active, pinned):
+    def probe_scenarios(self, node_valid, pod_active, pinned, budget=None):
         """Batched masked scans with PER-SCENARIO pin vectors — the
         fault-injection substrate (resilience/chaos.py). Each row of
         `node_valid` [Sc, N] / `pod_active` [Sc, P] / `pinned` [Sc, P]
@@ -717,7 +729,8 @@ class CapacitySweep:
             return self._host_scenario_stats(node_valid[i], placements)[:4]
 
         rows = run_chunked(
-            evaluate, sc, label="chaos", serial_fallback=serial_fallback
+            evaluate, sc, label="chaos", serial_fallback=serial_fallback,
+            budget=budget,
         )
         placements = np.stack([np.asarray(r[0]) for r in rows])
         unsched = np.array([int(r[1]) for r in rows], dtype=np.int64)
@@ -902,19 +915,59 @@ class CapacitySweep:
         feasible,
         start: int = 0,
         on_probe=None,
+        budget=None,
     ) -> Optional[ProbeResult]:
         """Smallest count whose probe satisfies `feasible(ProbeResult)`
-        (one spec; see _search_gen for the search shape)."""
+        (one spec; see _search_gen for the search shape). `budget` is
+        checked between probe rounds (the search's safe boundary); on
+        halt the raised ExecutionHalted carries a machine-readable
+        partial payload: every completed probe and the best feasible
+        count seen so far."""
+        from ..runtime.errors import ExecutionHalted
+
         gen = self._search_gen(feasible, start)
+        fulfilled: dict = {}
         try:
             req = next(gen)
             while True:
-                req = gen.send(self._fulfill(req, on_probe))
+                if budget is not None:
+                    try:
+                        budget.check("capacity-probe boundary")
+                    except ExecutionHalted as e:
+                        e.partial = _search_partial(fulfilled, feasible)
+                        raise
+                got = self._fulfill(req, on_probe)
+                fulfilled.update(got)
+                req = gen.send(got)
         except StopIteration as stop:
             return stop.value
 
 
-def find_min_count_multi(jobs, on_probe=None) -> List[Optional[ProbeResult]]:
+def _search_partial(fulfilled: dict, feasible) -> dict:
+    """Machine-readable progress of an interrupted min-count search:
+    completed probes + the best (smallest) feasible count so far."""
+    rows = []
+    best = None
+    for count in sorted(fulfilled):
+        res = fulfilled[count]
+        ok = bool(feasible(res))
+        rows.append(
+            {
+                "count": int(count),
+                "unscheduled": int(res.unscheduled),
+                "feasible": ok,
+            }
+        )
+        if ok and (best is None or count < best):
+            best = int(count)
+    return {
+        "phase": "capacity-search",
+        "completedProbes": rows,
+        "bestCount": best,
+    }
+
+
+def find_min_count_multi(jobs, on_probe=None, budget=None) -> List[Optional[ProbeResult]]:
     """Drive MANY specs' min-count searches in lockstep: `jobs` is a
     list of (CapacitySweep, feasible, start). Each round collects every
     live spec's requested probe counts, dispatches ALL of them deferred
@@ -953,6 +1006,8 @@ def find_min_count_multi(jobs, on_probe=None) -> List[Optional[ProbeResult]]:
     while live:
         import time as _time
 
+        if budget is not None:
+            budget.check("what-if probe round")
         _t0 = _time.time()
         _n0 = dispatches
         rounds += 1
@@ -965,14 +1020,28 @@ def find_min_count_multi(jobs, on_probe=None) -> List[Optional[ProbeResult]]:
                     dispatches += 1
                     if sweep._pallas_plan is not None:
                         valid = sweep.node_valid(c)
-                        out_d = pallas_scan.run_scan_pallas(
-                            sweep._pallas_plan,
-                            sweep.batch.class_of_pod,
-                            sweep.pod_active(valid),
-                            valid,
-                            pinned=sweep.batch.pinned_node,
-                            defer=True,
-                        )
+                        try:
+                            out_d = pallas_scan.run_scan_pallas(
+                                sweep._pallas_plan,
+                                sweep.batch.class_of_pod,
+                                sweep.pod_active(valid),
+                                valid,
+                                pinned=sweep.batch.pinned_node,
+                                defer=True,
+                            )
+                        except (RuntimeError, MemoryError, OSError) as e:
+                            from ..runtime.guard import try_downgrade
+
+                            if not try_downgrade(
+                                e, label="whatif", frm="pallas", to="xla-scan"
+                            ):
+                                raise
+                            # retire the dead Pallas rung for this
+                            # spec; probe() finishes the downgrade
+                            sweep._pallas_plan = None
+                            answers[i][c] = sweep.probe(c)
+                            syncs += 1
+                            continue
                         deferred.append((i, c, valid, out_d))
                     else:
                         answers[i][c] = sweep.probe(c)

@@ -97,6 +97,10 @@ class ChaosReport:
     outcomes: List[ScenarioOutcome] = field(default_factory=list)
     serial_confirmed: Optional[str] = None  # label of the serially
     # re-simulated scenario, set by confirm_serial on success
+    # deadline/SIGINT halted the sweep at a chunk boundary: `outcomes`
+    # holds only the completed scenarios out of `planned`
+    partial: bool = False
+    planned: int = 0  # scenarios the full sweep would evaluate
 
     @property
     def total(self) -> int:
@@ -126,6 +130,8 @@ class ChaosReport:
             "baselineUnscheduled": self.baseline_unscheduled,
             "survived": self.survived,
             "total": self.total,
+            "partial": self.partial,
+            "plannedScenarios": self.planned or self.total,
             "serialConfirmed": self.serial_confirmed,
             "scenarios": [
                 {
@@ -154,6 +160,13 @@ class ChaosReport:
         lines = [
             f"Fault-injection survivability: K={self.failures}, "
             f"{self.total} scenario(s) ({self.mode}), seed {self.seed}",
+        ]
+        if self.partial:
+            lines.append(
+                f"PARTIAL: {self.total}/{self.planned} scenario(s) "
+                "evaluated before the run halted (deadline/interrupt)"
+            )
+        lines += [
             f"baseline: {self.baseline_count} new node(s), "
             f"{self.baseline_unscheduled} unschedulable pod(s)",
             f"SURVIVED {self.survived}/{self.total} scenario(s)"
@@ -453,65 +466,176 @@ class ChaosEngine:
 
     # -- evaluation ---------------------------------------------------------
 
+    def _scenario_key(self, scen: OutageScenario) -> str:
+        """Journal key of one scenario verdict: the committed count plus
+        the failure set (the journal fingerprint already pins the
+        config, flags, seed, and perturbations)."""
+        return f"{self.count}:{scen.kind}:{'+'.join(scen.failed_names)}"
+
+    @staticmethod
+    def _outcome_record(o: ScenarioOutcome) -> dict:
+        return {
+            "scenKind": o.scenario.kind,
+            "failed": [int(i) for i in o.scenario.failed],
+            "failedNames": list(o.scenario.failed_names),
+            "displaced": o.displaced,
+            "rescheduled": o.rescheduled,
+            "unschedulable": o.unschedulable,
+            "baselineUnsched": o.baseline_unsched,
+            "lostDaemonSet": o.lost_daemonset,
+            "lostNodeBound": o.lost_node_bound,
+            "cpuUtil": o.cpu_util,
+            "memUtil": o.mem_util,
+            "reasons": [[p, r] for p, r in o.reasons],
+            "unschedulablePods": [int(i) for i in o.unschedulable_pods],
+        }
+
+    @staticmethod
+    def _outcome_from_record(scen: OutageScenario, rec: dict) -> ScenarioOutcome:
+        return ScenarioOutcome(
+            scenario=scen,
+            displaced=int(rec["displaced"]),
+            rescheduled=int(rec["rescheduled"]),
+            unschedulable=int(rec["unschedulable"]),
+            baseline_unsched=int(rec["baselineUnsched"]),
+            lost_daemonset=int(rec["lostDaemonSet"]),
+            lost_node_bound=int(rec["lostNodeBound"]),
+            cpu_util=float(rec["cpuUtil"]),
+            mem_util=float(rec["memUtil"]),
+            reasons=[(p, r) for p, r in rec.get("reasons") or []],
+            unschedulable_pods=tuple(
+                int(i) for i in rec.get("unschedulablePods") or ()
+            ),
+        )
+
+    def _outcome(self, scen, masks, row, cpu, mem, explain_left) -> ScenarioOutcome:
+        valid, active, _pinned, displaced = masks
+        b = self.baseline
+        newly = (row == -1) & (b >= 0)
+        outcome = ScenarioOutcome(
+            scenario=scen,
+            displaced=int(displaced.sum()),
+            rescheduled=int((displaced & (row >= 0)).sum()),
+            unschedulable=int(newly.sum()),
+            baseline_unsched=int(((row == -1) & (b == -1)).sum()),
+            lost_daemonset=int((self.base_active & ~active).sum()),
+            lost_node_bound=int(
+                (
+                    self.had
+                    & (self.orig_pin >= 0)
+                    & ~valid[np.clip(self.orig_pin, 0, None)]
+                ).sum()
+            ),
+            cpu_util=float(cpu),
+            mem_util=float(mem),
+            unschedulable_pods=tuple(int(i) for i in np.flatnonzero(newly)),
+        )
+        if outcome.unschedulable and explain_left > 0:
+            outcome.reasons = self._explain(valid, row, newly)
+        return outcome
+
     def run(
         self,
         failures: int = 1,
         seed: int = 1,
         trials: int = 32,
         explain: int = MAX_EXPLAINED_SCENARIOS,
+        budget=None,
+        journal=None,
     ) -> ChaosReport:
+        """Evaluate the scenario set against the committed placement.
+
+        With a `journal`, scenarios whose verdict is already journaled
+        are reconstructed without any device work and only the
+        remainder rides the batched sweep; fresh verdicts are appended
+        as they land. With a `budget`, the sweep halts between device
+        chunks: the raised ExecutionHalted carries a PARTIAL ChaosReport
+        (completed scenarios only, journaled) as its payload."""
+        from ..runtime.errors import ExecutionHalted
         from ..utils.trace import GLOBAL, phase
 
         scens, mode = self.build_scenarios(failures, seed, trials)
-        masks = [self._masks(s) for s in scens]
-        with phase("chaos/sweep"):
-            placements, _unsched, cpu, mem = self.scen.probe_scenarios(
-                np.stack([m[0] for m in masks]),
-                np.stack([m[1] for m in masks]),
-                np.stack([m[2] for m in masks]),
-            )
-        b = self.baseline
         report = ChaosReport(
             failures=failures,
             seed=seed,
             mode=mode,
             baseline_count=self.count,
-            baseline_unscheduled=int((b == -1).sum()),
+            baseline_unscheduled=int((self.baseline == -1).sum()),
+            planned=len(scens),
         )
-        explained = 0
+        outcomes: List[Optional[ScenarioOutcome]] = [None] * len(scens)
+        eval_idx: List[int] = []
+        if journal is not None:
+            for s_i, scen in enumerate(scens):
+                rec = journal.get_scenario(self._scenario_key(scen))
+                if rec is not None:
+                    outcomes[s_i] = self._outcome_from_record(scen, rec)
+                else:
+                    eval_idx.append(s_i)
+            if len(eval_idx) < len(scens):
+                GLOBAL.append_note(
+                    "chaos-journal",
+                    f"count {self.count}: {len(scens) - len(eval_idx)}/"
+                    f"{len(scens)} scenario verdict(s) replayed from the "
+                    "journal",
+                )
+        else:
+            eval_idx = list(range(len(scens)))
+
+        masks = {s_i: self._masks(scens[s_i]) for s_i in eval_idx}
+        halted = None
+        rows: dict = {}
+        if eval_idx:
+            try:
+                with phase("chaos/sweep"):
+                    placements, _unsched, cpu, mem = self.scen.probe_scenarios(
+                        np.stack([masks[i][0] for i in eval_idx]),
+                        np.stack([masks[i][1] for i in eval_idx]),
+                        np.stack([masks[i][2] for i in eval_idx]),
+                        budget=budget,
+                    )
+                rows = {
+                    s_i: (placements[k], cpu[k], mem[k])
+                    for k, s_i in enumerate(eval_idx)
+                }
+            except ExecutionHalted as e:
+                halted = e
+                partial = getattr(e, "partial_results", None) or []
+                rows = {
+                    s_i: (r[0], r[2], r[3])
+                    for s_i, r in zip(eval_idx, partial)
+                    if r is not None
+                }
+        explain_left = explain
         for s_i, scen in enumerate(scens):
-            valid, active, _pinned, displaced = masks[s_i]
-            row = placements[s_i]
-            newly = (row == -1) & (b >= 0)
-            outcome = ScenarioOutcome(
-                scenario=scen,
-                displaced=int(displaced.sum()),
-                rescheduled=int((displaced & (row >= 0)).sum()),
-                unschedulable=int(newly.sum()),
-                baseline_unsched=int(((row == -1) & (b == -1)).sum()),
-                lost_daemonset=int((self.base_active & ~active).sum()),
-                lost_node_bound=int(
-                    (
-                        self.had
-                        & (self.orig_pin >= 0)
-                        & ~valid[np.clip(self.orig_pin, 0, None)]
-                    ).sum()
-                ),
-                cpu_util=float(cpu[s_i]),
-                mem_util=float(mem[s_i]),
-                unschedulable_pods=tuple(
-                    int(i) for i in np.flatnonzero(newly)
-                ),
+            if outcomes[s_i] is not None:
+                continue
+            if s_i not in rows:
+                continue
+            row, cpu_i, mem_i = rows[s_i]
+            outcome = self._outcome(
+                scen, masks[s_i], row, cpu_i, mem_i, explain_left
             )
-            if outcome.unschedulable and explained < explain:
-                explained += 1
-                outcome.reasons = self._explain(valid, row, newly)
-            report.outcomes.append(outcome)
+            if outcome.reasons:
+                explain_left -= 1
+            outcomes[s_i] = outcome
+            if journal is not None:
+                journal.record_scenario(
+                    self._scenario_key(scen), self._outcome_record(outcome)
+                )
+        report.outcomes = [o for o in outcomes if o is not None]
+        report.partial = halted is not None
         GLOBAL.note(
             "chaos-scenarios",
             f"{report.survived}/{report.total} survive (K={failures}, "
-            f"{mode}, seed {seed})",
+            f"{mode}, seed {seed})"
+            + (f" [partial: {report.total}/{report.planned}]" if report.partial else ""),
         )
+        if halted is not None:
+            halted.partial = {"phase": "chaos-sweep", "report": report.as_dict()}
+            # hand the assembled partial report to the caller too
+            halted.partial_report = report
+            raise halted
         return report
 
     def _explain(self, valid, row, newly) -> List[Tuple[str, str]]:
@@ -628,6 +752,8 @@ def raise_plan_to_nplusk(
     failures: int,
     seed: int = 1,
     trials: int = 32,
+    budget=None,
+    journal=None,
 ) -> Tuple[Optional[ProbeResult], Optional[ChaosReport]]:
     """Escalate a feasible capacity plan until its committed placement
     survives every evaluated K-failure scenario (`simon apply
@@ -637,17 +763,47 @@ def raise_plan_to_nplusk(
     failure set stagnates across escalations. A surviving plan is only
     returned after one sampled outage scenario re-simulates SERIALLY to
     the same verdict — a batched-scan bug must not certify a fake N+K
-    plan."""
+    plan.
+
+    `budget` halts the escalation at its safe boundaries (between
+    escalations and between device chunks) with a machine-readable
+    partial payload; `journal` makes the escalation resumable — probe
+    results ride the sweep's attached journal and every scenario
+    verdict is appended as it lands, so a resumed run re-executes zero
+    journaled work."""
+    from ..runtime.errors import ExecutionHalted
     from ..utils.trace import GLOBAL
 
     probe = best
     stagnant = 0
     prev_failure_sig = None
+
+    def _partial(exc, report=None):
+        exc.partial = {
+            "phase": "nplusk-escalation",
+            "tolerateFailures": failures,
+            "count": probe.count,
+            "planFeasibleAtCount": True,
+            "chaos": (exc.partial or {}).get("report")
+            if isinstance(exc.partial, dict)
+            else (report.as_dict() if report is not None else None),
+        }
+        return exc
+
     while True:
+        if budget is not None:
+            try:
+                budget.check("N+K escalation boundary")
+            except ExecutionHalted as e:
+                raise _partial(e)
         engine = ChaosEngine(sweep, probe.count, probe.placements)
-        report = engine.run(
-            failures=failures, seed=seed, trials=trials, explain=0
-        )
+        try:
+            report = engine.run(
+                failures=failures, seed=seed, trials=trials, explain=0,
+                budget=budget, journal=journal,
+            )
+        except ExecutionHalted as e:
+            raise _partial(e)
         GLOBAL.append_note(
             "nplusk-escalation",
             f"count {probe.count}: {report.survived}/{report.total} survive",

@@ -1,0 +1,51 @@
+"""Execution-guard runtime: deadline budgets, resumable journals, the
+unified device degradation ladder, and retrying external I/O.
+
+The planner answers questions about 100k-pod clusters; this package
+makes those runs survivable — a wall-clock budget and SIGINT both stop
+a run at the next safe boundary with a well-formed partial report
+(budget.py), completed work lands in a crash-safe journal that
+``--resume`` replays (journal.py), every device call site shares one
+audited OOM/compile-failure degradation ladder (guard.py), and flaky
+external dependencies retry with backoff behind per-endpoint circuit
+breakers (retry.py). docs/ROBUSTNESS.md is the operator-facing map.
+"""
+
+from .budget import Budget, sigint_to_budget
+from .errors import (
+    EXIT_INFEASIBLE,
+    EXIT_INPUT_ERROR,
+    EXIT_INTERRUPTED,
+    EXIT_OK,
+    EXIT_PARTIAL_DEADLINE,
+    BackendUnavailable,
+    CompileFailure,
+    DeadlineExceeded,
+    DeviceOOM,
+    ExecutionHalted,
+    ExternalIOError,
+    GuardError,
+    Interrupted,
+)
+from .journal import Journal, JournalMismatch, config_fingerprint
+
+__all__ = [
+    "Budget",
+    "sigint_to_budget",
+    "Journal",
+    "JournalMismatch",
+    "config_fingerprint",
+    "GuardError",
+    "DeviceOOM",
+    "CompileFailure",
+    "BackendUnavailable",
+    "DeadlineExceeded",
+    "Interrupted",
+    "ExecutionHalted",
+    "ExternalIOError",
+    "EXIT_OK",
+    "EXIT_INFEASIBLE",
+    "EXIT_INPUT_ERROR",
+    "EXIT_PARTIAL_DEADLINE",
+    "EXIT_INTERRUPTED",
+]

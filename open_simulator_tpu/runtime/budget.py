@@ -1,0 +1,109 @@
+"""Wall-clock deadline budgets and cooperative interruption.
+
+A ``Budget`` is created once per CLI invocation (``--deadline SECONDS``,
+or unbounded when the flag is absent) and threaded through every long
+loop in the planner, sweep, chaos engine, and serial scheduler. Loops
+call ``budget.check("<boundary>")`` at their safe boundaries — between
+capacity probes, between device chunks, between N+K escalations,
+between serially scheduled pods — and the check raises
+``DeadlineExceeded`` (deadline expired) or ``Interrupted`` (SIGINT
+observed) exactly there, never mid-commit. Callers that can describe
+partial progress catch the exception, attach their payload to
+``exc.partial``, and re-raise; the CLI renders the outermost payload as
+a well-formed partial report with a distinct exit code
+(docs/ROBUSTNESS.md).
+
+SIGINT handling is two-stage (``sigint_to_budget``): the first ^C flags
+the budget so the run stops at the next safe boundary with a partial
+report; a second ^C restores the previous handler, so an operator can
+still kill a run wedged inside a device call.
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from .errors import DeadlineExceeded, Interrupted
+
+
+class Budget:
+    """Deadline + interruption state for one run.
+
+    ``deadline_s=None`` means unbounded: ``check`` then only reacts to
+    ``interrupt()``. The clock is injectable for tests."""
+
+    def __init__(self, deadline_s: Optional[float] = None, clock=time.monotonic):
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline must be >= 0, got {deadline_s}")
+        self._clock = clock
+        self.started = clock()
+        self.deadline_s = deadline_s
+        self._interrupted = False
+
+    def interrupt(self):
+        """Flag the budget (SIGINT handler / tests); the run halts at
+        the next ``check`` boundary."""
+        self._interrupted = True
+
+    @property
+    def interrupted(self) -> bool:
+        return self._interrupted
+
+    def elapsed(self) -> float:
+        return self._clock() - self.started
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.deadline_s - self.elapsed()
+
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0
+
+    def check(self, boundary: str):
+        """Raise ``Interrupted`` / ``DeadlineExceeded`` when the run
+        must stop; a no-op otherwise. ``boundary`` names the safe point
+        for the partial report and the trace."""
+        if self._interrupted:
+            raise Interrupted(f"interrupted at {boundary}")
+        if self.expired():
+            raise DeadlineExceeded(
+                f"deadline of {self.deadline_s:g}s exceeded at {boundary} "
+                f"after {self.elapsed():.1f}s"
+            )
+
+
+@contextmanager
+def sigint_to_budget(budget: Budget):
+    """Route SIGINT into ``budget.interrupt()`` for the enclosed block.
+
+    First ^C: flag the budget (stop at the next safe boundary, partial
+    report). Second ^C: the previous handler is already restored, so it
+    behaves like a normal interrupt (KeyboardInterrupt by default).
+    Outside the main thread no handler can be installed; the block runs
+    unguarded (``budget.interrupt()`` still works when called
+    directly)."""
+    prev = None
+
+    def handler(signum, frame):
+        budget.interrupt()
+        if prev is not None:
+            signal.signal(signal.SIGINT, prev)
+
+    try:
+        prev = signal.signal(signal.SIGINT, handler)
+    except ValueError:  # not the main thread
+        yield budget
+        return
+    try:
+        yield budget
+    finally:
+        try:
+            if signal.getsignal(signal.SIGINT) is handler:
+                signal.signal(signal.SIGINT, prev)
+        except ValueError:  # pragma: no cover - interpreter teardown
+            pass

@@ -1,0 +1,96 @@
+"""Structured error taxonomy of the execution-guard runtime.
+
+Every way a plan can die maps to one typed error (docs/ROBUSTNESS.md):
+
+- ``DeviceOOM``: the accelerator ran out of memory (XLA
+  RESOURCE_EXHAUSTED / host MemoryError). Recoverable by the guard's
+  chunk-halving ladder (runtime/guard.py).
+- ``CompileFailure``: XLA / Mosaic compilation or lowering rejected the
+  program. Halving cannot help; the guard downgrades the whole batch to
+  the next engine rung.
+- ``BackendUnavailable``: the backend (usually a relay-attached TPU
+  plugin) died or refused to initialize mid-run.
+- ``DeadlineExceeded`` / ``Interrupted``: the run hit its ``--deadline``
+  wall-clock budget or received SIGINT and stopped at the next safe
+  boundary (runtime/budget.py). Both carry a machine-readable
+  ``partial`` payload describing completed work and map to distinct
+  exit codes.
+- ``ExternalIOError``: an external dependency (kube apiserver, HTTP
+  scheduler extender, credential-plugin subprocess) failed after the
+  retry policy was exhausted or its circuit breaker opened
+  (runtime/retry.py). Carries the endpoint URL or subprocess argv.
+
+The CLI exit-code contract (docs/ROBUSTNESS.md):
+
+====  =========================================================
+code  meaning
+====  =========================================================
+0     success (plan feasible / every chaos scenario survives)
+1     infeasible (valid input, negative answer)
+2     input error (bad config, bad flags, refused resume)
+3     partial result: deadline expired at a safe boundary
+4     partial result: interrupted (SIGINT) at a safe boundary
+====  =========================================================
+"""
+
+from __future__ import annotations
+
+EXIT_OK = 0
+EXIT_INFEASIBLE = 1
+EXIT_INPUT_ERROR = 2
+EXIT_PARTIAL_DEADLINE = 3
+EXIT_INTERRUPTED = 4
+
+
+class GuardError(Exception):
+    """Base of the execution-guard taxonomy."""
+
+
+class DeviceOOM(GuardError):
+    """Device memory exhausted (RESOURCE_EXHAUSTED / MemoryError)."""
+
+
+class CompileFailure(GuardError):
+    """XLA / Mosaic compilation or lowering failed."""
+
+
+class BackendUnavailable(GuardError):
+    """The device backend died or refused to initialize."""
+
+
+class ExternalIOError(GuardError):
+    """An external I/O dependency failed after retries (or its circuit
+    breaker is open). Carries the endpoint or subprocess argv so the
+    report names what actually failed."""
+
+    def __init__(self, message: str, *, endpoint=None, argv=None):
+        super().__init__(message)
+        self.endpoint = endpoint
+        self.argv = list(argv) if argv is not None else None
+
+
+class ExecutionHalted(GuardError):
+    """The run stopped early at a safe boundary. ``partial`` is a
+    machine-readable payload describing the work that DID complete
+    (the CLI renders it as the partial report)."""
+
+    exit_code = EXIT_PARTIAL_DEADLINE
+    reason = "halted"
+
+    def __init__(self, message: str, partial=None):
+        super().__init__(message)
+        self.partial = partial
+
+
+class DeadlineExceeded(ExecutionHalted):
+    """The wall-clock budget (``--deadline``) expired."""
+
+    exit_code = EXIT_PARTIAL_DEADLINE
+    reason = "deadline"
+
+
+class Interrupted(ExecutionHalted):
+    """SIGINT / KeyboardInterrupt observed at a safe boundary."""
+
+    exit_code = EXIT_INTERRUPTED
+    reason = "interrupt"

@@ -1,0 +1,259 @@
+"""Unified degradation ladder for device work.
+
+Every device call site — capacity probes, the batched sweep, chaos
+scenario batches, defrag depth scans, the what-if multi-spec driver —
+routes through this module instead of carrying its own retry logic
+(the PR-1 halving machinery lived inside parallel/sweep.py; promoted
+here so every path shares one audited ladder).
+
+Engine ladder, in downgrade order (docs/ROBUSTNESS.md):
+
+1. ``pallas`` — the fused single-kernel fast path (ops/pallas_scan.py)
+2. ``pallas-stream`` — same kernel with HBM-streamed term state; the
+   downgrade happens at plan-build time (build_plan auto-rewrites when
+   the resident state exceeds the VMEM budget) and is trace-noted by
+   fallback_reason()
+3. ``xla-scan`` — the vmapped masked lax.scan
+4. ``serial-oracle`` — the deterministic host oracle, always correct,
+   never OOMs
+
+Error-driven downgrades (run_laddered) and chunk-halving retries
+(run_chunked) react to the classified taxonomy (runtime/errors.py):
+``DeviceOOM`` halves the batch before falling to the next rung,
+``CompileFailure`` / ``BackendUnavailable`` skip straight down (a
+smaller batch would hit the same compiler/backend wall). Every
+downgrade is trace-noted with its reason and logged — no silent paths.
+Errors that classify to nothing propagate untouched: a shape bug must
+stay loud.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .errors import (
+    BackendUnavailable,
+    CompileFailure,
+    DeviceOOM,
+    ExecutionHalted,
+)
+
+LADDER = ("pallas", "pallas-stream", "xla-scan", "serial-oracle")
+
+# test hook: callable(chunk_len) invoked before each device chunk is
+# evaluated; tests make it raise fake device errors to exercise the
+# halving-retry / ladder-downgrade paths without real hardware faults
+_OOM_INJECT = None
+
+log = logging.getLogger(__name__)
+
+
+def is_oom(e: BaseException) -> bool:
+    """Device-memory exhaustion, as XLA reports it (XlaRuntimeError is
+    a RuntimeError whose message carries the RESOURCE_EXHAUSTED status
+    code; some backends phrase it as an allocation failure)."""
+    if isinstance(e, (MemoryError, DeviceOOM)):
+        return True
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+def classify_device_error(e: BaseException):
+    """Map a raw device-side exception onto the taxonomy. Returns the
+    taxonomy CLASS (DeviceOOM / CompileFailure / BackendUnavailable)
+    or None when the error is not a recognized device fault and must
+    propagate unchanged."""
+    if isinstance(e, (DeviceOOM, CompileFailure, BackendUnavailable)):
+        return type(e)
+    if isinstance(e, MemoryError):
+        return DeviceOOM
+    if not isinstance(e, (RuntimeError, OSError)):
+        return None
+    msg = str(e)
+    if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+        return DeviceOOM
+    low = msg.lower()
+    if "mosaic" in low or "compilation" in low or "lowering" in low:
+        return CompileFailure
+    if (
+        "UNAVAILABLE" in msg
+        or "failed to initialize" in low
+        or "backend" in low
+        and "not found" in low
+    ):
+        return BackendUnavailable
+    return None
+
+
+def _reason(e: BaseException) -> str:
+    return str(e).split("\n", 1)[0][:120]
+
+
+def note_downgrade(label: str, frm: str, to: str, reason: str, trace=None):
+    """Record one ladder downgrade: trace note + warning log. Callers
+    downgrade THROUGH this so every degradation carries its reason."""
+    from ..utils.trace import GLOBAL
+
+    (trace or GLOBAL).append_note(
+        f"{label}-downgrade", f"{frm} -> {to}: {reason}"
+    )
+    log.warning("%s: downgrading %s -> %s (%s)", label, frm, to, reason)
+
+
+def try_downgrade(e: BaseException, *, label: str, frm: str, to: str,
+                  trace=None) -> bool:
+    """One-rung downgrade for call sites that hold their own fallback
+    path (defrag's XLA branch, the what-if driver's per-spec probe):
+    when `e` classifies as a device fault, trace-note the downgrade and
+    return True (caller switches rungs); else return False (caller
+    re-raises — the error is a real bug, not a degradation)."""
+    if classify_device_error(e) is None:
+        return False
+    note_downgrade(label, frm, to, _reason(e), trace)
+    return True
+
+
+def run_laddered(
+    steps: Sequence[Tuple[str, Callable[[], object]]],
+    *,
+    label: str,
+    trace=None,
+    on_downgrade: Optional[Callable[[str, BaseException], None]] = None,
+):
+    """Run the first rung; on a classified device error fall to the
+    next, trace-noting the downgrade. ``steps`` is [(rung_name,
+    thunk)] in ladder order; ``on_downgrade(rung, error)`` lets the
+    caller retire state tied to the failed rung (e.g. drop a Pallas
+    plan so later probes skip the dead rung). Unclassified errors
+    propagate; a classified error on the LAST rung is re-raised as its
+    taxonomy type."""
+    if not steps:
+        raise ValueError("run_laddered needs at least one rung")
+    for i, (rung, thunk) in enumerate(steps):
+        try:
+            return thunk()
+        except Exception as e:  # audited: classified, then re-raised or downgraded
+            cls = classify_device_error(e)
+            if cls is None:
+                raise
+            if i + 1 >= len(steps):
+                raise cls(f"{label}: {rung} failed: {_reason(e)}") from e
+            note_downgrade(label, rung, steps[i + 1][0], _reason(e), trace)
+            if on_downgrade is not None:
+                on_downgrade(rung, e)
+
+
+def run_chunked(
+    evaluate,
+    n_items: int,
+    *,
+    label: str,
+    serial_fallback=None,
+    trace=None,
+    budget=None,
+):
+    """Evaluate items [0, n_items) in device batches with bounded
+    halving-retry on device OOM (a 10k-scenario vmap that exhausts
+    device memory must not kill the whole plan).
+
+    ``evaluate(lo, hi)`` runs one contiguous chunk on the device and
+    returns a list of per-item results. On ``DeviceOOM`` the chunk is
+    split in half and each half retried, bottoming out at single-item
+    chunks; a single item that still OOMs goes through
+    ``serial_fallback(i)`` (the deterministic host-oracle rung). A
+    ``CompileFailure`` / ``BackendUnavailable`` skips the halving — a
+    smaller batch hits the same wall — and sends every remaining item
+    of the chunk through ``serial_fallback`` directly (or re-raises
+    typed when there is none). Every degradation is trace-noted with
+    its reason and logged; errors that classify to nothing propagate.
+
+    ``budget.check`` runs between chunks (the executor's safe
+    boundary); on expiry/interrupt the raised ``ExecutionHalted``
+    carries ``partial_results`` (the per-item result list, None where
+    incomplete) so callers can report the completed prefix."""
+    from ..utils.trace import GLOBAL
+
+    tr = trace or GLOBAL
+    out = [None] * n_items
+    done = [False] * n_items
+    pending: List[Tuple[int, int]] = [(0, n_items)] if n_items else []
+    halvings = serial = 0
+
+    def run_serial(lo, hi, reason, why):
+        nonlocal serial
+        for i in range(lo, hi):
+            serial += 1
+            tr.append_note(
+                f"{label}-serial-fallback", f"item {i} via serial oracle after {reason}"
+            )
+            log.warning(
+                "%s: item %d falling back to the serial oracle after %s (%s)",
+                label, i, why, reason,
+            )
+            out[i] = serial_fallback(i)
+            done[i] = True
+
+    while pending:
+        if budget is not None:
+            try:
+                budget.check(f"{label} chunk boundary")
+            except ExecutionHalted as e:
+                e.partial_results = [
+                    r if ok else None for r, ok in zip(out, done)
+                ]
+                raise
+        lo, hi = pending.pop()
+        try:
+            if _OOM_INJECT is not None:
+                _OOM_INJECT(hi - lo)
+            results = evaluate(lo, hi)
+        except (
+            RuntimeError,
+            MemoryError,
+            OSError,
+            DeviceOOM,
+            CompileFailure,
+            BackendUnavailable,
+        ) as e:
+            # everything classify_device_error can recognize — raw XLA
+            # RuntimeErrors, OSError-shaped backend faults, and already-
+            # typed taxonomy errors from nested rungs
+            cls = classify_device_error(e)
+            if cls is None:
+                raise
+            reason = _reason(e)
+            if cls is not DeviceOOM:
+                # halving cannot fix a compiler/backend fault: the
+                # whole remaining chunk drops to the serial rung
+                if serial_fallback is None:
+                    raise cls(f"{label}: {reason}") from e
+                run_serial(lo, hi, reason, cls.__name__)
+                continue
+            if hi - lo == 1:
+                if serial_fallback is None:
+                    raise
+                run_serial(lo, hi, reason, "device OOM even alone")
+                continue
+            mid = (lo + hi) // 2
+            halvings += 1
+            tr.append_note(
+                f"{label}-chunk-halving",
+                f"[{lo},{hi}) -> [{lo},{mid})+[{mid},{hi}) after {reason}",
+            )
+            log.warning(
+                "%s: chunk [%d,%d) exhausted device memory; retrying as "
+                "two halves (%s)", label, lo, hi, reason
+            )
+            # LIFO: push the upper half first so the lower half runs next
+            pending.append((mid, hi))
+            pending.append((lo, mid))
+            continue
+        out[lo:hi] = results
+        done[lo:hi] = [True] * (hi - lo)
+    if halvings or serial:
+        tr.note(
+            f"{label}-degraded",
+            f"{halvings} chunk-halving(s), {serial} serial fallback(s)",
+        )
+    return out

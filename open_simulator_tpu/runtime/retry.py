@@ -1,0 +1,222 @@
+"""Retrying I/O with capped exponential backoff and circuit breakers.
+
+External dependencies — the kube apiserver (models/kubeclient.py), HTTP
+scheduler extenders (scheduler/extender.py), credential-plugin
+subprocesses — fail in ways the simulator must survive: transient
+network errors retry with capped exponential backoff and DETERMINISTIC
+jitter (hashed from the call label + attempt number, so two runs of the
+same plan back off identically and a report is reproducible); a
+dependency that keeps failing trips a per-endpoint circuit breaker and
+every later call fails fast with a loud trace note instead of hanging
+the plan behind timeout × retries × pods. Exhausted retries and open
+breakers raise ``ExternalIOError`` carrying the endpoint URL or
+subprocess argv (runtime/errors.py).
+
+Knobs (env): ``SIMON_IO_ATTEMPTS`` (default 3 tries per call),
+``SIMON_SUBPROCESS_TIMEOUT`` (default 60 s, credential-plugin
+subprocesses), ``SIMON_HTTP_TIMEOUT`` (default 30 s, kube REST).
+Extender HTTP timeouts stay per-extender (``httpTimeoutSeconds`` in the
+scheduler config).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+from .errors import ExternalIOError
+
+DEFAULT_ATTEMPTS = 3
+BASE_DELAY_S = 0.05
+MAX_DELAY_S = 2.0
+BREAKER_THRESHOLD = 5  # consecutive failed calls before the circuit opens
+
+SUBPROCESS_TIMEOUT_ENV = "SIMON_SUBPROCESS_TIMEOUT"
+HTTP_TIMEOUT_ENV = "SIMON_HTTP_TIMEOUT"
+ATTEMPTS_ENV = "SIMON_IO_ATTEMPTS"
+
+DEFAULT_SUBPROCESS_TIMEOUT_S = 60.0
+DEFAULT_HTTP_TIMEOUT_S = 30.0
+
+
+def _env_float(env: str, default: float) -> float:
+    raw = os.environ.get(env, "")
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def subprocess_timeout() -> float:
+    """Credential-plugin subprocess timeout (was hard-coded 60 s)."""
+    return _env_float(SUBPROCESS_TIMEOUT_ENV, DEFAULT_SUBPROCESS_TIMEOUT_S)
+
+
+def http_timeout() -> float:
+    """Kube REST timeout (was hard-coded 30 s)."""
+    return _env_float(HTTP_TIMEOUT_ENV, DEFAULT_HTTP_TIMEOUT_S)
+
+
+def io_attempts() -> int:
+    v = int(_env_float(ATTEMPTS_ENV, DEFAULT_ATTEMPTS))
+    return max(v, 1)
+
+
+def backoff_delay(key: str, attempt: int, base: float = BASE_DELAY_S,
+                  cap: float = MAX_DELAY_S) -> float:
+    """Delay before retry `attempt` (1-based): capped exponential with
+    deterministic jitter in [0.5, 1.0) of the step, hashed from
+    (key, attempt) — reproducible, but two endpoints never beat in
+    phase."""
+    step = min(base * (2 ** (attempt - 1)), cap)
+    digest = hashlib.sha256(f"{key}:{attempt}".encode()).digest()
+    frac = 0.5 + (digest[0] / 256.0) / 2.0
+    return step * frac
+
+
+@dataclass
+class CircuitBreaker:
+    """Consecutive-failure breaker for one endpoint. Once open it stays
+    open for the rest of the process: a plan run is one-shot, and a
+    flapping dependency mid-plan is worse than a skipped one."""
+
+    endpoint: str
+    threshold: int = BREAKER_THRESHOLD
+    failures: int = 0
+    opened: bool = False
+
+    @property
+    def is_open(self) -> bool:
+        return self.opened
+
+    def record_success(self):
+        self.failures = 0
+
+    def record_failure(self, trace=None):
+        self.failures += 1
+        if not self.opened and self.failures >= self.threshold:
+            self.opened = True
+            from ..utils.trace import GLOBAL
+
+            (trace or GLOBAL).append_note(
+                "io-circuit-open",
+                f"{self.endpoint}: open after {self.failures} consecutive "
+                "failures; further calls skip fast",
+            )
+
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(endpoint: str) -> CircuitBreaker:
+    with _breakers_lock:
+        b = _breakers.get(endpoint)
+        if b is None:
+            b = _breakers[endpoint] = CircuitBreaker(endpoint)
+        return b
+
+
+def reset_io_state():
+    """Forget all breaker state (tests / long-lived embedders)."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
+def retry_io(
+    fn: Callable[[], object],
+    *,
+    label: str,
+    endpoint: Optional[str] = None,
+    argv=None,
+    attempts: Optional[int] = None,
+    catch: Tuple[type, ...] = (OSError,),
+    retryable: Optional[Callable[[BaseException], bool]] = None,
+    trace=None,
+    sleep=time.sleep,
+):
+    """Call ``fn`` with retries, backoff, and the endpoint's breaker.
+
+    Exceptions in ``catch`` are retried when ``retryable(e)`` (default:
+    always) says so; non-retryable ones re-raise unchanged and do not
+    count against the breaker (an HTTP 404 is an answer, not an
+    outage). One exhausted call counts ONE breaker failure; an open
+    breaker fails fast with ``ExternalIOError`` and a trace note."""
+    from ..utils.trace import GLOBAL
+
+    tr = trace or GLOBAL
+    breaker = breaker_for(endpoint or label)
+    if breaker.is_open:
+        tr.append_note("io-skip", f"{label}: circuit open, skipping call")
+        raise ExternalIOError(
+            f"{label}: circuit breaker open after {breaker.failures} "
+            "consecutive failures; skipping",
+            endpoint=endpoint,
+            argv=argv,
+        )
+    n = attempts if attempts is not None else io_attempts()
+    last: Optional[BaseException] = None
+    for attempt in range(1, n + 1):
+        try:
+            out = fn()
+        except catch as e:
+            if retryable is not None and not retryable(e):
+                raise
+            last = e
+            if attempt < n:
+                delay = backoff_delay(label, attempt)
+                tr.append_note(
+                    "io-retry",
+                    f"{label}: attempt {attempt}/{n} failed "
+                    f"({str(e)[:80]}); retrying in {delay:.2f}s",
+                )
+                sleep(delay)
+        else:
+            breaker.record_success()
+            return out
+    breaker.record_failure(trace=tr)
+    raise ExternalIOError(
+        f"{label}: failed after {n} attempt(s): {last}",
+        endpoint=endpoint,
+        argv=argv,
+    ) from last
+
+
+def run_subprocess(
+    argv,
+    *,
+    env=None,
+    timeout: Optional[float] = None,
+    label: str = "",
+    check: bool = True,
+):
+    """``subprocess.run`` with the configurable timeout and a typed
+    timeout failure: ``ExternalIOError`` carrying the argv instead of a
+    raw ``subprocess.TimeoutExpired`` (docs/ROBUSTNESS.md). Other
+    subprocess failures (OSError, CalledProcessError) propagate for the
+    caller's own handling."""
+    argv = [str(a) for a in argv]
+    t = timeout if timeout is not None else subprocess_timeout()
+    try:
+        return subprocess.run(
+            argv,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=t,
+            check=check,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise ExternalIOError(
+            f"{label or argv[0]}: subprocess timed out after {t:g}s "
+            f"(set {SUBPROCESS_TIMEOUT_ENV} to adjust): argv={argv}",
+            argv=argv,
+        ) from e

@@ -103,8 +103,14 @@ class Simulator:
         select_host: str = "first-max",
         enable_preemption: bool = True,
         rng=None,
+        budget=None,
     ):
         self.engine_kind = engine
+        # execution-guard budget (runtime/budget.py): the serial
+        # scheduling loop checks it between pod commits — the finest
+        # safe boundary the engine has — so a --deadline / SIGINT stops
+        # a 100k-pod serial run without tearing a half-committed pod
+        self.budget = budget
         self.use_greed = use_greed
         # KubeSchedulerConfiguration score-plugin weights
         # (scheduler/schedconfig.py); None = default profile
@@ -372,7 +378,13 @@ class Simulator:
         failed: List[UnscheduledPod] = []
         deferred: List[dict] = []
         queue = deque(pods)
+        scheduled = 0
         while queue:
+            if self.budget is not None and scheduled % 128 == 0:
+                self.budget.check(
+                    f"serial scheduling ({scheduled}/{len(pods)} pods)"
+                )
+            scheduled += 1
             pod = queue.popleft()
             if (pod.get("spec") or {}).get("nodeName"):
                 self.oracle.place_existing_pod(pod)
@@ -495,8 +507,11 @@ def simulate(
     select_host: str = "first-max",
     enable_preemption: bool = True,
     rng=None,
+    budget=None,
 ) -> SimulateResult:
-    """One-shot simulation (core.go:64-103)."""
+    """One-shot simulation (core.go:64-103). `budget` (runtime/budget)
+    is checked between apps and between serial pod commits; on expiry
+    or SIGINT the raised ExecutionHalted names the boundary."""
     sim = Simulator(
         engine=engine,
         use_greed=use_greed,
@@ -505,6 +520,7 @@ def simulate(
         select_host=select_host,
         enable_preemption=enable_preemption,
         rng=rng,
+        budget=budget,
     )
     # NOTE: the identity memos are deliberately NOT cleared here — the
     # planner's serial bisection calls simulate() once per guess over
@@ -520,6 +536,8 @@ def simulate(
     failed.extend(result.unscheduled_pods)
     preemptions.extend(result.preemptions)
     for app in apps:
+        if budget is not None:
+            budget.check(f"app boundary ({app.name})")
         result = sim.schedule_app(app)
         failed.extend(result.unscheduled_pods)
         preemptions.extend(result.preemptions)

@@ -22,6 +22,14 @@ Extenders run on the host (they are arbitrary RPC), so a simulation
 with extenders uses the serial oracle path — the scan cannot carry an
 HTTP round-trip per pod (SURVEY.md §2.3: extender fan-out maps to a
 host-callback escape hatch, not a kernel).
+
+I/O hardening (runtime/retry.py, docs/ROBUSTNESS.md): every extender
+call retries transient transport errors with capped exponential
+backoff and deterministic jitter; an endpoint that keeps failing trips
+its per-endpoint circuit breaker, after which calls fail fast (for an
+`ignorable` extender that is a loud trace-noted skip; a mandatory one
+fails the pod) — a dead extender must not hang a 100k-pod plan behind
+timeout × retries × pods.
 """
 
 from __future__ import annotations
@@ -32,13 +40,18 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..runtime.errors import ExternalIOError
+from ..runtime.retry import retry_io
+
 MAX_NODE_SCORE = 100
 MAX_EXTENDER_PRIORITY = 10
 DEFAULT_TIMEOUT_S = 5.0
 
 
-class ExtenderError(RuntimeError):
-    pass
+class ExtenderError(ExternalIOError, RuntimeError):
+    """Extender transport/protocol failure. Part of the runtime error
+    taxonomy (an ExternalIOError) while staying a RuntimeError for the
+    oracle's existing handling."""
 
 
 def _pod_uid(pod: dict) -> str:
@@ -116,17 +129,42 @@ class HTTPExtender:
 
     def _send(self, verb: str, args: dict) -> dict:
         url = self.config.url_prefix.rstrip("/") + "/" + verb
-        req = urllib.request.Request(
-            url,
-            data=json.dumps(args).encode(),
-            headers={"Content-Type": "application/json", "Accept": "application/json"},
-            method="POST",
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.config.http_timeout_s) as r:
+        body = json.dumps(args).encode()
+
+        def attempt() -> dict:
+            req = urllib.request.Request(
+                url,
+                data=body,
+                headers={
+                    "Content-Type": "application/json",
+                    "Accept": "application/json",
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.config.http_timeout_s
+            ) as r:
                 return json.load(r)
-        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
-            raise ExtenderError(f"extender {url}: {e}") from e
+
+        def retryable(e: BaseException) -> bool:
+            # 4xx and malformed bodies are protocol answers, not
+            # transient outages — fail them without retrying
+            if isinstance(e, urllib.error.HTTPError) and e.code < 500:
+                return False
+            return not isinstance(e, json.JSONDecodeError)
+
+        try:
+            return retry_io(
+                attempt,
+                label=f"extender {url}",
+                endpoint=url,
+                catch=(OSError, json.JSONDecodeError),
+                retryable=retryable,
+            )
+        except ExtenderError:
+            raise
+        except (ExternalIOError, OSError, json.JSONDecodeError) as e:
+            raise ExtenderError(f"extender {url}: {e}", endpoint=url) from e
 
     def filter(
         self, pod: dict, nodes: List[dict]

@@ -33,3 +33,13 @@ def _deterministic_names():
 
     reset_name_counter()
     yield
+
+
+@pytest.fixture(autouse=True)
+def _fresh_io_state():
+    # per-endpoint circuit breakers are process-global (runtime/retry);
+    # one test's deliberately dead endpoint must not fail-fast another's
+    from open_simulator_tpu.runtime.retry import reset_io_state
+
+    reset_io_state()
+    yield

@@ -7,9 +7,10 @@ import numpy as np
 import pytest
 import yaml as _yaml
 
-import open_simulator_tpu.parallel.sweep as sweep_mod
+import open_simulator_tpu.runtime.guard as guard_mod
 from open_simulator_tpu.models.decode import ResourceTypes
-from open_simulator_tpu.parallel.sweep import CapacitySweep, run_chunked
+from open_simulator_tpu.parallel.sweep import CapacitySweep
+from open_simulator_tpu.runtime.guard import run_chunked
 from open_simulator_tpu.resilience.chaos import (
     ChaosEngine,
     perturbed_cluster,
@@ -374,7 +375,7 @@ def test_run_chunked_halves_on_oom_and_notes(monkeypatch):
     def evaluate(lo, hi):
         return [i * 10 for i in range(lo, hi)]
 
-    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(2, calls))
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _counting_injector(2, calls))
     GLOBAL.reset()
     out = run_chunked(evaluate, 8, label="sweep")
     assert out == [i * 10 for i in range(8)]
@@ -386,7 +387,7 @@ def test_run_chunked_halves_on_oom_and_notes(monkeypatch):
 
 
 def test_run_chunked_serial_floor_and_non_oom_propagates(monkeypatch):
-    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(0, []))
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _counting_injector(0, []))
     GLOBAL.reset()
     out = run_chunked(
         lambda lo, hi: list(range(lo, hi)),
@@ -397,7 +398,7 @@ def test_run_chunked_serial_floor_and_non_oom_propagates(monkeypatch):
     assert out == [0, -1, -2]
     assert "sweep-serial-fallback" in GLOBAL.notes
     # without a serial floor the OOM propagates once chunks reach 1
-    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(0, []))
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _counting_injector(0, []))
     with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
         run_chunked(lambda lo, hi: list(range(lo, hi)), 2, label="sweep")
     # a non-OOM error is never swallowed
@@ -405,7 +406,7 @@ def test_run_chunked_serial_floor_and_non_oom_propagates(monkeypatch):
     def boom(chunk_len):
         raise RuntimeError("shape mismatch (not memory)")
 
-    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", boom)
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", boom)
     with pytest.raises(RuntimeError, match="shape mismatch"):
         run_chunked(lambda lo, hi: [], 4, label="sweep", serial_fallback=id)
 
@@ -423,7 +424,7 @@ def test_probe_many_oom_chunking_matches_clean_run(monkeypatch):
     clean = sweep_clean.probe_many(counts)
 
     sweep_oom = CapacitySweep(cluster, apps, new_node, max(counts))
-    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(2, []))
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _counting_injector(2, []))
     GLOBAL.reset()
     chunked = sweep_oom.probe_many(counts)
     assert "sweep-chunk-halving" in GLOBAL.notes
@@ -434,7 +435,7 @@ def test_probe_many_oom_chunking_matches_clean_run(monkeypatch):
     # chunking bottoms out: every scenario through the serial oracle,
     # still bit-identical to the batched scan
     sweep_serial = CapacitySweep(cluster, apps, new_node, max(counts))
-    monkeypatch.setattr(sweep_mod, "_OOM_INJECT", _counting_injector(0, []))
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _counting_injector(0, []))
     GLOBAL.reset()
     serial = sweep_serial.probe_many(counts)
     assert "sweep-serial-fallback" in GLOBAL.notes
@@ -490,10 +491,11 @@ def test_cli_chaos_json_deterministic(tmp_path, capsys):
 
     cfg = _write_cli_config(tmp_path)
     # the planner picks +0 (6 cpu fits 8); chaos over a fixed count
-    # shows single failures stranding pods -> exit 2
+    # shows single failures stranding pods -> exit 1 (infeasible;
+    # docs/ROBUSTNESS.md exit-code table)
     rc = main(["chaos", "-f", cfg, "--failures", "1", "--format", "json"])
     out1 = capsys.readouterr().out
-    assert rc == 2
+    assert rc == 1
     doc = json.loads(out1)
     assert doc["failures"] == 1 and doc["total"] == doc["survived"] + 2
     assert all(
@@ -545,6 +547,10 @@ def test_cli_bad_input_errors_cleanly_not_tracebacks(tmp_path, capsys):
             ["apply", "-f", cfg, "-i", "--tolerate-node-failures", "1"],
             "not available in interactive mode",
         ),
+        (
+            ["apply", "-f", cfg, "-i", "--deadline", "5"],
+            "not available in interactive mode",
+        ),
         (["chaos", "-f", cfg, "--new-node-count", "-1"], "must be >= 0"),
         (
             [
@@ -560,5 +566,5 @@ def test_cli_bad_input_errors_cleanly_not_tracebacks(tmp_path, capsys):
     for argv, expect in cases:
         rc = main(argv)
         captured = capsys.readouterr()
-        assert rc == 1, argv
+        assert rc == 2, argv  # input error (docs/ROBUSTNESS.md)
         assert expect in captured.err, (argv, captured.err)

@@ -15,6 +15,11 @@ small pyflakes-class checker built on the stdlib `ast`:
   allowlisted by (file, enclosing function) in BROAD_EXCEPT_ALLOW
 - S110 silent `except ...: pass` handlers in the same scope — a
   swallowed exception must at least record why (trace note / log)
+- S113 `urllib.request.urlopen` / `subprocess.run` (and friends)
+  without an explicit `timeout=` in first-party runtime code — an
+  unbounded external call can hang a whole plan; every I/O call site
+  names its timeout (runtime/retry.py holds the configurable
+  defaults). Audited exceptions go in IO_TIMEOUT_ALLOW.
 - E711 comparisons to None with ==/!=
 - F541 f-strings without any placeholder
 - B011/assert-tuple: `assert (x, y)` is always true
@@ -50,7 +55,31 @@ BROAD_EXCEPT_ALLOW = {
     # cleanup on close — audited silent-pass survivors
     ("open_simulator_tpu/models/chart.py", "_eval_atom"),
     ("open_simulator_tpu/models/kubeclient.py", "close"),
+    # ladder executor: classifies via classify_device_error and either
+    # re-raises typed or downgrades with a trace note — never swallows
+    ("open_simulator_tpu/runtime/guard.py", "run_laddered"),
+    # signal-handler restore at interpreter teardown: ValueError means
+    # "not the main thread anymore", there is nothing left to restore
+    ("open_simulator_tpu/runtime/budget.py", "sigint_to_budget"),
 }
+
+# I/O entry points that hang forever without a timeout; calls in
+# first-party runtime code must pass `timeout=` explicitly (S113).
+IO_TIMEOUT_FUNCS = {
+    "urllib.request.urlopen",
+    "urlopen",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "Popen",
+}
+
+# Audited call sites allowed without an explicit timeout, keyed like
+# BROAD_EXCEPT_ALLOW by (repo-relative path, enclosing function).
+# Currently empty: every first-party I/O call names its timeout.
+IO_TIMEOUT_ALLOW: set = set()
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent
 _EXEMPT_TOPDIRS = {"tests", "tools"}
@@ -228,6 +257,36 @@ class _Checker(ast.NodeVisitor):
                     "exception is safe to swallow (trace note / log) or "
                     "narrow it away",
                 )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _dotted_name(func) -> str:
+        parts = []
+        while isinstance(func, ast.Attribute):
+            parts.append(func.attr)
+            func = func.value
+        if isinstance(func, ast.Name):
+            parts.append(func.id)
+            return ".".join(reversed(parts))
+        return ""
+
+    def visit_Call(self, node):
+        # S113 polices the same first-party runtime scope as BLE001
+        if self.police_broad_except:
+            name = self._dotted_name(node.func)
+            if name in IO_TIMEOUT_FUNCS and not any(
+                kw.arg == "timeout" for kw in node.keywords
+            ):
+                ctx = self._func_stack[-1] if self._func_stack else "<module>"
+                if (self.rel, ctx) not in IO_TIMEOUT_ALLOW:
+                    self.report(
+                        node.lineno,
+                        "S113",
+                        f"'{name}' without an explicit timeout= in '{ctx}' "
+                        "— an unbounded external call can hang the plan "
+                        "(audited exceptions go in tools/lint.py "
+                        "IO_TIMEOUT_ALLOW)",
+                    )
         self.generic_visit(node)
 
     def visit_Compare(self, node):

@@ -194,60 +194,115 @@ def replay_masked(sweep, valid, placements):
     import numpy as np
 
     from ..scheduler.core import NodeStatus, SimulateResult, UnscheduledPod
+    from ..scheduler.engine import build_bulk_tables
     from ..scheduler.oracle import ClassCommitCache, Oracle, simple_commit_mask
+    from ..utils.trace import profiled
 
     valid = np.asarray(valid)
     kept = [i for i in range(len(sweep.oracle.nodes)) if valid[i]]
     nodes = [sweep.oracle.nodes[i].node for i in kept]
     oracle = Oracle(nodes)
-    local_of = {sweep_i: local_i for local_i, sweep_i in enumerate(kept)}
+    # sweep node index -> local replay index, vectorized (-1 unknown)
+    local_of_arr = np.full(len(sweep.oracle.nodes) + 1, -1, dtype=np.int64)
+    for local_i, sweep_i in enumerate(kept):
+        local_of_arr[sweep_i] = local_i
     # classes with no GPU/storage side effects take a minimal bind
-    # (nodeName + phase + NodeInfo accounting) — the general
-    # _reserve_and_bind re-checks GPU/storage/extenders per pod, which
-    # is most of the replay wall-clock at 100k pods
+    # (nodeName + phase + NodeInfo accounting) — and contiguous runs of
+    # them commit in BULK (oracle.commit_simple_bulk: per-node
+    # scatter-add of per-class summary deltas), which the general
+    # per-pod walk can't touch: the replay used to be most of the
+    # 100k-pod capacity plan's host tail
     batch = sweep.batch
     simple_class = simple_commit_mask(batch, bool(sweep.oracle.extenders))
-    class_of_pod = np.asarray(batch.class_of_pod)
-    had_node_name = sweep.had_node_name
+    field_tbl, ports_of, scalars_of, bulk_ok = build_bulk_tables(
+        batch, simple_class
+    )
+    class_of_pod = np.asarray(batch.class_of_pod, dtype=np.int64)
+    had_node_name = np.asarray(sweep.had_node_name, dtype=bool)
+    place_arr = np.asarray(placements, dtype=np.int64)
+    pods = sweep.pods
     failed = []
     commit_cache = ClassCommitCache()
-    for p_i, (pod, idx) in enumerate(zip(sweep.pods, placements)):
-        idx = int(idx)
-        if idx == -2:  # inactive in this scenario (disabled-node ds pod)
-            continue
-        # original pins only: a previous replay may have written
-        # nodeName/phase into this shared pod dict — clear those so
-        # failure reasons (_find_feasible's NodeName filter) and the
-        # reported pod see the pre-bind state
-        if not had_node_name[p_i]:
-            (pod.get("spec") or {}).pop("nodeName", None)
-            (pod.get("status") or {}).pop("phase", None)
-            name = None
-        else:
-            name = (pod.get("spec") or {}).get("nodeName")
-        if name:
-            if name in oracle.node_index:
-                oracle.place_existing_pod(pod)
-            # else dangling: kept in the tracker, never scheduled
-            # (reference simulator.go:221-229)
-        elif idx < 0:
-            if len(failed) < MAX_DETAILED_REASONS:
-                _, reasons, _ = oracle._find_feasible(pod)
-                reason = Oracle._failure_message(pod, reasons)
-            else:
-                meta = pod.get("metadata") or {}
-                reason = (
-                    f"failed to schedule pod ({meta.get('namespace', 'default')}/"
-                    f"{meta.get('name', '')}): Unschedulable: "
-                    f"0/{len(nodes)} nodes are available"
-                )
-            failed.append(UnscheduledPod(pod=pod, reason=reason))
-        elif simple_class[class_of_pod[p_i]]:
-            commit_cache.commit(
-                oracle, pod, oracle.nodes[local_of[idx]], int(class_of_pod[p_i])
+    with profiled("engine/replay"):
+        # event pods (inactive / pinned / failed / side-effect classes)
+        # take the exact per-pod path in order; runs between them bulk
+        bulk_mask = (
+            (place_arr >= 0)
+            & ~had_node_name
+            & simple_class[class_of_pod]
+            & bulk_ok[class_of_pod]
+        )
+
+        def bulk(a, b):
+            if b <= a:
+                return
+            local = local_of_arr[place_arr[a:b]]
+            if (local < 0).any():
+                # a placement names a node outside this scenario's mask
+                # — scan invariant violation; fail loudly (the per-pod
+                # path would have KeyError'd on the same input)
+                bad = int(place_arr[a:b][local < 0][0])
+                raise KeyError(f"placement on masked-off node index {bad}")
+            # prios=None is exact here: CapacitySweep refuses any
+            # priority-bearing pod at construction (PrioritySignalError,
+            # parallel/sweep.py) and neither oracle carries priority
+            # classes, so every effective priority is provably 0 — the
+            # documented commit_simple_bulk fast-path contract
+            oracle.commit_simple_bulk(
+                pods[a:b],
+                local,
+                class_of_pod[a:b],
+                field_tbl, ports_of, scalars_of,
             )
-        else:
-            oracle._reserve_and_bind(pod, oracle.nodes[local_of[idx]])
+
+        prev = 0
+        for p_i in np.flatnonzero(~bulk_mask).tolist():
+            bulk(prev, p_i)
+            prev = p_i + 1
+            pod = pods[p_i]
+            idx = int(place_arr[p_i])
+            if idx == -2:  # inactive in this scenario (disabled-node ds pod)
+                continue
+            # original pins only: a previous replay may have written
+            # nodeName/phase into this shared pod dict — clear those so
+            # failure reasons (_find_feasible's NodeName filter) and the
+            # reported pod see the pre-bind state
+            if not had_node_name[p_i]:
+                (pod.get("spec") or {}).pop("nodeName", None)
+                (pod.get("status") or {}).pop("phase", None)
+                name = None
+            else:
+                name = (pod.get("spec") or {}).get("nodeName")
+            if name:
+                if name in oracle.node_index:
+                    oracle.place_existing_pod(pod)
+                # else dangling: kept in the tracker, never scheduled
+                # (reference simulator.go:221-229)
+            elif idx < 0:
+                if len(failed) < MAX_DETAILED_REASONS:
+                    _, reasons, _ = oracle._find_feasible(pod)
+                    reason = Oracle._failure_message(pod, reasons)
+                else:
+                    meta = pod.get("metadata") or {}
+                    reason = (
+                        f"failed to schedule pod ({meta.get('namespace', 'default')}/"
+                        f"{meta.get('name', '')}): Unschedulable: "
+                        f"0/{len(nodes)} nodes are available"
+                    )
+                failed.append(UnscheduledPod(pod=pod, reason=reason))
+            else:
+                local_i = int(local_of_arr[idx])
+                if local_i < 0:
+                    # same loud failure as the bulk path: a negative
+                    # index would silently wrap to the LAST node
+                    raise KeyError(f"placement on masked-off node index {idx}")
+                if simple_class[class_of_pod[p_i]]:
+                    commit_cache.commit(
+                        oracle, pod, oracle.nodes[local_i], int(class_of_pod[p_i])
+                    )
+                else:
+                    oracle._reserve_and_bind(pod, oracle.nodes[local_i])
+        bulk(prev, len(pods))
     status = [NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes]
     return SimulateResult(unscheduled_pods=failed, node_status=status), oracle
 

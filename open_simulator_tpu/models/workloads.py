@@ -217,7 +217,7 @@ def _expand_template(owner: dict, kind: str, count: int) -> list:
             )
             meta["labels"] = shared_labels
             pod = {"metadata": meta, "spec": dict(shared_spec)}
-            validate_pod_name(pod)
+            _validate_pod_name_cached(pod)
         pods.append(pod)
     return pods
 
@@ -309,7 +309,102 @@ def _set_storage_annotation(pods: list, volume_claim_templates: list):
         pod["metadata"].setdefault("annotations", {})[ANNO_POD_LOCAL_STORAGE] = payload
 
 
-def pod_from_pod(pod: dict, _interned: Optional[dict] = None) -> dict:
+# raw-pod -> intern-key memo: planners and benches expand the SAME
+# decoded pod dicts once per simulate() call, and the sort-keyed
+# json.dumps content key below is ~60% of warm re-expansion wall-clock
+# at 20k bare pods. Keyed on the raw pod's identity — the entry holds
+# a strong ref to the pod, so a key hit proves identity (the
+# utils/memo.py contract; decoded inputs are read-only after load).
+# The sentinel marks non-JSON-serializable pods that must take the
+# full per-pod path every time.
+_POD_KEY_CACHE: dict = {}
+_POD_KEY_CACHE_MAX = 1 << 17
+_UNSERIALIZABLE = object()
+
+
+def _register_pod_key_cache():
+    from ..utils.memo import register_cache
+
+    register_cache(_POD_KEY_CACHE.clear)
+
+
+_register_pod_key_cache()
+
+
+def _pod_intern_key(pod: dict):
+    hit = _POD_KEY_CACHE.get(id(pod))
+    if hit is not None:
+        return hit[1]
+    meta = pod.get("metadata") or {}
+    try:
+        # everything except metadata.name participates in the key,
+        # so a clone can only differ from its first by name —
+        # generateName, apiVersion/kind, status etc. are all
+        # shared content
+        key = json.dumps(
+            {
+                "metadata": {k: v for k, v in meta.items() if k != "name"},
+                "rest": {k: v for k, v in pod.items() if k != "metadata"},
+            },
+            sort_keys=True,
+        )
+    except (TypeError, ValueError):
+        key = _UNSERIALIZABLE
+    if len(_POD_KEY_CACHE) >= _POD_KEY_CACHE_MAX:
+        _POD_KEY_CACHE.clear()
+    _POD_KEY_CACHE[id(pod)] = (pod, key)
+    return key
+
+
+# validated pod NAMES (value-keyed — strings are immutable): re-runs
+# over the same decoded inputs re-validate the same 20k-100k generated
+# names against the same DNS-1123 regex for zero information. Only
+# successes are cached; failures raise before insertion.
+_VALID_NAMES: set = set()
+_VALID_NAMES_MAX = 1 << 17
+
+
+def _validate_pod_name_cached(pod: dict) -> None:
+    name = (pod.get("metadata") or {}).get("name") or ""
+    if name in _VALID_NAMES:
+        return
+    validate_pod_name(pod)
+    if len(_VALID_NAMES) >= _VALID_NAMES_MAX:
+        _VALID_NAMES.clear()
+    _VALID_NAMES.add(name)
+
+
+class ExpandIndex:
+    """Group index emitted alongside workload expansion: pods of one
+    group are clones of one content-identical template — same spec,
+    labels, annotations content, nodeName; everything but
+    metadata.name — so queue-sort keys, effective priorities, encode
+    class keys, and pin targets resolve ONCE per group and broadcast
+    by numpy indexing instead of per-pod Python passes
+    (scheduler/core.py schedule_app, ops/encode.py encode_batch).
+
+    `group_of[i]` is the group of the i-th expanded pod, `firsts[g]`
+    a representative pod of group g (one of the expanded pods)."""
+
+    __slots__ = ("group_of", "firsts")
+
+    def __init__(self):
+        self.group_of: list = []
+        self.firsts: list = []
+
+    def new_group(self, first: dict) -> int:
+        self.firsts.append(first)
+        return len(self.firsts) - 1
+
+    def mark(self, gid: int) -> None:
+        self.group_of.append(gid)
+
+    def mark_group(self, first: dict, count: int) -> None:
+        gid = self.new_group(first)
+        self.group_of.extend([gid] * count)
+
+
+def pod_from_pod(pod: dict, _interned: Optional[dict] = None, index=None) -> dict:
     """MakeValidPod for a bare Pod resource. With `_interned` (a
     per-batch dict the caller threads through), raw pods whose content
     — minus name/generateName — is identical sanitize ONCE and clone
@@ -321,44 +416,55 @@ def pod_from_pod(pod: dict, _interned: Optional[dict] = None) -> dict:
     shapes costs a handful of deepcopy+validation passes instead of
     20k, and the shared spec objects let the encode class-key memo hit
     by identity (ops/encode.py). Non-JSON-serializable input falls
-    back to the full per-pod path."""
+    back to the full per-pod path. `index` (an ExpandIndex) records
+    the pod's content group."""
     if _interned is None:
-        return make_valid_pod(pod)
+        pod = make_valid_pod(pod)
+        if index is not None:
+            index.mark_group(pod, 1)
+        return pod
     meta = pod.get("metadata") or {}
-    try:
-        # everything except metadata.name participates in the key, so a
-        # clone can only differ from its first by name — generateName,
-        # apiVersion/kind, status etc. are all shared content
-        key = json.dumps(
-            {
-                "metadata": {k: v for k, v in meta.items() if k != "name"},
-                "rest": {k: v for k, v in pod.items() if k != "metadata"},
-            },
-            sort_keys=True,
+    key = _pod_intern_key(pod)
+    if key is _UNSERIALIZABLE:
+        pod = make_valid_pod(pod)
+        if index is not None:
+            index.mark_group(pod, 1)
+        return pod
+    entry = _interned.get(key)
+    if entry is None:
+        first = make_valid_pod(pod)
+        gid = index.new_group(first) if index is not None else -1
+        fmeta = first["metadata"]
+        # clone template, precomputed once per group: the non-varying
+        # top-level items and the shared sub-dict refs
+        base = {
+            k: v for k, v in first.items() if k not in ("metadata", "spec", "status")
+        }
+        _interned[key] = (
+            first, gid, base, fmeta,
+            fmeta.get("annotations") or {}, first["spec"],
+            first.get("status"),
         )
-    except (TypeError, ValueError):
-        return make_valid_pod(pod)
-    first = _interned.get(key)
-    if first is None:
-        _interned[key] = first = make_valid_pod(pod)
+        if index is not None:
+            index.mark(gid)
         return first
-    fmeta = first["metadata"]
+    first, gid, base, fmeta, fanno, fspec, fstatus = entry
     clone_meta = dict(fmeta)
     clone_meta["name"] = meta.get("name", "")
-    clone_meta["annotations"] = dict(fmeta.get("annotations") or {})
-    clone = {
-        k: v for k, v in first.items() if k not in ("metadata", "spec", "status")
-    }
+    clone_meta["annotations"] = dict(fanno)
+    clone = dict(base)
     clone["metadata"] = clone_meta
-    clone["spec"] = dict(first["spec"])
-    if "status" in first:
-        clone["status"] = copy.deepcopy(first["status"])
+    clone["spec"] = dict(fspec)
+    if fstatus is not None:
+        clone["status"] = copy.deepcopy(fstatus)
     if clone_meta.get("name") or not clone_meta.get("generateName"):
         # name present: format-validate it; name AND generateName both
         # absent: raise the same required error the full path would.
         # generateName-only clones skip: their generateName is part of
         # the intern key, so the first's full validation covered it
-        validate_pod_name(clone)
+        _validate_pod_name_cached(clone)
+    if index is not None:
+        index.mark(gid)
     return clone
 
 
@@ -424,36 +530,60 @@ def pods_from_daemon_set(ds: dict, nodes: list) -> list:
 # ------------------------------------------------------------------- facade
 
 
-def pods_excluding_daemon_sets(resources) -> list:
-    """GetValidPodExcludeDaemonSet (pkg/simulator/utils.go:76-136)."""
+def pods_excluding_daemon_sets(resources, index: Optional[ExpandIndex] = None) -> list:
+    """GetValidPodExcludeDaemonSet (pkg/simulator/utils.go:76-136).
+    With `index`, records each pod's content group (ExpandIndex): every
+    `_expand_template` call yields one group (replicas are clones of
+    one validated template), bare pods group by intern key."""
     pods = []
     interned: dict = {}
     for p in resources.pods:
-        pods.append(pod_from_pod(p, _interned=interned))
+        pods.append(pod_from_pod(p, _interned=interned, index=index))
+
+    def extend(ps):
+        pods.extend(ps)
+        if index is not None and ps:
+            index.mark_group(ps[0], len(ps))
+
     for d in resources.deployments:
-        pods.extend(pods_from_deployment(d))
+        extend(pods_from_deployment(d))
     for rs in resources.replica_sets:
-        pods.extend(pods_from_replica_set(rs))
+        extend(pods_from_replica_set(rs))
     for rc in resources.replication_controllers:
-        pods.extend(pods_from_replication_controller(rc))
+        extend(pods_from_replication_controller(rc))
     for sts in resources.stateful_sets:
-        pods.extend(pods_from_stateful_set(sts))
+        extend(pods_from_stateful_set(sts))
     for job in resources.jobs:
-        pods.extend(pods_from_job(job))
+        extend(pods_from_job(job))
     for cj in resources.cron_jobs:
-        pods.extend(pods_from_cron_job(cj))
+        extend(pods_from_cron_job(cj))
     return pods
 
 
-def generate_valid_pods_from_app(app_name: str, resources, nodes: list) -> list:
+def generate_valid_pods_from_app(
+    app_name: str, resources, nodes: list, index: Optional[ExpandIndex] = None
+) -> list:
     """GenerateValidPodsFromAppResources (pkg/simulator/utils.go:36-73):
     regular workloads + per-node daemonset pods, all labelled with the
-    app name."""
-    pods = pods_excluding_daemon_sets(resources)
+    app name. With `index` (ExpandIndex) the app-name label stamps once
+    per GROUP — clones share their labels dict with the group's first
+    by construction, so the write is identical, minus one pass over
+    100k pods."""
+    pods = pods_excluding_daemon_sets(resources, index=index)
     for ds in resources.daemon_sets:
-        pods.extend(pods_from_daemon_set(ds, nodes))
-    for pod in pods:
-        pod["metadata"].setdefault("labels", {})[LABEL_APP_NAME] = app_name
+        ds_pods = pods_from_daemon_set(ds, nodes)
+        pods.extend(ds_pods)
+        if index is not None:
+            for pod in ds_pods:
+                # daemonset pods pin per node via matchFields — every
+                # pod is its own content group
+                index.mark_group(pod, 1)
+    if index is not None:
+        for first in index.firsts:
+            first["metadata"].setdefault("labels", {})[LABEL_APP_NAME] = app_name
+    else:
+        for pod in pods:
+            pod["metadata"].setdefault("labels", {})[LABEL_APP_NAME] = app_name
     return pods
 
 

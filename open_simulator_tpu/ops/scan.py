@@ -776,6 +776,15 @@ def run_scan_masked(
     that do not exist in this scenario (e.g. daemonset pods of disabled
     new nodes). Inactive pods commit nothing and report INACTIVE.
 
+    The tiered priority engine is a second caller of the pod mask
+    (engine.scan_active): escape rounds re-dispatch the SAME batch
+    encoding with the committed prefix masked off, so every round
+    reuses one compiled program (shapes never change) — the masked-pod
+    contract it relies on is exactly the sweep's: an inactive pod
+    mutates no carry state and, under features.sample, consumes ZERO
+    Go-RNG words (the escape rewind arithmetic in
+    engine.rewind_sample_rng depends on this).
+
     `features` (a ScanFeatures, static under jit) specializes the
     compiled scan to the subsystems the batch uses; None derives it from
     `static`/`pinned_node`, which must then be concrete arrays.

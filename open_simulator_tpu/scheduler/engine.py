@@ -7,24 +7,40 @@ Every commit the scan makes is replayed on the host through the same
 binding code the oracle uses, so oracle state after an engine batch is
 identical to having scheduled serially — this is asserted by the
 conformance tests (tests/test_engine_conformance.py).
+
+Batch lifecycle (the tiered priority engine's contract): `begin_batch`
+encodes a pod batch ONCE — class tensors, features, the XLA scan
+static, the port vocabulary; `scan_active(mask)` then dispatches one
+scan over any active subset of that batch against the oracle's CURRENT
+dynamic state. A priority round that escapes re-dispatches the same
+encoding with the committed prefix masked off instead of re-encoding
+(and re-compiling: the shapes never change) the shrinking remainder —
+an escape-heavy batch pays per round only the dynamic re-encode and
+the dispatch, not the full host encode. `schedule(pods)` is the
+one-shot form.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
 from ..ops.encode import (
     ClusterStatic,
     encode_batch,
-    encode_cluster,
+    encode_cluster_cached,
     encode_dynamic,
     features_of_batch,
 )
 from .oracle import Oracle
 
 __all__ = ["SampleRngOverflow", "TpuEngine"]
+
+# per-class summary integers above this magnitude lose int64 headroom
+# in the bulk scatter-add; such classes (a >2^55-byte request is ~36 PB
+# — malformed input, not a workload) take the per-pod commit path
+_BULK_MAX_ABS = 1 << 55
 
 
 class SampleRngOverflow(RuntimeError):
@@ -39,21 +55,28 @@ class TpuEngine:
     """Holds the oracle plus a per-node-set cache of the cluster
     encoding: with K apps on an N-node cluster the O(N) ClusterStatic
     build runs once, not K times (per-batch state — DynamicState, pod
-    statics, port vocab — is still rebuilt per schedule call)."""
+    statics, port vocab — is still rebuilt per begin_batch call)."""
 
     def __init__(self, oracle: Oracle):
         self.oracle = oracle
         self._cluster: ClusterStatic = None
         self._cache_key = None
-        # per-schedule()-call replay fast path (class ids are batch
-        # scoped): classes with no GPU/storage/extender side effects
-        # commit via per-class summaries instead of the general bind
+        # per-batch replay fast path (class ids are batch scoped):
+        # classes with no GPU/storage/extender side effects commit via
+        # per-class summaries instead of the general bind
         self._last_class_of = None
         self._last_simple = None
         self._class_commit_info = None
-        # sample mode: (pre-batch rng history, per-pod consumed-word
-        # cumsum) of the last scanned batch — rewind_sample_rng uses it
-        # when a priority-scan escape discards the scanned tail
+        # batch encoding reused across masked rounds (begin_batch)
+        self._batch = None
+        self._batch_pods: Optional[List[dict]] = None
+        self._features = None
+        self._scan_static = None
+        self._scan_static_cluster = None
+        self._bulk_tbl = None
+        # sample mode: (pre-round rng history, per-pod consumed-word
+        # cumsum) of the last dispatched scan — rewind_sample_rng uses
+        # it when a priority-scan escape discards the scanned tail
         self._last_rng = None
 
     def cluster_static(self) -> ClusterStatic:
@@ -63,51 +86,73 @@ class TpuEngine:
         # cache for the next
         key = (len(self.oracle.nodes), self.oracle.alloc_epoch)
         if self._cluster is None or self._cache_key != key:
-            self._cluster = encode_cluster(self.oracle)
+            self._cluster = encode_cluster_cached(self.oracle)
             self._cache_key = key
         return self._cluster
 
-    def schedule(self, pods: List[dict]) -> np.ndarray:
-        """Returns placements[P]: node index or -1 (unschedulable).
+    def begin_batch(self, pods: List[dict], groups=None) -> None:
+        """Encode `pods` once for any number of scan_active dispatches.
 
         Pods with a spec.nodeName naming an unknown node must be
         filtered out by the caller (the reference leaves them dangling
-        in the tracker, simulator.go:221-229).
-        """
-        import jax.numpy as jnp
-
-        from ..ops import scan as scan_ops
-        from ..ops.encode import to_scan_static, to_scan_state
-        from ..utils.trace import phase, profiled
+        in the tracker, simulator.go:221-229). `groups` is the
+        (group_of, firsts) content-group index from workload expansion
+        (workloads.ExpandIndex) — class keys then resolve once per
+        group instead of once per pod."""
+        from ..utils.trace import phase
 
         oracle = self.oracle
         with phase("engine/encode"):
             cluster = self.cluster_static()
-            batch = encode_batch(oracle, cluster, pods)
-            # replay fast-path tables (commit_host_at): batch-scoped
+            batch = encode_batch(oracle, cluster, pods, groups=groups)
             from .oracle import ClassCommitCache, simple_commit_mask
 
+            self._batch = batch
+            self._batch_pods = pods
             self._last_class_of = np.asarray(batch.class_of_pod)
             self._last_simple = simple_commit_mask(batch, bool(oracle.extenders))
             self._class_commit_info = ClassCommitCache()
-            dyn = encode_dynamic(oracle, cluster)
+            self._bulk_tbl = None
+            self._scan_static = None
             sample = getattr(oracle, "select_host", "first-max") == "sample"
-            features = features_of_batch(
+            self._features = features_of_batch(
                 cluster, batch,
                 weights=getattr(oracle, "score_weights", None),
                 sample=sample,
             )
-            from ..ops import pallas_scan
 
+    def scan_active(self, active: np.ndarray) -> np.ndarray:
+        """One masked scan over the begin_batch encoding against the
+        oracle's CURRENT state. Returns placements for the full batch:
+        node index, -1 (active but unschedulable), or -2 (inactive —
+        `ops.scan.INACTIVE`, positions masked off by `active`)."""
+        import jax.numpy as jnp
+
+        from ..ops import pallas_scan
+        from ..ops import scan as scan_ops
+        from ..ops.encode import to_scan_static, to_scan_state
+        from ..utils.trace import GLOBAL, phase, profiled
+
+        oracle = self.oracle
+        batch = self._batch
+        sample = bool(getattr(self._features, "sample", False))
+        with phase("engine/encode"):
+            cluster = self.cluster_static()
+            dyn = encode_dynamic(oracle, cluster)
             plan = (
                 pallas_scan.build_plan(
-                    cluster, batch, dyn, features, weights=features.weights
+                    cluster, batch, dyn, self._features,
+                    weights=self._features.weights,
                 )
                 if pallas_scan.should_use()
                 else None
             )
             if plan is None:
-                static = to_scan_static(cluster, batch)
+                # the scan static survives masked rounds; only a
+                # ClusterStatic rebuild (GPU alloc epoch) invalidates it
+                if self._scan_static is None or self._scan_static_cluster is not cluster:
+                    self._scan_static = to_scan_static(cluster, batch)
+                    self._scan_static_cluster = cluster
                 init = to_scan_state(dyn, batch)
                 if sample:
                     # the scan consumes the oracle's Go RNG stream: hand
@@ -120,8 +165,6 @@ class TpuEngine:
                             np.array(hist0, dtype=np.uint64)
                         )
                     )
-        from ..utils.trace import GLOBAL
-
         # never a silent fallback: name why the fused kernel was out of
         # scope or unavailable (pallas_scan.fallback_reason)
         GLOBAL.note(
@@ -137,18 +180,20 @@ class TpuEngine:
                 out, _final = pallas_scan.run_scan_pallas(
                     plan,
                     batch.class_of_pod,
-                    np.ones(len(pods), bool),
+                    np.asarray(active, bool),
                     np.ones(cluster.n, bool),
                     pinned=batch.pinned_node,
                 )
-            return out
+            return np.asarray(out)
         with profiled("engine/scan"):
-            placements, final_state = scan_ops.run_scan(
-                static,
+            placements, final_state = scan_ops.run_scan_masked(
+                self._scan_static,
                 init,
                 jnp.asarray(batch.class_of_pod),
                 jnp.asarray(batch.pinned_node),
-                features=features,
+                jnp.ones(cluster.n, bool),
+                jnp.asarray(np.asarray(active, bool)),
+                features=self._features,
             )
             if sample:
                 placements, consumed = placements
@@ -167,14 +212,21 @@ class TpuEngine:
             )
         return out
 
+    def schedule(self, pods: List[dict]) -> np.ndarray:
+        """Returns placements[P]: node index or -1 (unschedulable)."""
+        self.begin_batch(pods)
+        return self.scan_active(np.ones(len(pods), bool))
+
     def rewind_sample_rng(self, batch_pos: int) -> None:
         """Reposition the oracle's sample-mode stream to where it stood
-        BEFORE the last scanned batch's pod at `batch_pos` consumed its
+        BEFORE the last scanned round's pod at `batch_pos` consumed its
         draws. A priority-scan escape discards every scanned placement
         from the escape point on and reschedules those pods (serially,
-        then by rescanning), so their draws must be un-consumed — the
-        pre-batch history advanced by the consumed-word prefix is
-        exactly that position (gorand.advance_history)."""
+        then by re-dispatching a masked scan), so their draws must be
+        un-consumed — the pre-round history advanced by the
+        consumed-word prefix is exactly that position
+        (gorand.advance_history). Masked-off pods consume zero words,
+        so the cumsum is escape-round-local by construction."""
         if self._last_rng is None:
             return
         from ..utils.gorand import advance_history
@@ -204,3 +256,53 @@ class TpuEngine:
                 )
                 return
         self.commit_host(pod, node_idx)
+
+    def bulk_tables(self):
+        """(field_tbl[U,7] int64, ports_of_cls, scalars_of_cls,
+        bulk_ok[U] bool) for commit_host_bulk — the per-class
+        RequestSummary integers resolved once per batch (class members
+        share request/port content by class-key construction)."""
+        if self._bulk_tbl is None:
+            self._bulk_tbl = build_bulk_tables(self._batch, self._last_simple)
+        return self._bulk_tbl
+
+    def commit_host_bulk(self, pods, node_idx, cls_ids, prios=None):
+        """Bulk replay of a contiguous run of simple-class placements
+        (oracle.commit_simple_bulk). Callers gate on `simple &
+        bulk_ok`; anything else goes through commit_host_at."""
+        field_tbl, ports_of, scalars_of, _ok = self.bulk_tables()
+        self.oracle.commit_simple_bulk(
+            pods, node_idx, cls_ids, field_tbl, ports_of, scalars_of,
+            prios=prios,
+        )
+
+
+def build_bulk_tables(batch, simple_mask):
+    """Per-class commit tables from a PodBatch's class representatives
+    (shared by TpuEngine.commit_host_bulk and the capacity replay,
+    applier.replay_masked — the eligibility rule must stay identical in
+    both). Only classes marked simple get real rows; the rest never
+    reach the bulk path."""
+    from ..models import requests as req
+    from .oracle import _pod_host_ports
+
+    u = batch.u
+    field_tbl = np.zeros((u, 7), dtype=np.int64)
+    ports_of = [()] * u
+    scalars_of = [()] * u
+    bulk_ok = np.zeros(u, dtype=bool)
+    for u_i, pod in enumerate(batch.class_pods):
+        if not simple_mask[u_i]:
+            continue
+        s = req.pod_request_summary(pod)
+        vals = (s.mcpu, s.mem, s.eph, s.floor_mcpu, s.floor_mem,
+                s.nz_mcpu, s.nz_mem)
+        if any(abs(v) > _BULK_MAX_ABS for v in vals) or any(
+            abs(iv) > _BULK_MAX_ABS for _n, iv in s.scalars
+        ):
+            continue  # int64 headroom guard: per-pod path
+        field_tbl[u_i] = vals
+        ports_of[u_i] = tuple(_pod_host_ports(pod))
+        scalars_of[u_i] = s.scalars
+        bulk_ok[u_i] = True
+    return field_tbl, ports_of, scalars_of, bulk_ok

@@ -144,6 +144,29 @@ class NodeState:
     # open-local allocations committed at bind, keyed by (namespace,
     # name) — recorded so preemption can reverse them exactly
     local_allocs: Dict[Tuple[str, str], tuple] = field(default_factory=dict)
+    # copy-on-write: a pristine NodeState shares the decoded node dict
+    # read-only; the ONLY binding-time node mutation is the open-local
+    # storage annotation, which clones the metadata layers first via
+    # own_node(). (An eager 4-dict clone per node cost ~40 ms per
+    # Oracle at 10k nodes for runs that never touch storage.)
+    owns_node: bool = False
+
+    def own_node(self) -> dict:
+        """Clone the node's metadata layers before the first
+        annotation write, leaving the decoded source dict untouched
+        (spec/status stay shared read-only, as before)."""
+        if not self.owns_node:
+            meta = self.node.get("metadata") or {}
+            self.node = {
+                **self.node,
+                "metadata": {
+                    **meta,
+                    "labels": dict(meta.get("labels") or {}),
+                    "annotations": dict(meta.get("annotations") or {}),
+                },
+            }
+            self.owns_node = True
+        return self.node
 
     @property
     def name(self) -> str:
@@ -160,6 +183,36 @@ class NodeState:
     def alloc_int(self, resource: str) -> int:
         v = self.alloc.get(resource, Fraction(0))
         return v.numerator // v.denominator
+
+
+# per-source-node template memo (allocatable dict + GPU geometry):
+# one identity-keyed lookup per add_node instead of three — the entry
+# holds a strong ref to the node, so a key hit proves identity
+# (utils/memo.py contract; registered with clear_all_memos below)
+_NODE_TMPL_CACHE: dict = {}
+_NODE_TMPL_CACHE_MAX = 1 << 17
+
+
+def _node_template(node: dict):
+    hit = _NODE_TMPL_CACHE.get(id(node))
+    if hit is not None:
+        return hit[1], hit[2], hit[3]
+    alloc = req.node_allocatable(node)
+    gpu_count = stor.node_gpu_count(node)
+    per_dev = stor.node_gpu_per_device_memory(node) if gpu_count > 0 else 0
+    if len(_NODE_TMPL_CACHE) >= _NODE_TMPL_CACHE_MAX:
+        _NODE_TMPL_CACHE.clear()
+    _NODE_TMPL_CACHE[id(node)] = (node, alloc, gpu_count, per_dev)
+    return alloc, gpu_count, per_dev
+
+
+def _register_node_tmpl_cache():
+    from ..utils.memo import register_cache
+
+    register_cache(_NODE_TMPL_CACHE.clear)
+
+
+_register_node_tmpl_cache()
 
 
 # replica clones share their containers list, so the port scan runs
@@ -349,6 +402,11 @@ class Oracle:
         self.alloc_epoch = 0
         self.nodes: List[NodeState] = []
         self.node_index: Dict[str, int] = {}
+        # source (pre-clone) node dicts, in add order: the cross-run
+        # ClusterStatic cache keys on their identities (encode.py
+        # encode_cluster_cached) — strong refs per the IdentityMemo
+        # contract, so a key hit proves the same objects
+        self.source_nodes: List[dict] = []
         for n in nodes:
             self.add_node(n)
         # a fresh Oracle is a fresh scheduler run: stateful custom
@@ -378,25 +436,22 @@ class Oracle:
     def add_node(self, node: dict):
         # binding mutates ONLY node metadata annotations (storage VG
         # state via set_node_storage; gpu goes through ns.alloc) and
-        # labels are report-read — clone exactly those layers and share
-        # spec/status read-only. A full deepcopy of 10k nodes cost ~1 s
-        # per Oracle at bench scale for the same isolation.
-        meta = node.get("metadata") or {}
-        node = {
-            **node,
-            "metadata": {
-                **meta,
-                "labels": dict(meta.get("labels") or {}),
-                "annotations": dict(meta.get("annotations") or {}),
-            },
-        }
+        # labels are report-read — so the decoded dict is shared
+        # read-only and the metadata layers clone lazily on the FIRST
+        # storage-annotation write (NodeState.own_node copy-on-write;
+        # a full deepcopy of 10k nodes cost ~1 s per Oracle at bench
+        # scale, the eager metadata clone still ~40 ms)
+        self.source_nodes.append(node)
         ns = NodeState(node=node, index=len(self.nodes))
-        # copy: GPU accounting writes ns.alloc[gpu-count], and
-        # node_allocatable's result is a shared identity-keyed memo
-        ns.alloc = dict(req.node_allocatable(node))
-        gpu_count = stor.node_gpu_count(node)
+        alloc, gpu_count, per_dev = _node_template(node)
         if gpu_count > 0:
-            ns.gpu = GpuState(count=gpu_count, per_device_mem=stor.node_gpu_per_device_memory(node))
+            # copy: GPU accounting writes ns.alloc[gpu-count]; non-GPU
+            # nodes share the memoized allocatable read-only (no write
+            # path touches ns.alloc when ns.gpu is None)
+            ns.alloc = dict(alloc)
+            ns.gpu = GpuState(count=gpu_count, per_device_mem=per_dev)
+        else:
+            ns.alloc = alloc
         ns.storage = stor.parse_node_storage(node)
         self.nodes.append(ns)
         self.node_index[ns.name] = ns.index
@@ -1452,7 +1507,7 @@ class Oracle:
             dalloc = self._device_fit(dev_vols, ns.storage) if dev_vols else []
             for dev_idx, _size in dalloc or []:
                 ns.storage.devices[dev_idx].is_allocated = True
-            stor.set_node_storage(ns.node, ns.storage)
+            stor.set_node_storage(ns.own_node(), ns.storage)
             ns.local_allocs[self._pod_key(pod)] = (alloc or [], dalloc or [])
         # Simon Bind
         spec["nodeName"] = ns.name
@@ -1479,6 +1534,102 @@ class Oracle:
         return self._commit_known(
             pod, ns, req.pod_request_summary(pod), None
         )
+
+    def commit_simple_bulk(
+        self, pods, node_idx, cls_ids, field_tbl, ports_of_cls, scalars_of_cls,
+        prios=None,
+    ):
+        """Vectorized `commit_simple` over a contiguous run of
+        side-effect-free placements (the batched host replay of the
+        tiered scan engine and the capacity replay). Exact reduction of
+        per-pod `commit_simple` + `_commit_known` in the same order:
+
+        - per-NODE resource aggregates land as one scatter-add of the
+          per-class summary deltas (`field_tbl[u]` = (mcpu, mem, eph,
+          floor_mcpu, floor_mem, nz_mcpu, nz_mem) int64 — the exact
+          RequestSummary integers, summed in int64 so arithmetic stays
+          exact), applied once per touched node;
+        - `ns.pods` grows by one grouped extend per node, preserving
+          batch order within each node (stable argsort) — the order
+          MoreImportantPod's commit-seq proxy and the PDB walk read;
+        - commit_seq numbers are assigned in batch order from one
+          counter advance; `_min_prio`/`saw_priority` update from the
+          batch min (prios=None means the caller proved every pod's
+          effective priority is 0 — the priority-free engine route);
+        - ports / scalar resources are per-pod only for classes that
+          carry them (ports_of_cls / scalars_of_cls, usually empty).
+
+        Callers must guarantee every pod is unpinned, placed, and in a
+        class with no GPU/storage/extender side effects
+        (`simple_commit_mask`); anything else takes the per-pod path.
+        """
+        import numpy as np
+
+        k = len(pods)
+        if k == 0:
+            return
+        node_idx = np.asarray(node_idx, dtype=np.int64)
+        cls_ids = np.asarray(cls_ids, dtype=np.int64)
+        nodes = self.nodes
+        # per-node aggregate deltas: sum class rows per touched node
+        touched, inv = np.unique(node_idx, return_inverse=True)
+        sums = np.zeros((len(touched), field_tbl.shape[1]), dtype=np.int64)
+        np.add.at(sums, inv, field_tbl[cls_ids])
+        for t_i, n_i in enumerate(touched.tolist()):
+            ns = nodes[n_i]
+            s = sums[t_i]
+            ns.req_mcpu += int(s[0])
+            ns.req_mem += int(s[1])
+            ns.req_eph += int(s[2])
+            ns.req_floor_mcpu += int(s[3])
+            ns.req_floor_mem += int(s[4])
+            ns.nz_mcpu += int(s[5])
+            ns.nz_mem += int(s[6])
+        # rare per-class extras (most classes have neither)
+        has_extra = np.array(
+            [bool(ports_of_cls[u]) or bool(scalars_of_cls[u])
+             for u in range(len(ports_of_cls))],
+            dtype=bool,
+        )
+        any_extra = bool(has_extra[cls_ids].any())
+        # bind writes + per-node pod lists, grouped by node in batch order
+        order = np.argsort(node_idx, kind="stable")
+        sorted_nodes = node_idx[order]
+        group_bounds = np.flatnonzero(np.diff(sorted_nodes)) + 1
+        cls_list = cls_ids.tolist() if any_extra else None
+        for g in np.split(order, group_bounds):
+            ns = nodes[int(node_idx[g[0]])]
+            name = ns.name
+            plist = ns.pods
+            for j in g.tolist():
+                pod = pods[j]
+                pod.setdefault("spec", {})["nodeName"] = name
+                pod.setdefault("status", {})["phase"] = "Running"
+                plist.append(pod)
+                if any_extra and has_extra[cls_list[j]]:
+                    u = cls_list[j]
+                    for port in ports_of_cls[u]:
+                        ns.used_ports.add(port)
+                    for sname, iv in scalars_of_cls[u]:
+                        ns.req_scalar[sname] = ns.req_scalar.get(sname, 0) + iv
+        # commit sequence + priority bookkeeping, batch order
+        seq = self._seq_counter
+        commit_seq = self.commit_seq
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            seq += 1
+            commit_seq[(meta.get("namespace") or "default",
+                        meta.get("name", ""))] = seq
+        self._seq_counter = seq
+        if prios is None:
+            if self._min_prio > 0:
+                self._min_prio = 0
+        else:
+            mn = int(np.min(prios))
+            if mn < self._min_prio:
+                self._min_prio = mn
+            if not self.saw_priority and bool((np.asarray(prios) != 0).any()):
+                self.saw_priority = True
 
     def _commit_known(self, pod: dict, ns: NodeState, s, ports):
         """_commit with the pod's request summary (and optionally its
@@ -1557,7 +1708,7 @@ class Oracle:
                 ns.storage.vgs[vg_idx].requested -= size
             for dev_idx, _size in dalloc:
                 ns.storage.devices[dev_idx].is_allocated = False
-            stor.set_node_storage(ns.node, ns.storage)
+            stor.set_node_storage(ns.own_node(), ns.storage)
         return (pos, gpu_devs, gpu_mem, local)
 
     def restore_pod_to_node(self, ns: NodeState, pod: dict, token):
@@ -1587,7 +1738,7 @@ class Oracle:
                 ns.storage.vgs[vg_idx].requested += size
             for dev_idx, _size in dalloc:
                 ns.storage.devices[dev_idx].is_allocated = True
-            stor.set_node_storage(ns.node, ns.storage)
+            stor.set_node_storage(ns.own_node(), ns.storage)
             ns.local_allocs[self._pod_key(pod)] = (alloc, dalloc)
 
     def evict_pod(self, ns: NodeState, pod: dict):

@@ -97,6 +97,16 @@ class Trace:
     def as_json(self) -> str:
         return json.dumps(self.as_dict())
 
+    def phase_seconds(self, name: str) -> float:
+        """Accumulated wall-clock of one named phase (0.0 when it never
+        ran) — the bench's sort/encode/scan/replay breakdown reads the
+        tiered engine's phases (`host/expand`, `priority/sort`,
+        `engine/encode`, `engine/scan`, `engine/replay`) through this
+        instead of re-deriving them from as_dict()."""
+        with _lock:
+            rec = self.phases.get(name)
+            return rec.seconds if rec is not None else 0.0
+
 
 # process-wide trace; callers that need isolation use Trace() directly
 GLOBAL = Trace()

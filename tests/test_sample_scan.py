@@ -293,23 +293,25 @@ def test_custom_rng_with_only_intn_stays_serial():
 
 
 def _flaky_schedule(monkeypatch, fail_calls=1):
-    """Make the first `fail_calls` TpuEngine.schedule calls raise
-    SampleRngOverflow (the real trigger — a draw exceeding the in-scan
-    rejection bound — has probability < 1e-17 per draw, so the
+    """Make the first `fail_calls` TpuEngine.scan_active dispatches
+    raise SampleRngOverflow (the real trigger — a draw exceeding the
+    in-scan rejection bound — has probability < 1e-17 per draw, so the
     fallback paths are exercised by forcing the raise; the real raise
-    also happens before any commit or rng mutation)."""
+    also happens before any commit or rng mutation). scan_active is
+    the per-round dispatch of the tiered engine, so counting calls
+    counts scan rounds."""
     from open_simulator_tpu.scheduler import engine as eng_mod
 
     calls = {"n": 0}
-    orig = eng_mod.TpuEngine.schedule
+    orig = eng_mod.TpuEngine.scan_active
 
-    def flaky(self, pods):
+    def flaky(self, active):
         calls["n"] += 1
         if calls["n"] <= fail_calls:
             raise eng_mod.SampleRngOverflow("forced by test")
-        return orig(self, pods)
+        return orig(self, active)
 
-    monkeypatch.setattr(eng_mod.TpuEngine, "schedule", flaky)
+    monkeypatch.setattr(eng_mod.TpuEngine, "scan_active", flaky)
     return calls
 
 

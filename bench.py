@@ -43,6 +43,9 @@ Scenarios (SIMON_BENCH env):
   numerics check.
 - `defrag`: pod-migration defragmentation sweep on a cluster snapshot.
 - `whatif`: minimal-count capacity plan over 8 candidate newnode specs.
+- `serve-qps`: the `simon serve` daemon under a concurrent client
+  storm — qps, p50/p95 latency, mean coalesced batch fill, and device
+  dispatches per request (<1 proves the micro-batching; r6).
 - `all`: capacity headline with the others embedded in the metric
   string (one scenario per BASELINE.json config).
 
@@ -389,6 +392,112 @@ def run_whatif(n_base=500, n_pods=5000) -> dict:
         "rounds": GLOBAL.notes.get("whatif-rounds"),
         "syncs": GLOBAL.notes.get("whatif-syncs"),
     }
+
+
+def run_serve_qps(n_clients=8, per_client=6, n_nodes=200) -> dict:
+    """SIMON_BENCH=serve-qps: the `simon serve` daemon under concurrent
+    what-if load (docs/SERVING.md). An in-process daemon (HTTP on an
+    ephemeral port) takes a storm of N clients x M requests; concurrent
+    requests coalesce onto batched scenario scans (up to --max-batch
+    per device dispatch), so the recorded dispatches-per-request proves
+    the micro-batching (<1 means coalescing happened; 1 would be the
+    one-dispatch-per-request serial daemon). One warm storm first:
+    each distinct in-flight batch size compiles its own scan shape, and
+    the measured storm should see the jit cache, not the compiler."""
+    import threading
+    import urllib.request
+
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.serve.server import ServeDaemon
+    from open_simulator_tpu.serve.session import Session
+    from open_simulator_tpu.utils.trace import COUNTERS
+
+    nodes = [
+        _make_node(f"serve-n-{i:04d}", 32, 128, {"zone": f"z{i % 8}"})
+        for i in range(n_nodes)
+    ]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    session = Session(cluster)
+    daemon = ServeDaemon(session, port=0, max_batch=8, queue_depth=256)
+    daemon.start()
+    base = f"http://{daemon.host}:{daemon.port}"
+    app = {
+        "kind": "Deployment",
+        "metadata": {"name": "qps", "namespace": "bench", "labels": {"app": "qps"}},
+        "spec": {
+            "replicas": 50,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img-qps",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    }
+    body = json.dumps(
+        {"apps": [{"name": "qps", "yaml": json.dumps(app)}]}
+    ).encode()
+
+    def storm():
+        errors = []
+
+        def client():
+            try:
+                for _ in range(per_client):
+                    req = urllib.request.Request(
+                        base + "/v1/simulate",
+                        data=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=600) as resp:
+                        resp.read()
+            except Exception as e:  # noqa: BLE001 - surfaced via the raise below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"serve-qps client failed: {errors[0]}")
+
+    try:
+        storm()  # warm: compile the in-flight batch shapes
+        COUNTERS.reset()  # measured storm owns the windows and totals
+        t0 = time.perf_counter()
+        storm()
+        elapsed = time.perf_counter() - t0
+        total = COUNTERS.get("serve_requests_total")
+        dispatches = COUNTERS.get("serve_device_dispatches_total")
+        return {
+            "qps": round(total / elapsed, 2),
+            "p50_ms": round(
+                COUNTERS.percentile("serve_latency_seconds", 50) * 1000, 1
+            ),
+            "p95_ms": round(
+                COUNTERS.percentile("serve_latency_seconds", 95) * 1000, 1
+            ),
+            "batch_fill_mean": round(COUNTERS.mean("serve_batch_fill"), 2),
+            "dispatches_per_request": round(dispatches / max(total, 1), 3),
+            "requests": total,
+            "shed": COUNTERS.get("serve_shed_total"),
+            "clients": n_clients,
+            "nodes": n_nodes,
+            "elapsed_s": round(elapsed, 3),
+        }
+    finally:
+        # a failed storm must not leak the daemon (port, dispatcher
+        # thread) into the rest of a SIMON_BENCH=all run
+        daemon.shutdown()
 
 
 def run_sample() -> dict:
@@ -1364,6 +1473,23 @@ def main():
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
         }
+    elif scenario == "serve-qps":
+        s = run_serve_qps()
+        out = {
+            "metric": f"simon serve qps, {s['clients']} concurrent clients x "
+            f"{s['nodes']} nodes ({s['requests']} requests, p50 {s['p50_ms']}ms "
+            f"p95 {s['p95_ms']}ms, mean batch fill {s['batch_fill_mean']}, "
+            f"{s['dispatches_per_request']} device dispatches/request, "
+            f"{s['shed']} shed)",
+            "value": s["qps"],
+            "unit": "req/s",
+            "vs_baseline": None,
+            "qps": s["qps"],
+            "p50_ms": s["p50_ms"],
+            "p95_ms": s["p95_ms"],
+            "batch_fill_mean": s["batch_fill_mean"],
+            "dispatches_per_request": s["dispatches_per_request"],
+        }
     elif scenario == "defrag":
         d = run_defrag()
         out = {
@@ -1418,6 +1544,7 @@ def main():
         pd = isolated(run_priority_dense)
         ts = isolated(run_tier_stress)
         sm = isolated(run_sample)
+        sq = isolated(run_serve_qps)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -1447,7 +1574,11 @@ def main():
             f"tier-stress e2e {ts['pods_per_sec']:.0f} pods/s "
             f"({ts['escapes']} escapes, serial tail {ts['serial_tail']}), "
             f"sample-mode e2e {sm['pods_per_sec']:.0f} pods/s "
-            f"({sm['ratio']:.2f}x first-max on the same XLA path); "
+            f"({sm['ratio']:.2f}x first-max on the same XLA path), "
+            f"serve-qps {sq['qps']:.1f} req/s over {sq['clients']} clients "
+            f"(p50 {sq['p50_ms']}ms p95 {sq['p95_ms']}ms, batch fill "
+            f"{sq['batch_fill_mean']}, {sq['dispatches_per_request']} "
+            f"dispatches/request); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"

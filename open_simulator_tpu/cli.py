@@ -501,6 +501,64 @@ def _result_json(result) -> str:
     return json.dumps(out)
 
 
+def cmd_serve(args) -> int:
+    """Long-lived what-if daemon (serve/; docs/SERVING.md): load the
+    cluster once, pre-warm the encode + compiled-scan caches, coalesce
+    concurrent POST /v1/simulate requests onto batched device scans.
+    Exit 0 after a clean SIGTERM/SIGINT drain, 3 when --drain-timeout
+    expired with requests still queued (shed), 2 on input errors."""
+    from .apply.applier import Applier, SimonConfig
+    from .models.validation import InputError
+    from .runtime import ExternalIOError
+    from .serve.server import ServeDaemon
+    from .serve.session import Session
+
+    _force_platform()
+    try:
+        # flag validation up front: a bad value must exit 2 BEFORE
+        # listening, never crash per request (docs/ROBUSTNESS.md)
+        if args.default_deadline is not None and args.default_deadline <= 0:
+            raise InputError("--default-deadline must be > 0 seconds")
+        if args.drain_timeout < 0:
+            raise InputError("--drain-timeout must be >= 0 seconds")
+        config = SimonConfig.from_file(args.simon_config)
+        applier = Applier(config)
+        cluster = applier.load_cluster()
+        session = Session(cluster)
+        daemon = ServeDaemon(
+            session,
+            host=args.host,
+            port=args.port,
+            max_batch=args.max_batch,
+            queue_depth=args.queue_depth,
+            default_deadline_s=args.default_deadline,
+            drain_timeout_s=args.drain_timeout,
+        )
+    except (OSError, ValueError, ExternalIOError, InputError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not args.no_warm:
+        # one tiny request through the whole path before we listen:
+        # cluster static encode + scenario-scan jit are warm, so the
+        # first real request pays traffic-shape compile only
+        session.warm()
+    daemon.start()
+    if session.force_serial_reason:
+        logging.warning(
+            "cluster cannot ride the batched scan (%s); every request "
+            "will be answered serially",
+            session.force_serial_reason,
+        )
+    # machine-parsable readiness line (tests and the CI smoke step read
+    # the bound port from it — --port 0 binds an ephemeral one)
+    print(
+        f"simon serve listening on http://{daemon.host}:{daemon.port} "
+        f"(cluster {session.fingerprint})",
+        flush=True,
+    )
+    return daemon.run_until_signaled()
+
+
 def cmd_version(_args) -> int:
     print(f"simon-tpu version {__version__}")
     return 0
@@ -761,6 +819,56 @@ def build_parser() -> argparse.ArgumentParser:
         help="print per-phase wall-clock JSON to stderr",
     )
     p_chaos.set_defaults(func=cmd_chaos)
+
+    p_serve = sub.add_parser(
+        "serve",
+        help="long-lived what-if scheduling daemon (JSON-over-HTTP)",
+        description="Load the cluster once, pre-warm the encode and "
+        "compiled-scan caches, and serve concurrent what-if questions: "
+        "POST /v1/simulate with app YAML answers exactly like a "
+        "standalone simulation of those apps on the loaded cluster "
+        "under the DEFAULT scheduler profile (apply's "
+        "--default-scheduler-config / --use-greed customizations are "
+        "not served — docs/SERVING.md). Concurrent requests coalesce "
+        "onto batched device scans (up to --max-batch per dispatch); "
+        "overload sheds with 503 + Retry-After at --queue-depth; "
+        "SIGTERM drains in-flight requests then exits 0.",
+    )
+    p_serve.add_argument(
+        "-f", "--simon-config", required=True,
+        help="simon config file path (its cluster section is served; "
+        "appList is ignored — apps arrive per request)",
+    )
+    p_serve.add_argument("--host", default="127.0.0.1", help="bind address")
+    p_serve.add_argument(
+        "--port", type=int, default=8080,
+        help="bind port (0 = ephemeral; the readiness line prints it)",
+    )
+    p_serve.add_argument(
+        "--max-batch", type=int, default=16, metavar="B",
+        help="max requests coalesced into one batched device scan",
+    )
+    p_serve.add_argument(
+        "--queue-depth", type=int, default=64, metavar="N",
+        help="bounded request queue; submits beyond it shed with 503",
+    )
+    p_serve.add_argument(
+        "--default-deadline", type=float, default=None, metavar="SECONDS",
+        help="per-request deadline when the request body sets none; a "
+        "request whose deadline expires while queued is shed with a "
+        "machine-readable PARTIAL 503 body",
+    )
+    p_serve.add_argument(
+        "--drain-timeout", type=float, default=30.0, metavar="SECONDS",
+        help="SIGTERM drain bound: queued requests still unanswered "
+        "after this are shed and the daemon exits 3 instead of 0",
+    )
+    p_serve.add_argument(
+        "--no-warm", action="store_true",
+        help="skip the pre-listen warmup request (faster start, slower "
+        "first request)",
+    )
+    p_serve.set_defaults(func=cmd_serve)
 
     p_version = sub.add_parser("version", help="print version")
     p_version.set_defaults(func=cmd_version)

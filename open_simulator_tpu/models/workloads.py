@@ -71,6 +71,26 @@ def reset_name_counter():
     _name_counter = itertools.count()
 
 
+def name_counter_state() -> int:
+    """The counter's next value, without advancing it (observing
+    requires a draw, so the counter is re-seated at the drawn value).
+    The serve coalescer snapshots the post-cluster-expansion state once
+    and replays it before expanding EVERY request's apps, so a
+    coalesced request's generated pod names are identical to the names
+    a standalone `simulate()` of that request would mint."""
+    global _name_counter
+    n = next(_name_counter)
+    _name_counter = itertools.count(n)
+    return n
+
+
+def set_name_counter(n: int):
+    """Re-seat the generated-name counter at `n` (see
+    name_counter_state)."""
+    global _name_counter
+    _name_counter = itertools.count(n)
+
+
 def _hash_suffix(digits: int) -> str:
     n = next(_name_counter)
     return hashlib.sha256(str(n).encode()).hexdigest()[:digits]

@@ -31,6 +31,13 @@ code  meaning
 3     partial result: deadline expired at a safe boundary
 4     partial result: interrupted (SIGINT) at a safe boundary
 ====  =========================================================
+
+``simon serve`` maps its lifecycle onto the same codes: 0 = clean
+SIGTERM/SIGINT drain (every queued request answered), 2 = input error
+before listening, 3 = drain timeout expired with requests still
+queued (shed with a machine-readable PARTIAL 503 body). Per-request
+overload/deadline shedding stays at the HTTP layer (503), never a
+process exit (docs/SERVING.md, docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations

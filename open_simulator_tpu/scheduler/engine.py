@@ -217,6 +217,49 @@ class TpuEngine:
         self.begin_batch(pods)
         return self.scan_active(np.ones(len(pods), bool))
 
+    def scan_scenarios(self, actives: np.ndarray) -> np.ndarray:
+        """Batch-of-requests entry point (serve/coalescer.py): ONE
+        vmapped device dispatch evaluating every row of `actives`
+        [Sc, P] as an independent masked scan over the begin_batch
+        encoding against the oracle's CURRENT state — Sc what-if
+        questions for the price of one dispatch. Scenarios share the
+        batch's pin vector and see all nodes; each row's placements
+        are identical to scan_active(row) run alone (scenarios never
+        see each other's commits — nothing is replayed here).
+
+        Returns placements [Sc, P]: node index, -1 (active but
+        unschedulable), or -2 (masked off in that scenario)."""
+        import jax.numpy as jnp
+
+        from ..ops.encode import to_scan_static, to_scan_state
+        from ..utils.trace import phase, profiled
+
+        if bool(getattr(self._features, "sample", False)):
+            # the Go-RNG stream is a single serial sequence; scenario
+            # rows would race for it (core.py routes sample serially)
+            raise ValueError(
+                "sample-mode batches cannot ride the scenario scan"
+            )
+        batch = self._batch
+        with phase("engine/encode"):
+            cluster = self.cluster_static()
+            dyn = encode_dynamic(self.oracle, cluster)
+            if self._scan_static is None or self._scan_static_cluster is not cluster:
+                self._scan_static = to_scan_static(cluster, batch)
+                self._scan_static_cluster = cluster
+            init = to_scan_state(dyn, batch)
+        with profiled("engine/scan"):
+            out = _scenario_scan_jit()(
+                self._scan_static,
+                init,
+                jnp.asarray(batch.class_of_pod),
+                jnp.asarray(batch.pinned_node),
+                jnp.ones(cluster.n, bool),
+                jnp.asarray(np.asarray(actives, bool)),
+                self._features,
+            )
+        return np.asarray(out)
+
     def rewind_sample_rng(self, batch_pos: int) -> None:
         """Reposition the oracle's sample-mode stream to where it stood
         BEFORE the last scanned round's pod at `batch_pos` consumed its
@@ -275,6 +318,39 @@ class TpuEngine:
             pods, node_idx, cls_ids, field_tbl, ports_of, scalars_of,
             prios=prios,
         )
+
+
+def _scan_scenarios_impl(static, init, cls, pinned, valid, actives, features):
+    import jax
+
+    from ..ops import scan as scan_ops
+
+    def one(active):
+        placements, _final = scan_ops.run_scan_masked(
+            static, init, cls, pinned, valid, active, features=features
+        )
+        return placements
+
+    return jax.vmap(one)(actives)
+
+
+_SCENARIO_SCAN_JIT = None
+
+
+def _scenario_scan_jit():
+    """The jitted scenario vmap, compiled once per (shape, features)
+    pair PROCESS-WIDE: static/init/masks are traced pytree arguments
+    (not closures), so a long-lived daemon re-dispatching same-shaped
+    request batches hits the jit cache instead of recompiling — the
+    warm-compiled-scan property `simon serve` is built on."""
+    global _SCENARIO_SCAN_JIT
+    if _SCENARIO_SCAN_JIT is None:
+        import jax
+
+        _SCENARIO_SCAN_JIT = jax.jit(
+            _scan_scenarios_impl, static_argnums=(6,)
+        )
+    return _SCENARIO_SCAN_JIT
 
 
 def build_bulk_tables(batch, simple_mask):

@@ -1,0 +1,258 @@
+"""Micro-batching request coalescer — the serving core of `simon serve`.
+
+The shape is continuous batching from inference serving, applied to
+what-if scheduling: HTTP handler threads only parse and enqueue; ONE
+dispatcher thread drains up to ``max_batch`` queued requests per tick
+and answers all of them with a single batched device dispatch
+(serve/session.evaluate_batch), so B concurrent requests cost
+``ceil(B / max_batch)`` dispatches instead of B — the counters at
+``/metrics`` prove it (tests/test_serve.py asserts the bound).
+
+Backpressure contract (docs/SERVING.md):
+
+- the queue is BOUNDED (``queue_depth``): a submit against a full
+  queue is rejected immediately — the HTTP layer turns that into
+  503 + Retry-After, the shed counter increments, and the daemon's
+  latency distribution stays honest instead of growing an unbounded
+  tail (the same load-shedding posture as runtime/retry's circuit
+  breakers: fail fast, recover fast)
+- every request carries a ``Budget`` (runtime/budget.py): a request
+  whose deadline expired while it sat in the queue is SHED at pickup
+  with a machine-readable PARTIAL body — device time is never spent on
+  an answer nobody is waiting for. Once dispatched, a request runs to
+  completion (the scan has no per-request halt boundary).
+- SIGTERM drains: ``close()`` stops intake (submits reject as
+  draining), the dispatcher finishes every queued request, then the
+  thread exits. ``drain(timeout)`` bounds the wait; leftovers past the
+  timeout are shed with the drain body.
+
+Single-dispatcher concurrency contract: all expansion, encode, scan,
+and replay run on the dispatcher thread — the warm identity caches are
+effectively single-threaded (docs/PERFORMANCE.md "warm-cache
+concurrency contract"); handler threads touch only the queue, the
+counters, and their own parsed request.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..runtime.budget import Budget
+from ..utils.trace import COUNTERS
+from .session import Session, WhatIfReply, WhatIfRequest
+
+
+def partial_body(reason: str, message: str) -> bytes:
+    """Machine-readable shed body — the HTTP analogue of the CLI's
+    PARTIAL report (cli._emit_partial): same `partial`/`reason` keys,
+    so one client-side parser reads both surfaces."""
+    return json.dumps(
+        {"partial": True, "reason": reason, "message": message}
+    ).encode()
+
+
+@dataclass
+class PendingRequest:
+    """One enqueued question plus its rendezvous with the handler
+    thread (`done` fires when `reply` is set)."""
+
+    request: WhatIfRequest
+    budget: Budget
+    enqueued_at: float = field(default_factory=time.monotonic)
+    done: threading.Event = field(default_factory=threading.Event)
+    reply: Optional[WhatIfReply] = None
+
+    def finish(self, reply: WhatIfReply):
+        self.reply = reply
+        self.done.set()
+
+
+class Coalescer:
+    def __init__(
+        self,
+        session: Session,
+        max_batch: int = 16,
+        queue_depth: int = 64,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.session = session
+        self.max_batch = max_batch
+        self.queue_depth = queue_depth
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._wakeup = threading.Event()
+        self._closing = False
+        self._drained = threading.Event()
+        # tests set this to hold the dispatcher between ticks, so a
+        # burst enqueued while held provably coalesces into one tick
+        self.hold: Optional[threading.Event] = None
+        self._thread = threading.Thread(
+            target=self._run, name="simon-serve-dispatcher", daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+
+    # -- intake (handler threads) -------------------------------------------
+
+    def submit(self, req: PendingRequest) -> bool:
+        """Enqueue; False = rejected (queue full or draining). The
+        caller owns the 503 rendering."""
+        with self._lock:
+            if self._closing or len(self._queue) >= self.queue_depth:
+                COUNTERS.inc("serve_shed_total")
+                COUNTERS.inc(
+                    "serve_shed_draining_total"
+                    if self._closing
+                    else "serve_shed_overload_total"
+                )
+                return False
+            self._queue.append(req)
+            COUNTERS.gauge("serve_queue_depth", len(self._queue))
+        self._wakeup.set()
+        return True
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def retry_after_s(self) -> int:
+        """Overload hint: how long until the backlog plausibly clears,
+        from the observed per-tick latency (>= 1s so clients never busy
+        spin)."""
+        tick_s = COUNTERS.mean("serve_tick_seconds") or 1.0
+        ticks = max(1, -(-self.depth // self.max_batch))
+        return max(1, int(round(ticks * tick_s)))
+
+    def _finish_counted(self, pending: PendingRequest, reply: WhatIfReply):
+        """Answer one request AND account for it: every answered
+        request — simulate result or shed — counts in
+        serve_requests_total and the latency window ('Requests
+        answered (any status)', serve/server.render_metrics), so the
+        exported distribution keeps its worst cases exactly when the
+        daemon is shedding."""
+        latency = time.monotonic() - pending.enqueued_at
+        COUNTERS.observe("serve_latency_seconds", latency)
+        COUNTERS.mark("serve_completions")
+        COUNTERS.inc("serve_requests_total")
+        pending.finish(reply)
+
+    # -- dispatch (the one dispatcher thread) -------------------------------
+
+    def _drain_tick(self) -> List[PendingRequest]:
+        """Take up to max_batch requests, shedding any whose deadline
+        already expired in the queue (503 PARTIAL, no device time)."""
+        picked: List[PendingRequest] = []
+        while len(picked) < self.max_batch:
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                COUNTERS.gauge("serve_queue_depth", len(self._queue))
+            if req.budget.expired() or req.budget.interrupted:
+                COUNTERS.inc("serve_shed_total")
+                COUNTERS.inc("serve_shed_deadline_total")
+                self._finish_counted(
+                    req,
+                    WhatIfReply(
+                        status=503,
+                        body=partial_body(
+                            "deadline",
+                            f"deadline of {req.budget.deadline_s:g}s expired "
+                            f"after {req.budget.elapsed():.2f}s in the queue",
+                        ),
+                        meta={"engine": "shed-deadline"},
+                    ),
+                )
+                continue
+            picked.append(req)
+        return picked
+
+    def _run(self):
+        while True:
+            if self.hold is not None:
+                self.hold.wait()
+            self._wakeup.wait(timeout=0.05)
+            self._wakeup.clear()
+            batch = self._drain_tick()
+            if not batch:
+                with self._lock:
+                    if self._closing and not self._queue:
+                        break
+                continue
+            t0 = time.monotonic()
+            COUNTERS.observe("serve_batch_fill", len(batch))
+            COUNTERS.inc("serve_batches_total")
+            try:
+                replies = self.session.evaluate_batch(
+                    [p.request for p in batch]
+                )
+            except Exception as e:  # noqa: BLE001 - the daemon must outlive any one batch
+                # a failed batch answers its waiters (500) and the
+                # dispatcher keeps serving; an unhandled raise here
+                # would strand every queued request forever
+                COUNTERS.inc("serve_batch_errors_total")
+                replies = [
+                    WhatIfReply(
+                        status=500,
+                        body=json.dumps(
+                            {"error": f"evaluation failed: {e}"}
+                        ).encode(),
+                        meta={"engine": "error"},
+                    )
+                    for _ in batch
+                ]
+            tick_s = time.monotonic() - t0
+            COUNTERS.observe("serve_tick_seconds", tick_s)
+            for pending, reply in zip(batch, replies):
+                reply.meta.setdefault("batchSize", len(batch))
+                reply.meta["queueSeconds"] = round(
+                    t0 - pending.enqueued_at, 6
+                )
+                self._finish_counted(pending, reply)
+        self._drained.set()
+
+    # -- shutdown -----------------------------------------------------------
+
+    def close(self):
+        """Stop intake; the dispatcher exits once the queue is empty."""
+        with self._lock:
+            self._closing = True
+        self._wakeup.set()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued request is answered (True) or the
+        timeout passes (False — leftovers are shed with the drain
+        body so no handler thread waits forever)."""
+        self.close()
+        ok = self._drained.wait(timeout=timeout)
+        if not ok:
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        break
+                    req = self._queue.popleft()
+                COUNTERS.inc("serve_shed_total")
+                COUNTERS.inc("serve_shed_draining_total")
+                self._finish_counted(
+                    req,
+                    WhatIfReply(
+                        status=503,
+                        body=partial_body(
+                            "drain",
+                            "daemon shutting down before this request "
+                            "could be evaluated",
+                        ),
+                        meta={"engine": "shed-drain"},
+                    ),
+                )
+        return ok

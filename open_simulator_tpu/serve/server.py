@@ -1,0 +1,384 @@
+"""`simon serve` — the long-lived what-if scheduling daemon.
+
+JSON-over-HTTP API (docs/SERVING.md):
+
+- ``POST /v1/simulate`` — body is either a JSON object
+  ``{"apps": [{"name": ..., "yaml": "..."}], "deadlineSeconds": N,
+  "trace": bool}`` or raw YAML (treated as one unnamed app). Replies
+  200 with the canonical simulate answer (byte-identical to a
+  standalone ``simulate()`` of the same request), 400 on undecodable
+  input, 503 with a machine-readable PARTIAL body when shed
+  (queue full / draining / queue-expired deadline).
+- ``GET /healthz`` — liveness + the loaded cluster's fingerprint.
+- ``GET /metrics`` — Prometheus text: QPS, queue depth, batch fill,
+  latency p50/p95, shed and dispatch counters.
+
+Lifecycle: SIGTERM (or SIGINT) stops intake, drains in-flight and
+queued requests through the coalescer, and exits 0; if
+``--drain-timeout`` expires first, leftovers are shed and the exit
+code is 3 (the deadline-partial code — docs/ROBUSTNESS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..models.decode import ResourceTypes, decode_yaml_content
+from ..runtime.budget import Budget
+from ..runtime.errors import EXIT_OK, EXIT_PARTIAL_DEADLINE
+from ..scheduler.core import AppResource
+from ..utils.trace import COUNTERS
+from .coalescer import Coalescer, PendingRequest
+from .session import Session, WhatIfRequest
+
+log = logging.getLogger(__name__)
+
+# wait bound for a handler thread whose request IS being evaluated: the
+# dispatcher always answers (even shed/error paths), so this only trips
+# if the dispatcher thread died — answer 500 instead of hanging the
+# client transport forever
+_RESULT_WAIT_SLACK_S = 600.0
+
+
+def parse_request_body(raw: bytes, content_type: str):
+    """-> (WhatIfRequest, deadline_s or None, want_trace). Raises
+    ValueError on undecodable input (the handler answers 400).
+
+    The JSON envelope is recognized by Content-Type OR by shape (a
+    JSON object with an "apps" key): a client that forgets the
+    Content-Type header must not have its envelope silently YAML-
+    decoded into an empty workload and answered 200 "success" —
+    a wrong answer indistinguishable from "everything fits"."""
+    deadline = None
+    want_trace = False
+    doc = None
+    if "json" in (content_type or "").lower():
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise ValueError(f"body is not valid JSON: {e}") from e
+        if not isinstance(doc, dict):
+            raise ValueError("JSON body must be an object")
+    else:
+        try:
+            sniffed = json.loads(raw.decode("utf-8"))
+            if isinstance(sniffed, dict) and "apps" in sniffed:
+                doc = sniffed
+        except (UnicodeDecodeError, ValueError):  # noqa: S110 - sniff only: a non-JSON body is the normal raw-YAML case, decoded (with real errors) just below
+            pass
+    if doc is not None:
+        if doc.get("deadlineSeconds") is not None:
+            deadline = float(doc["deadlineSeconds"])
+            if deadline <= 0:
+                raise ValueError("deadlineSeconds must be > 0")
+        want_trace = bool(doc.get("trace", False))
+        apps_spec = doc.get("apps")
+        if not isinstance(apps_spec, list) or not apps_spec:
+            raise ValueError('JSON body needs a non-empty "apps" list')
+        apps: List[AppResource] = []
+        for i, a in enumerate(apps_spec):
+            if not isinstance(a, dict) or not isinstance(a.get("yaml"), str):
+                raise ValueError(f'apps[{i}] needs a "yaml" string')
+            apps.append(
+                AppResource(
+                    name=str(a.get("name") or f"app-{i}"),
+                    resource=_decode_app_yaml(a["yaml"], i),
+                )
+            )
+        return WhatIfRequest(apps=apps), deadline, want_trace
+    # raw YAML: one unnamed app
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise ValueError(f"body is not UTF-8 YAML: {e}") from e
+    resource = _decode_app_yaml(text, 0)
+    if all(not getattr(resource, f) for f in vars(resource)):
+        # parsed, but nothing simulatable: almost certainly a malformed
+        # request (unknown kinds, or a JSON envelope that failed the
+        # shape sniff) — a 200 for an empty workload would be a wrong
+        # answer, not an answer
+        raise ValueError(
+            "body decoded to no recognized Kubernetes objects; send "
+            'either k8s YAML or the {"apps": [...]} JSON envelope'
+        )
+    return (
+        WhatIfRequest(apps=[AppResource(name="app-0", resource=resource)]),
+        deadline,
+        want_trace,
+    )
+
+
+def _decode_app_yaml(text: str, i: int) -> ResourceTypes:
+    import yaml
+
+    try:
+        return decode_yaml_content([text])
+    except yaml.YAMLError as e:
+        raise ValueError(f"apps[{i}]: invalid YAML: {e}") from e
+
+
+def render_metrics(coalescer: Coalescer) -> bytes:
+    """Prometheus text exposition of the process-wide counters
+    (utils/trace.COUNTERS)."""
+    snap = COUNTERS.snapshot()
+    counts = snap["counts"]
+    lines = []
+
+    def metric(name, kind, help_text, value):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+
+    metric(
+        "simon_serve_requests_total", "counter",
+        "Requests answered (any status).", counts.get("serve_requests_total", 0),
+    )
+    metric(
+        "simon_serve_shed_total", "counter",
+        "Requests shed (overload, drain, or queue-expired deadline).",
+        counts.get("serve_shed_total", 0),
+    )
+    metric(
+        "simon_serve_shed_overload_total", "counter",
+        "Sheds due to a full queue.", counts.get("serve_shed_overload_total", 0),
+    )
+    metric(
+        "simon_serve_shed_deadline_total", "counter",
+        "Sheds due to a deadline that expired in the queue.",
+        counts.get("serve_shed_deadline_total", 0),
+    )
+    metric(
+        "simon_serve_device_dispatches_total", "counter",
+        "Batched device dispatches (one per coalesced scan chunk).",
+        counts.get("serve_device_dispatches_total", 0),
+    )
+    metric(
+        "simon_serve_batches_total", "counter",
+        "Coalescer ticks that evaluated at least one request.",
+        counts.get("serve_batches_total", 0),
+    )
+    metric(
+        "simon_serve_batch_errors_total", "counter",
+        "Coalescer ticks that failed and answered 500.",
+        counts.get("serve_batch_errors_total", 0),
+    )
+    metric(
+        "simon_serve_queue_depth", "gauge",
+        "Requests currently queued.", coalescer.depth,
+    )
+    metric(
+        "simon_serve_batch_fill_mean", "gauge",
+        "Mean requests per coalesced tick (recent window).",
+        round(COUNTERS.mean("serve_batch_fill"), 4),
+    )
+    metric(
+        "simon_serve_qps", "gauge",
+        "Completions per second over the trailing 60s.",
+        round(COUNTERS.rate("serve_completions"), 4),
+    )
+    metric(
+        "simon_serve_latency_p50_seconds", "gauge",
+        "Median request latency (recent window).",
+        round(COUNTERS.percentile("serve_latency_seconds", 50), 6),
+    )
+    metric(
+        "simon_serve_latency_p95_seconds", "gauge",
+        "p95 request latency (recent window).",
+        round(COUNTERS.percentile("serve_latency_seconds", 95), 6),
+    )
+    lines.append("")
+    return "\n".join(lines).encode()
+
+
+class ServeDaemon:
+    """Owns the HTTP server, the coalescer, and the drain lifecycle."""
+
+    def __init__(
+        self,
+        session: Session,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        max_batch: int = 16,
+        queue_depth: int = 64,
+        default_deadline_s: Optional[float] = None,
+        drain_timeout_s: float = 30.0,
+    ):
+        self.session = session
+        self.default_deadline_s = default_deadline_s
+        self.drain_timeout_s = drain_timeout_s
+        self.coalescer = Coalescer(
+            session, max_batch=max_batch, queue_depth=queue_depth
+        )
+        self._shutdown = threading.Event()
+        # simulate requests currently inside do_POST (parse -> reply
+        # WRITTEN): the drain waits for this to reach zero so "exit 0"
+        # really means every answered request reached its client's
+        # socket, not just the coalescer (handler threads are daemonic
+        # and would otherwise die mid-write at process exit)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight_zero = threading.Event()
+        self._inflight_zero.set()
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # stdlib logs to stderr per request
+                log.debug("%s %s", self.address_string(), fmt % args)
+
+            def _send(self, status: int, body: bytes, content_type="application/json", headers=()):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "ok": True,
+                                "cluster": daemon.session.fingerprint,
+                                "queueDepth": daemon.coalescer.depth,
+                                "draining": daemon._shutdown.is_set(),
+                            }
+                        ).encode(),
+                    )
+                elif self.path == "/metrics":
+                    self._send(
+                        200,
+                        render_metrics(daemon.coalescer),
+                        content_type="text/plain; version=0.0.4",
+                    )
+                else:
+                    self._send(404, json.dumps({"error": "not found"}).encode())
+
+            def do_POST(self):
+                if self.path != "/v1/simulate":
+                    self._send(404, json.dumps({"error": "not found"}).encode())
+                    return
+                with daemon._inflight_lock:
+                    daemon._inflight += 1
+                    daemon._inflight_zero.clear()
+                try:
+                    self._do_simulate()
+                finally:
+                    with daemon._inflight_lock:
+                        daemon._inflight -= 1
+                        if daemon._inflight == 0:
+                            daemon._inflight_zero.set()
+
+            def _do_simulate(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length)
+                try:
+                    req, deadline, want_trace = parse_request_body(
+                        raw, self.headers.get("Content-Type", "")
+                    )
+                except ValueError as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                if deadline is None:
+                    deadline = daemon.default_deadline_s
+                pending = PendingRequest(request=req, budget=Budget(deadline))
+                if not daemon.coalescer.submit(pending):
+                    from .coalescer import partial_body
+
+                    draining = daemon._shutdown.is_set()
+                    self._send(
+                        503,
+                        partial_body(
+                            "drain" if draining else "overload",
+                            "daemon is draining for shutdown"
+                            if draining
+                            else f"queue full at depth {daemon.coalescer.queue_depth}",
+                        ),
+                        headers=(
+                            ("Retry-After", str(daemon.coalescer.retry_after_s())),
+                        ),
+                    )
+                    return
+                wait = (deadline or 0) + _RESULT_WAIT_SLACK_S
+                if not pending.done.wait(timeout=wait):
+                    self._send(
+                        500,
+                        json.dumps({"error": "dispatcher unresponsive"}).encode(),
+                    )
+                    return
+                reply = pending.reply
+                headers = [
+                    ("X-Simon-Engine", str(reply.meta.get("engine", ""))),
+                    ("X-Simon-Batch-Size", str(reply.meta.get("batchSize", ""))),
+                ]
+                if want_trace:
+                    headers.append(
+                        ("X-Simon-Trace", json.dumps(reply.meta, sort_keys=True))
+                    )
+                self._send(reply.status, reply.body, headers=headers)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.host, self.port = self.httpd.server_address[:2]
+        self._server_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="simon-serve-http",
+            daemon=True,
+        )
+
+    def start(self):
+        self.coalescer.start()
+        self._server_thread.start()
+        log.info("simon serve listening on %s:%d", self.host, self.port)
+
+    def begin_shutdown(self):
+        """Stop intake (new submits shed as draining); idempotent."""
+        self._shutdown.set()
+        self.coalescer.close()
+
+    def shutdown(self) -> int:
+        """Drain and stop. Returns the process exit code: 0 when every
+        queued request was answered within --drain-timeout, 3 (the
+        deadline-partial code) when leftovers had to be shed."""
+        self.begin_shutdown()  # also closes coalescer intake
+        drained = self.coalescer.drain(timeout=self.drain_timeout_s)
+        # the coalescer answered every request; now wait for the
+        # handler threads to finish WRITING those answers (bounded: a
+        # wedged client socket must not hold the exit hostage)
+        self._inflight_zero.wait(timeout=min(self.drain_timeout_s, 10.0))
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if not drained:
+            log.warning(
+                "drain timeout (%.1fs) expired with requests still queued; shed",
+                self.drain_timeout_s,
+            )
+        return EXIT_OK if drained else EXIT_PARTIAL_DEADLINE
+
+    def run_until_signaled(self) -> int:
+        """Block until SIGTERM/SIGINT, then drain and return the exit
+        code. Installs handlers (main thread only)."""
+
+        def handler(signum, frame):
+            log.info("received signal %d: draining", signum)
+            self.begin_shutdown()
+            self._wake.set()
+
+        self._wake = threading.Event()
+        prev_term = signal.signal(signal.SIGTERM, handler)
+        prev_int = signal.signal(signal.SIGINT, handler)
+        try:
+            self._wake.wait()
+            return self.shutdown()
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
+            signal.signal(signal.SIGINT, prev_int)

@@ -1,0 +1,409 @@
+"""Warm what-if session: one loaded cluster, many simulate questions.
+
+The one-shot CLI pays process startup, cluster build, encode, and XLA
+compile for every question (SURVEY.md §0; the reference's
+pkg/simulator/core.go:64-103 is strictly one-shot). A ``Session`` loads
+the cluster ONCE and keeps everything derivable from it warm across
+requests:
+
+- the ``Oracle`` over the cluster nodes (never mutated — replay happens
+  on per-request oracles), whose ``ClusterStatic`` encoding is cached
+  inside the shared ``TpuEngine``
+- the expanded cluster pods and the generated-name counter state after
+  their expansion, replayed before every request's app expansion so a
+  coalesced request mints exactly the pod names a standalone
+  ``simulate()`` would (models/workloads.name_counter_state)
+- the jitted scenario scan (engine._scenario_scan_jit): same-shaped
+  request batches across dispatches hit the jit cache
+
+``evaluate_batch`` answers B requests with ONE device dispatch: each
+request becomes one scenario row of a batched masked scan — the same
+per-scenario pod-activity masking the capacity sweep and the chaos
+engine use (parallel/sweep.py) — and each row's placements replay into
+a fresh per-request oracle for the report. Responses are byte-identical
+to a standalone ``simulate()`` of the same request (conformance-gated,
+tests/test_serve.py); requests the batched scan cannot model (priority
+/ preemption semantics, per-pod host callbacks) fall back to a real
+``simulate()`` call inside the dispatcher, so the answer is identical
+either way — only the latency differs.
+
+The session is keyed by a fingerprint of the loaded cluster
+(runtime/journal.config_fingerprint), reported at ``/healthz`` so
+clients can detect a daemon serving stale state after a config change.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..models import workloads as wl
+from ..models.decode import ResourceTypes
+from ..runtime.journal import config_fingerprint
+from ..scheduler.core import (
+    AppResource,
+    NodeStatus,
+    SimulateResult,
+    UnscheduledPod,
+    _sort_app_pods,
+    simulate,
+)
+from ..scheduler.oracle import Oracle
+from ..utils.trace import COUNTERS
+
+# pod absent from a scenario — must match the scan sentinel
+# (parallel/sweep.py asserts the same identity against ops.scan)
+INACTIVE = -2
+
+
+@dataclass
+class WhatIfRequest:
+    """One decoded /v1/simulate question: apps in deployment order."""
+
+    apps: List[AppResource]
+
+
+@dataclass
+class WhatIfReply:
+    """The evaluated answer. `body` is the canonical response bytes
+    (byte-identical across the coalesced and serial paths); `meta` is
+    per-request diagnostics exported as HTTP headers, NEVER mixed into
+    the body (a batch-dependent body would break the conformance
+    contract)."""
+
+    status: int
+    body: bytes
+    meta: dict = field(default_factory=dict)
+
+
+def result_payload(result: SimulateResult) -> bytes:
+    """Canonical response body of one simulate answer. Key-sorted,
+    separator-normalized JSON: the bytes are a pure function of the
+    placements and reasons, so coalesced and standalone evaluations of
+    the same request compare equal byte-for-byte."""
+    out = {
+        "success": not result.unscheduled_pods,
+        "unscheduledPods": [
+            {
+                "namespace": (up.pod.get("metadata") or {}).get("namespace"),
+                "name": (up.pod.get("metadata") or {}).get("name"),
+                "reason": up.reason,
+            }
+            for up in result.unscheduled_pods
+        ],
+        "nodes": [
+            {
+                "name": (ns.node.get("metadata") or {}).get("name"),
+                "pods": [
+                    {
+                        "namespace": (p.get("metadata") or {}).get("namespace"),
+                        "name": (p.get("metadata") or {}).get("name"),
+                        "app": ((p.get("metadata") or {}).get("labels") or {}).get(
+                            "simon/app-name"
+                        ),
+                    }
+                    for p in ns.pods
+                ],
+            }
+            for ns in result.node_status
+        ],
+    }
+    return json.dumps(out, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _own_pod(p: dict) -> dict:
+    """Shallow-clone the mutation surface of a pod dict (bind writes
+    spec.nodeName / status.phase / metadata.annotations) so replaying a
+    scenario never pollutes the shared cluster pods or a request's
+    expansion — the next batch re-encodes those dicts and a stale
+    nodeName would read as a pin."""
+    q = dict(p)
+    q["spec"] = dict(p.get("spec") or {})
+    meta = dict(p.get("metadata") or {})
+    if meta.get("annotations") is not None:
+        meta["annotations"] = dict(meta["annotations"])
+    q["metadata"] = meta
+    if isinstance(q.get("status"), dict):
+        q["status"] = dict(q["status"])
+    return q
+
+
+class Session:
+    """One warm cluster + the machinery to answer request batches."""
+
+    def __init__(self, cluster: ResourceTypes):
+        from ..scheduler.engine import TpuEngine
+        from ..scheduler.preemption import build_priority_resolver, pod_uses_priority
+        from ..utils.trace import phase
+
+        self.cluster = cluster
+        self.fingerprint = config_fingerprint(
+            {k: getattr(cluster, k) for k in sorted(vars(cluster))}
+        )
+        with phase("serve/session-build"):
+            wl.reset_name_counter()
+            pods: List[dict] = []
+            pods.extend(wl.pods_excluding_daemon_sets(cluster))
+            for ds in cluster.daemon_sets:
+                pods.extend(wl.pods_from_daemon_set(ds, cluster.nodes))
+            self.cluster_pods = pods
+            # every request's app expansion restarts from this state
+            self._counter0 = wl.name_counter_state()
+            self.oracle = Oracle(cluster.nodes)
+            self.engine = TpuEngine(self.oracle)
+            self._resolver = build_priority_resolver(cluster.priority_classes)
+            # the batched scan cannot model priority/preemption or
+            # per-pod host callbacks; a cluster that carries either
+            # routes EVERY request through the serial path. The gate
+            # must cover every condition scheduler/core treats as
+            # scan-breaking, or batched answers would diverge from
+            # simulate(): permit/stateful hooks (needs_serial), a
+            # custom queue-sort comparator (reorders pods before the
+            # scan would see them), a custom post_filter (acts on ANY
+            # failed pod — core routes those through the escape path),
+            # and priority-bearing cluster pods
+            self.force_serial_reason = ""
+            registry = self.oracle.registry
+            if registry.needs_serial:
+                self.force_serial_reason = "plugin registry needs serial engine"
+            elif registry.queue_sort_plugin is not None:
+                self.force_serial_reason = "custom queue-sort plugin orders pods"
+            elif registry.has_post_filter:
+                self.force_serial_reason = "custom post_filter plugin registered"
+            elif any(pod_uses_priority(p, self._resolver) for p in pods):
+                self.force_serial_reason = "cluster pods carry priority"
+            self._pod_uses_priority = pod_uses_priority
+
+    def warm(self):
+        """Pre-compile the scan for a small request shape and build the
+        ClusterStatic encoding, so the first real request does not pay
+        the daemon's cold start. Real traffic with other shapes still
+        compiles once per shape (jit cache, persistent across
+        requests)."""
+        warm_app = ResourceTypes()
+        warm_app.pods = [
+            {
+                "kind": "Pod",
+                "metadata": {"name": "serve-warm", "namespace": "default"},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "warm",
+                            "resources": {
+                                "requests": {"cpu": "1m", "memory": "1Mi"}
+                            },
+                        }
+                    ],
+                    "schedulerName": "default-scheduler",
+                },
+            }
+        ]
+        self.evaluate_batch(
+            [WhatIfRequest(apps=[AppResource("serve-warm", warm_app)])]
+        )
+
+    # -- expansion ----------------------------------------------------------
+
+    def _expand_request(self, req: WhatIfRequest) -> List[dict]:
+        """Expand one request's apps exactly like a standalone run:
+        counter re-seated to the post-cluster state, apps in order,
+        each app's pods through the affinity/toleration queue sorts
+        (the zero-priority ordering of scheduler/core.schedule_app)."""
+        wl.set_name_counter(self._counter0)
+        pods: List[dict] = []
+        for app in req.apps:
+            app_pods = wl.generate_valid_pods_from_app(
+                app.name, app.resource, self.cluster.nodes
+            )
+            pods.extend(_sort_app_pods(app_pods))
+        return pods
+
+    # -- evaluation ---------------------------------------------------------
+
+    def evaluate_batch(self, reqs: List[WhatIfRequest]) -> List[WhatIfReply]:
+        """Answer every request of one coalesced tick: expansion and
+        routing per request, then ONE batched device dispatch for all
+        scan-eligible scenarios (chunk-halving on device OOM, serial
+        host-oracle floor — runtime/guard.run_chunked), then per
+        request a replay into a fresh oracle and the canonical body."""
+        from ..models.validation import InputError
+        from ..runtime.guard import run_chunked
+        from ..utils.trace import phase
+
+        replies: List[Optional[WhatIfReply]] = [None] * len(reqs)
+        expanded: List[Optional[List[dict]]] = [None] * len(reqs)
+        batched: List[int] = []
+        with phase("serve/expand"):
+            for r_i, req in enumerate(reqs):
+                try:
+                    pods = self._expand_request(req)
+                except (InputError, ValueError, KeyError) as e:
+                    replies[r_i] = WhatIfReply(
+                        status=400,
+                        body=json.dumps(
+                            {"error": f"invalid request: {e}"}
+                        ).encode(),
+                        meta={"engine": "rejected"},
+                    )
+                    continue
+                expanded[r_i] = pods
+                if self.force_serial_reason or any(
+                    self._pod_uses_priority(p, self._resolver) for p in pods
+                ):
+                    replies[r_i] = self._evaluate_serial(
+                        req,
+                        reason=self.force_serial_reason
+                        or "request carries priority",
+                    )
+                else:
+                    batched.append(r_i)
+        if not batched:
+            return replies
+
+        # one pod axis for the whole tick: cluster pods first (active
+        # in every scenario), then each request's pods (active only in
+        # its own row) — scenario r's scan order equals the standalone
+        # run's schedule order
+        all_pods = list(self.cluster_pods)
+        req_span = {}
+        for r_i in batched:
+            lo = len(all_pods)
+            all_pods.extend(expanded[r_i])
+            req_span[r_i] = (lo, len(all_pods))
+        node_index = self.oracle.node_index
+        # pods pinned to unknown nodes never reach the scheduler
+        # (begin_batch contract; reference simulator.go:221-229)
+        pos_of = np.full(len(all_pods), -1, dtype=np.int64)
+        batch_idx = []
+        for i, pod in enumerate(all_pods):
+            name = (pod.get("spec") or {}).get("nodeName")
+            if name and name not in node_index:
+                continue
+            pos_of[i] = len(batch_idx)
+            batch_idx.append(i)
+        n_batch = len(batch_idx)
+        n_cluster = len(self.cluster_pods)
+
+        bidx_arr = np.asarray(batch_idx, dtype=np.int64)
+        actives = np.zeros((len(batched), n_batch), dtype=bool)
+        for row, r_i in enumerate(batched):
+            lo, hi = req_span[r_i]
+            actives[row] = (bidx_arr < n_cluster) | (
+                (bidx_arr >= lo) & (bidx_arr < hi)
+            )
+
+        if n_batch:
+            with phase("serve/encode"):
+                self.engine.begin_batch([all_pods[i] for i in batch_idx])
+
+            def evaluate(lo, hi):
+                COUNTERS.inc("serve_device_dispatches_total")
+                rows = self.engine.scan_scenarios(actives[lo:hi])
+                return [np.asarray(r) for r in rows]
+
+            def serial_fallback(i):
+                return self._serial_placements(actives[i], batch_idx, all_pods)
+
+            rows = run_chunked(
+                evaluate,
+                len(batched),
+                label="serve",
+                serial_fallback=serial_fallback,
+            )
+        else:
+            rows = [np.zeros(0, dtype=np.int64) for _ in batched]
+
+        with phase("serve/replay"):
+            for row, r_i in enumerate(batched):
+                lo, hi = req_span[r_i]
+                # lo >= n_cluster always, so this is scan order
+                scenario_pods = [
+                    (i, all_pods[i])
+                    for i in list(range(n_cluster)) + list(range(lo, hi))
+                ]
+                result = self._replay(scenario_pods, rows[row], pos_of)
+                replies[r_i] = WhatIfReply(
+                    status=200,
+                    body=result_payload(result),
+                    meta={"engine": "coalesced-scan"},
+                )
+        return replies
+
+    def _replay(self, scenario_pods, placements, pos_of) -> SimulateResult:
+        """Mirror one scenario's placements into a fresh host oracle in
+        scan order — the engine-replay contract of scheduler/engine.py:
+        failure reasons read the oracle state of their own step, so
+        they match what the standalone run reports. Pods replay as
+        copies (_own_pod): the session's shared dicts stay pristine for
+        the next batch's encode."""
+        oracle = Oracle([ns.node for ns in self.oracle.nodes])
+        failed: List[UnscheduledPod] = []
+        for i, pod in scenario_pods:
+            pos = int(pos_of[i])
+            pod2 = _own_pod(pod)
+            if pos < 0:
+                # dangling (unknown spec.nodeName): tracked, never
+                # scheduled, absent from node status — like simulate()
+                continue
+            place = int(placements[pos])
+            if place == INACTIVE:  # pragma: no cover - defensive
+                continue
+            if (pod.get("spec") or {}).get("nodeName"):
+                oracle.place_existing_pod(pod2)
+            elif place < 0:
+                _, reasons, _ = oracle._find_feasible(pod2)
+                failed.append(
+                    UnscheduledPod(
+                        pod=pod2, reason=Oracle._failure_message(pod2, reasons)
+                    )
+                )
+            else:
+                oracle._reserve_and_bind(pod2, oracle.nodes[place])
+        status = [
+            NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes
+        ]
+        return SimulateResult(unscheduled_pods=failed, node_status=status)
+
+    def _serial_placements(self, active, batch_idx, all_pods) -> np.ndarray:
+        """Deterministic host-oracle evaluation of ONE scenario row —
+        the guard ladder's floor when even a single-scenario dispatch
+        dies on the device. Same conventions as the scan: node index,
+        -1 unschedulable, INACTIVE for masked-off positions."""
+        oracle = Oracle([ns.node for ns in self.oracle.nodes])
+        node_index = self.oracle.node_index
+        out = np.full(len(batch_idx), INACTIVE, dtype=np.int64)
+        for pos, i in enumerate(batch_idx):
+            if not active[pos]:
+                continue
+            pod2 = _own_pod(all_pods[i])
+            if (pod2.get("spec") or {}).get("nodeName"):
+                oracle.place_existing_pod(pod2)
+                out[pos] = node_index[pod2["spec"]["nodeName"]]
+                continue
+            name, _reason = oracle.schedule_pod(pod2)
+            out[pos] = -1 if name is None else node_index[name]
+        return out
+
+    def _evaluate_serial(self, req: WhatIfRequest, reason: str) -> WhatIfReply:
+        """The full-fidelity path for requests the batched scan cannot
+        model: a real simulate() over deep copies (the session's loaded
+        cluster must stay pristine — simulate binds pods in place)."""
+        from ..utils.trace import phase
+
+        with phase("serve/serial"):
+            wl.reset_name_counter()
+            cluster = copy.deepcopy(self.cluster)
+            apps = [
+                AppResource(a.name, copy.deepcopy(a.resource)) for a in req.apps
+            ]
+            result = simulate(cluster, apps, engine="tpu")
+        return WhatIfReply(
+            status=200,
+            body=result_payload(result),
+            meta={"engine": "serial", "serialReason": reason},
+        )

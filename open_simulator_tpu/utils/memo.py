@@ -18,6 +18,7 @@ working set per run is far below the cap.
 
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import Callable, Tuple
 
@@ -29,13 +30,19 @@ _DEFAULT_MAX = 8192
 # register their own clear() via register_cache
 _ALL_MEMOS: "weakref.WeakSet[IdentityMemo]" = weakref.WeakSet()
 _EXTRA_CACHES: list = []
+# guards the registries and every memo's eviction/insertion compound
+# (a `simon serve` process runs request threads alongside the
+# dispatcher; the warm-cache concurrency contract is documented in
+# docs/PERFORMANCE.md)
+_REGISTRY_LOCK = threading.Lock()
 
 
 def register_cache(clear_fn):
     """Register an extra cache-clearing callback run by
     clear_all_memos (for identity-keyed caches outside this module
     that pin run-scoped objects — same contract)."""
-    _EXTRA_CACHES.append(clear_fn)
+    with _REGISTRY_LOCK:
+        _EXTRA_CACHES.append(clear_fn)
 
 
 def clear_all_memos():
@@ -44,20 +51,36 @@ def clear_all_memos():
     Called at the planner boundaries (Applier.run, probe_plan) so a
     long-lived process embedding the library does not pin whole
     simulations' object graphs between runs. Library users driving
-    simulate() directly can call this themselves."""
-    for memo in list(_ALL_MEMOS):
+    simulate() directly can call this themselves. MUST NOT run
+    concurrently with an in-flight simulation over the same object
+    graphs — the serve daemon therefore never calls it (its caches are
+    bounded by their caps instead; docs/PERFORMANCE.md)."""
+    with _REGISTRY_LOCK:
+        memos = list(_ALL_MEMOS)
+        extras = list(_EXTRA_CACHES)
+    for memo in memos:
         memo.clear()
-    for fn in _EXTRA_CACHES:
+    for fn in extras:
         fn()
 
 
 class IdentityMemo:
-    """Memoize ``compute(*sources)`` keyed by the identity of sources."""
+    """Memoize ``compute(*sources)`` keyed by the identity of sources.
+
+    Thread-safe for concurrent readers/writers: the fast-path hit is a
+    single dict read (atomic under the GIL, and a hit proves identity
+    per the module contract); the miss path runs ``compute`` OUTSIDE
+    the lock (two racing threads may both compute — benign, the values
+    are equal by construction) and takes the lock only for the
+    eviction + insertion compound, so a wholesale clear can never
+    interleave with a half-done insert."""
 
     def __init__(self, max_entries: int = _DEFAULT_MAX):
         self._cache: dict = {}
         self._max = max_entries
-        _ALL_MEMOS.add(self)
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            _ALL_MEMOS.add(self)
 
     def get(self, sources: Tuple, compute: Callable):
         key = tuple(map(id, sources))
@@ -67,10 +90,12 @@ class IdentityMemo:
             # make live-id collisions impossible)
             return hit[1]
         value = compute()
-        if len(self._cache) >= self._max:
-            self._cache.clear()
-        self._cache[key] = (sources, value)
+        with self._lock:
+            if len(self._cache) >= self._max:
+                self._cache.clear()
+            self._cache[key] = (sources, value)
         return value
 
     def clear(self):
-        self._cache.clear()
+        with self._lock:
+            self._cache.clear()

@@ -79,19 +79,27 @@ class Trace:
             self.appended.clear()
 
     def as_dict(self) -> dict:
-        out = {
-            "phases": [
-                {
-                    "name": n,
-                    "seconds": round(self.phases[n].seconds, 6),
-                    "count": self.phases[n].count,
-                }
-                for n in self.order
-            ],
-            "total_seconds": round(sum(p.seconds for p in self.phases.values()), 6),
-        }
-        if self.notes:
-            out["notes"] = dict(self.notes)
+        # atomic snapshot: request threads sharing one process (simon
+        # serve) mutate phases/notes concurrently with serialization,
+        # so the whole read happens under the same lock the writers
+        # hold — a trace JSON never shows a phase list and a note map
+        # from two different instants
+        with _lock:
+            out = {
+                "phases": [
+                    {
+                        "name": n,
+                        "seconds": round(self.phases[n].seconds, 6),
+                        "count": self.phases[n].count,
+                    }
+                    for n in self.order
+                ],
+                "total_seconds": round(
+                    sum(p.seconds for p in self.phases.values()), 6
+                ),
+            }
+            if self.notes:
+                out["notes"] = dict(self.notes)
         return out
 
     def as_json(self) -> str:
@@ -120,6 +128,131 @@ def phase(name: str, trace: Optional[Trace] = None):
         yield
     finally:
         (trace or GLOBAL).add(name, time.perf_counter() - t0)
+
+
+class Counters:
+    """Thread-safe process-wide operational counters (simon serve's
+    `/metrics` endpoint reads these; the coalescer and the HTTP
+    handler threads write them concurrently).
+
+    Three kinds, all guarded by one lock:
+
+    - counters (`inc`): monotonically increasing totals (requests,
+      sheds, device dispatches)
+    - gauges (`gauge`): last-written values (queue depth, batch fill)
+    - observations (`observe`): bounded reservoirs of recent samples
+      (request latency, batch fill) from which `percentile` and `mean`
+      derive summary stats, plus a timestamp ring for `rate` (QPS over
+      a sliding window)
+
+    `snapshot()` returns everything at one instant — the same
+    atomic-read contract as Trace.as_dict.
+    """
+
+    _WINDOW = 2048
+
+    def __init__(self, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._counts: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._obs: Dict[str, List[float]] = {}
+        # event counts in 1-second buckets [(bucket_epoch_s, count)]:
+        # bounded by TIME (pruned past _RATE_KEEP_S), not entry count,
+        # so `rate` never saturates at high event rates the way a
+        # fixed-size timestamp ring would
+        self._marks: Dict[str, List[List[float]]] = {}
+        self._first_mark: Dict[str, float] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            buf = self._obs.setdefault(name, [])
+            buf.append(float(value))
+            if len(buf) > self._WINDOW:
+                del buf[: len(buf) - self._WINDOW]
+
+    _RATE_KEEP_S = 600.0
+
+    def mark(self, name: str) -> None:
+        """Record one event for `rate` (1-second bucket counts)."""
+        with self._lock:
+            now = self._clock()
+            self._first_mark.setdefault(name, now)
+            buf = self._marks.setdefault(name, [])
+            bucket = float(int(now))
+            if buf and buf[-1][0] == bucket:
+                buf[-1][1] += 1
+            else:
+                buf.append([bucket, 1])
+                while buf and now - buf[0][0] > self._RATE_KEEP_S:
+                    buf.pop(0)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def mean(self, name: str) -> float:
+        with self._lock:
+            buf = self._obs.get(name)
+            return (sum(buf) / len(buf)) if buf else 0.0
+
+    def percentile(self, name: str, q: float) -> float:
+        """q in [0, 100], nearest-rank on the recent-sample window."""
+        with self._lock:
+            buf = sorted(self._obs.get(name) or ())
+        if not buf:
+            return 0.0
+        k = min(len(buf) - 1, max(0, int(round(q / 100.0 * (len(buf) - 1)))))
+        return buf[k]
+
+    def rate(self, name: str, window_s: float = 60.0) -> float:
+        """Events per second over the trailing `window_s`. The
+        denominator is the WINDOW, not the burst span — an idle hour
+        followed by 10 events in 2s is a trailing rate of 10/60, not
+        10/2. Only when the very first event is younger than the
+        window does the denominator shrink to the observed age (>= 1s),
+        so a fresh daemon reports its true rate instead of a diluted
+        one."""
+        now = self._clock()
+        with self._lock:
+            buf = self._marks.get(name) or []
+            recent = sum(c for t, c in buf if now - t <= window_s)
+            first_ever = self._first_mark.get(name)
+        if not recent:
+            return 0.0
+        denom = window_s
+        if first_ever is not None and now - first_ever < window_s:
+            denom = max(now - first_ever, 1.0)
+        return recent / denom
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._gauges.clear()
+            self._obs.clear()
+            self._marks.clear()
+            self._first_mark.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counts": dict(self._counts),
+                "gauges": dict(self._gauges),
+                "observations": {k: len(v) for k, v in self._obs.items()},
+            }
+
+
+# process-wide operational counters (simon serve /metrics); distinct
+# from GLOBAL (phase wall-clock) — counters survive GLOBAL.reset()
+COUNTERS = Counters()
 
 
 @contextmanager

@@ -1316,6 +1316,24 @@ def main():
         os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
     )
 
+    # flight-recorder attribution for every recorded number: device
+    # dispatches, XLA recompiles, transfer bytes (always-on counters,
+    # obs/profile.py) and the top spans by EXCLUSIVE wall-clock (span
+    # recorder at phase granularity — a handful of microseconds per
+    # phase, far inside the run-to-run spread). Future perf PRs get
+    # phase attribution out of every BENCH_*.json for free.
+    from open_simulator_tpu.obs import profile as obs_profile
+    from open_simulator_tpu.obs import spans as obs_spans
+
+    # SIMON_BENCH_OBS=0 turns the span recorder off for strict
+    # flags-off timing (the counters stay — they are always-on and
+    # per-dispatch, not per-pod); measured spans-on overhead is ~1%
+    # at phase granularity (docs/OBSERVABILITY.md)
+    bench_obs = os.environ.get("SIMON_BENCH_OBS", "1") != "0"
+    if bench_obs:
+        obs_spans.RECORDER.enable()
+    obs_before = obs_profile.snapshot()
+
     scenario = os.environ.get("SIMON_BENCH", "all")
     if scenario == "default":
         nodes, pods = build_scenario()
@@ -1589,6 +1607,16 @@ def main():
             "unit": "s",
             "vs_baseline": round(NORTH_STAR_PLAN_SECONDS / c["elapsed_s"], 3),
         }
+    recorded = obs_spans.RECORDER.snapshot() if bench_obs else []
+    obs_spans.RECORDER.disable()
+    prof = obs_profile.delta(obs_before)
+    out["obs"] = {
+        "jax_dispatches": prof["jax_dispatches_total"],
+        "jax_recompiles": prof["jax_recompiles_total"],
+        "transfer_d2h_bytes": prof["device_transfer_d2h_bytes_total"],
+        "transfer_h2d_bytes": prof["device_transfer_h2d_bytes_total"],
+        "top_spans_exclusive_ms": obs_spans.top_spans(recorded, 5),
+    }
     print(json.dumps(out))
 
 

@@ -193,11 +193,14 @@ def replay_masked(sweep, valid, placements):
     the caller that needs every reason runs the serial engine."""
     import numpy as np
 
+    from ..obs.explain import EXPLAIN
     from ..scheduler.core import NodeStatus, SimulateResult, UnscheduledPod
     from ..scheduler.engine import build_bulk_tables
     from ..scheduler.oracle import ClassCommitCache, Oracle, simple_commit_mask
     from ..utils.trace import profiled
 
+    if EXPLAIN.enabled:
+        EXPLAIN.set_context(engine="capacity-replay")
     valid = np.asarray(valid)
     kept = [i for i in range(len(sweep.oracle.nodes)) if valid[i]]
     nodes = [sweep.oracle.nodes[i].node for i in kept]
@@ -232,6 +235,15 @@ def replay_masked(sweep, valid, placements):
             & simple_class[class_of_pod]
             & bulk_ok[class_of_pod]
         )
+        if EXPLAIN.enabled and EXPLAIN.target is not None:
+            # a targeted explained pod leaves the bulk run so its
+            # filter/score walk is captured against the oracle state of
+            # its own commit step (scheduler/core._replay_window has
+            # the same carve-out; failed pods explain regardless)
+            want = np.fromiter(
+                (EXPLAIN.wants(p) for p in pods), dtype=bool, count=len(pods)
+            )
+            bulk_mask &= ~want
 
         def bulk(a, b):
             if b <= a:
@@ -279,7 +291,16 @@ def replay_masked(sweep, valid, placements):
                 # else dangling: kept in the tracker, never scheduled
                 # (reference simulator.go:221-229)
             elif idx < 0:
-                if len(failed) < MAX_DETAILED_REASONS:
+                if len(failed) < MAX_DETAILED_REASONS or (
+                    EXPLAIN.enabled and EXPLAIN.should_record(pod)
+                ):
+                    # an explained pod past the detailed-reason cap
+                    # still gets its serial filter pass (the verdict
+                    # hook rides _find_feasible). should_record, not
+                    # wants: once the untargeted recorder is full this
+                    # must NOT widen the detailed-reason cap to every
+                    # failure — that O(nodes) walk per failed pod is
+                    # the cliff MAX_DETAILED_REASONS exists to prevent
                     _, reasons, _ = oracle._find_feasible(pod)
                     reason = Oracle._failure_message(pod, reasons)
                 else:
@@ -296,6 +317,14 @@ def replay_masked(sweep, valid, placements):
                     # same loud failure as the bulk path: a negative
                     # index would silently wrap to the LAST node
                     raise KeyError(f"placement on masked-off node index {idx}")
+                if (
+                    EXPLAIN.enabled
+                    and EXPLAIN.target is not None
+                    and EXPLAIN.wants(pod)
+                ):
+                    # committed-pod captures are targeted-only (the
+                    # untargeted recorder explains failures)
+                    EXPLAIN.capture(oracle, pod, local_i)
                 if simple_class[class_of_pod[p_i]]:
                     commit_cache.commit(
                         oracle, pod, oracle.nodes[local_i], int(class_of_pod[p_i])

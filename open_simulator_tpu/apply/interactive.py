@@ -215,6 +215,14 @@ def run_interactive(applier, shell: Optional[Shell] = None, max_iterations: int 
                         f"{i:4d} {meta.get('namespace', 'default')}/"
                         f"{meta.get('name', '')}: {up.reason}"
                     )
+                from ..obs.explain import EXPLAIN
+
+                if EXPLAIN.enabled:
+                    # `simon apply -i --explain`: the per-node verdict
+                    # tables recorded during this iteration's replay
+                    from ..obs.explain import render_explanations
+
+                    shell.say(render_explanations())
             elif choice == SURVEY_ADD_NODE:
                 if new_node is None:
                     shell.say("no newNode spec configured; cannot add nodes")

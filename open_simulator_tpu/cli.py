@@ -79,6 +79,85 @@ def _force_platform():
         os.environ["JAX_PLATFORMS"] = "cpu"
 
 
+def _obs_begin(args):
+    """Arm the flight recorder (obs/) from the shared observability
+    flags (--trace-out / --explain / --profile-dir; docs/OBSERVABILITY.md).
+    Returns a finish callback that exports the trace and disarms —
+    called from _with_obs's finally so every exit path exports."""
+    from .obs import profile as obs_profile
+    from .obs import spans
+    from .obs.explain import EXPLAIN
+
+    trace_out = getattr(args, "trace_out", "")
+    explain = getattr(args, "explain", None)
+    profile_dir = getattr(args, "profile_dir", "")
+    if profile_dir:
+        obs_profile.set_profile_dir(profile_dir)
+    if trace_out:
+        sink = spans.JsonlSink(trace_out) if trace_out.endswith(".jsonl") else None
+        spans.RECORDER.enable(sink)
+    if explain is not None:
+        EXPLAIN.enable(explain or None)
+
+    def finish():
+        if trace_out:
+            if not trace_out.endswith(".jsonl"):
+                spans.export_chrome_trace(trace_out)
+            dropped = spans.RECORDER.dropped
+            spans.RECORDER.disable()
+            note = f" ({dropped} span(s) dropped at cap)" if dropped else ""
+            print(f"span trace written to {trace_out}{note}", file=sys.stderr)
+        if explain is not None:
+            EXPLAIN.disable()
+        if profile_dir:
+            obs_profile.set_profile_dir(None)
+            print(f"JAX profiler capture(s) in {profile_dir}", file=sys.stderr)
+
+    return finish
+
+
+def _with_obs(name: str):
+    """Decorator for the long-running commands: arm the recorder from
+    the obs flags, run the command under a root span (`simon <name>` —
+    phases and jit dispatches nest under it), export on ANY exit."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(args):
+            from .obs.spans import RECORDER
+
+            finish = _obs_begin(args)
+            try:
+                with RECORDER.span(f"simon {name}", command=name):
+                    return fn(args)
+            finally:
+                finish()
+
+        return wrapper
+
+    return deco
+
+
+def _print_explanations(args, out=None):
+    """Append the --explain block to the human-readable output."""
+    if getattr(args, "explain", None) is None:
+        return
+    from .obs.explain import render_explanations
+
+    print(render_explanations(), file=out)
+
+
+def _explanations_payload(args):
+    """The --explain block for JSON output (None when off)."""
+    if getattr(args, "explain", None) is None:
+        return None
+    from .obs.explain import explanations_dict
+
+    return explanations_dict()
+
+
 def _emit_partial(e, args, journal_path: str) -> int:
     """Render an ExecutionHalted (deadline / SIGINT at a safe boundary)
     as a well-formed machine-readable partial report, never a
@@ -108,6 +187,7 @@ def _emit_partial(e, args, journal_path: str) -> int:
     return e.exit_code
 
 
+@_with_obs("apply")
 def cmd_apply(args) -> int:
     from .apply.applier import Applier, SimonConfig
     from .models.validation import InputError
@@ -194,7 +274,7 @@ def cmd_apply(args) -> int:
             result.result, args.snapshot, cluster=getattr(applier, "last_cluster", None)
         )
     if args.format == "json":
-        print(_result_json(result))
+        print(_result_json(result, explain=_explanations_payload(args)))
         return 0 if result.success else 1
     if not result.success:
         print(result.message)
@@ -202,11 +282,13 @@ def cmd_apply(args) -> int:
             for i, up in enumerate(result.result.unscheduled_pods):
                 meta = up.pod.get("metadata") or {}
                 print(f"{i:4d} {meta.get('namespace')}/{meta.get('name')}: {up.reason}")
+        _print_explanations(args)
         return 1
     print("Simulation success!")
     if result.new_node_count:
         print(f"new nodes added: {result.new_node_count}")
     print(result.report_text)
+    _print_explanations(args)
     return 0
 
 
@@ -232,6 +314,7 @@ def _parse_degrade(spec: str):
     return pct, ([n for n in nodes.split(",") if n] or None) if nodes else None
 
 
+@_with_obs("chaos")
 def cmd_chaos(args) -> int:
     """Fault-injection survivability of a committed plan
     (resilience/chaos.py; docs/RESILIENCE.md)."""
@@ -388,12 +471,18 @@ def cmd_chaos(args) -> int:
     if args.trace:
         print(GLOBAL.as_json(), file=sys.stderr)
     if args.format == "json":
-        print(json.dumps(report.as_dict()))
+        payload = report.as_dict()
+        explain = _explanations_payload(args)
+        if explain is not None:
+            payload["explain"] = explain
+        print(json.dumps(payload))
     else:
         print(report.render_text())
+        _print_explanations(args)
     return 0 if report.all_survived else 1
 
 
+@_with_obs("defrag")
 def cmd_defrag(args) -> int:
     import json
 
@@ -416,28 +505,29 @@ def cmd_defrag(args) -> int:
 
     plan = plan_defrag(snapshot, max_drain=args.max_drain, protect=protect)
     if args.format == "json":
-        print(
-            json.dumps(
+        payload = {
+            "drainOrder": plan.ranked_nodes,
+            "chosenDepth": plan.chosen_depth,
+            "drainedNodes": plan.drained_nodes,
+            "unscheduledByDepth": [int(x) for x in plan.unscheduled],
+            "moves": [
                 {
-                    "drainOrder": plan.ranked_nodes,
-                    "chosenDepth": plan.chosen_depth,
-                    "drainedNodes": plan.drained_nodes,
-                    "unscheduledByDepth": [int(x) for x in plan.unscheduled],
-                    "moves": [
-                        {
-                            "namespace": (m.pod.get("metadata") or {}).get("namespace"),
-                            "pod": (m.pod.get("metadata") or {}).get("name"),
-                            "from": m.from_node,
-                            "to": m.to_node,
-                        }
-                        for m in plan.moves
-                    ],
+                    "namespace": (m.pod.get("metadata") or {}).get("namespace"),
+                    "pod": (m.pod.get("metadata") or {}).get("name"),
+                    "from": m.from_node,
+                    "to": m.to_node,
                 }
-            )
-        )
+                for m in plan.moves
+            ],
+        }
+        explain = _explanations_payload(args)
+        if explain is not None:
+            payload["explain"] = explain
+        print(json.dumps(payload))
         return 0
     if plan.chosen_depth == 0:
         print("no node can be fully drained")
+        _print_explanations(args)
         return 0
     print(f"drainable nodes ({plan.chosen_depth}): {', '.join(plan.drained_nodes)}")
     print(f"migrations required: {len(plan.moves)}")
@@ -453,12 +543,14 @@ def cmd_defrag(args) -> int:
         for m in plan.moves
     ]
     print(render_table(["Namespace", "Pod", "From", "To"], rows))
+    _print_explanations(args)
     return 0
 
 
-def _result_json(result) -> str:
+def _result_json(result, explain=None) -> str:
     """Structured results (SURVEY.md §5: structured results + optional
-    table renderer instead of ASCII-only)."""
+    table renderer instead of ASCII-only). `explain` (the --explain
+    recorder payload) rides along as an `explain` key when armed."""
     import json
 
     from .models.workloads import LABEL_NEW_NODE
@@ -470,6 +562,8 @@ def _result_json(result) -> str:
         "nodes": [],
         "unscheduledPods": [],
     }
+    if explain is not None:
+        out["explain"] = explain
     if result.result is not None:
         for ns in result.result.node_status:
             meta = ns.node.get("metadata") or {}
@@ -501,6 +595,7 @@ def _result_json(result) -> str:
     return json.dumps(out)
 
 
+@_with_obs("serve")
 def cmd_serve(args) -> int:
     """Long-lived what-if daemon (serve/; docs/SERVING.md): load the
     cluster once, pre-warm the encode + compiled-scan caches, coalesce
@@ -556,7 +651,13 @@ def cmd_serve(args) -> int:
         f"(cluster {session.fingerprint})",
         flush=True,
     )
-    return daemon.run_until_signaled()
+    code = daemon.run_until_signaled()
+    if args.explain is not None:
+        # daemon mode: explanations accumulated across requests land on
+        # stderr at drain (per-request output must stay byte-identical
+        # to standalone runs — the serve conformance contract)
+        _print_explanations(args, out=sys.stderr)
+    return code
 
 
 def cmd_version(_args) -> int:
@@ -635,6 +736,43 @@ def cmd_gen_doc(args) -> int:
         )
     print(f"wrote {len(subs) + 1} pages to {out_dir}")
     return 0
+
+
+def _add_obs_flags(p: argparse.ArgumentParser):
+    """Flight-recorder flags shared by every long-running command
+    (docs/OBSERVABILITY.md): span trace export, per-pod placement
+    explanations, JAX profiler capture."""
+    p.add_argument(
+        "--trace-out",
+        default="",
+        metavar="PATH",
+        help="record a hierarchical span trace of the whole run and "
+        "write it on exit: a .json path gets Chrome trace-event format "
+        "(loadable in Perfetto / chrome://tracing), a .jsonl path gets "
+        "streaming JSONL with each span fsync'd as it closes (a crash "
+        "keeps every finished span)",
+    )
+    p.add_argument(
+        "--explain",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="POD",
+        help="record per-pod placement explanations — per-node filter "
+        "verdicts, score vectors, and preemption/escape provenance — "
+        "and append them to the output (JSON output gains an `explain` "
+        "key). With POD (a pod name or namespace/name) the named pod's "
+        "full decision is explained even when it schedules; without, "
+        "unschedulable pods are explained (capped)",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default="",
+        metavar="DIR",
+        help="capture JAX profiler traces of the device phases into DIR "
+        "(viewable in TensorBoard/Perfetto; equivalent to setting "
+        "SIMON_PROFILE_DIR)",
+    )
 
 
 def _add_guard_flags(p: argparse.ArgumentParser):
@@ -718,6 +856,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="sampled K-failure scenarios per escalation (K >= 2)",
     )
     _add_guard_flags(p_apply)
+    _add_obs_flags(p_apply)
     p_apply.add_argument(
         "--format", choices=["table", "json"], default="table", help="result output format"
     )
@@ -753,6 +892,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_defrag.add_argument(
         "--format", choices=["table", "json"], default="table", help="result output format"
     )
+    _add_obs_flags(p_defrag)
     p_defrag.set_defaults(func=cmd_defrag)
 
     p_chaos = sub.add_parser(
@@ -810,6 +950,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_chaos.add_argument("--use-greed", action="store_true", help=argparse.SUPPRESS)
     _add_guard_flags(p_chaos)
+    _add_obs_flags(p_chaos)
     p_chaos.add_argument(
         "--format", choices=["table", "json"], default="table", help="result output format"
     )
@@ -868,6 +1009,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the pre-listen warmup request (faster start, slower "
         "first request)",
     )
+    _add_obs_flags(p_serve)
     p_serve.set_defaults(func=cmd_serve)
 
     p_version = sub.add_parser("version", help="print version")

@@ -1,0 +1,34 @@
+"""Process-wide flight recorder (docs/OBSERVABILITY.md).
+
+Three cooperating pieces, all off by default and costing nothing on the
+hot path until a CLI flag turns them on:
+
+- ``obs.spans``: thread-safe hierarchical wall-clock spans (context
+  manager + decorator, contextvar parent tracking so dispatcher threads
+  and nested phases nest correctly) with Chrome trace-event JSON and
+  streaming JSONL exporters — ``--trace-out``.
+- ``obs.explain``: per-pod placement explanations — per-node filter
+  verdicts and score vectors captured at commit/failure time on both
+  the serial oracle and the scan-replay paths — ``--explain [POD]``.
+- ``obs.profile``: JAX dispatch / jit-cache-miss (recompile) / device
+  transfer-bytes accounting through the ``utils.trace.Counters``
+  registry, plus the ``--profile-dir`` JAX profiler capture.
+
+``obs.profile`` is deliberately NOT imported here: it imports
+``utils.trace`` for the counter registry, and ``utils.trace`` imports
+``obs.spans`` for the phase shim — importing profile at package level
+would close that cycle while ``utils.trace`` is still initializing.
+"""
+
+from . import explain, spans
+from .explain import EXPLAIN
+from .spans import RECORDER, span, traced
+
+__all__ = [
+    "EXPLAIN",
+    "RECORDER",
+    "explain",
+    "span",
+    "spans",
+    "traced",
+]

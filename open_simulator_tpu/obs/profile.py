@@ -1,0 +1,142 @@
+"""JAX dispatch / recompile / transfer accounting.
+
+"How many XLA recompiles did this sweep trigger" was previously
+unanswerable: the module-level jits in ``scheduler/engine.py``,
+``ops/scan.py``, and ``parallel/sweep.py`` compiled (or didn't)
+invisibly. This module wraps them in ``InstrumentedJit``, which counts
+
+- ``jax_dispatches_total`` (+ per-site ``jax_dispatches_<site>``):
+  every call into a jitted entry point — one device dispatch each;
+- ``jax_recompiles_total`` (+ per-site): calls whose jit cache grew
+  (``PjitFunction._cache_size`` before/after — a miss means XLA traced
+  and compiled a new executable for this shape/static combination);
+- ``device_transfer_d2h_bytes_total`` / ``..._h2d_bytes_total``:
+  bytes materialized from / shipped to the device at the few sites
+  that do it (engine scan outputs, scenario batches).
+
+Everything lands in the existing process-wide ``utils.trace.Counters``
+registry, so ``simon serve``'s ``/metrics`` endpoint and the bench
+harness report the same numbers with zero extra plumbing. The counters
+are always on (one lock + dict-add per DISPATCH, which is rare —
+dispatches are per scan round, not per pod), so there is no flag to
+forget before asking "did this workload recompile".
+
+The optional ``jax.profiler`` capture (``--profile-dir``) reuses the
+``utils.trace.profiled`` machinery via the SIMON_PROFILE_DIR env var.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.trace import COUNTERS
+
+
+class InstrumentedJit:
+    """Wraps a jitted callable with dispatch + cache-miss counters and
+    (when the span recorder is on) a per-dispatch span. Transparent to
+    callers: ``__call__`` only."""
+
+    __slots__ = ("_fn", "name")
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self.name = name
+
+    def _cache_size(self) -> Optional[int]:
+        size = getattr(self._fn, "_cache_size", None)
+        if size is None:
+            return None
+        try:
+            return int(size())
+        except (TypeError, ValueError):  # non-standard jit wrapper
+            return None
+
+    def __call__(self, *args, **kwargs):
+        COUNTERS.inc("jax_dispatches_total")
+        COUNTERS.inc(f"jax_dispatches_{self.name}")
+        before = self._cache_size()
+        from .spans import RECORDER
+
+        if RECORDER.enabled:
+            with RECORDER.span(f"jit/{self.name}", site=self.name):
+                out = self._fn(*args, **kwargs)
+        else:
+            out = self._fn(*args, **kwargs)
+        after = self._cache_size()
+        if before is not None and after is not None and after > before:
+            COUNTERS.inc("jax_recompiles_total", after - before)
+            COUNTERS.inc(f"jax_recompiles_{self.name}", after - before)
+        return out
+
+
+def instrument_jit(fn, name: str) -> InstrumentedJit:
+    """Wrap a jitted function for dispatch/recompile accounting. Safe
+    to apply to anything callable; cache-miss detection degrades to
+    dispatch-only when the wrapper exposes no ``_cache_size``."""
+    return InstrumentedJit(fn, name)
+
+
+# ------------------------------------------------------ transfer gauges
+
+
+def record_d2h(nbytes: int) -> None:
+    """Bytes materialized host-side from device outputs (np.asarray of
+    placements and friends)."""
+    COUNTERS.inc("device_transfer_d2h_bytes_total", int(nbytes))
+    COUNTERS.gauge("device_transfer_d2h_last_bytes", float(nbytes))
+
+
+def record_h2d(nbytes: int) -> None:
+    """Bytes shipped device-wards (encoded batches, scenario masks)."""
+    COUNTERS.inc("device_transfer_h2d_bytes_total", int(nbytes))
+    COUNTERS.gauge("device_transfer_h2d_last_bytes", float(nbytes))
+
+
+def nbytes_of(*arrays) -> int:
+    """Total nbytes of numpy/jax arrays (anything exposing .nbytes);
+    non-arrays count zero — callers pass whatever they just moved."""
+    total = 0
+    for a in arrays:
+        nb = getattr(a, "nbytes", None)
+        if isinstance(nb, int):
+            total += nb
+    return total
+
+
+# ------------------------------------------------------ profiler capture
+
+
+def set_profile_dir(path: Optional[str]) -> None:
+    """Arm (or disarm with None) the ``utils.trace.profiled`` JAX
+    profiler capture — the --profile-dir CLI wiring. Captures land in
+    ``<path>/<phase-name>/`` and open in TensorBoard / Perfetto."""
+    if path:
+        os.makedirs(path, exist_ok=True)
+        os.environ["SIMON_PROFILE_DIR"] = path
+    else:
+        os.environ.pop("SIMON_PROFILE_DIR", None)
+
+
+# ------------------------------------------------------ snapshot helpers
+
+
+_KEYS = (
+    "jax_dispatches_total",
+    "jax_recompiles_total",
+    "device_transfer_d2h_bytes_total",
+    "device_transfer_h2d_bytes_total",
+)
+
+
+def snapshot() -> dict:
+    """Current values of the headline profiling counters."""
+    return {k: COUNTERS.get(k) for k in _KEYS}
+
+
+def delta(since: dict) -> dict:
+    """Counter movement since a previous ``snapshot()`` — the bench
+    harness stamps each scenario's dispatch/recompile cost with this."""
+    now = snapshot()
+    return {k: now[k] - since.get(k, 0) for k in _KEYS}

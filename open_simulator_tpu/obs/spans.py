@@ -1,0 +1,334 @@
+"""Hierarchical span tracing — the flight recorder's timeline.
+
+The flat ``utils.trace.Trace`` phase timers answer "how long did encode
+take in total"; spans answer "where did these 4.4 seconds go, span by
+span": every recorded interval carries its parent, so one `simon apply`
+run renders as a tree (command root -> probe search -> per-probe scan
+-> device dispatch) loadable in Perfetto / chrome://tracing.
+
+Design:
+
+- ONE process-wide ``Recorder`` (``RECORDER``), disabled by default.
+  Disabled cost is a single attribute read per ``span()`` entry —
+  the hot path pays nothing until ``--trace-out`` (or a test) enables
+  it.
+- Parent tracking rides a ``contextvars.ContextVar``: each thread (the
+  CLI main thread, serve's dispatcher thread, HTTP handler threads)
+  gets its own span stack for free, so concurrent requests nest under
+  their own roots instead of interleaving.
+- ``utils.trace.phase`` is shimmed to emit each phase as a leaf span
+  when the recorder is on, so every existing phase annotation joins
+  the tree without touching its call sites.
+- Exporters: Chrome trace-event JSON (``export_chrome_trace``; complete
+  "X" events, microsecond timestamps — Perfetto nests same-thread
+  events by time containment) and streaming JSONL (``JsonlSink``; one
+  fsync'd line per completed span, the PR-2 journal append discipline,
+  so a crashed run keeps every finished span).
+
+This module is stdlib-only on purpose: ``utils.trace`` imports it at
+module load, so it must not pull in anything from the package.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+SPAN_SCHEMA_VERSION = 1
+
+# current span id of the calling context (None = root); a ContextVar
+# rather than a thread-local so async callers inherit correctly too
+_parent: contextvars.ContextVar = contextvars.ContextVar(
+    "simon_obs_parent_span", default=None
+)
+
+
+@dataclass
+class SpanRecord:
+    """One closed span. Times are seconds relative to the recorder's
+    enable() epoch (perf_counter domain)."""
+
+    span_id: int
+    parent_id: Optional[int]
+    name: str
+    t0: float
+    t1: float
+    tid: int
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return self.t1 - self.t0
+
+    def as_dict(self) -> dict:
+        out = {
+            "kind": "span",
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "t0": round(self.t0, 9),
+            "t1": round(self.t1, 9),
+            "tid": self.tid,
+        }
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+class JsonlSink:
+    """Streaming JSONL span export with the journal's append
+    discipline (runtime/journal.py): one line per record, flushed and
+    fsync'd per append, header line first — a crash keeps every span
+    that finished before it, and a torn final line is the only possible
+    damage."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # own lock, NOT the recorder's: the fsync must never run under
+        # the process-wide span lock (it would serialize every thread's
+        # span close behind disk latency — see Recorder.span)
+        self._lock = threading.Lock()
+        self._f = open(path, "w", encoding="utf-8")
+        self._emit(
+            {
+                "kind": "header",
+                "version": SPAN_SCHEMA_VERSION,
+                "pid": os.getpid(),
+                "clock": "perf_counter-relative-seconds",
+            }
+        )
+
+    def _emit(self, rec: dict):
+        with self._lock:
+            if self._f is None:  # closed concurrently (recorder disable)
+                return
+            self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def emit(self, rec: SpanRecord):
+        self._emit(rec.as_dict())
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class Recorder:
+    """Process-wide span store. enable()/disable() bracket a recording
+    session; spans closing while disabled are dropped silently (a
+    thread may still be inside a span when the CLI disables at exit)."""
+
+    # hard cap so a pathological run cannot grow the recorder without
+    # bound; overflow increments `dropped` instead of failing the run
+    MAX_SPANS = 250_000
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._spans: List[SpanRecord] = []
+        self._next_id = 1
+        self.dropped = 0
+        self._epoch = 0.0
+        self._sink: Optional[JsonlSink] = None
+
+    def enable(self, sink: Optional[JsonlSink] = None):
+        with self._lock:
+            self._spans = []
+            self._next_id = 1
+            self.dropped = 0
+            self._epoch = time.perf_counter()
+            self._sink = sink
+            self.enabled = True
+
+    def disable(self):
+        with self._lock:
+            self.enabled = False
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+    def reset(self):
+        with self._lock:
+            self._spans = []
+            self._next_id = 1
+            self.dropped = 0
+
+    def snapshot(self) -> List[SpanRecord]:
+        with self._lock:
+            return list(self._spans)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Record the enclosed block as a span under the context's
+        current parent. Yields the span id (None when disabled)."""
+        if not self.enabled:
+            yield None
+            return
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        parent = _parent.get()
+        token = _parent.set(sid)
+        t0 = time.perf_counter()
+        try:
+            yield sid
+        finally:
+            t1 = time.perf_counter()
+            _parent.reset(token)
+            rec = SpanRecord(
+                span_id=sid,
+                parent_id=parent,
+                name=name,
+                t0=t0 - self._epoch,
+                t1=t1 - self._epoch,
+                tid=threading.get_ident(),
+                attrs=attrs,
+            )
+            with self._lock:
+                if not self.enabled:
+                    return  # disabled mid-span: drop, don't resurrect
+                if len(self._spans) < self.MAX_SPANS:
+                    self._spans.append(rec)
+                else:
+                    self.dropped += 1
+                sink = self._sink
+            # sink I/O (write+flush+fsync) happens OUTSIDE the recorder
+            # lock: concurrent threads closing spans must not queue
+            # behind each other's disk syncs. The sink's own lock keeps
+            # lines whole; a close() racing in from disable() makes the
+            # emit a no-op (the span stays in the in-memory snapshot)
+            if sink is not None:
+                sink.emit(rec)
+
+RECORDER = Recorder()
+
+
+def span(name: str, **attrs):
+    """Module-level convenience: ``with span("apply/plan"): ...``"""
+    return RECORDER.span(name, **attrs)
+
+
+def traced(name: Optional[str] = None, **attrs):
+    """Decorator form: record every call of the function as a span."""
+
+    def deco(fn):
+        import functools
+
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not RECORDER.enabled:
+                return fn(*args, **kwargs)
+            with RECORDER.span(label, **attrs):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# ------------------------------------------------------------- exporters
+
+
+def export_chrome_trace(path: str, spans: Optional[List[SpanRecord]] = None):
+    """Write the recorded spans as Chrome trace-event JSON (the
+    ``traceEvents`` array of complete "X" events), loadable in Perfetto
+    or chrome://tracing. Same-thread events nest by time containment,
+    which the parent-tracked spans satisfy by construction."""
+    if spans is None:
+        spans = RECORDER.snapshot()
+    events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": os.getpid(),
+            "tid": 0,
+            "args": {"name": "simon"},
+        }
+    ]
+    for s in spans:
+        args = {"span_id": s.span_id}
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        args.update(s.attrs)
+        events.append(
+            {
+                "name": s.name,
+                "cat": "simon",
+                "ph": "X",
+                "ts": round(s.t0 * 1e6, 3),
+                "dur": round(s.duration * 1e6, 3),
+                "pid": os.getpid(),
+                "tid": s.tid,
+                "args": args,
+            }
+        )
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+
+
+def export_jsonl(path: str, spans: Optional[List[SpanRecord]] = None):
+    """One-shot JSONL dump of recorded spans (the streaming form is
+    ``JsonlSink`` passed to ``Recorder.enable``)."""
+    if spans is None:
+        spans = RECORDER.snapshot()
+    sink = JsonlSink(path)
+    try:
+        for s in spans:
+            sink.emit(s)
+    finally:
+        sink.close()
+
+
+# ------------------------------------------------------------- analysis
+
+
+def nesting_depth(spans: List[SpanRecord]) -> int:
+    """Maximum depth of the span forest (roots are depth 1)."""
+    by_id = {s.span_id: s for s in spans}
+    best = 0
+    for s in spans:
+        d, cur = 1, s
+        while cur.parent_id is not None and cur.parent_id in by_id:
+            cur = by_id[cur.parent_id]
+            d += 1
+        best = max(best, d)
+    return best
+
+
+def exclusive_times(spans: List[SpanRecord]) -> Dict[str, float]:
+    """Per-span-NAME exclusive wall-clock: each span's duration minus
+    the durations of its direct children (self-time), summed per name.
+    The bench's "top spans" attribution reads this — a parent phase
+    that merely contains an expensive child stops looking expensive."""
+    child_sum: Dict[int, float] = {}
+    for s in spans:
+        if s.parent_id is not None:
+            child_sum[s.parent_id] = child_sum.get(s.parent_id, 0.0) + s.duration
+    out: Dict[str, float] = {}
+    for s in spans:
+        excl = max(s.duration - child_sum.get(s.span_id, 0.0), 0.0)
+        out[s.name] = out.get(s.name, 0.0) + excl
+    return out
+
+
+def top_spans(spans: List[SpanRecord], k: int = 5) -> List[dict]:
+    """Top-k span names by exclusive time, for machine-readable
+    reports (bench metrics, docs)."""
+    excl = exclusive_times(spans)
+    ranked = sorted(excl.items(), key=lambda kv: -kv[1])[:k]
+    return [
+        {"name": name, "exclusive_ms": round(sec * 1e3, 3)}
+        for name, sec in ranked
+    ]

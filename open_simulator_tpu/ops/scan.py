@@ -27,12 +27,12 @@ the same intermediate states as the serial path.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
+from ..obs import profile as _obs_profile
 from ..scheduler.schedconfig import DEFAULT_SCORE_WEIGHTS as _DEFAULT_WEIGHTS
 
 MAX_SCORE = 100
@@ -816,8 +816,7 @@ def run_scan_masked(
     )
 
 
-@partial(jax.jit, static_argnums=0)
-def _run_scan_compiled(
+def _run_scan_compiled_impl(
     features: ScanFeatures,
     static: ScanStatic,
     init: ScanState,
@@ -1070,3 +1069,13 @@ def _run_scan_compiled(
     # sample mode: placements is a (placements[P], consumed_words[P])
     # pair — the engine unpacks it (no other caller runs sample)
     return placements, final_state
+
+
+# The module-level scan jit, wrapped for dispatch/recompile accounting
+# (obs/profile.py): every run_scan / run_scan_masked call is one
+# counted device dispatch, and a grown jit cache is a counted
+# recompile — the warm-cache contract the tiered engine and `simon
+# serve` rely on is pinned by tests/test_obs.py through these counters.
+_run_scan_compiled = _obs_profile.instrument_jit(
+    jax.jit(_run_scan_compiled_impl, static_argnums=0), "scan"
+)

@@ -430,7 +430,11 @@ class CapacitySweep:
         from ..utils.trace import phase
 
         if self._probe_jit is None:
-            self._probe_jit = jax.jit(self._scenario)
+            from ..obs import profile
+
+            self._probe_jit = profile.instrument_jit(
+                jax.jit(self._scenario), "sweep_probe"
+            )
         with phase("sweep/probe"):
             placements, unsched, cpu, mem, vg = self._probe_jit(
                 jnp.asarray(valid), jnp.asarray(self.pod_active(valid))
@@ -712,7 +716,11 @@ class CapacitySweep:
         pinned = np.asarray(pinned)
         sc = node_valid.shape[0]
         if self._chaos_jit is None:
-            self._chaos_jit = jax.jit(jax.vmap(self._scenario_pinned))
+            from ..obs import profile
+
+            self._chaos_jit = profile.instrument_jit(
+                jax.jit(jax.vmap(self._scenario_pinned)), "chaos_sweep"
+            )
 
         def evaluate(lo, hi):
             out = self._chaos_jit(

@@ -321,6 +321,15 @@ class Simulator:
         priority_free = tpu_ok and not self.oracle.registry.has_post_filter and (
             not self.oracle.saw_priority and not bool((prios != 0).any())
         )
+        from ..obs.explain import EXPLAIN
+
+        if EXPLAIN.enabled:
+            EXPLAIN.set_context(
+                engine="batch-scan"
+                if priority_free
+                else ("priority-scan" if tpu_ok and len(pods) >= MIN_SCAN_RUN
+                      else "serial-oracle")
+            )
         if priority_free:
             GLOBAL.note("engine", "batch")
             try:
@@ -398,6 +407,7 @@ class Simulator:
 
         from .engine import SampleRngOverflow
         from .preemption import tier_escape_mask
+        from ..obs.explain import EXPLAIN
         from ..utils.trace import GLOBAL
 
         failed: List[UnscheduledPod] = []
@@ -424,6 +434,12 @@ class Simulator:
                 policy_gate = True
             if tiers_round1 is None:
                 tiers_round1 = n_tiers
+            if EXPLAIN.enabled:
+                # tier/escape provenance: explanations recorded during
+                # this round's replay carry the round + tier count
+                EXPLAIN.set_context(
+                    engine="priority-scan", scan_round=rounds, tiers=n_tiers
+                )
             try:
                 f, escape_at = self._scan_and_commit(
                     pods, armed=armed, policy_gate=policy_gate,
@@ -441,6 +457,12 @@ class Simulator:
                 start = p
                 break
             escapes += 1
+            if EXPLAIN.enabled and EXPLAIN.wants(pods[escape_at]):
+                EXPLAIN.annotate(
+                    pods[escape_at],
+                    escape_round=rounds,
+                    path="serial-preemption-cycle",
+                )
             f2, d2 = self._schedule_pods_oracle(
                 [pods[escape_at]], defer_victims=True
             )
@@ -659,6 +681,8 @@ class Simulator:
 
         if stop <= start:
             return
+        from ..obs.explain import EXPLAIN
+
         eng = self._engine
         bidx, pos_of = self._batch_map
         cluster_pods = self.cluster_pods
@@ -678,6 +702,19 @@ class Simulator:
                 (w_place >= 0) & ~w_pin & in_batch
                 & simple[w_cls] & bulk_ok[w_cls]
             )
+            if EXPLAIN.enabled and EXPLAIN.target is not None:
+                # a TARGETED explained pod must leave the bulk run so
+                # its filter/score walk can be captured against the
+                # oracle state of exactly its own commit step (failed
+                # pods already take the per-pod path); target-less
+                # explain does not pay this — committed-pod captures
+                # are opt-in by name, failures record regardless
+                want = np.fromiter(
+                    (EXPLAIN.wants(pods[start + i])
+                     for i in range(stop - start)),
+                    dtype=bool, count=stop - start,
+                )
+                bulk_mask &= ~want
         else:
             w_place = np.full(stop - start, -3, dtype=np.int64)
             w_cls = np.zeros(stop - start, dtype=np.int64)
@@ -715,6 +752,15 @@ class Simulator:
                     )
                 )
             else:
+                if (
+                    EXPLAIN.enabled
+                    and EXPLAIN.target is not None
+                    and EXPLAIN.wants(pod)
+                ):
+                    # pre-commit: the oracle state here is the serial
+                    # cycle's state at this pod's step (replay order);
+                    # committed-pod captures are targeted-only
+                    EXPLAIN.capture(oracle, pod, int(w_place[e]))
                 # GPU/storage/extender side effects: exact per-pod bind
                 eng.commit_host_at(pod, int(w_place[e]), int(w_pos[e]))
                 cluster_pods.append(pod)

@@ -198,6 +198,9 @@ class TpuEngine:
             if sample:
                 placements, consumed = placements
             out = np.asarray(placements)  # blocks on device completion
+            from ..obs import profile
+
+            profile.record_d2h(out.nbytes)
         if sample:
             if bool(np.asarray(final_state.rng_overflow)):
                 # oracle state is untouched (commits replay only after
@@ -248,6 +251,7 @@ class TpuEngine:
                 self._scan_static = to_scan_static(cluster, batch)
                 self._scan_static_cluster = cluster
             init = to_scan_state(dyn, batch)
+        actives_arr = np.asarray(actives, bool)
         with profiled("engine/scan"):
             out = _scenario_scan_jit()(
                 self._scan_static,
@@ -255,10 +259,15 @@ class TpuEngine:
                 jnp.asarray(batch.class_of_pod),
                 jnp.asarray(batch.pinned_node),
                 jnp.ones(cluster.n, bool),
-                jnp.asarray(np.asarray(actives, bool)),
+                jnp.asarray(actives_arr),
                 self._features,
             )
-        return np.asarray(out)
+        out = np.asarray(out)
+        from ..obs import profile
+
+        profile.record_h2d(actives_arr.nbytes)
+        profile.record_d2h(out.nbytes)
+        return out
 
     def rewind_sample_rng(self, batch_pos: int) -> None:
         """Reposition the oracle's sample-mode stream to where it stood
@@ -342,13 +351,18 @@ def _scenario_scan_jit():
     pair PROCESS-WIDE: static/init/masks are traced pytree arguments
     (not closures), so a long-lived daemon re-dispatching same-shaped
     request batches hits the jit cache instead of recompiling — the
-    warm-compiled-scan property `simon serve` is built on."""
+    warm-compiled-scan property `simon serve` is built on. Wrapped for
+    dispatch/recompile accounting (obs/profile.py): the warm-cache
+    contract is now a measured number, not a comment."""
     global _SCENARIO_SCAN_JIT
     if _SCENARIO_SCAN_JIT is None:
         import jax
 
-        _SCENARIO_SCAN_JIT = jax.jit(
-            _scan_scenarios_impl, static_argnums=(6,)
+        from ..obs import profile
+
+        _SCENARIO_SCAN_JIT = profile.instrument_jit(
+            jax.jit(_scan_scenarios_impl, static_argnums=(6,)),
+            "scenario_scan",
         )
     return _SCENARIO_SCAN_JIT
 

@@ -332,9 +332,15 @@ def filter_with_extenders(
     pod: dict,
     feasible: List,
     fail,
+    on_node_fail=None,
 ) -> List:
     """findNodesThatPassExtenders (generic_scheduler.go:345-374) over
-    oracle NodeStates. `fail(reason)` records per-node failure reasons."""
+    oracle NodeStates. `fail(reason)` records per-node failure reasons;
+    `on_node_fail(node_name, reason)` (optional) additionally receives
+    the NODE attribution the aggregate counts discard — the --explain
+    recorder reads per-node verdicts through it, with the exact same
+    message strings `fail` sees, so explain and report stay in
+    lockstep."""
     for ext in extenders:
         if not feasible:
             break
@@ -347,8 +353,10 @@ def filter_with_extenders(
             if ext.config.ignorable:
                 continue
             raise
-        for _name, msg in sorted(failed.items()):
+        for name, msg in sorted(failed.items()):
             fail(msg)
+            if on_node_fail is not None:
+                on_node_fail(name, msg)
         kept_names = {
             ((n.get("metadata") or {}).get("name", "")) for n in kept_nodes
         }

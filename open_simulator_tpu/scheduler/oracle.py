@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 from ..models import labels as lbl
 from ..models import requests as req
 from ..models import storage as stor
+from ..obs.explain import EXPLAIN
 from ..utils.memo import IdentityMemo
 
 MAX_NODE_SCORE = 100
@@ -569,6 +570,13 @@ class Oracle:
             for ns, sc in zip(feasible[1:], scores[1:]):
                 if sc > best_score:
                     best, best_score = ns, sc
+        if EXPLAIN.enabled and EXPLAIN.should_record(pod):
+            # the exact weighted score vector selectHost just consumed
+            EXPLAIN.record_scores(
+                pod,
+                [(ns.name, sc) for ns, sc in zip(feasible, scores)],
+                best.name,
+            )
         # custom Reserve plugins claim state first; any later veto rolls
         # them back in reverse order (framework.go RunReservePlugins*)
         reserved = []
@@ -661,6 +669,15 @@ class Oracle:
             self.evict_pod(ns, victim)
             self.preempted.append(
                 PreemptedPod(pod=victim, node_name=ns.name, preemptor=preemptor)
+            )
+        if EXPLAIN.enabled and EXPLAIN.should_record(pod):
+            EXPLAIN.annotate(
+                pod,
+                preemption_node=ns.name,
+                preempted=[
+                    (v.get("metadata") or {}).get("name", "")
+                    for v in result.victims
+                ],
             )
         # retry cycle: with victims evicted the pod fits on the
         # nominated node (it may score another feasible node higher —
@@ -796,6 +813,10 @@ class Oracle:
         feasible = []
         reasons: Dict[str, int] = {}
         codes: Dict[int, str] = {}
+        # flight-recorder hook (--explain): keep every node's verdict,
+        # not just the aggregate counts — one attribute read when off
+        explain = EXPLAIN.enabled and EXPLAIN.should_record(pod)
+        verdicts = [] if explain else None
 
         def fail(reason: str):
             reasons[reason] = reasons.get(reason, 0) + 1
@@ -804,17 +825,41 @@ class Oracle:
             r = self._check_node(pod, ctx, pre, ns)
             if r is None:
                 feasible.append(ns)
+                if explain:
+                    verdicts.append((ns.name, None, "feasible"))
                 continue
             reason, code = r
             fail(reason)
             codes[ns.index] = code
+            if explain:
+                verdicts.append((ns.name, reason, code))
         if self.extenders:
             from .extender import filter_with_extenders
 
             before = {ns.index for ns in feasible}
-            feasible = filter_with_extenders(self.extenders, pod, feasible, fail)
+            on_node_fail = None
+            if explain:
+                # the verdict row gets the extender's ACTUAL per-node
+                # message — the same string `fail` just aggregated —
+                # so the explain block's failure message stays equal
+                # to the report's (verdict rows parallel self.nodes)
+                def on_node_fail(name, msg):
+                    idx = self.node_index.get(name)
+                    if idx is not None:
+                        verdicts[idx] = (name, msg, "unschedulable")
+
+            feasible = filter_with_extenders(
+                self.extenders, pod, feasible, fail, on_node_fail=on_node_fail
+            )
             for idx in before - {ns.index for ns in feasible}:
                 codes[idx] = "unschedulable"
+                if explain and verdicts[idx][1] is None:
+                    # dropped without a message: keep reason None so
+                    # the aggregate counts still mirror `fail` exactly;
+                    # the status code alone records the drop
+                    verdicts[idx] = (verdicts[idx][0], None, "unschedulable")
+        if explain:
+            EXPLAIN.record_filter(pod, verdicts, len(feasible))
         return feasible, reasons, codes
 
     def passes_filters_on_node(self, pod: dict, ns: NodeState, ctx=None) -> bool:

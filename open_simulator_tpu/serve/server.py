@@ -191,6 +191,29 @@ def render_metrics(coalescer: Coalescer) -> bytes:
         "p95 request latency (recent window).",
         round(COUNTERS.percentile("serve_latency_seconds", 95), 6),
     )
+    # flight-recorder profiling counters (obs/profile.py): the same
+    # registry the bench harness reads, so daemon and bench report
+    # dispatch/recompile cost identically
+    metric(
+        "simon_jax_dispatches_total", "counter",
+        "JAX jitted device dispatches (scan / scenario / sweep entry points).",
+        counts.get("jax_dispatches_total", 0),
+    )
+    metric(
+        "simon_jax_recompiles_total", "counter",
+        "JAX jit-cache misses (XLA recompilations).",
+        counts.get("jax_recompiles_total", 0),
+    )
+    metric(
+        "simon_device_transfer_d2h_bytes_total", "counter",
+        "Bytes materialized host-side from device outputs.",
+        counts.get("device_transfer_d2h_bytes_total", 0),
+    )
+    metric(
+        "simon_device_transfer_h2d_bytes_total", "counter",
+        "Bytes shipped to the device (scenario masks and friends).",
+        counts.get("device_transfer_h2d_bytes_total", 0),
+    )
     lines.append("")
     return "\n".join(lines).encode()
 

@@ -230,7 +230,17 @@ class Session:
         routing per request, then ONE batched device dispatch for all
         scan-eligible scenarios (chunk-halving on device OOM, serial
         host-oracle floor — runtime/guard.run_chunked), then per
-        request a replay into a fresh oracle and the canonical body."""
+        request a replay into a fresh oracle and the canonical body.
+
+        Under `--trace-out` each tick is one span on the dispatcher
+        thread's own tree (`serve/tick`, batch size attached), with the
+        expand/encode/scan/replay phases nesting below it."""
+        from ..obs.spans import RECORDER
+
+        with RECORDER.span("serve/tick", requests=len(reqs)):
+            return self._evaluate_batch(reqs)
+
+    def _evaluate_batch(self, reqs: List[WhatIfRequest]) -> List[WhatIfReply]:
         from ..models.validation import InputError
         from ..runtime.guard import run_chunked
         from ..utils.trace import phase

@@ -12,12 +12,19 @@ TPU-level analysis (`SIMON_PROFILE_DIR=... ` -> TensorBoard trace).
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+# flight-recorder shim (obs/spans.py is stdlib-only, safe this early):
+# when the span recorder is enabled, every phase() block also records a
+# hierarchical span, so the flat phase timers become leaf spans of the
+# trace tree for free — call sites unchanged
+from ..obs.spans import RECORDER as _SPANS
 
 _lock = threading.Lock()
 
@@ -122,12 +129,20 @@ GLOBAL = Trace()
 
 @contextmanager
 def phase(name: str, trace: Optional[Trace] = None):
-    """Record wall-clock of the enclosed block under `name`."""
+    """Record wall-clock of the enclosed block under `name`. When the
+    flight recorder is on (--trace-out), the block is also recorded as
+    a span nested under the caller's current span — phases called
+    inside phases nest automatically via the contextvar parent."""
+    span_cm = _SPANS.span(name, kind="phase") if _SPANS.enabled else None
+    if span_cm is not None:
+        span_cm.__enter__()
     t0 = time.perf_counter()
     try:
         yield
     finally:
         (trace or GLOBAL).add(name, time.perf_counter() - t0)
+        if span_cm is not None:
+            span_cm.__exit__(None, None, None)
 
 
 class Counters:
@@ -220,11 +235,26 @@ class Counters:
         10/2. Only when the very first event is younger than the
         window does the denominator shrink to the observed age (>= 1s),
         so a fresh daemon reports its true rate instead of a diluted
-        one."""
+        one.
+
+        Window membership is decided in WHOLE buckets: a 1-second
+        bucket `b` is in the window iff `b > floor(now) - window_s`.
+        Events are floored into buckets at mark() time, so comparing
+        the fractional `now` against bucket starts (the old
+        `now - t <= window_s` test) made inclusion depend on the
+        read-time clock phase: an event marked at t=100.2 (bucket 100)
+        was counted at now=160.0 but dropped at now=160.5 — same age,
+        different verdict — and a reader sampling twice around a
+        boundary could see the event twice in one window and never in
+        the next. Whole-bucket membership gives every (event, read)
+        pair one deterministic verdict regardless of sub-second
+        alignment (pinned by the fake-clock tests in
+        tests/test_trace.py)."""
         now = self._clock()
+        cutoff = math.floor(now) - window_s
         with self._lock:
             buf = self._marks.get(name) or []
-            recent = sum(c for t, c in buf if now - t <= window_s)
+            recent = sum(c for t, c in buf if t > cutoff)
             first_ever = self._first_mark.get(name)
         if not recent:
             return 0.0

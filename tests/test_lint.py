@@ -269,3 +269,25 @@ def test_first_party_io_calls_all_have_timeouts():
             if code == "S113"
         )
     assert findings == []
+
+
+def test_bare_print_flagged_in_library_code(tmp_path):
+    findings = _lint_src(tmp_path, "def f():\n    print('hi')\n")
+    assert ("T201", 2) in findings
+
+
+def test_print_with_explicit_file_not_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import sys\n\n"
+        "def f(out):\n"
+        "    print('hi', file=out)\n"
+        "    print('err', file=sys.stderr)\n",
+    )
+    assert not any(c == "T201" for c, _ in findings)
+
+
+def test_cli_surface_allowlisted_for_print():
+    repo = Path(__file__).resolve().parent.parent
+    findings = lint_file(repo / "open_simulator_tpu" / "cli.py")
+    assert not any(code == "T201" for _, _, code, _ in findings)

@@ -1,0 +1,398 @@
+"""The flight recorder (open_simulator_tpu/obs/; docs/OBSERVABILITY.md):
+hierarchical spans + exporters, per-pod placement explanations on both
+engine paths, and the jit dispatch/recompile counters — including the
+warm-cache regression guard (a repeat same-shaped batch must trigger
+ZERO new jit-cache misses, the contract PR 4's serve daemon and the
+tiered scan engine are built on)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.obs import spans
+from open_simulator_tpu.obs.explain import EXPLAIN
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.testing import make_fake_node, make_fake_pod
+from open_simulator_tpu.utils.trace import COUNTERS
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorders():
+    spans.RECORDER.disable()
+    spans.RECORDER.reset()
+    EXPLAIN.disable()
+    EXPLAIN.reset()
+    yield
+    spans.RECORDER.disable()
+    spans.RECORDER.reset()
+    EXPLAIN.disable()
+    EXPLAIN.reset()
+
+
+# ------------------------------------------------------------------ spans
+
+
+def test_span_nesting_parent_links_and_chrome_export(tmp_path):
+    spans.RECORDER.enable()
+    with spans.span("root", cmd="test"):
+        with spans.span("mid"):
+            with spans.span("leaf", detail=1):
+                pass
+        with spans.span("mid2"):
+            pass
+    recs = spans.RECORDER.snapshot()
+    by = {r.name: r for r in recs}
+    assert by["leaf"].parent_id == by["mid"].span_id
+    assert by["mid"].parent_id == by["root"].span_id
+    assert by["mid2"].parent_id == by["root"].span_id
+    assert by["root"].parent_id is None
+    assert spans.nesting_depth(recs) == 3
+    path = tmp_path / "trace.json"
+    spans.export_chrome_trace(str(path), recs)
+    doc = json.loads(path.read_text())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 4
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in xs)
+    # parent containment in time: Perfetto nests by this
+    x = {e["args"]["span_id"]: e for e in xs}
+    leaf, mid = x[by["leaf"].span_id], x[by["mid"].span_id]
+    assert mid["ts"] <= leaf["ts"]
+    assert leaf["ts"] + leaf["dur"] <= mid["ts"] + mid["dur"] + 1e-6
+
+
+def test_spans_thread_isolated_roots():
+    spans.RECORDER.enable()
+    def worker():
+        with spans.span("thread-root"):
+            with spans.span("thread-child"):
+                pass
+    with spans.span("main-root"):
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    by = {r.name: r for r in spans.RECORDER.snapshot()}
+    # a dispatcher-style thread does NOT inherit the main thread's
+    # span as parent — it roots its own tree (contextvar isolation)
+    assert by["thread-root"].parent_id is None
+    assert by["thread-child"].parent_id == by["thread-root"].span_id
+    assert by["thread-root"].tid != by["main-root"].tid
+
+
+def test_phase_shim_emits_leaf_spans_only_when_enabled():
+    from open_simulator_tpu.utils.trace import Trace, phase
+
+    tr = Trace()
+    spans.RECORDER.enable()
+    with spans.span("outer"):
+        with phase("p1", tr):
+            pass
+    by = {r.name: r for r in spans.RECORDER.snapshot()}
+    assert by["p1"].parent_id == by["outer"].span_id
+    assert tr.phase_seconds("p1") >= 0.0  # flat timer still recorded
+    spans.RECORDER.disable()
+    with phase("p2", tr):
+        pass
+    assert all(r.name != "p2" for r in spans.RECORDER.snapshot())
+
+
+def test_jsonl_sink_streams_spans_as_they_close(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    spans.RECORDER.enable(spans.JsonlSink(str(path)))
+    with spans.span("a"):
+        with spans.span("b"):
+            pass
+    # read BEFORE disable/close: completed spans are already durably
+    # on disk (journal append discipline — fsync per span)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines[0]["kind"] == "header"
+    names = [ln["name"] for ln in lines if ln["kind"] == "span"]
+    assert names == ["b", "a"]  # close order; b closed first
+    parents = {ln["name"]: ln["parent"] for ln in lines if ln["kind"] == "span"}
+    ids = {ln["name"]: ln["id"] for ln in lines if ln["kind"] == "span"}
+    assert parents["b"] == ids["a"] and parents["a"] is None
+
+
+def test_exclusive_time_attribution():
+    r1 = spans.SpanRecord(1, None, "parent", 0.0, 10.0, 1)
+    r2 = spans.SpanRecord(2, 1, "child", 1.0, 9.0, 1)
+    excl = spans.exclusive_times([r1, r2])
+    assert excl["parent"] == pytest.approx(2.0)
+    assert excl["child"] == pytest.approx(8.0)
+    top = spans.top_spans([r1, r2], k=1)
+    assert top[0]["name"] == "child"
+
+
+def test_traced_decorator_records_calls():
+    calls = []
+
+    @spans.traced("decorated-op", kind="test")
+    def op(x):
+        calls.append(x)
+        return x * 2
+
+    assert op(2) == 4  # disabled: plain call, no record
+    assert spans.RECORDER.snapshot() == []
+    spans.RECORDER.enable()
+    assert op(3) == 6
+    recs = spans.RECORDER.snapshot()
+    assert [r.name for r in recs] == ["decorated-op"]
+    assert recs[0].attrs == {"kind": "test"}
+
+
+# ---------------------------------------------------------------- explain
+
+
+def _tiny_cluster(n=3, cpu="2", mem="4Gi"):
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node(f"n{i}", cpu, mem) for i in range(n)]
+    return cluster
+
+
+def _app(*pods):
+    res = ResourceTypes()
+    res.pods = list(pods)
+    return [AppResource("a", res)]
+
+
+@pytest.mark.parametrize("engine", ["oracle", "tpu"])
+def test_explain_unschedulable_matches_report_reason(engine):
+    """Acceptance: the explain block names the SAME failure reason as
+    the existing report, plus per-node filter verdicts — on both the
+    serial oracle and the scan-replay paths."""
+    EXPLAIN.enable()
+    out = simulate(
+        _tiny_cluster(), _app(make_fake_pod("huge", "default", "64", "1Gi")),
+        engine=engine,
+    )
+    assert len(out.unscheduled_pods) == 1
+    up = out.unscheduled_pods[0]
+    recs = EXPLAIN.snapshot()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec.name == "huge"
+    assert rec.failure_message() == up.reason
+    assert rec.total_nodes == 3 and rec.feasible_count == 0
+    assert [n for n, _r, _c in rec.verdicts] == ["n0", "n1", "n2"]
+    assert all(r == "Insufficient cpu" for _n, r, _c in rec.verdicts)
+
+
+def test_explain_untargeted_skips_scheduled_pods():
+    EXPLAIN.enable()
+    out = simulate(
+        _tiny_cluster(), _app(make_fake_pod("fits", "default", "1", "1Gi")),
+        engine="oracle",
+    )
+    assert not out.unscheduled_pods
+    assert EXPLAIN.snapshot() == []
+
+
+@pytest.mark.parametrize("engine", ["oracle", "tpu"])
+def test_explain_targeted_scheduled_pod_records_scores(engine):
+    EXPLAIN.enable("pick-me")
+    out = simulate(
+        _tiny_cluster(),
+        _app(
+            make_fake_pod("other-0", "default", "1", "1Gi"),
+            make_fake_pod("pick-me", "default", "1", "1Gi"),
+        ),
+        engine=engine,
+    )
+    assert not out.unscheduled_pods
+    placed_on = next(
+        (ns.node["metadata"]["name"] for ns in out.node_status
+         for p in ns.pods if p["metadata"]["name"] == "pick-me"),
+        None,
+    )
+    recs = EXPLAIN.snapshot()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec.chosen_node == placed_on
+    assert rec.feasible_count == 3 and len(rec.scores) == 3
+    # the chosen node's score is a maximum (first-max tie rule)
+    score_of = dict(rec.scores)
+    assert score_of[rec.chosen_node] == max(score_of.values())
+    assert all(r is None for _n, r, _c in rec.verdicts)  # all feasible
+
+
+def test_explain_capacity_replay_path(tmp_path):
+    """The probe/replay planner path (simon apply without priorities)
+    explains failures through replay_masked's serial reason pass."""
+    from open_simulator_tpu.apply.applier import probe_plan
+
+    EXPLAIN.enable()
+    cluster = _tiny_cluster(2, cpu="2", mem="4Gi")
+    res = ResourceTypes()
+    res.pods = [make_fake_pod("toobig", "default", "64", "1Gi")]
+    result = probe_plan(cluster, [AppResource("a", res)], None, max_count=0)
+    assert not result.success
+    recs = EXPLAIN.snapshot()
+    assert any(
+        r.name == "toobig"
+        and r.reason_counts.get("Insufficient cpu") == 2
+        for r in recs
+    )
+
+
+def test_explain_extender_verdict_carries_real_message():
+    """An extender-rejected node's verdict row carries the extender's
+    ACTUAL failure message (not a generic placeholder), so the explain
+    failure message equals the report's (the never-disagree invariant
+    holds across the extender path too)."""
+    from test_extender import _ExtenderServer, _app, _cluster
+
+    from open_simulator_tpu.scheduler.extender import (
+        ExtenderConfig,
+        HTTPExtender,
+    )
+
+    srv = _ExtenderServer()
+    EXPLAIN.enable()
+    try:
+        ext = HTTPExtender(
+            ExtenderConfig(url_prefix=srv.url, filter_verb="filter")
+        )
+        out = simulate(
+            _cluster(["banned-1"]), _app(replicas=1), extenders=[ext]
+        )
+    finally:
+        srv.stop()
+    assert len(out.unscheduled_pods) == 1
+    recs = EXPLAIN.snapshot()
+    assert len(recs) == 1
+    assert recs[0].failure_message() == out.unscheduled_pods[0].reason
+    assert recs[0].verdicts == [
+        ("banned-1", "node is banned by extender", "unschedulable")
+    ]
+
+
+def test_explain_render_text_contains_table_and_reason():
+    from open_simulator_tpu.obs.explain import render_explanations
+
+    EXPLAIN.enable()
+    out = simulate(
+        _tiny_cluster(), _app(make_fake_pod("huge", "default", "64", "1Gi")),
+        engine="tpu",
+    )
+    text = render_explanations()
+    assert "Placement Explanations" in text
+    assert out.unscheduled_pods[0].reason in text
+    assert "Insufficient cpu" in text and "| n0" in text
+
+
+# ------------------------------------------------- dispatch / recompile
+
+
+def _scan_scenario_engine():
+    from open_simulator_tpu.scheduler.engine import TpuEngine
+    from open_simulator_tpu.scheduler.oracle import Oracle
+
+    nodes = [make_fake_node(f"n{i}", "8", "16Gi") for i in range(4)]
+    oracle = Oracle(nodes)
+    eng = TpuEngine(oracle)
+    pods = [make_fake_pod(f"p{i}", "default", "1", "1Gi") for i in range(6)]
+    eng.begin_batch(pods)
+    return eng, pods
+
+
+def test_repeat_scan_scenarios_batch_zero_new_jit_misses():
+    """PR-4 warm-cache contract, now locked in by the miss counter: a
+    repeat same-shaped scan_scenarios batch re-dispatches the SAME
+    compiled executable — zero new jit-cache misses."""
+    eng, pods = _scan_scenario_engine()
+    actives = np.ones((3, len(pods)), dtype=bool)
+    actives[1, ::2] = False
+    eng.scan_scenarios(actives)  # warm: may compile
+    before_miss = COUNTERS.get("jax_recompiles_total")
+    before_disp = COUNTERS.get("jax_dispatches_total")
+    out = eng.scan_scenarios(actives.copy())
+    assert out.shape == (3, len(pods))
+    assert COUNTERS.get("jax_dispatches_total") == before_disp + 1
+    assert COUNTERS.get("jax_recompiles_total") == before_miss, (
+        "repeat same-shaped scenario batch recompiled"
+    )
+
+
+def test_repeat_simulate_same_cluster_zero_new_jit_misses():
+    """A repeat simulate() of the same cluster/apps (fresh objects,
+    identical shapes) must hit the scan jit cache."""
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    def run():
+        reset_name_counter()
+        out = simulate(
+            _tiny_cluster(4, cpu="8", mem="16Gi"),
+            _app(*[make_fake_pod(f"p{i}", "default", "1", "1Gi")
+                   for i in range(6)]),
+            engine="tpu",
+        )
+        assert not out.unscheduled_pods
+
+    run()  # warm: may compile
+    before = COUNTERS.get("jax_recompiles_total")
+    run()
+    assert COUNTERS.get("jax_recompiles_total") == before, (
+        "repeat same-shaped simulate() recompiled — the warm-cache "
+        "contract regressed"
+    )
+
+
+def test_dispatch_counters_exported_via_metrics_endpoint():
+    from open_simulator_tpu.serve.server import render_metrics
+
+    text = render_metrics(type("C", (), {"depth": 0})()).decode()
+    assert "simon_jax_dispatches_total" in text
+    assert "simon_jax_recompiles_total" in text
+    assert "simon_device_transfer_d2h_bytes_total" in text
+
+
+# ------------------------------------------------------------- CLI e2e
+
+
+def test_cli_apply_trace_out_and_explain(tmp_path, capsys):
+    """`simon apply --trace-out --explain --format json` end to end:
+    the Chrome trace loads as JSON with >= 3 levels of span nesting
+    (acceptance), and the JSON result carries the explain block."""
+    import os
+    from pathlib import Path
+
+    from open_simulator_tpu.cli import main
+    from open_simulator_tpu.models.workloads import reset_name_counter
+
+    repo = Path(__file__).resolve().parent.parent
+    trace_path = tmp_path / "apply-trace.json"
+    reset_name_counter()
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        code = main(
+            [
+                "apply",
+                "-f", "example/simon-config.yaml",
+                "--trace-out", str(trace_path),
+                "--explain",
+                "--format", "json",
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    out = capsys.readouterr().out
+    assert code == 0
+    result = json.loads(out.strip().splitlines()[-1])
+    assert result["success"] is True
+    assert "explain" in result  # armed; empty because everything fits
+    doc = json.loads(trace_path.read_text())
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "trace has no spans"
+    recs = [
+        spans.SpanRecord(
+            e["args"]["span_id"], e["args"].get("parent_id"), e["name"],
+            e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6, e["tid"],
+        )
+        for e in xs
+    ]
+    assert spans.nesting_depth(recs) >= 3
+    names = {r.name for r in recs}
+    assert "simon apply" in names  # the command root span

@@ -100,3 +100,73 @@ def test_kernel_fallback_names_reason():
     note = GLOBAL.notes.get("batch-kernel", "")
     assert note.startswith("xla-scan (")
     assert "storage" in note or "no TPU" in note
+
+
+def test_rate_bucket_boundary_deterministic_fake_clock():
+    """The window-bucket edge case (ISSUE 5 satellite): an event marked
+    mid-bucket used to be included or dropped depending on the READ
+    clock's sub-second phase (`now - bucket <= window`), so two reads
+    of the same history around a boundary disagreed — double-counted in
+    one window, missing from the next. Whole-bucket membership
+    (`bucket > floor(now) - window`) gives one verdict per (event,
+    read-second) pair regardless of fractional alignment."""
+    from open_simulator_tpu.utils.trace import Counters
+
+    t = [1000.0]
+    c = Counters(clock=lambda: t[0])
+    c.mark("x")  # bucket 1000
+    t[0] = 1000.9
+    c.mark("x")  # same bucket (mid-bucket event — the alignment trap)
+
+    # exactly window-old: bucket 1000 is OUTSIDE the trailing 60 whole
+    # buckets ending at floor(now)=1060, at EVERY sub-second phase
+    # (the old test included it at now=1060.0 and dropped it at 1060.5)
+    for frac in (0.0, 0.2, 0.5, 0.9):
+        t[0] = 1060.0 + frac
+        assert c.rate("x", 60.0) == 0.0, f"phase {frac}"
+
+    # one bucket earlier it is INSIDE at every phase
+    for frac in (0.0, 0.5, 0.99):
+        t[0] = 1059.0 + frac
+        assert c.rate("x", 60.0) > 0.0, f"phase {frac}"
+
+
+def test_rate_young_stream_denominator_and_totals():
+    from open_simulator_tpu.utils.trace import Counters
+
+    t = [500.0]
+    c = Counters(clock=lambda: t[0])
+    for _ in range(10):
+        c.mark("q")
+    t[0] = 502.0
+    # young stream: denominator is the observed age (2s), not the window
+    assert c.rate("q", 60.0) == 10 / 2.0
+    # old stream: full-window denominator
+    t[0] = 500.0 + 120.0
+    assert c.rate("q", 60.0) == 0.0  # all events aged out
+    c.mark("q")  # bucket 620
+    t[0] = 630.0
+    assert c.rate("q", 60.0) == 1 / 60.0
+
+
+def test_rate_whole_bucket_membership():
+    """A bucket is in or out as a unit: the window is the `window_s`
+    whole buckets ending at floor(now), so mid-bucket event times and
+    mid-second read times cannot shift membership."""
+    from open_simulator_tpu.utils.trace import Counters
+
+    t = [100.0]
+    c = Counters(clock=lambda: t[0])
+    c.mark("e")          # bucket 100
+    t[0] = 100.7
+    c.mark("e")          # bucket 100 again
+    t[0] = 101.0
+    c.mark("e")          # bucket 101
+    # floor(160.9)=160, cutoff=100: bucket 101 in, bucket 100 out —
+    # BOTH of bucket 100's events leave together, including the one
+    # marked at 100.7 that the old arithmetic would have kept
+    t[0] = 160.9
+    assert c.rate("e", 60.0) == 1 / 60.0
+    # floor(161.4)=161, cutoff=101: bucket 101 ages out as a unit too
+    t[0] = 161.4
+    assert c.rate("e", 60.0) == 0.0

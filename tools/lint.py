@@ -20,6 +20,13 @@ small pyflakes-class checker built on the stdlib `ast`:
   unbounded external call can hang a whole plan; every I/O call site
   names its timeout (runtime/retry.py holds the configurable
   defaults). Audited exceptions go in IO_TIMEOUT_ALLOW.
+- T201 bare `print()` (no explicit `file=`) in library code under
+  open_simulator_tpu/ — library output goes through the report
+  writer, the logging module, or the flight recorder (obs/), never
+  straight to a stdout the embedding process may own (simon serve's
+  HTTP replies, a driver parsing JSON). The CLI surface itself is the
+  audited allowlist (PRINT_ALLOW_FILES / PRINT_ALLOW); a print that
+  names its stream (`file=...`) is a report writer, not a stray.
 - E711 comparisons to None with ==/!=
 - F541 f-strings without any placeholder
 - B011/assert-tuple: `assert (x, y)` is always true
@@ -80,6 +87,18 @@ IO_TIMEOUT_FUNCS = {
 # BROAD_EXCEPT_ALLOW by (repo-relative path, enclosing function).
 # Currently empty: every first-party I/O call names its timeout.
 IO_TIMEOUT_ALLOW: set = set()
+
+# T201: files whose job IS terminal output — the CLI command surface.
+# Everything else in open_simulator_tpu/ must route output through the
+# report writer / logging / obs spans, or name its stream with file=.
+PRINT_ALLOW_FILES = {
+    "open_simulator_tpu/cli.py",
+}
+# Audited individual call sites, keyed like BROAD_EXCEPT_ALLOW by
+# (repo-relative path, enclosing function). Currently empty: the
+# non-CLI survivors all pass an explicit file= (interactive.py's shell
+# writes to its injected fout).
+PRINT_ALLOW: set = set()
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent
 _EXEMPT_TOPDIRS = {"tests", "tools"}
@@ -271,7 +290,7 @@ class _Checker(ast.NodeVisitor):
         return ""
 
     def visit_Call(self, node):
-        # S113 polices the same first-party runtime scope as BLE001
+        # S113 + T201 police the same first-party runtime scope as BLE001
         if self.police_broad_except:
             name = self._dotted_name(node.func)
             if name in IO_TIMEOUT_FUNCS and not any(
@@ -286,6 +305,21 @@ class _Checker(ast.NodeVisitor):
                         "— an unbounded external call can hang the plan "
                         "(audited exceptions go in tools/lint.py "
                         "IO_TIMEOUT_ALLOW)",
+                    )
+            if (
+                name == "print"
+                and self.rel not in PRINT_ALLOW_FILES
+                and not any(kw.arg == "file" for kw in node.keywords)
+            ):
+                ctx = self._func_stack[-1] if self._func_stack else "<module>"
+                if (self.rel, ctx) not in PRINT_ALLOW:
+                    self.report(
+                        node.lineno,
+                        "T201",
+                        f"bare print() in library code ('{ctx}') — route "
+                        "through the report writer / logging / obs spans, "
+                        "or name the stream with file= (CLI surfaces go "
+                        "in tools/lint.py PRINT_ALLOW_FILES)",
                     )
         self.generic_visit(node)
 

@@ -1,0 +1,82 @@
+"""Validate a `--trace-out` Chrome trace file (CI artifact gate).
+
+Checks that the file is well-formed trace-event JSON, contains span
+("X") events, and that the span forest reaches a minimum nesting depth
+— the observable proof that the flight recorder captured a real
+hierarchy (command root -> phase -> device dispatch), not a flat list.
+
+    python tools/validate_trace.py TRACE.json [--min-depth 3]
+
+Exit 0 on success (prints a one-line summary), 1 with a diagnostic
+otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from open_simulator_tpu.obs.spans import SpanRecord, nesting_depth  # noqa: E402
+
+
+def validate(path: str, min_depth: int = 3) -> str:
+    """Returns the summary line; raises ValueError on any failure."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        raise ValueError("no traceEvents array (or empty)")
+    xs = [e for e in events if e.get("ph") == "X"]
+    if not xs:
+        raise ValueError("no complete ('X') span events")
+    for e in xs:
+        for key in ("name", "ts", "dur", "pid", "tid"):
+            if key not in e:
+                raise ValueError(f"span event missing {key!r}: {e}")
+        if e["dur"] < 0:
+            raise ValueError(f"negative duration: {e}")
+    recs = [
+        SpanRecord(
+            span_id=e["args"]["span_id"],
+            parent_id=e["args"].get("parent_id"),
+            name=e["name"],
+            t0=e["ts"] / 1e6,
+            t1=(e["ts"] + e["dur"]) / 1e6,
+            tid=e["tid"],
+        )
+        for e in xs
+        if isinstance(e.get("args"), dict) and "span_id" in e["args"]
+    ]
+    if not recs:
+        raise ValueError("span events carry no span_id/parent_id args")
+    depth = nesting_depth(recs)
+    if depth < min_depth:
+        raise ValueError(
+            f"span nesting depth {depth} < required {min_depth} "
+            f"({len(recs)} spans: {sorted({r.name for r in recs})})"
+        )
+    return (
+        f"{path}: OK — {len(recs)} spans, nesting depth {depth}, "
+        f"{len({r.tid for r in recs})} thread(s)"
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="Chrome trace JSON from --trace-out")
+    ap.add_argument("--min-depth", type=int, default=3)
+    args = ap.parse_args()
+    try:
+        print(validate(args.trace, args.min_depth))
+    except (OSError, ValueError, KeyError) as e:
+        print(f"{args.trace}: INVALID — {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

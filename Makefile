@@ -3,10 +3,12 @@
 # Mirrors the reference's Makefile test target (reference Makefile:20-26).
 #
 #   make test      run the full suite (the end-of-round gate)
-#   make lint      syntax-compile every source file, then the
-#                  first-party AST linter (tools/lint.py: unused
-#                  imports, mutable defaults, bare except, broad/silent
-#                  except, I/O calls without an explicit timeout, ...)
+#   make lint      syntax-compile every source file, then simonlint —
+#                  the first-party static analysis framework
+#                  (tools/simonlint/, docs/STATIC_ANALYSIS.md): unused
+#                  imports, mutable defaults, broad/silent except, I/O
+#                  without timeouts, bare prints, JAX trace-safety +
+#                  recompile hazards, lock discipline
 #   make check     lint + test
 #   make examples  run both quickstart configs end to end
 #   make bench     one bench line (SIMON_BENCH selects the scenario)
@@ -20,7 +22,7 @@ test:
 
 lint:
 	$(PY) -m compileall -q open_simulator_tpu tools tests bench.py __graft_entry__.py
-	$(PY) tools/lint.py
+	$(PY) -m tools.simonlint
 
 check: lint test
 

@@ -162,7 +162,10 @@ class ExplainRecorder:
         meta = pod.get("metadata") or {}
         return (meta.get("namespace") or "default", meta.get("name", ""))
 
-    def wants(self, pod: dict) -> bool:
+    # unlocked `target` reads: set once by enable() before any hook
+    # fires and only cleared by disable(); a stale read at the
+    # boundary records or skips one pod, never corrupts state
+    def wants(self, pod: dict) -> bool:  # simonlint: disable=CONC001
         """Callers guard with ``EXPLAIN.enabled and EXPLAIN.wants(pod)``
         so the disabled path never reaches this call."""
         if self.target is None:
@@ -170,7 +173,7 @@ class ExplainRecorder:
         ns, name = self._pod_key(pod)
         return self.target == name or self.target == f"{ns}/{name}"
 
-    def _note_dropped(self, key) -> None:
+    def _note_dropped(self, key) -> None:  # simonlint: disable=CONC001
         """Caller holds self._lock. One accounting scheme everywhere:
         `dropped` is the count of UNIQUE pods the cap turned away
         (bounded key set so a pathological run cannot grow it)."""
@@ -178,7 +181,8 @@ class ExplainRecorder:
             self._dropped_keys.add(key)
         self.dropped = len(self._dropped_keys)
 
-    def should_record(self, pod: dict) -> bool:
+    # unlocked `target` read: same boundary-staleness argument as wants()
+    def should_record(self, pod: dict) -> bool:  # simonlint: disable=CONC001
         """``wants`` plus the record cap, checked BEFORE the caller
         collects per-node data: once the untargeted recorder is full,
         the hooks stop paying the O(nodes) verdict collection for pods
@@ -193,7 +197,7 @@ class ExplainRecorder:
                     return False
         return True
 
-    def _get(self, pod: dict, create: bool = True) -> Optional[PodExplanation]:
+    def _get(self, pod: dict, create: bool = True) -> Optional[PodExplanation]:  # simonlint: disable=CONC001
         """Caller holds self._lock."""
         key = self._pod_key(pod)
         rec = self._records.get(key)

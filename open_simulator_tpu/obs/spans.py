@@ -170,12 +170,20 @@ class Recorder:
     def span(self, name: str, **attrs):
         """Record the enclosed block as a span under the context's
         current parent. Yields the span id (None when disabled)."""
-        if not self.enabled:
+        # unlocked fast-path read: `enabled` flips rarely (CLI
+        # enable/disable brackets) and a stale read only drops or
+        # records one span at the boundary — the close path re-checks
+        # under the lock before appending
+        if not self.enabled:  # simonlint: disable=CONC001
             yield None
             return
         with self._lock:
             sid = self._next_id
             self._next_id += 1
+            # epoch snapshot rides the id-allocation lock: enable()
+            # resets it concurrently, and t0/t1 must subtract the SAME
+            # epoch or the span's duration is garbage
+            epoch = self._epoch
         parent = _parent.get()
         token = _parent.set(sid)
         t0 = time.perf_counter()
@@ -188,19 +196,25 @@ class Recorder:
                 span_id=sid,
                 parent_id=parent,
                 name=name,
-                t0=t0 - self._epoch,
-                t1=t1 - self._epoch,
+                t0=t0 - epoch,
+                t1=t1 - epoch,
                 tid=threading.get_ident(),
                 attrs=attrs,
             )
             with self._lock:
-                if not self.enabled:
-                    return  # disabled mid-span: drop, don't resurrect
-                if len(self._spans) < self.MAX_SPANS:
-                    self._spans.append(rec)
+                # disabled mid-span: drop, don't resurrect. NOT an
+                # early return — a `return` inside this finally would
+                # swallow any in-flight exception from the span body
+                # (contextlib reads the generator's clean exit as
+                # "exception suppressed")
+                if self.enabled:
+                    if len(self._spans) < self.MAX_SPANS:
+                        self._spans.append(rec)
+                    else:
+                        self.dropped += 1
+                    sink = self._sink
                 else:
-                    self.dropped += 1
-                sink = self._sink
+                    sink = None
             # sink I/O (write+flush+fsync) happens OUTSIDE the recorder
             # lock: concurrent threads closing spans must not queue
             # behind each other's disk syncs. The sink's own lock keeps

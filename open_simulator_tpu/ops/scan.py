@@ -82,8 +82,11 @@ class ScanFeatures(NamedTuple):
 ALL_FEATURES = ScanFeatures(*([True] * 9))
 
 
+# trace-safe by explicit guard: the tracer isinstance check below
+# bails to the pure ALL_FEATURES value before any np.asarray runs on
+# a traced input, so the host reads only ever see concrete arrays
 def features_of(static: "ScanStatic", pinned_node, weights=None,
-                sample: bool = False) -> ScanFeatures:
+                sample: bool = False) -> ScanFeatures:  # simonlint: disable=JAX001
     """Derive the feature set host-side.
 
     Inputs are normally concrete arrays; when called from inside a

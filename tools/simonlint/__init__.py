@@ -1,0 +1,61 @@
+"""simonlint — the repo's first-party multi-pass static analysis
+framework (`make lint`, `python -m tools.simonlint`).
+
+No third-party linter ships in this environment, so the lint gate is
+built on the stdlib `ast` module. What began as a single-file
+pyflakes-class checker (the old tools/lint.py) is now a framework:
+
+- a shared project index (`project.py`): every source file parsed once,
+  with parent links, scope chains, module-name resolution, and import
+  alias maps that the rules share instead of re-deriving;
+- an intra-package call-graph builder (`callgraph.py`) that resolves
+  plain calls, `self.method()` calls, and imported-module attribute
+  calls to their defining functions — the substrate for whole-program
+  analyses like JAX001 trace-safety;
+- a rule registry (`core.py`): each rule is a class registered under a
+  stable id; `python -m tools.simonlint --list-rules` enumerates them;
+- inline pragmas (`pragmas.py`): `# simonlint: disable=RULE[,RULE]` on
+  the finding's line (or on the enclosing `def`/`class` line to cover a
+  whole body). A pragma that suppresses nothing is itself reported
+  (SL001) so dead suppressions cannot rot. Legacy `# noqa` lines keep
+  working for the migrated rules;
+- text and JSON output (`runner.py`), wired into `make lint` and CI
+  (the findings JSON is uploaded as a workflow artifact).
+
+Rule inventory (docs/STATIC_ANALYSIS.md holds the full table):
+
+- pyflakes-class (rules/basic.py): F401 unused imports, F811 duplicate
+  defs, B006 mutable defaults, E722 bare except, E711 None comparison,
+  F541 placeholder-free f-strings, B011 assert-on-tuple
+- runtime hygiene (rules/hygiene.py): BLE001 broad except, S110 silent
+  except-pass, S113 I/O without timeout, T201 bare print — first-party
+  runtime scope (open_simulator_tpu/), audited allowlists in
+  allowlists.py
+- JAX (rules/jax_trace.py, rules/jax_compile.py): JAX001 host side
+  effects reachable inside traced code, JAX002 per-call `jax.jit`
+  wrappers that defeat the compile cache / non-hashable static args
+- concurrency (rules/concurrency.py): CONC001 lock-discipline — fields
+  guarded by `with self._lock` elsewhere must not be touched unlocked
+
+Checks that need full runtime resolution (undefined names) stay out of
+scope — `compileall` plus the test suite carry those.
+
+Exit status 1 when any finding survives suppression (the CI gate).
+"""
+
+from __future__ import annotations
+
+from .core import Finding, Rule, all_rules, get_rule, register
+from .runner import DEFAULT_ROOTS, lint_file, lint_paths, lint_repo
+
+__all__ = [
+    "DEFAULT_ROOTS",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "get_rule",
+    "lint_file",
+    "lint_paths",
+    "lint_repo",
+    "register",
+]

@@ -1,0 +1,99 @@
+"""`python -m tools.simonlint` — the `make lint` / CI entry point.
+
+Exit status 1 when any finding survives suppression, 0 on a clean
+tree. `--format json` prints the machine-readable findings document;
+`--out PATH` writes that document to a file regardless of the stdout
+format (CI uploads it as a workflow artifact while keeping readable
+logs)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import all_rules
+from .runner import (
+    DEFAULT_ROOTS,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.simonlint",
+        description="first-party static analysis (docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/directories to lint (default: {' '.join(DEFAULT_ROOTS)})",
+    )
+    ap.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="stdout format (default text)",
+    )
+    ap.add_argument(
+        "--out",
+        metavar="PATH",
+        help="also write the JSON findings document to PATH",
+    )
+    ap.add_argument(
+        "--rules",
+        metavar="ID[,ID...]",
+        help="restrict to a comma-separated subset of rule ids",
+    )
+    ap.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule inventory and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id:8s} {rule.title}")
+            print(f"         {rule.rationale}")
+        # framework-level, not a registered rule: emitted by the
+        # pragma accounting pass itself
+        print("SL001    unused suppression")
+        print(
+            "         a `# simonlint: disable=` pragma that silences "
+            "nothing is itself an error — suppressions cannot rot"
+        )
+        return 0
+
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    if rules:
+        known = {r.id for r in all_rules()}
+        unknown = [r for r in rules if r not in known]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+    try:
+        findings = lint_paths(args.paths or DEFAULT_ROOTS, rules=rules)
+    except (OSError, UnicodeDecodeError) as e:
+        # bad path / unreadable or undecodable file: a usage error
+        # (2), distinct from "findings found" (1)
+        print(f"simonlint: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        Path(args.out).write_text(render_json(findings) + "\n")
+    print(
+        render_json(findings)
+        if args.format == "json"
+        else render_text(findings)
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Intra-package call graph + traced-root discovery.
+
+Two jobs, both shared by whole-program rules:
+
+1. **Root discovery** (`iter_traced_roots`): find every function that
+   enters JAX tracing — the argument of ``jax.jit(...)`` /
+   ``partial(jax.jit, ...)(...)`` / ``jax.vmap`` / ``jax.pmap``, a
+   ``@jax.jit``-decorated def, or the kernel handed to
+   ``pl.pallas_call(...)``. Arguments are resolved through one level of
+   local aliasing (``sweep_fn = jax.vmap(self._scenario)`` then
+   ``jax.jit(sweep_fn)`` roots ``_scenario``) because that is exactly
+   how this codebase writes them.
+
+2. **Call resolution** (`Resolver.resolve`): map a Call node inside a
+   known function to the FunctionDef it invokes, when that target is
+   first-party: same-module top-level functions, nested defs in the
+   enclosing scope chain, ``self.method()`` on the enclosing class, and
+   ``module_alias.func()`` through the import map to another indexed
+   module. Anything unresolved returns None — the walker treats it as
+   opaque (external) and only checks it against the host-effect table.
+
+Heuristic by design: no data-flow through containers, no inheritance,
+no decorators-as-wrappers. That bounds both false negatives (documented
+in docs/STATIC_ANALYSIS.md) and analysis cost (one AST pass per file).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+from .project import ProjectIndex, SourceFile
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: call names (alias-normalized) whose first argument becomes traced
+JIT_ENTRY_CALLS = {"jax.jit", "jax.vmap", "jax.pmap"}
+#: pallas_call kernels are traced the same way; both the `pl.` alias
+#: and a from-import of pallas_call normalize to these
+PALLAS_CALLS = {"jax.experimental.pallas.pallas_call"}
+
+
+def is_jit_name(dotted: str) -> bool:
+    return dotted == "jax.jit"
+
+
+def is_pallas_call(dotted: str) -> bool:
+    return dotted in PALLAS_CALLS or dotted.endswith(".pallas_call") or dotted == "pallas_call"
+
+
+@dataclass(frozen=True)
+class TracedRoot:
+    """One function entering JAX tracing, with its registration site."""
+
+    sf: SourceFile          # file DEFINING the root function
+    node: ast.AST           # FunctionDef / Lambda
+    site_sf: SourceFile     # file of the jit/vmap/pallas_call site
+    site_line: int
+    via: str                # "jax.jit", "pallas_call", "@jax.jit", ...
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+
+class Resolver:
+    """Resolve call/argument expressions to first-party FunctionDefs."""
+
+    def __init__(self, project: ProjectIndex):
+        self.project = project
+
+    # -- expression -> function ---------------------------------------------
+
+    def resolve_func_expr(
+        self, sf: SourceFile, expr: ast.AST, scope: Optional[ast.AST]
+    ) -> Optional[Tuple[SourceFile, ast.AST]]:
+        """The FunctionDef an expression evaluates to, through local
+        aliases and jit/vmap wrappers. `scope` is the enclosing
+        FunctionDef (None at module scope)."""
+        seen = 0
+        while seen < 8:  # alias-chain bound; cycles impossible below it
+            seen += 1
+            if isinstance(expr, ast.Lambda):
+                return sf, expr
+            if isinstance(expr, ast.Call):
+                dotted = sf.dotted_call_name(expr.func)
+                if dotted in JIT_ENTRY_CALLS or is_pallas_call(dotted):
+                    if expr.args:
+                        expr = expr.args[0]
+                        continue
+                # functools.partial(f, ...) forwards to f
+                if dotted in ("functools.partial", "partial") and expr.args:
+                    expr = expr.args[0]
+                    continue
+                return None
+            if isinstance(expr, ast.Name):
+                resolved = self._resolve_name(sf, expr.id, scope)
+                if isinstance(resolved, ast.AST):
+                    return sf, resolved
+                if resolved is not None:  # (sf, node) cross-module
+                    return resolved
+                # local alias: x = <expr> in the enclosing scope chain
+                alias = self._local_assignment(scope, expr.id)
+                if alias is not None:
+                    expr = alias
+                    continue
+                return None
+            if isinstance(expr, ast.Attribute):
+                if (
+                    isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                ):
+                    return self._resolve_self_method(sf, expr)
+                dotted = sf.dotted_call_name(expr)
+                if dotted:
+                    hit = self.project.top_level_function(dotted)
+                    if hit is not None:
+                        return hit
+                return None
+            return None
+        return None
+
+    def _resolve_name(
+        self, sf: SourceFile, name: str, scope: Optional[ast.AST]
+    ):
+        """nested def in the scope chain > module top-level def >
+        from-imported first-party function."""
+        node = scope
+        while node is not None:
+            for stmt in ast.walk(node):
+                if isinstance(stmt, _FUNC_NODES) and stmt.name == name:
+                    return stmt
+            node = sf.enclosing_function_node(node)
+        if sf.tree is not None:
+            for stmt in sf.tree.body:
+                if isinstance(stmt, _FUNC_NODES) and stmt.name == name:
+                    return stmt
+        target = sf.imports.get(name)
+        if target:
+            return self.project.top_level_function(target)
+        return None
+
+    def _local_assignment(
+        self, scope: Optional[ast.AST], name: str
+    ) -> Optional[ast.AST]:
+        if scope is None:
+            return None
+        for stmt in ast.walk(scope):
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return stmt.value
+        return None
+
+    def _resolve_self_method(
+        self, sf: SourceFile, attr: ast.Attribute
+    ) -> Optional[Tuple[SourceFile, ast.AST]]:
+        cls = sf.enclosing_class(attr)
+        if cls is None:
+            return None
+        for stmt in cls.body:
+            if isinstance(stmt, _FUNC_NODES) and stmt.name == attr.attr:
+                return sf, stmt
+        return None
+
+    # -- call site -> function ----------------------------------------------
+
+    def resolve_call(
+        self, sf: SourceFile, call: ast.Call
+    ) -> Optional[Tuple[SourceFile, ast.AST]]:
+        scope = sf.enclosing_function_node(call)
+        return self.resolve_func_expr(sf, call.func, scope)
+
+
+def iter_traced_roots(project: ProjectIndex) -> Iterator[TracedRoot]:
+    """Every traced-function registration in runtime-scope files.
+    Duplicate (function, via) pairs are collapsed to the first site."""
+    resolver = Resolver(project)
+    seen = set()
+    for sf in project.files:
+        if sf.tree is None or not sf.is_runtime_scope:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                dotted = sf.dotted_call_name(node.func)
+                via = None
+                target_expr = None
+                if dotted in JIT_ENTRY_CALLS and node.args:
+                    via, target_expr = dotted, node.args[0]
+                elif is_pallas_call(dotted) and node.args:
+                    via, target_expr = "pallas_call", node.args[0]
+                elif (
+                    isinstance(node.func, ast.Call)
+                    and sf.dotted_call_name(node.func.func)
+                    in ("functools.partial", "partial")
+                    and node.func.args
+                    and sf.dotted_call_name(node.func.args[0]) == "jax.jit"
+                    and node.args
+                ):
+                    # partial(jax.jit, ...)(fn)
+                    via, target_expr = "partial(jax.jit)", node.args[0]
+                if via is None:
+                    continue
+                scope = sf.enclosing_function_node(node)
+                hit = resolver.resolve_func_expr(sf, target_expr, scope)
+                if hit is None:
+                    continue
+                root_sf, fn = hit
+                key = (root_sf.rel, getattr(fn, "lineno", 0), via)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield TracedRoot(root_sf, fn, sf, node.lineno, via)
+            elif isinstance(node, _FUNC_NODES):
+                for deco in node.decorator_list:
+                    d = deco.func if isinstance(deco, ast.Call) else deco
+                    dotted = sf.dotted_call_name(d)
+                    is_partial_jit = (
+                        isinstance(deco, ast.Call)
+                        and sf.dotted_call_name(deco.func)
+                        in ("functools.partial", "partial")
+                        and deco.args
+                        and sf.dotted_call_name(deco.args[0]) == "jax.jit"
+                    )
+                    if dotted == "jax.jit" or is_partial_jit:
+                        key = (sf.rel, node.lineno, "@jax.jit")
+                        if key not in seen:
+                            seen.add(key)
+                            yield TracedRoot(
+                                sf, node, sf, node.lineno, "@jax.jit"
+                            )

@@ -1,0 +1,113 @@
+"""Rule registry and the Finding record every rule emits.
+
+A rule is a class with a stable ``id`` registered via ``@register``;
+the runner instantiates the registry once per invocation and hands each
+rule the shared project index (``project.ProjectIndex``) so no rule
+re-parses a file the framework has already parsed.
+
+Two granularities:
+
+- ``scope = "file"``: ``check_file(ctx)`` runs once per source file
+  with a ``FileContext`` (the parsed file + the project it belongs to).
+  Most rules live here.
+- ``scope = "project"``: ``check_project(project)`` runs once with the
+  whole index — for cross-module analyses (JAX001 walks the package
+  call graph from every jit root, which no single file can see).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import TYPE_CHECKING, Dict, Iterable, List
+
+if TYPE_CHECKING:  # import cycle: project.py imports nothing from here
+    from .project import ProjectIndex, SourceFile
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One reported violation. ``rel`` is the repo-relative path (or
+    the bare filename for out-of-tree files, e.g. test fixtures)."""
+
+    path: Path
+    rel: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.rel}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "file": self.rel,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+@dataclass
+class FileContext:
+    """What a file-scoped rule sees: the parsed file plus the project
+    index (for import resolution and runtime-scope decisions)."""
+
+    sf: "SourceFile"
+    project: "ProjectIndex"
+    findings: List[Finding] = field(default_factory=list)
+
+    def report(self, line: int, rule: str, message: str) -> None:
+        self.findings.append(
+            Finding(self.sf.path, self.sf.rel, line, rule, message)
+        )
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``title``/``scope``, implement
+    the matching ``check_*`` method, and decorate with ``@register``."""
+
+    id: str = ""
+    title: str = ""
+    #: one-line rationale shown by --list-rules (the full table with
+    #: examples lives in docs/STATIC_ANALYSIS.md)
+    rationale: str = ""
+    scope: str = "file"  # "file" | "project"
+
+    def check_file(self, ctx: FileContext) -> None:
+        raise NotImplementedError
+
+    def check_project(self, project: "ProjectIndex") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and index the rule by id. Duplicate
+    ids are a programming error and fail loudly at import time."""
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    """Registered rules, stable-ordered by id (output determinism)."""
+    _load_rules()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    _load_rules()
+    return _REGISTRY[rule_id]
+
+
+def _load_rules() -> None:
+    # rules register on import; deferred so `import tools.simonlint.core`
+    # alone (e.g. from a rule module) cannot cycle
+    from . import rules  # noqa: F401

@@ -1,0 +1,183 @@
+"""Inline suppression pragmas.
+
+Two spellings are honored:
+
+- ``# simonlint: disable=RULE[,RULE...]`` — the first-party form. On a
+  finding's own line it suppresses that finding; on a ``def`` / ``class``
+  header line it suppresses matching findings anywhere in that body
+  (for caller-holds-lock helpers and documented hot-path reads, where a
+  per-line pragma would repeat the same justification five times).
+  Every pragma is accounted for: one that suppressed nothing is itself
+  reported as **SL001 unused suppression**, so stale pragmas cannot
+  accumulate after the code they excused is fixed.
+- ``# noqa`` / ``# noqa: CODE[,CODE]`` — the legacy form the migrated
+  rules (F401 ... T201) already use in the tree. Bare ``noqa``
+  suppresses every rule on its line; with codes, only those. noqa
+  pragmas are NOT usage-tracked (they predate the framework and some
+  annotate tool output, e.g. conftest's E402 markers); new suppressions
+  should use the simonlint form.
+
+SL001 findings are themselves unsuppressible — a pragma whose only
+effect is to hide "this pragma is unused" is definitionally unused.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+UNUSED_SUPPRESSION = "SL001"
+
+_SIMONLINT_RE = re.compile(
+    r"#\s*simonlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", re.IGNORECASE)
+
+
+@dataclass
+class LinePragmas:
+    """Suppressions attached to one physical line."""
+
+    #: rule ids from `# simonlint: disable=...`
+    disable: Tuple[str, ...] = ()
+    #: True for bare `# noqa`
+    noqa_all: bool = False
+    #: rule ids from `# noqa: CODE,...`
+    noqa: Tuple[str, ...] = ()
+    #: simonlint ids that actually suppressed a finding (usage ledger)
+    used: set = field(default_factory=set)
+
+
+def parse_pragmas(lines: List[str]) -> Dict[int, LinePragmas]:
+    """1-based line -> LinePragmas, for lines carrying any pragma.
+
+    Matched against real COMMENT tokens only (via `tokenize`), so a
+    docstring or message string that merely MENTIONS a pragma — this
+    framework's own sources are full of them — never suppresses
+    anything. Tokenization errors (only possible on files that already
+    fail to parse) degrade to no pragmas."""
+    comments: Dict[int, str] = {}
+    source = "\n".join(lines) + "\n"
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return {}
+    out: Dict[int, LinePragmas] = {}
+    for i, comment in comments.items():
+        lp = LinePragmas()
+        m = _SIMONLINT_RE.search(comment)
+        if m:
+            lp.disable = tuple(
+                s.strip() for s in m.group(1).split(",") if s.strip()
+            )
+        m = _NOQA_RE.search(comment)
+        if m:
+            codes = m.group(1)
+            if codes:
+                lp.noqa = tuple(
+                    s.strip().upper() for s in codes.split(",") if s.strip()
+                )
+            else:
+                lp.noqa_all = True
+        if lp.disable or lp.noqa or lp.noqa_all:
+            out[i] = lp
+    return out
+
+
+def _suppresses(lp: LinePragmas, rule: str, *, line_local: bool) -> bool:
+    """Does this pragma line silence `rule`? noqa forms only apply on
+    the finding's own line (the legacy contract); simonlint disables
+    also apply from enclosing def/class headers."""
+    if rule == UNUSED_SUPPRESSION:
+        return False
+    if rule in lp.disable:
+        lp.used.add(rule)
+        return True
+    if line_local and (lp.noqa_all or rule in lp.noqa):
+        return True
+    return False
+
+
+def apply_suppressions(findings, files, active_rules=None) -> List:
+    """Drop suppressed findings, then report unused simonlint pragmas.
+
+    `findings` is the full pre-suppression list; `files` the
+    SourceFiles they came from (for pragma maps and scope lines).
+    `active_rules` is the set of rule ids that actually RAN this
+    invocation (None = all): a pragma for a rule that did not run
+    cannot be proven unused and is never reported — otherwise a
+    `--rules F401` subset run would flag every CONC001/JAX001 pragma
+    in the tree. Returns the surviving findings plus SL001 entries,
+    unsorted — the runner owns ordering."""
+    from .core import Finding  # local import: core imports nothing from here
+
+    by_rel = {sf.rel: sf for sf in files}
+    kept = []
+    for f in findings:
+        sf = by_rel.get(f.rel)
+        if sf is None:
+            kept.append(f)
+            continue
+        lp = sf.pragmas.get(f.line)
+        if lp is not None and _suppresses(lp, f.rule, line_local=True):
+            continue
+        # body-wide pragmas on enclosing def/class header lines
+        node = _node_at(sf, f.line)
+        suppressed = False
+        if node is not None:
+            for scope_line in sf.scope_lines(node):
+                slp = sf.pragmas.get(scope_line)
+                if slp is not None and _suppresses(
+                    slp, f.rule, line_local=False
+                ):
+                    suppressed = True
+                    break
+        if not suppressed:
+            kept.append(f)
+    for sf in files:
+        for line, lp in sorted(sf.pragmas.items()):
+            for rule in lp.disable:
+                if active_rules is not None and rule not in active_rules:
+                    continue
+                if rule not in lp.used:
+                    kept.append(
+                        Finding(
+                            sf.path,
+                            sf.rel,
+                            line,
+                            UNUSED_SUPPRESSION,
+                            f"unused suppression: no {rule} finding is "
+                            "silenced by this pragma — remove it (or fix "
+                            "the rule id)",
+                        )
+                    )
+    return kept
+
+
+def _node_at(sf, line: int):
+    """Any AST node on `line` (for scope-chain lookup). Cheap linear
+    scan per finding; findings are rare on a healthy tree."""
+    if sf.tree is None:
+        return None
+    import ast
+
+    best = None
+    for node in ast.walk(sf.tree):
+        if getattr(node, "lineno", None) == line:
+            return node
+        # fall back to any node whose span covers the line (multi-line
+        # statements report findings on sub-lines)
+        end = getattr(node, "end_lineno", None)
+        if (
+            best is None
+            and getattr(node, "lineno", None) is not None
+            and end is not None
+            and node.lineno <= line <= end
+        ):
+            best = node
+    return best

@@ -1,0 +1,247 @@
+"""Shared AST + scope index — every file is parsed exactly once.
+
+``SourceFile`` wraps one parsed module with the derived structure the
+rules keep needing: parent links for upward walks, the enclosing
+function of any node, per-file import alias maps (``np`` ->
+``numpy``, ``scan_ops`` -> ``open_simulator_tpu.ops.scan``), and the
+line pragmas. ``ProjectIndex`` holds every SourceFile keyed by path and
+dotted module name, which is what lets cross-module analyses resolve
+``scan_ops.run_scan_masked`` to the function node in ops/scan.py.
+
+Scope policy (inherited from the old tools/lint.py): the runtime-
+hygiene and JAX/concurrency rules police FIRST-PARTY RUNTIME code —
+inside the repo that means ``open_simulator_tpu/`` (tests, tools,
+bench.py and the graft entry are exempt); outside the repo (the lint
+test suite's tmp fixtures) they are live so tests can exercise them
+directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .pragmas import parse_pragmas
+
+_EXEMPT_TOPDIRS = {"tests", "tools"}
+_EXEMPT_FILES = {"bench.py", "__graft_entry__.py"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SCOPE_NODES = _FUNC_NODES + (ast.ClassDef,)
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+class SourceFile:
+    """One parsed source file plus the shared derived structure."""
+
+    def __init__(self, path: Path, root: Optional[Path] = None):
+        self.path = Path(path)
+        self.root = Path(root) if root is not None else repo_root()
+        # tokenize.open honors PEP 263 coding declarations, so a
+        # legacy-encoded file compileall accepts does not crash the
+        # gate with a UnicodeDecodeError
+        with tokenize.open(self.path) as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.rel = self._relpath()
+        self.module = self._module_name()
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(
+                self.source, filename=str(self.path)
+            )
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+            self.parents = {}
+            self.pragmas = {}
+            self.imports = {}
+            return
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.pragmas = parse_pragmas(self.lines)
+        #: alias -> dotted target. `import numpy as np` -> np: numpy;
+        #: `from ..ops import scan as scan_ops` (in
+        #: open_simulator_tpu.scheduler.engine) ->
+        #: scan_ops: open_simulator_tpu.ops.scan; `from time import
+        #: sleep` -> sleep: time.sleep. Function-local imports are
+        #: included — this codebase imports inside functions to defer
+        #: jax initialization, and alias resolution must still work
+        #: there (collisions across functions are theoretical and
+        #: resolve last-wins).
+        self.imports: Dict[str, str] = {}
+        self._collect_imports()
+
+    # -- path / scope -------------------------------------------------------
+
+    def _relpath(self) -> str:
+        try:
+            return str(self.path.resolve().relative_to(self.root.resolve()))
+        except ValueError:
+            return self.path.name
+
+    def _module_name(self) -> Optional[str]:
+        """Dotted module name for in-repo files (None out of tree)."""
+        rel = Path(self.rel)
+        if rel.is_absolute() or not self.rel.endswith(".py"):
+            return None
+        parts = list(rel.parts)
+        parts[-1] = parts[-1][: -len(".py")]
+        if parts[-1] == "__init__":
+            parts.pop()
+        return ".".join(parts) if parts else None
+
+    @property
+    def is_runtime_scope(self) -> bool:
+        """True when the runtime-hygiene / JAX / concurrency rules
+        apply (see module docstring for the policy)."""
+        parts = Path(self.rel).parts
+        if parts and parts[0] in _EXEMPT_TOPDIRS:
+            return False
+        if self.rel in _EXEMPT_FILES:
+            return False
+        return True
+
+    # -- imports ------------------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        pkg_parts = (self.module or "").split(".")[:-1] if self.module else []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    self.imports[alias] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                base: Optional[str]
+                if node.level:
+                    # relative import: climb `level` packages from the
+                    # containing package
+                    up = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    if node.level - 1 > len(pkg_parts):
+                        up = []
+                    base = ".".join(up)
+                    if node.module:
+                        base = f"{base}.{node.module}" if base else node.module
+                else:
+                    base = node.module
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = f"{base}.{a.name}"
+
+    def dotted_call_name(self, func: ast.AST) -> str:
+        """Dotted name of a call target with the FIRST segment rewritten
+        through the import alias map: ``np.random.seed`` ->
+        ``numpy.random.seed``, ``scan_ops.run_scan_masked`` ->
+        ``open_simulator_tpu.ops.scan.run_scan_masked``. Unresolvable
+        shapes (subscripts, calls) return ""."""
+        parts: List[str] = []
+        while isinstance(func, ast.Attribute):
+            parts.append(func.attr)
+            func = func.value
+        if not isinstance(func, ast.Name):
+            return ""
+        head = self.imports.get(func.id, func.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+    # -- upward walks -------------------------------------------------------
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> str:
+        """Name of the innermost enclosing def ("<module>" at module
+        scope) — the allowlist key the hygiene rules share."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, _FUNC_NODES):
+                return anc.name
+        return "<module>"
+
+    def enclosing_function_node(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, _FUNC_NODES):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+            if isinstance(anc, _FUNC_NODES):
+                # a def between node and the class breaks method-hood
+                # only if the class is further out; keep climbing — a
+                # nested function inside a method still belongs to the
+                # method's class for self-resolution purposes
+                continue
+        return None
+
+    def scope_lines(self, node: ast.AST) -> List[int]:
+        """Line numbers of every enclosing def/class HEADER (innermost
+        first) — where body-wide pragmas may sit. A multi-line
+        signature counts every header line (decorators excluded), so
+        the pragma can ride the line with the closing colon."""
+        out = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, _SCOPE_NODES):
+                body_start = anc.body[0].lineno if anc.body else anc.lineno
+                header_end = max(anc.lineno, body_start - 1)
+                out.extend(range(anc.lineno, header_end + 1))
+        return out
+
+
+class ProjectIndex:
+    """Every SourceFile of one lint invocation, plus module lookup."""
+
+    def __init__(self, paths: List[Path], root: Optional[Path] = None):
+        self.root = Path(root) if root is not None else repo_root()
+        self.files: List[SourceFile] = []
+        self.by_path: Dict[Path, SourceFile] = {}
+        self.by_module: Dict[str, SourceFile] = {}
+        for p in paths:
+            self.add(p)
+
+    def add(self, path: Path) -> SourceFile:
+        sf = SourceFile(path, self.root)
+        self.files.append(sf)
+        self.by_path[sf.path] = sf
+        if sf.module:
+            self.by_module[sf.module] = sf
+        return sf
+
+    def resolve_module(self, dotted: str) -> Optional[SourceFile]:
+        """SourceFile for a dotted module name (packages resolve to
+        their __init__ when indexed)."""
+        return self.by_module.get(dotted)
+
+    def top_level_function(
+        self, dotted: str
+    ) -> Optional[Tuple[SourceFile, ast.AST]]:
+        """Resolve ``pkg.mod.func`` to (SourceFile, FunctionDef) when
+        the module is in the index and defines the function at top
+        level."""
+        if "." not in dotted:
+            return None
+        mod_name, func_name = dotted.rsplit(".", 1)
+        sf = self.by_module.get(mod_name)
+        if sf is None or sf.tree is None:
+            return None
+        for node in sf.tree.body:
+            if isinstance(node, _FUNC_NODES) and node.name == func_name:
+                return sf, node
+        return None

@@ -1,0 +1,7 @@
+"""Rule modules register themselves on import (core.register)."""
+
+from . import basic  # noqa: F401
+from . import concurrency  # noqa: F401
+from . import hygiene  # noqa: F401
+from . import jax_compile  # noqa: F401
+from . import jax_trace  # noqa: F401

@@ -1,0 +1,215 @@
+"""Pyflakes-class correctness checks (everywhere, including tests and
+tools): unused imports, duplicate definitions, mutable defaults, bare
+except, None comparison, placeholder-free f-strings, assert-on-tuple.
+
+Ported rule-for-rule from the original single-file linter; behavior is
+pinned by tests/test_simonlint.py (incl. the r5 regression where F811
+once suppressed itself whenever the scope contained ANY `if`)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule, register
+
+
+@register
+class UnusedImports(Rule):
+    id = "F401"
+    title = "unused import"
+    rationale = (
+        "module-scope imports nothing references are dead weight and "
+        "hide real dependency changes (__init__.py re-exports exempt)"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        sf = ctx.sf
+        if sf.path.name == "__init__.py":
+            return  # __init__ re-exports are intentional
+        imported: dict = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    imported[name] = node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imported[a.asname or a.name] = node.lineno
+        if not imported:
+            return
+        used: set = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+        # names referenced in __all__ strings count as used
+        for node in sf.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        used.add(elt.value)
+        for name, lineno in imported.items():
+            if name not in used:
+                ctx.report(lineno, self.id, f"'{name}' imported but unused")
+
+
+@register
+class DuplicateDefs(Rule):
+    id = "F811"
+    title = "redefinition in one scope"
+    rationale = (
+        "a duplicate def/class in one scope is the classic copy-paste "
+        "bug (the second silently wins); conditional dispatch with an "
+        "if/try BETWEEN the defs stays legal"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        self._scope(ctx, ctx.sf.tree.body)
+        for node in ast.walk(ctx.sf.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                self._scope(ctx, node.body)
+
+    def _scope(self, ctx: FileContext, body) -> None:
+        seen: dict = {}
+        for idx, node in enumerate(body):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                prev = seen.get(node.name)
+                # a redefinition is a bug unless an If/Try stands
+                # BETWEEN the two defs (conditional dispatch pattern) —
+                # scanning the whole body would let any unrelated `if`
+                # suppress the check
+                if prev is not None and not any(
+                    isinstance(n, (ast.If, ast.Try))
+                    for n in body[prev[0] + 1 : idx]
+                ):
+                    ctx.report(
+                        node.lineno,
+                        self.id,
+                        f"redefinition of '{node.name}' from line {prev[1]}",
+                    )
+                seen[node.name] = (idx, node.lineno)
+
+
+@register
+class MutableDefaults(Rule):
+    id = "B006"
+    title = "mutable default argument"
+    rationale = (
+        "a list/dict/set default is created once and shared across "
+        "calls — mutation leaks between callers"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    ctx.report(
+                        default.lineno,
+                        self.id,
+                        f"mutable default argument in '{node.name}'",
+                    )
+
+
+@register
+class BareExcept(Rule):
+    id = "E722"
+    title = "bare except"
+    rationale = "an untyped handler catches SystemExit/KeyboardInterrupt too"
+
+    def check_file(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.sf.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                ctx.report(node.lineno, self.id, "bare 'except:'")
+
+
+@register
+class NoneComparison(Rule):
+    id = "E711"
+    title = "comparison to None with ==/!="
+    rationale = "None identity must use is/is not (== can be overloaded)"
+
+    def check_file(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.sf.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    (isinstance(comp, ast.Constant) and comp.value is None)
+                    or (
+                        isinstance(node.left, ast.Constant)
+                        and node.left.value is None
+                    )
+                ):
+                    ctx.report(
+                        node.lineno, self.id, "comparison to None with ==/!="
+                    )
+
+
+@register
+class EmptyFString(Rule):
+    id = "F541"
+    title = "f-string without placeholders"
+    rationale = "an f-prefix with no interpolation is usually a lost brace"
+
+    def check_file(self, ctx: FileContext) -> None:
+        for child in ast.iter_child_nodes(ctx.sf.tree):
+            self._visit(ctx, child)
+
+    def _visit(self, ctx: FileContext, node) -> None:
+        if isinstance(node, ast.JoinedStr):
+            if not any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                ctx.report(
+                    node.lineno, self.id, "f-string without placeholders"
+                )
+            # do NOT recurse into the JoinedStr generically: a format
+            # spec (":05d") is a placeholder-free JoinedStr child and
+            # must not be flagged — only visit the formatted values'
+            # expressions
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._visit(ctx, v.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(ctx, child)
+
+
+@register
+class AssertTuple(Rule):
+    id = "B011"
+    title = "assert on a non-empty tuple"
+    rationale = "`assert (x, y)` is always true — the comma was meant as args"
+
+    def check_file(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.sf.tree):
+            if (
+                isinstance(node, ast.Assert)
+                and isinstance(node.test, ast.Tuple)
+                and node.test.elts
+            ):
+                ctx.report(
+                    node.lineno,
+                    self.id,
+                    "assert on a non-empty tuple is always true",
+                )

@@ -1,0 +1,151 @@
+"""CONC001 — lock discipline: fields guarded somewhere must be guarded
+everywhere.
+
+`simon serve` runs HTTP handler threads alongside one dispatcher
+thread; the shared mutable state they touch (utils/trace.Counters,
+utils/memo.IdentityMemo, serve/coalescer.Coalescer, obs/spans.Recorder,
+obs/explain.ExplainRecorder) is guarded by a per-instance `_lock`. The
+failure mode this rule targets is the asymmetric access: a field
+consistently written under `with self._lock:` in five methods and then
+read (or worse, read-modify-written) bare in a sixth — invisible to
+review, intermittent under load, and exactly what the thread-safety
+tests only catch when the interleaving cooperates.
+
+Mechanics: in any class that defines `_lock` (a `self._lock = ...`
+assignment, typically in __init__), every `self.<field>` access is
+classified as inside or outside a `with self._lock:` block. A field
+with at least one guarded access (outside __init__) is a GUARDED
+field; any unguarded access to it (outside __init__/__new__, where the
+instance is not yet shared) is flagged.
+
+Intentional escapes are real and documented in this codebase — the
+memo identity fast path, hot-path `enabled` reads, caller-holds-lock
+helpers — and carry a usage-checked `# simonlint: disable=CONC001`
+pragma (line- or def-level) with the justification next to the code it
+excuses. Anything broader goes in allowlists.CONC001_ALLOW.
+
+Known limits (docs/STATIC_ANALYSIS.md): only the literal `_lock` name
+is recognized; accesses through aliases other than `self` and locks
+taken via .acquire() are invisible; cross-class access (other.field)
+is out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .. import allowlists
+from ..core import FileContext, Rule, register
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
+
+
+def _defines_lock(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "_lock"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    return True
+        elif isinstance(node, ast.AnnAssign):
+            t = node.target
+            if (
+                isinstance(t, ast.Attribute)
+                and t.attr == "_lock"
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                return True
+    return False
+
+
+def _is_self_lock(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "_lock"
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    )
+
+
+@register
+class LockDiscipline(Rule):
+    id = "CONC001"
+    title = "guarded field accessed outside the lock"
+    rationale = (
+        "a field accessed under `with self._lock:` anywhere must be "
+        "accessed under it everywhere (outside __init__) — asymmetric "
+        "access is the data race reviews miss"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        sf = ctx.sf
+        if not sf.is_runtime_scope:
+            return
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and _defines_lock(node):
+                self._check_class(ctx, node)
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef) -> None:
+        sf = ctx.sf
+        #: field -> [(line, method, under_lock)]
+        accesses: List[Tuple[str, int, str, bool]] = []
+        guarded: Set[str] = set()
+        guard_site: Dict[str, int] = {}
+        for method in cls.body:
+            if not isinstance(method, _FUNC_NODES):
+                continue
+            exempt = method.name in _EXEMPT_METHODS
+            for field, line, under in self._method_accesses(method):
+                if field == "_lock":
+                    continue
+                if under and not exempt:
+                    guarded.add(field)
+                    guard_site.setdefault(field, line)
+                if not exempt:
+                    accesses.append((field, line, method.name, under))
+        for field, line, method_name, under in accesses:
+            if under or field not in guarded:
+                continue
+            if (sf.rel, method_name) in allowlists.CONC001_ALLOW:
+                continue
+            ctx.report(
+                line,
+                self.id,
+                f"'{cls.name}.{field}' is accessed under self._lock "
+                f"elsewhere (e.g. line {guard_site[field]}) but touched "
+                f"here in '{method_name}' without it — take the lock, or "
+                "document the benign race with a "
+                "`# simonlint: disable=CONC001` pragma",
+            )
+
+    def _method_accesses(self, method):
+        """Yield (field, line, under_lock) for every self.<field>
+        access in one method, nested defs included (they run on the
+        caller's thread)."""
+        #: nodes inside any `with self._lock:` body
+        locked_spans: List[Tuple[int, int]] = []
+        for node in ast.walk(method):
+            if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                _is_self_lock(item.context_expr) for item in node.items
+            ):
+                locked_spans.append(
+                    (node.body[0].lineno, node.end_lineno or node.lineno)
+                )
+
+        def under_lock(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi in locked_spans)
+
+        for node in ast.walk(method):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                yield node.attr, node.lineno, under_lock(node.lineno)

@@ -1,0 +1,198 @@
+"""Runtime-hygiene rules — first-party runtime scope only
+(open_simulator_tpu/; tests, tools, bench.py and the graft entry are
+exempt; out-of-repo fixture files are policed so tests can exercise the
+rules directly — see project.SourceFile.is_runtime_scope).
+
+- BLE001 broad `except Exception:` / `except BaseException:` — catch
+  the specific expected errors so real bugs stay loud. Audited
+  survivors (logged + trace-noted, never silent) live in
+  allowlists.BROAD_EXCEPT_ALLOW.
+- S110 silent `except ...: pass` — a swallowed exception must at least
+  record why (trace note / log).
+- S113 `urllib.request.urlopen` / `subprocess.run` (and friends)
+  without an explicit `timeout=` — an unbounded external call can hang
+  a whole plan; every I/O call site names its timeout
+  (runtime/retry.py holds the configurable defaults).
+- T201 bare `print()` (no explicit `file=`) in library code — library
+  output goes through the report writer, the logging module, or the
+  flight recorder (obs/), never straight to a stdout the embedding
+  process may own (simon serve's HTTP replies, a driver parsing JSON).
+  The CLI surface is the audited allowlist; a print that names its
+  stream (`file=...`) is a report writer, not a stray.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import allowlists
+from ..core import FileContext, Rule, register
+
+# I/O entry points that hang forever without a timeout
+IO_TIMEOUT_FUNCS = {
+    "urllib.request.urlopen",
+    "urlopen",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "Popen",
+}
+
+
+def _handler_type_names(node: ast.ExceptHandler) -> list:
+    types = []
+    if isinstance(node.type, ast.Tuple):
+        types = list(node.type.elts)
+    elif node.type is not None:
+        types = [node.type]
+    return [t.id for t in types if isinstance(t, ast.Name)]
+
+
+@register
+class BroadExcept(Rule):
+    id = "BLE001"
+    title = "broad except in runtime code"
+    rationale = (
+        "except Exception/BaseException hides real bugs; audited "
+        "last-resort degradations go in allowlists.BROAD_EXCEPT_ALLOW"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        sf = ctx.sf
+        if not sf.is_runtime_scope:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            fn = sf.enclosing_function(node)
+            if (sf.rel, fn) in allowlists.BROAD_EXCEPT_ALLOW:
+                continue
+            broad = [
+                n
+                for n in _handler_type_names(node)
+                if n in ("Exception", "BaseException")
+            ]
+            if broad:
+                ctx.report(
+                    node.lineno,
+                    self.id,
+                    f"broad 'except {broad[0]}:' in '{fn}' — catch the "
+                    "specific expected errors (audited degradation paths "
+                    "go in tools/simonlint/allowlists.py "
+                    "BROAD_EXCEPT_ALLOW)",
+                )
+
+
+@register
+class SilentExceptPass(Rule):
+    id = "S110"
+    title = "silent except: pass in runtime code"
+    rationale = (
+        "a swallowed exception must record why (trace note / log) or "
+        "be narrowed away"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        sf = ctx.sf
+        if not sf.is_runtime_scope:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            fn = sf.enclosing_function(node)
+            if (sf.rel, fn) in allowlists.BROAD_EXCEPT_ALLOW:
+                continue
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                ctx.report(
+                    node.lineno,
+                    self.id,
+                    f"silent 'except: pass' in '{fn}' — record why the "
+                    "exception is safe to swallow (trace note / log) or "
+                    "narrow it away",
+                )
+
+
+@register
+class IoWithoutTimeout(Rule):
+    id = "S113"
+    title = "I/O call without explicit timeout"
+    rationale = (
+        "urlopen/subprocess without timeout= can hang the whole plan; "
+        "configurable defaults live in runtime/retry.py"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        sf = ctx.sf
+        if not sf.is_runtime_scope:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _raw_dotted(node.func)
+            if name not in IO_TIMEOUT_FUNCS:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            fn = sf.enclosing_function(node)
+            if (sf.rel, fn) in allowlists.IO_TIMEOUT_ALLOW:
+                continue
+            ctx.report(
+                node.lineno,
+                self.id,
+                f"'{name}' without an explicit timeout= in '{fn}' — an "
+                "unbounded external call can hang the plan (audited "
+                "exceptions go in tools/simonlint/allowlists.py "
+                "IO_TIMEOUT_ALLOW)",
+            )
+
+
+@register
+class BarePrint(Rule):
+    id = "T201"
+    title = "bare print() in library code"
+    rationale = (
+        "library output goes through the report writer / logging / obs "
+        "spans, or names its stream with file=; the CLI surface is "
+        "allowlisted"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        sf = ctx.sf
+        if not sf.is_runtime_scope:
+            return
+        if sf.rel in allowlists.PRINT_ALLOW_FILES:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _raw_dotted(node.func) != "print":
+                continue
+            if any(kw.arg == "file" for kw in node.keywords):
+                continue
+            fn = sf.enclosing_function(node)
+            if (sf.rel, fn) in allowlists.PRINT_ALLOW:
+                continue
+            ctx.report(
+                node.lineno,
+                self.id,
+                f"bare print() in library code ('{fn}') — route through "
+                "the report writer / logging / obs spans, or name the "
+                "stream with file= (CLI surfaces go in "
+                "tools/simonlint/allowlists.py PRINT_ALLOW_FILES)",
+            )
+
+
+def _raw_dotted(func: ast.AST) -> str:
+    """Dotted name WITHOUT alias normalization — S113/T201 match the
+    spelled call (`subprocess.run`, `urlopen`, `print`), same contract
+    as the original linter."""
+    parts = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+        return ".".join(reversed(parts))
+    return ""

@@ -1,0 +1,235 @@
+"""JAX002 — recompile hazards: `jax.jit` wrappers that cannot hit a
+warm compile cache.
+
+Each `jax.jit(f)` call returns a NEW wrapper with its own compile
+cache; a wrapper created per call (or per loop iteration) re-traces and
+re-compiles every time, silently turning a warm serving path into a
+cold one. The repo convention (ROADMAP item 4, PRs 4–5) is
+module-level jits — created once per process, instrumented for
+dispatch/recompile accounting (obs/profile.py) — and this rule makes
+the convention machine-checked. The runtime counterpart is the
+`jax_recompiles_total` counter and the CI recompile-regression guard
+(docs/OBSERVABILITY.md); JAX002 catches the same defect before
+anything runs.
+
+Flagged (runtime scope only):
+
+- `jax.jit(...)` / `partial(jax.jit, ...)` created inside a for/while
+  loop — a fresh cache every iteration;
+- `jax.jit(...)(args)` — created and invoked in one expression, a
+  fresh cache every call;
+- `jax.jit(...)` inside a function body whose wrapper is bound to a
+  plain local (or returned directly) — it dies with the frame;
+- `@jax.jit` on a def nested inside another function — re-decorated
+  per enclosing call;
+- a list/dict/set literal passed at a `static_argnums` position —
+  static args are cache keys and must be hashable (TypeError at
+  runtime).
+
+NOT flagged (the audited caching idioms):
+
+- module-level `jax.jit(...)` / `@jax.jit` on a top-level def;
+- assignment to an attribute (`self._jit = jax.jit(...)` — instance
+  cache) or a subscript (`cache[key] = jax.jit(...)`);
+- assignment to a name declared `global` in the enclosing function
+  (the module-singleton lazy-init idiom, scheduler/engine.py);
+- wrapping through other calls on the way to such an assignment
+  (`self._jit = profile.instrument_jit(jax.jit(f), "site")`).
+
+Escapes the analysis cannot follow earn an allowlist entry
+(allowlists.JAX002_ALLOW) with a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import allowlists
+from ..core import FileContext, Rule, register
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_jit_call(sf, node: ast.Call) -> bool:
+    dotted = sf.dotted_call_name(node.func)
+    if dotted == "jax.jit":
+        return True
+    # partial(jax.jit, ...) builds a deferred jit factory
+    if dotted in ("functools.partial", "partial") and node.args:
+        return sf.dotted_call_name(node.args[0]) == "jax.jit"
+    return False
+
+
+def _static_positions(node: ast.Call):
+    """Literal static_argnums positions, when spelled as int/tuple."""
+    for kw in node.keywords:
+        if kw.arg != "static_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, int
+                ):
+                    out.append(elt.value)
+            return out
+    return []
+
+
+@register
+class RecompileHazard(Rule):
+    id = "JAX002"
+    title = "per-call jax.jit wrapper / non-hashable static arg"
+    rationale = (
+        "a jit created per call or per loop iteration re-compiles every "
+        "time; module-level (or cached) jits are the convention the "
+        "warm serve path depends on"
+    )
+
+    def check_file(self, ctx: FileContext) -> None:
+        sf = ctx.sf
+        if not sf.is_runtime_scope:
+            return
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _is_jit_call(sf, node):
+                self._check_jit_site(ctx, node)
+            elif isinstance(node, _FUNC_NODES):
+                self._check_decorated(ctx, node)
+
+    # -- jax.jit(...) expression sites --------------------------------------
+
+    def _check_jit_site(self, ctx: FileContext, node: ast.Call) -> None:
+        sf = ctx.sf
+        parent = sf.parents.get(node)
+        if isinstance(parent, _FUNC_NODES) and node in parent.decorator_list:
+            return  # @partial(jax.jit, ...) — _check_decorated owns it
+        fn = sf.enclosing_function(node)
+        if (sf.rel, fn) in allowlists.JAX002_ALLOW:
+            return
+        self._check_static_args(ctx, node, fn)
+        # in a loop: always a hazard, even at module scope
+        for anc in sf.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                ctx.report(
+                    node.lineno,
+                    self.id,
+                    f"jax.jit created inside a loop in '{fn}' — a fresh "
+                    "compile cache every iteration; hoist it to module "
+                    "level (or a guarded cache) per the module-level-jit "
+                    "convention",
+                )
+                return
+        if sf.enclosing_function_node(node) is None:
+            return  # module level: the convention itself
+        parent = sf.parents.get(node)
+        # immediately invoked: jax.jit(f)(args)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            ctx.report(
+                node.lineno,
+                self.id,
+                f"jax.jit created and invoked in one expression in '{fn}' "
+                "— a fresh compile cache (and a re-trace + re-compile) "
+                "every call; create the jit once at module level or in a "
+                "guarded cache (self._jit / global)",
+            )
+            return
+        sink = self._assignment_sink(sf, node)
+        if sink == "escapes":
+            return
+        verb = "returned directly" if sink == "return" else "bound to a local"
+        ctx.report(
+            node.lineno,
+            self.id,
+            f"jax.jit created inside '{fn}' and {verb} — the wrapper "
+            "(and its compile cache) dies with the call frame; hoist to "
+            "module level, or cache it (self._jit, a global declared in "
+            "the function, or a cache dict)",
+        )
+
+    def _assignment_sink(self, sf, node: ast.Call) -> str:
+        """Where does the fresh wrapper land? "escapes" = stored
+        somewhere that outlives the frame (attribute / subscript /
+        global-declared name), "return" = returned raw, "local" =
+        plain local binding (or unknown)."""
+        for anc in sf.ancestors(node):
+            if isinstance(anc, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    anc.targets
+                    if isinstance(anc, ast.Assign)
+                    else [anc.target]
+                )
+                globals_declared = _global_names(
+                    sf.enclosing_function_node(anc)
+                )
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        return "escapes"
+                    if isinstance(t, ast.Name) and t.id in globals_declared:
+                        return "escapes"
+                return "local"
+            if isinstance(anc, ast.Return):
+                return "return"
+            if isinstance(anc, _FUNC_NODES):
+                return "local"
+        return "local"
+
+    def _check_static_args(
+        self, ctx: FileContext, node: ast.Call, fn: str
+    ) -> None:
+        """Non-hashable literals at static_argnums positions of an
+        immediately-invoked jit: static args are hash keys."""
+        positions = _static_positions(node)
+        if not positions:
+            return
+        parent = ctx.sf.parents.get(node)
+        if not (isinstance(parent, ast.Call) and parent.func is node):
+            return
+        for pos in positions:
+            if pos < len(parent.args) and isinstance(
+                parent.args[pos], (ast.List, ast.Dict, ast.Set)
+            ):
+                ctx.report(
+                    parent.args[pos].lineno,
+                    self.id,
+                    f"non-hashable literal at static_argnums position "
+                    f"{pos} in '{fn}' — static args are compile-cache "
+                    "keys and must be hashable (tuple, not list/dict/set)",
+                )
+
+    # -- @jax.jit decorators ------------------------------------------------
+
+    def _check_decorated(self, ctx: FileContext, node) -> None:
+        sf = ctx.sf
+        if sf.enclosing_function_node(node) is None:
+            return  # top-level @jax.jit def: the convention itself
+        for deco in node.decorator_list:
+            d = deco.func if isinstance(deco, ast.Call) else deco
+            is_jit = sf.dotted_call_name(d) == "jax.jit"
+            if isinstance(deco, ast.Call) and not is_jit:
+                is_jit = _is_jit_call(sf, deco)
+            if not is_jit:
+                continue
+            fn = sf.enclosing_function(node)
+            if (sf.rel, fn) in allowlists.JAX002_ALLOW:
+                continue
+            ctx.report(
+                node.lineno,
+                self.id,
+                f"@jax.jit on '{node.name}', nested inside '{fn}' — "
+                "re-decorated (fresh compile cache) every enclosing "
+                "call; hoist the jitted function to module level or "
+                "cache the wrapper",
+            )
+
+
+def _global_names(func_node) -> set:
+    if func_node is None:
+        return set()
+    out = set()
+    for stmt in ast.walk(func_node):
+        if isinstance(stmt, ast.Global):
+            out.update(stmt.names)
+    return out

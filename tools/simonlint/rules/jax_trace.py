@@ -1,0 +1,263 @@
+"""JAX001 — trace-safety: host side effects reachable inside traced
+code.
+
+A function handed to `jax.jit` / `jax.vmap` / `partial(jax.jit, ...)`
+/ `pl.pallas_call` runs ONCE at trace time; host-side effects inside it
+either burn into the compiled program as constants (wall-clock reads,
+RNG draws — silently wrong on every later dispatch), force a blocking
+device sync (`.item()`, `float()` on a tracer, `np.asarray`), raise a
+TracerError at the worst moment, or mutate host state (`self.x = ...`)
+once instead of per call. The serial oracle and the scan must stay
+bit-identical (tests/test_engine_conformance.py) — a stray
+`np.random` or `time.time` inside the traced graph is exactly the kind
+of divergence no dynamic test reliably catches.
+
+The rule walks the intra-package call graph (tools/simonlint/
+callgraph.py) from every traced root — including nested defs (a
+`lax.scan` step function or pallas kernel body is traced with its
+parent) — and flags:
+
+- `time.*` calls (wall clock burned in at trace time)
+- `random.*` / `np.random.*` (host RNG: one draw at trace time, same
+  "random" number on every dispatch; jax.random is the traced-safe API)
+- `print(...)` (fires once at trace time; use `jax.debug.print`)
+- `.item()` / `float(tracer)` / `np.asarray` / `np.array` (forced
+  host sync, or TracerError under jit)
+- assignment to `self.<attr>` (host mutation happens once, at trace
+  time, not per call)
+
+Reads of host state (`self.features`, closures over numpy constants)
+are trace-time constants by design and stay legal. Guarded host paths
+(e.g. ops/scan.features_of, which bails to a pure value when it sees a
+tracer) carry a usage-checked `# simonlint: disable=JAX001` pragma on
+the def line; anything broader goes in allowlists.JAX001_ALLOW with a
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import allowlists
+from ..callgraph import Resolver, TracedRoot, iter_traced_roots
+from ..core import Finding, Rule, register
+from ..project import ProjectIndex, SourceFile
+
+#: alias-normalized dotted prefixes whose every call is a host effect
+HOST_EFFECT_PREFIXES = ("time.", "random.", "numpy.random.")
+#: exact alias-normalized names
+HOST_EFFECT_CALLS = {
+    "print",
+    "input",
+    "breakpoint",
+    "numpy.asarray",
+    "numpy.array",
+}
+#: traced-safe exceptions under the prefixes (none today; placeholder
+#: so e.g. time.monotonic_ns used for seeding COULD be carved out)
+HOST_EFFECT_SAFE: Set[str] = set()
+
+
+@register
+class TraceSafety(Rule):
+    id = "JAX001"
+    title = "host side effect reachable inside traced code"
+    rationale = (
+        "host effects run once at trace time (stale constants, forced "
+        "syncs, TracerErrors) — the scan/serial conformance contract "
+        "cannot survive them"
+    )
+    scope = "project"
+
+    def check_project(self, project: ProjectIndex) -> Iterable[Finding]:
+        resolver = Resolver(project)
+        findings: List[Finding] = []
+        #: (rel, line, effect) -> already reported (roots overlap)
+        reported: Set[Tuple[str, int, str]] = set()
+        for root in iter_traced_roots(project):
+            walker = _Walker(project, resolver, root, reported)
+            findings.extend(walker.run())
+        return findings
+
+
+class _Walker:
+    """BFS from one traced root through resolvable first-party calls,
+    nested defs included."""
+
+    MAX_DEPTH = 12
+
+    def __init__(
+        self,
+        project: ProjectIndex,
+        resolver: Resolver,
+        root: TracedRoot,
+        reported: Set[Tuple[str, int, str]],
+    ):
+        self.project = project
+        self.resolver = resolver
+        self.root = root
+        self.reported = reported
+        self.findings: List[Finding] = []
+        self.visited: Set[Tuple[str, int]] = set()
+
+    def run(self) -> List[Finding]:
+        self._walk(self.root.sf, self.root.node, [self.root.name], 0)
+        return self.findings
+
+    def _walk(
+        self, sf: SourceFile, fn_node: ast.AST, chain: List[str], depth: int
+    ) -> None:
+        key = (sf.rel, getattr(fn_node, "lineno", 0))
+        if key in self.visited or depth > self.MAX_DEPTH:
+            return
+        self.visited.add(key)
+        fn_name = getattr(fn_node, "name", "<lambda>")
+        if (sf.rel, fn_name) in allowlists.JAX001_ALLOW:
+            return
+        #: local aliases of host-effect callables (`a = np.asarray`)
+        local_alias: Dict[str, str] = {}
+        body = (
+            fn_node.body
+            if isinstance(fn_node.body, list)
+            else [fn_node.body]  # Lambda
+        )
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign):
+                    self._check_self_mutation(sf, node, chain)
+                    self._note_alias(sf, node, local_alias)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    self._check_self_mutation(sf, node, chain)
+                elif isinstance(node, ast.Call):
+                    self._check_call(sf, node, chain, local_alias, depth)
+
+    # -- effects ------------------------------------------------------------
+
+    def _note_alias(
+        self, sf: SourceFile, node: ast.Assign, local_alias: Dict[str, str]
+    ) -> None:
+        dotted = sf.dotted_call_name(node.value)
+        if self._effect_name(dotted) is None:
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                local_alias[t.id] = dotted
+
+    @staticmethod
+    def _effect_name(dotted: str) -> Optional[str]:
+        if not dotted or dotted in HOST_EFFECT_SAFE:
+            return None
+        if dotted in HOST_EFFECT_CALLS:
+            return dotted
+        for prefix in HOST_EFFECT_PREFIXES:
+            if dotted.startswith(prefix):
+                return dotted
+        return None
+
+    def _check_self_mutation(self, sf: SourceFile, node, chain) -> None:
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                self._report(
+                    sf,
+                    t.lineno,
+                    f"self.{t.attr}",
+                    chain,
+                    f"mutation of self.{t.attr} inside traced code — "
+                    "happens once at trace time, not per dispatch",
+                )
+
+    def _check_call(
+        self,
+        sf: SourceFile,
+        node: ast.Call,
+        chain: List[str],
+        local_alias: Dict[str, str],
+        depth: int,
+    ) -> None:
+        dotted = sf.dotted_call_name(node.func)
+        # `a = np.asarray; a(x)` — flag through the local alias
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in local_alias
+        ):
+            dotted = local_alias[node.func.id]
+        effect = self._effect_name(dotted)
+        if effect is not None:
+            self._report(
+                sf,
+                node.lineno,
+                effect,
+                chain,
+                f"host call `{effect}` inside traced code — runs once "
+                "at trace time (stale constant / forced sync); use the "
+                "jax.* equivalent or move it outside the traced region",
+            )
+            return
+        # .item() on anything; float(tracer-ish)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._report(
+                sf,
+                node.lineno,
+                ".item()",
+                chain,
+                "`.item()` inside traced code — forces a device sync "
+                "(or TracerError under jit)",
+            )
+            return
+        if (
+            dotted == "float"
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            self._report(
+                sf,
+                node.lineno,
+                "float()",
+                chain,
+                "`float()` on a traced value — forces a device sync "
+                "(or TracerError under jit); keep it a jnp scalar",
+            )
+            return
+        # descend into resolvable first-party callees
+        hit = self.resolver.resolve_call(sf, node)
+        if hit is None:
+            return
+        callee_sf, callee = hit
+        if not callee_sf.is_runtime_scope:
+            return
+        self._walk(
+            callee_sf,
+            callee,
+            chain + [getattr(callee, "name", "<lambda>")],
+            depth + 1,
+        )
+
+    def _report(
+        self, sf: SourceFile, line: int, effect: str, chain: List[str], msg
+    ) -> None:
+        key = (sf.rel, line, effect)
+        if key in self.reported:
+            return
+        self.reported.add(key)
+        root = self.root
+        path = " -> ".join(chain[-4:])
+        self.findings.append(
+            Finding(
+                sf.path,
+                sf.rel,
+                line,
+                "JAX001",
+                f"{msg} [traced from {root.via}({root.name}) at "
+                f"{root.site_sf.rel}:{root.site_line}"
+                + (f"; path {path}" if len(chain) > 1 else "")
+                + "]",
+            )
+        )

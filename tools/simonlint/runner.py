@@ -1,0 +1,125 @@
+"""Lint driver: build the project index once, run every registered
+rule, apply pragmas, render text/JSON.
+
+`lint_paths` is the API surface the tests drive (they point it at tmp
+fixture trees with `root=` overriding the repo root so the runtime-
+scope policy applies to fixtures); `lint_repo` is what
+`python -m tools.simonlint` and `make lint` run.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from .core import FileContext, Finding, all_rules
+from .pragmas import apply_suppressions
+from .project import ProjectIndex, repo_root
+
+#: what `make lint` covers — the same roots the old monolith walked
+DEFAULT_ROOTS = (
+    "open_simulator_tpu",
+    "tools",
+    "tests",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+
+def _expand(paths: Sequence, root: Path) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            out.append(p)
+        else:
+            # a typo'd path must fail with a diagnostic, not a raw
+            # read_text traceback whose exit code 1 looks like
+            # "findings found" to scripts checking the gate
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    return out
+
+
+def lint_paths(
+    paths: Sequence,
+    root: Optional[Path] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint an explicit set of files/directories. `root` anchors
+    repo-relative names and the runtime-scope policy (defaults to the
+    real repo root). `rules` optionally restricts to a subset of rule
+    ids. Returns post-suppression findings, sorted."""
+    root = Path(root) if root is not None else repo_root()
+    project = ProjectIndex(_expand(paths, root), root)
+    findings: List[Finding] = []
+    active = [
+        r for r in all_rules() if rules is None or r.id in set(rules)
+    ]
+    for sf in project.files:
+        if sf.syntax_error is not None:
+            e = sf.syntax_error
+            findings.append(
+                Finding(
+                    sf.path,
+                    sf.rel,
+                    e.lineno or 0,
+                    "E999",
+                    f"syntax error: {e.msg}",
+                )
+            )
+    file_rules = [r for r in active if r.scope == "file"]
+    project_rules = [r for r in active if r.scope == "project"]
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        ctx = FileContext(sf, project)
+        for rule in file_rules:
+            rule.check_file(ctx)
+        findings.extend(ctx.findings)
+    for rule in project_rules:
+        findings.extend(rule.check_project(project))
+    findings = apply_suppressions(
+        findings,
+        project.files,
+        active_rules=None if rules is None else {r.id for r in active},
+    )
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    return findings
+
+
+def lint_repo(rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """The `make lint` entry: DEFAULT_ROOTS under the real repo root."""
+    return lint_paths(DEFAULT_ROOTS, rules=rules)
+
+
+def lint_file(path) -> List[tuple]:
+    """Single-file compatibility shim with the old tools/lint.py
+    signature: [(path, line, code, message)] tuples. Project-wide
+    rules see only this one file."""
+    findings = lint_paths([Path(path)])
+    return [(f.path, f.line, f.rule, f.message) for f in findings]
+
+
+# ------------------------------------------------------------- rendering
+
+
+def render_text(findings: List[Finding]) -> str:
+    lines = [f.render() for f in findings]
+    lines.append(
+        f"{len(findings)} finding(s)" if findings else "lint: clean"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding]) -> str:
+    doc = {
+        "version": 1,
+        "count": len(findings),
+        "findings": [f.as_dict() for f in findings],
+    }
+    return json.dumps(doc, indent=2)

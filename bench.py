@@ -46,6 +46,10 @@ Scenarios (SIMON_BENCH env):
 - `serve-qps`: the `simon serve` daemon under a concurrent client
   storm — qps, p50/p95 latency, mean coalesced batch fill, and device
   dispatches per request (<1 proves the micro-batching; r6).
+- `shadow-replay`: the shadow divergence auditor replaying a recorded
+  decision log of simon's own placements on the warm single-pod scan
+  probe — steps/s, agreement rate (gated at 1.0), dispatches per step,
+  zero warm jit-cache misses asserted (r7).
 - `all`: capacity headline with the others embedded in the metric
   string (one scenario per BASELINE.json config).
 
@@ -498,6 +502,75 @@ def run_serve_qps(n_clients=8, per_client=6, n_nodes=200) -> dict:
         # a failed storm must not leak the daemon (port, dispatcher
         # thread) into the rest of a SIMON_BENCH=all run
         daemon.shutdown()
+
+
+def run_shadow_replay(n_nodes=200, n_pods=400) -> dict:
+    """SIMON_BENCH=shadow-replay: the shadow divergence auditor
+    (docs/OBSERVABILITY.md) replaying a recorded decision log of
+    simon's own placements on the warm tpu probe — one single-pod
+    masked scan per decision against the incrementally mirrored
+    cluster. Measures replay steps/s, the agreement rate (must be 1.0:
+    the log IS simon's decisions), and dispatches per step; the
+    warm-path contract (zero jit-cache misses after the first step of
+    each shape) is asserted, not assumed."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.obs import profile as obs_profile
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.shadow.record import record_simulation
+    from open_simulator_tpu.shadow.replay import ShadowReplayer
+
+    nodes = [
+        _make_node(f"shadow-n-{i:04d}", 32, 128, {"zone": f"z{i % 8}"})
+        for i in range(n_nodes)
+    ]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    res = ResourceTypes()
+    res.pods = [
+        {
+            "kind": "Pod",
+            "metadata": {"name": f"shadow-p-{i:05d}", "namespace": "bench"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "img-shadow",
+                        "resources": {
+                            "requests": {"cpu": "500m", "memory": "1Gi"}
+                        },
+                    }
+                ]
+            },
+        }
+        for i in range(n_pods)
+    ]
+    steps = record_simulation(cluster, [AppResource("shadow-app", res)])
+    decisions = sum(1 for s in steps if s.kind == "decision")
+
+    def once():
+        replayer = ShadowReplayer(cluster, engine="tpu")
+        report = replayer.run(steps)
+        assert report.decisions == decisions
+        assert report.agreement_rate == 1.0
+        assert report.warm_recompiles == 0
+        return report
+
+    once()  # warm: compile the single-pod probe shape
+    obs0 = obs_profile.snapshot()
+    elapsed, spread, _report = _timed(once)
+    prof = obs_profile.delta(obs0)
+    return {
+        "nodes": n_nodes,
+        "decisions": decisions,
+        "steps": len(steps),
+        "steps_per_sec": round(decisions / elapsed, 1),
+        "elapsed_s": round(elapsed, 3),
+        "agreement_rate": 1.0,
+        "dispatches_per_step": round(
+            prof["jax_dispatches_total"] / (decisions * spread["runs"]), 3
+        ),
+        "spread": spread,
+    }
 
 
 def run_sample() -> dict:
@@ -1491,6 +1564,21 @@ def main():
             "unit": "pods/s",
             "vs_baseline": round(r["pods_per_sec"] / NORTH_STAR_PODS_PER_SEC, 3),
         }
+    elif scenario == "shadow-replay":
+        sh = run_shadow_replay()
+        out = {
+            "metric": f"shadow replay steps/s, {sh['decisions']} recorded "
+            f"decisions x {sh['nodes']} nodes on the warm tpu probe "
+            f"(agreement {sh['agreement_rate']:.2f}, "
+            f"{sh['dispatches_per_step']} dispatches/step, zero warm "
+            f"recompiles; median of {sh['spread']['runs']})",
+            "value": sh["steps_per_sec"],
+            "unit": "steps/s",
+            "vs_baseline": None,
+            "steps_per_sec": sh["steps_per_sec"],
+            "agreement_rate": sh["agreement_rate"],
+            "dispatches_per_step": sh["dispatches_per_step"],
+        }
     elif scenario == "serve-qps":
         s = run_serve_qps()
         out = {
@@ -1563,6 +1651,7 @@ def main():
         ts = isolated(run_tier_stress)
         sm = isolated(run_sample)
         sq = isolated(run_serve_qps)
+        sh = isolated(run_shadow_replay)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -1596,7 +1685,11 @@ def main():
             f"serve-qps {sq['qps']:.1f} req/s over {sq['clients']} clients "
             f"(p50 {sq['p50_ms']}ms p95 {sq['p95_ms']}ms, batch fill "
             f"{sq['batch_fill_mean']}, {sq['dispatches_per_request']} "
-            f"dispatches/request); "
+            f"dispatches/request), "
+            f"shadow-replay {sh['steps_per_sec']:.0f} steps/s over "
+            f"{sh['decisions']} recorded decisions (agreement "
+            f"{sh['agreement_rate']:.2f}, {sh['dispatches_per_step']} "
+            f"dispatches/step); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"
@@ -1617,6 +1710,17 @@ def main():
         "transfer_h2d_bytes": prof["device_transfer_h2d_bytes_total"],
         "top_spans_exclusive_ms": obs_spans.top_spans(recorded, 5),
     }
+    # shadow auditor counters ride the same registry (shadow/replay.py);
+    # present whenever the run replayed decisions
+    from open_simulator_tpu.utils.trace import COUNTERS
+
+    if COUNTERS.get("shadow_steps_total"):
+        out["obs"]["shadow"] = {
+            "steps": COUNTERS.get("shadow_steps_total"),
+            "agree": COUNTERS.get("shadow_agree_total"),
+            "divergences": COUNTERS.get("shadow_divergence_total"),
+            "warm_recompiles": COUNTERS.get("shadow_warm_recompiles_total"),
+        }
     print(json.dumps(out))
 
 

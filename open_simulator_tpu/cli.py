@@ -660,6 +660,186 @@ def cmd_serve(args) -> int:
     return code
 
 
+@_with_obs("shadow")
+def cmd_shadow(args) -> int:
+    """Shadow-scheduler divergence auditor (shadow/;
+    docs/OBSERVABILITY.md): record simon's own decisions as a log,
+    replay a recorded log of real scheduler decisions against the
+    config's cluster, or tail a live cluster — and explain every
+    disagreement. Exit 0 on full agreement, 1 when divergences were
+    found, 2 on input errors, 3/4 on deadline/interrupt partials."""
+    import json
+
+    from .apply.applier import Applier, SimonConfig
+    from .models.validation import InputError
+    from .runtime import (
+        Budget,
+        ExecutionHalted,
+        ExternalIOError,
+        Interrupted,
+        sigint_to_budget,
+    )
+    from .shadow.log import DecisionLogWriter, cluster_fingerprint, read_decision_log
+    from .shadow.record import record_simulation
+    from .shadow.replay import ShadowReplayer
+
+    _force_platform()
+    try:
+        modes = sum(bool(m) for m in (args.record, args.decision_log, args.tail))
+        if modes != 1:
+            raise InputError(
+                "pick exactly one mode: --record PATH (write simon's own "
+                "decisions), --decision-log PATH (replay a recorded log), "
+                "or --tail (poll the config's live cluster)"
+            )
+        config = SimonConfig.from_file(args.simon_config)
+        applier = Applier(config)
+        budget = Budget(args.deadline)
+        if args.tail and not config.kube_config:
+            raise InputError(
+                "--tail needs a kubeConfig cluster in the simon config "
+                "(customConfig clusters have no scheduler to shadow)"
+            )
+    except (OSError, ValueError, InputError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        with sigint_to_budget(budget):
+            if args.record:
+                cluster = applier.load_cluster()
+                apps = applier.load_apps()
+                steps = []
+                try:
+                    record_simulation(
+                        cluster, apps, budget=budget, steps_out=steps
+                    )
+                except ExecutionHalted as e:
+                    # a deadline/SIGINT still writes the completed
+                    # prefix — a valid, replayable log — and reports it
+                    if steps:
+                        with DecisionLogWriter(
+                            args.record, cluster_fingerprint(cluster)
+                        ) as w:
+                            for s in steps:
+                                w.append(s)
+                    e.partial = {
+                        "recordedSteps": len(steps),
+                        "decisionLog": args.record if steps else None,
+                    }
+                    raise
+                decisions = sum(1 for s in steps if s.kind == "decision")
+                scheduled = sum(
+                    1 for s in steps if s.kind == "decision" and s.node
+                )
+                with DecisionLogWriter(
+                    args.record, cluster_fingerprint(cluster)
+                ) as w:
+                    for s in steps:
+                        w.append(s)
+                print(
+                    f"recorded {decisions} decision(s) ({scheduled} "
+                    f"scheduled, {decisions - scheduled} failed) across "
+                    f"{len(steps)} step(s) to {args.record}"
+                )
+                return 0
+            if args.decision_log:
+                cluster = applier.load_cluster()
+                fp = cluster_fingerprint(cluster)
+                steps, meta = read_decision_log(
+                    args.decision_log,
+                    fingerprint=None
+                    if args.allow_fingerprint_mismatch
+                    else fp,
+                )
+                replayer = ShadowReplayer(cluster, engine=args.engine)
+                replayer.report.dropped_records = meta.get("dropped", 0)
+                try:
+                    report = replayer.run(steps, budget=budget)
+                except ExecutionHalted as e:
+                    # the audit so far IS the partial result
+                    e.partial = {"shadow": replayer.finish().as_dict()}
+                    raise
+            else:  # --tail
+                report = _shadow_tail(args, config, budget)
+    except ExecutionHalted as e:
+        return _emit_partial(e, args, "")
+    except KeyboardInterrupt:
+        return _emit_partial(
+            Interrupted("interrupted before any safe boundary"), args, ""
+        )
+    except (OSError, InputError, ExternalIOError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        payload = report.as_dict()
+        explain = _explanations_payload(args)
+        if explain is not None:
+            payload["explain"] = explain
+        print(json.dumps(payload, sort_keys=True))
+    else:
+        print(report.render_text())
+        _print_explanations(args)
+    return 0 if report.divergence_count == 0 else 1
+
+
+def _shadow_tail(args, config, budget):
+    """Live shadow loop: bootstrap the mirror from the first LIST, then
+    poll-diff-replay until --max-polls / --max-steps / deadline."""
+    import time
+
+    from .models.decode import ResourceTypes
+    from .models.kubeclient import KubeClient
+    from .runtime import ExecutionHalted
+    from .shadow.ingest import ClusterTailer
+    from .shadow.log import DecisionLogWriter, cluster_fingerprint
+    from .shadow.replay import ShadowReplayer
+
+    with KubeClient(config.kube_config) as client:
+        tailer = ClusterTailer(client)
+        nodes, boot_steps = tailer.bootstrap()
+        cluster = ResourceTypes()
+        cluster.nodes = nodes
+        replayer = ShadowReplayer(cluster, engine=args.engine)
+        writer = None
+        if args.tail_record:
+            writer = DecisionLogWriter(
+                args.tail_record, cluster_fingerprint(cluster)
+            )
+        try:
+            for st in boot_steps:
+                if writer is not None:
+                    writer.append(st)
+                replayer.step(st)
+            polls = 0
+            while True:
+                if budget is not None:
+                    budget.check(f"shadow tail (poll {polls})")
+                if args.max_polls is not None and polls >= args.max_polls:
+                    break
+                if (
+                    args.max_steps is not None
+                    and replayer.report.decisions >= args.max_steps
+                ):
+                    break
+                if polls:
+                    time.sleep(args.poll_interval)
+                for st in tailer.poll():
+                    if writer is not None:
+                        writer.append(st)
+                    replayer.step(st)
+                polls += 1
+        except ExecutionHalted as e:
+            # everything audited before the halt is the partial result
+            # (the --tail-record log already holds the observed steps)
+            e.partial = {"shadow": replayer.finish().as_dict()}
+            raise
+        finally:
+            if writer is not None:
+                writer.close()
+    return replayer.finish()
+
+
 def cmd_version(_args) -> int:
     print(f"simon-tpu version {__version__}")
     return 0
@@ -1011,6 +1191,110 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_obs_flags(p_serve)
     p_serve.set_defaults(func=cmd_serve)
+
+    p_shadow = sub.add_parser(
+        "shadow",
+        help="shadow-scheduler divergence auditor (replay/tail real decisions)",
+        description="Audit simon against a real scheduler's decisions: "
+        "replay each recorded (or live-tailed) scheduling decision "
+        "through the warm oracle/scan against the same evolving cluster "
+        "state, classify every step as agree / node-divergence / "
+        "feasibility-divergence / ordering-divergence, and attach "
+        "per-node filter verdicts and weighted score vectors to every "
+        "disagreement (docs/OBSERVABILITY.md). --record writes a log of "
+        "simon's OWN serial decisions (the self-conformance fixture and "
+        "trace generator); --decision-log replays a recorded log against "
+        "the config's cluster; --tail polls the config's live kubeConfig "
+        "cluster. Replay commits the REAL decision after each probe, so "
+        "the mirror tracks reality; same-shaped steps re-dispatch warm "
+        "compiled scans (zero jit-cache misses after the first step of "
+        "each shape — measured in the report). Exit 0 on full agreement, "
+        "1 when divergences were found.",
+    )
+    p_shadow.add_argument(
+        "-f", "--simon-config", required=True, help="simon config file path"
+    )
+    p_shadow.add_argument(
+        "--record",
+        default="",
+        metavar="PATH",
+        help="record simon's own serial decisions for the config's "
+        "cluster+apps as a fingerprinted decision log (fsync'd JSONL)",
+    )
+    p_shadow.add_argument(
+        "--decision-log",
+        default="",
+        metavar="PATH",
+        help="replay this decision log against the config's cluster and "
+        "report the divergence taxonomy (fingerprint mismatch refuses "
+        "loudly)",
+    )
+    p_shadow.add_argument(
+        "--tail",
+        action="store_true",
+        help="poll the config's live kubeConfig cluster and audit its "
+        "scheduler's decisions as they appear",
+    )
+    p_shadow.add_argument(
+        "--tail-record",
+        default="",
+        metavar="PATH",
+        help="with --tail: also write every observed step to this "
+        "decision log (doubles as an arrival trace; its fingerprint is "
+        "the live nodes at bootstrap, and live clusters drift, so "
+        "replaying it later usually needs --allow-fingerprint-mismatch)",
+    )
+    p_shadow.add_argument(
+        "--allow-fingerprint-mismatch",
+        action="store_true",
+        help="replay a decision log whose cluster fingerprint does not "
+        "match the config's cluster (needed for --tail-record logs of "
+        "drifting live clusters; divergences may then reflect cluster "
+        "drift, not scheduler disagreement)",
+    )
+    p_shadow.add_argument(
+        "--engine",
+        choices=["tpu", "oracle"],
+        default="tpu",
+        help="probe engine: tpu = one warm single-pod masked scan per "
+        "step, oracle = the serial filter+score walk",
+    )
+    p_shadow.add_argument(
+        "--poll-interval",
+        type=float,
+        default=10.0,
+        metavar="SECONDS",
+        help="--tail polling interval",
+    )
+    p_shadow.add_argument(
+        "--max-polls",
+        type=int,
+        default=None,
+        metavar="N",
+        help="--tail: stop after N poll rounds (default: until deadline "
+        "or SIGINT)",
+    )
+    p_shadow.add_argument(
+        "--max-steps",
+        type=int,
+        default=None,
+        metavar="N",
+        help="--tail: stop once N decisions have been audited",
+    )
+    p_shadow.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="wall-clock budget: on expiry (or SIGINT) the audit stops "
+        "at the next step boundary and reports what it has (exit 3/4)",
+    )
+    _add_obs_flags(p_shadow)
+    p_shadow.add_argument(
+        "--format", choices=["table", "json"], default="table",
+        help="report output format",
+    )
+    p_shadow.set_defaults(func=cmd_shadow)
 
     p_version = sub.add_parser("version", help="print version")
     p_version.set_defaults(func=cmd_version)

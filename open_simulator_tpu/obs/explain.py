@@ -103,6 +103,17 @@ class PodExplanation:
             out["scores"] = [{"node": n, "score": s} for n, s in self.scores]
         if self.provenance:
             out["provenance"] = dict(self.provenance)
+            # preemption-victim provenance as a first-class structured
+            # block: a pod scheduled after an escape round names the
+            # node it preempted on and its namespace-qualified victims,
+            # so downstream consumers (the shadow auditor's
+            # ordering-divergence class) can cite them without parsing
+            # the free-form provenance map
+            if "preempted" in self.provenance or "preemption_node" in self.provenance:
+                out["preemption"] = {
+                    "node": self.provenance.get("preemption_node"),
+                    "victims": list(self.provenance.get("preempted") or []),
+                }
         return out
 
 

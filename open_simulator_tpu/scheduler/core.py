@@ -136,6 +136,12 @@ class Simulator:
         self._engine = None  # TpuEngine, created once per cluster
         self._batch_map = None  # (batch indices, orig->pos) of the last batch
         self._events: List[PreemptionEvent] = []  # preemptions this batch
+        # optional serial-loop observer (shadow/record.py): an object
+        # with `prebound(pod_snapshot)` and `decision(pod_snapshot,
+        # node_or_None, reason, evictions)` called per serial cycle.
+        # Setting it forces nothing by itself — callers who need every
+        # pod to take the serial path must also pick engine="oracle"
+        self.decision_hook = None
 
     # RunCluster (simulator.go:159-164)
     def run_cluster(self, cluster: ResourceTypes, build_status: bool = True) -> SimulateResult:
@@ -494,12 +500,14 @@ class Simulator:
         """Returns (failed, deferred_victims). With defer_victims,
         preemption victims are returned instead of re-enqueued — the
         hybrid path re-enqueues them after its scan segment."""
+        import copy
         from collections import deque
 
         failed: List[UnscheduledPod] = []
         deferred: List[dict] = []
         queue = deque(pods)
         scheduled = 0
+        hook = self.decision_hook
         while queue:
             if self.budget is not None and scheduled % 128 == 0:
                 self.budget.check(
@@ -508,9 +516,14 @@ class Simulator:
             scheduled += 1
             pod = queue.popleft()
             if (pod.get("spec") or {}).get("nodeName"):
+                # the hook sees the PRE-commit dict (binding mutates it)
+                snap = copy.deepcopy(pod) if hook is not None else None
                 self.oracle.place_existing_pod(pod)
                 self.cluster_pods.append(pod)
+                if hook is not None:
+                    hook.prebound(snap)
                 continue
+            snap = copy.deepcopy(pod) if hook is not None else None
             node_name, reason = self.oracle.schedule_pod(pod)
             if node_name is None:
                 failed.append(UnscheduledPod(pod=pod, reason=reason))
@@ -522,6 +535,7 @@ class Simulator:
             # in MoreImportantPod order. Termination: a victim's
             # priority is strictly below its preemptor's, so eviction
             # chains strictly descend.
+            evictions = []
             for ev in self.oracle.drain_preempted():
                 self._events.append(
                     PreemptionEvent(
@@ -532,7 +546,10 @@ class Simulator:
                     if p is ev.pod:
                         self.cluster_pods.pop(i)
                         break
+                evictions.append(ev)
                 (deferred if defer_victims else queue).append(ev.pod)
+            if hook is not None:
+                hook.decision(snap, node_name, reason, evictions)
         return failed, deferred
 
     def _schedule_pods_tpu(self, pods: List[dict], groups=None) -> List[UnscheduledPod]:

@@ -671,11 +671,18 @@ class Oracle:
                 PreemptedPod(pod=victim, node_name=ns.name, preemptor=preemptor)
             )
         if EXPLAIN.enabled and EXPLAIN.should_record(pod):
+            # namespace-qualified victims: the JSON payload's structured
+            # `preemption` block (explain.as_dict) is citable by the
+            # shadow auditor's ordering-divergence class
             EXPLAIN.annotate(
                 pod,
                 preemption_node=ns.name,
                 preempted=[
-                    (v.get("metadata") or {}).get("name", "")
+                    "%s/%s"
+                    % (
+                        (v.get("metadata") or {}).get("namespace") or "default",
+                        (v.get("metadata") or {}).get("name", ""),
+                    )
                     for v in result.victims
                 ],
             )

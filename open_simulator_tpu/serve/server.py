@@ -214,6 +214,27 @@ def render_metrics(coalescer: Coalescer) -> bytes:
         "Bytes shipped to the device (scenario masks and friends).",
         counts.get("device_transfer_h2d_bytes_total", 0),
     )
+    # shadow divergence auditor (shadow/replay.py): zero until a shadow
+    # replay runs in this process, but always exported so dashboards
+    # can rely on the series existing
+    for key, help_text in (
+        ("shadow_steps_total", "Shadow replay steps applied (decisions + deltas)."),
+        ("shadow_decisions_total", "Real scheduler decisions replayed."),
+        ("shadow_agree_total", "Replayed decisions simon agreed with."),
+        ("shadow_divergence_total", "Replayed decisions simon diverged on."),
+        ("shadow_divergence_node_total", "Node-divergences (same pod, different node)."),
+        ("shadow_divergence_feasibility_total", "Feasibility-divergences (one side unschedulable)."),
+        ("shadow_divergence_ordering_total", "Ordering-divergences (preemption/arrival-order evidence)."),
+        ("shadow_warm_recompiles_total", "Jit-cache misses on an already-seen replay shape."),
+        ("shadow_reloads_total", "Replay state reloads forced by node removal."),
+        ("shadow_delta_skips_total", "Cluster-delta ops skipped (stale live-tail races)."),
+    ):
+        metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
+    metric(
+        "simon_shadow_agreement_rate", "gauge",
+        "Agreement rate of the most recent shadow replay (1.0 = full).",
+        snap["gauges"].get("shadow_agreement_rate", 1.0),
+    )
     lines.append("")
     return "\n".join(lines).encode()
 

@@ -1,0 +1,197 @@
+"""Live-cluster decision ingestor — watch-style polling over the
+LIST-only KubeClient.
+
+The reference's fake-apiserver layer imports a snapshot once
+(models/kubeclient.py); shadow mode needs the OTHER half: a stream of
+the decisions the production scheduler keeps making. A LIST-only
+client cannot watch, so the tailer polls: each ``poll()`` re-lists
+pods (and nodes) with the chunked, resourceVersion-anchored pager and
+diffs against the previous poll's state, normalizing every observed
+change into decision-log steps (shadow/log.py):
+
+- a pod newly carrying ``spec.nodeName`` -> one ``decision`` step (the
+  pod is recorded UNBOUND — nodeName/status stripped — with the
+  observed node as the real scheduler's choice);
+- a pod newly marked unschedulable (``PodScheduled`` condition False,
+  reason ``Unschedulable``) -> a failure ``decision`` carrying the
+  condition's message (emitted once per pod until its state changes);
+- a bound pod that disappeared -> an ``evict_pod`` delta;
+- node add/remove -> ``add_node`` / ``remove_node`` deltas.
+
+``bootstrap()`` turns the first LIST into the starting state: the node
+list plus one ``place_pod`` delta step for every already-bound pod, so
+the replayer's mirror begins from the cluster as found. Each pod LIST's
+apiserver resourceVersion is recorded (``last_rv``) for diagnostics and
+snapshot ordering; WITHIN a list, an expired continue token re-pages
+anchored at that version (kubeclient.list_with_rv) instead of forcing
+one giant GET. Polling cost is one paged LIST per interval, which the
+PR-2 retry/breaker machinery already hardens.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Tuple
+
+from .log import Step
+
+PODS_PATH = "/api/v1/pods"
+NODES_PATH = "/api/v1/nodes"
+
+
+def _pod_key(pod: dict) -> Tuple[str, str]:
+    meta = pod.get("metadata") or {}
+    return (meta.get("namespace") or "default", meta.get("name", ""))
+
+
+def _bound_node(pod: dict) -> Optional[str]:
+    return (pod.get("spec") or {}).get("nodeName") or None
+
+
+def _unschedulable_message(pod: dict) -> Optional[str]:
+    for cond in ((pod.get("status") or {}).get("conditions")) or []:
+        if (
+            cond.get("type") == "PodScheduled"
+            and cond.get("status") == "False"
+            and cond.get("reason") == "Unschedulable"
+        ):
+            return cond.get("message") or "Unschedulable"
+    return None
+
+
+def _strip_binding(pod: dict) -> dict:
+    """The decision records the pod as the scheduler SAW it: unbound,
+    no status phase/conditions (the replayer probes this form)."""
+    q = copy.deepcopy(pod)
+    (q.get("spec") or {}).pop("nodeName", None)
+    q.pop("status", None)
+    return q
+
+
+class ClusterTailer:
+    """Diff-based decision stream over one KubeClient."""
+
+    def __init__(self, client):
+        self.client = client
+        self._seq = 0
+        # (namespace, name) -> bound node (None = seen but unbound)
+        self._pods: Dict[Tuple[str, str], Optional[str]] = {}
+        self._failed: set = set()  # pods whose failure was already emitted
+        self._nodes: Dict[str, dict] = {}
+        # resourceVersion of the latest pod LIST (snapshot ordering)
+        self.last_rv: Optional[str] = None
+
+    def _next(self) -> int:
+        s = self._seq
+        self._seq += 1
+        return s
+
+    def bootstrap(self) -> Tuple[List[dict], List[Step]]:
+        """First LIST: returns (nodes, steps) where steps place every
+        already-bound pod onto the mirror."""
+        nodes = self.client.list(NODES_PATH)
+        pods, self.last_rv = self.client.list_with_rv(PODS_PATH)
+        self._nodes = {
+            (n.get("metadata") or {}).get("name", ""): n for n in nodes
+        }
+        steps: List[Step] = []
+        ops = []
+        for pod in pods:
+            key = _pod_key(pod)
+            node = _bound_node(pod)
+            self._pods[key] = node
+            if node and node in self._nodes:
+                ops.append({"op": "place_pod", "pod": copy.deepcopy(pod)})
+        if ops:
+            steps.append(Step(seq=self._next(), kind="delta", deltas=ops))
+        return nodes, steps
+
+    def poll(self) -> List[Step]:
+        """One diff round: LIST pods + nodes, emit steps for every
+        observed change since the previous round."""
+        steps: List[Step] = []
+        nodes = self.client.list(NODES_PATH)
+        seen_nodes = {
+            (n.get("metadata") or {}).get("name", ""): n for n in nodes
+        }
+        for name, node in seen_nodes.items():
+            if name not in self._nodes:
+                steps.append(
+                    Step(
+                        seq=self._next(),
+                        kind="delta",
+                        deltas=[{"op": "add_node", "node": copy.deepcopy(node)}],
+                    )
+                )
+        removed_nodes = [n for n in self._nodes if n not in seen_nodes]
+        pods, pods_rv = self.client.list_with_rv(PODS_PATH)
+        self.last_rv = pods_rv
+        seen: Dict[Tuple[str, str], Optional[str]] = {}
+        for pod in pods:
+            key = _pod_key(pod)
+            node = _bound_node(pod)
+            prev = self._pods.get(key, "absent")
+            if node and prev in ("absent", None):
+                if node not in seen_nodes:
+                    # bound to a node this round's node LIST has not
+                    # shown yet (the pod LIST races node creation):
+                    # leave the pod OUT of `seen` so the next poll —
+                    # after the add_node delta has landed — emits the
+                    # decision instead of dropping it forever
+                    continue
+                seen[key] = node
+                steps.append(
+                    Step(
+                        seq=self._next(),
+                        kind="decision",
+                        pod=_strip_binding(pod),
+                        node=node,
+                    )
+                )
+                self._failed.discard(key)
+                continue
+            seen[key] = node
+            if node is None:
+                msg = _unschedulable_message(pod)
+                if msg is not None and key not in self._failed:
+                    steps.append(
+                        Step(
+                            seq=self._next(),
+                            kind="decision",
+                            pod=_strip_binding(pod),
+                            node=None,
+                            reason=msg,
+                        )
+                    )
+                    self._failed.add(key)
+        # disappeared pods: evict from the mirror (skip pods whose node
+        # also vanished — the remove_node reload drops them wholesale).
+        # Failure dedup state always clears, so a recreated same-name
+        # pod that is unschedulable again gets a fresh decision
+        evict_ops = []
+        for key, node in self._pods.items():
+            if key in seen:
+                continue
+            self._failed.discard(key)
+            if node and node in seen_nodes:
+                evict_ops.append(
+                    {
+                        "op": "evict_pod",
+                        "namespace": key[0],
+                        "name": key[1],
+                        "node": node,
+                    }
+                )
+        if evict_ops:
+            steps.append(Step(seq=self._next(), kind="delta", deltas=evict_ops))
+        for name in removed_nodes:
+            steps.append(
+                Step(
+                    seq=self._next(),
+                    kind="delta",
+                    deltas=[{"op": "remove_node", "name": name}],
+                )
+            )
+        self._pods = seen
+        self._nodes = seen_nodes
+        return steps

@@ -33,10 +33,13 @@ def _pod(name, phase="Running", owner_kind=None, deleting=False):
 class _FakeApiServer:
     """Serves the seven LIST endpoints; records auth headers."""
 
-    def __init__(self, pdb_version="v1beta1", expire_continue=False):
+    def __init__(self, pdb_version="v1beta1", expire_continue=False,
+                 expire_continue_once=False):
         self.seen_auth = []
         self.seen_queries = []
         self.expire_continue = expire_continue
+        self.expire_continue_once = expire_continue_once
+        self._expired = set()
         outer = self
 
         nodes = [make_fake_node("live-0", cpu="8", memory="16Gi")]
@@ -82,13 +85,18 @@ class _FakeApiServer:
                 kind, api_version, items = route
                 # chunked LIST: honor limit/continue like the apiserver
                 limit = int(query.get("limit", ["0"])[0] or 0)
-                if outer.expire_continue and "continue" in query:
+                expire = outer.expire_continue or (
+                    outer.expire_continue_once
+                    and split.path not in outer._expired
+                )
+                if expire and "continue" in query:
+                    outer._expired.add(split.path)
                     self.send_response(410)  # expired continue token
                     self.end_headers()
                     self.wfile.write(b"{}")
                     return
                 start = int(query.get("continue", ["0"])[0] or 0)
-                meta = {}
+                meta = {"resourceVersion": "42"}
                 page = items
                 if limit:
                     page = items[start : start + limit]
@@ -380,6 +388,40 @@ def test_auth_provider_access_token_and_cmd(tmp_path):
     path = tmp_path / "kc2"
     path.write_text(yaml.safe_dump(cfg))
     assert KubeClient(str(path)).token == "fresh-tok"
+
+
+def test_list_410_relists_chunked_anchored_at_resource_version(
+    tmp_path, monkeypatch
+):
+    """An expired continue token restarts the CHUNKED pagination
+    anchored at the dead snapshot's resourceVersion — a 100k-pod
+    cluster never needs one giant un-chunked GET for a single expiry."""
+    from open_simulator_tpu.models import kubeclient as kc_mod
+
+    monkeypatch.setattr(kc_mod, "LIST_PAGE_LIMIT", 2)
+    srv = _FakeApiServer(expire_continue_once=True)
+    srv.routes["/api/v1/nodes"] = (
+        "NodeList",
+        "v1",
+        [make_fake_node(f"rv-{i}", cpu="1", memory="1Gi") for i in range(5)],
+    )
+    try:
+        kc = _write_kubeconfig(tmp_path, srv.url)
+        res = create_cluster_resource_from_client(kc)
+    finally:
+        srv.stop()
+    assert [n["metadata"]["name"] for n in res.nodes] == [
+        f"rv-{i}" for i in range(5)
+    ]
+    node_queries = [q for p, q in srv.seen_queries if p == "/api/v1/nodes"]
+    # every node query stayed chunked: no un-chunked fallback GET
+    assert all(q.get("limit") == ["2"] for q in node_queries)
+    # the restart's first page anchored at the snapshot's version
+    anchored = [q for q in node_queries if "resourceVersion" in q]
+    assert anchored and anchored[0]["resourceVersion"] == ["42"]
+    assert anchored[0]["resourceVersionMatch"] == ["NotOlderThan"]
+    # continue pages never carry a resourceVersion (apiserver rejects it)
+    assert all("resourceVersion" not in q for q in node_queries if "continue" in q)
 
 
 def test_list_410_expired_continue_falls_back_to_full_list(tmp_path, monkeypatch):

@@ -1,0 +1,515 @@
+"""Shadow-scheduler divergence auditor (open_simulator_tpu/shadow/).
+
+Covers the tentpole contracts:
+
+- decision-log round trip: record -> write -> read -> replay reports
+  100% agreement, and two replays of the same log produce the
+  identical report;
+- self-conformance through preemption: a recorded log whose decisions
+  carry eviction deltas replays to full agreement;
+- seeded-divergence fixture: every divergence class is produced, none
+  classifies as unknown, and each divergence carries per-node verdicts
+  + score-vector entries for both the real scheduler's node and
+  simon's node;
+- warm path: the tpu-engine replay re-dispatches warm shapes (zero
+  jit-cache misses after the first step of each shape);
+- live ingest: the polling tailer normalizes observed bindings /
+  failures / deletions into replayable steps;
+- satellites: explain's structured preemption payload, serve /metrics
+  shadow counters, kubeclient's resourceVersion-anchored re-list.
+"""
+
+import copy
+
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.runtime.journal import JournalMismatch
+from open_simulator_tpu.scheduler.core import AppResource
+from open_simulator_tpu.shadow.log import (
+    DecisionLogWriter,
+    Step,
+    cluster_fingerprint,
+    read_decision_log,
+)
+from open_simulator_tpu.shadow.record import record_simulation
+from open_simulator_tpu.shadow.replay import ShadowReplayer
+from open_simulator_tpu.testing import make_fake_node
+
+
+def _pod(name, cpu="500m", mem="512Mi", namespace="d", priority_class=None,
+         node_name=None):
+    pod = {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "img",
+                    "resources": {"requests": {"cpu": cpu, "memory": mem}},
+                }
+            ]
+        },
+    }
+    if priority_class:
+        pod["spec"]["priorityClassName"] = priority_class
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    return pod
+
+
+def _cluster(nodes):
+    cluster = ResourceTypes()
+    cluster.nodes = list(nodes)
+    return cluster
+
+
+def _app(name, pods):
+    res = ResourceTypes()
+    res.pods = list(pods)
+    return AppResource(name, res)
+
+
+def _small_cluster():
+    return _cluster(
+        [
+            make_fake_node("big-0", cpu="16", memory="32Gi"),
+            make_fake_node("big-1", cpu="16", memory="32Gi"),
+            make_fake_node("small-0", cpu="2", memory="4Gi"),
+        ]
+    )
+
+
+# --------------------------------------------------------- round trip
+
+
+def test_record_replay_round_trip_identical_report(tmp_path):
+    cluster = _small_cluster()
+    apps = [_app("web", [_pod(f"web-{i}", cpu="1") for i in range(6)])]
+    steps = record_simulation(cluster, apps)
+    assert sum(1 for s in steps if s.kind == "decision") == 6
+
+    path = str(tmp_path / "decisions.jsonl")
+    with DecisionLogWriter(path, cluster_fingerprint(cluster)) as w:
+        for s in steps:
+            w.append(s)
+
+    def replay():
+        loaded, meta = read_decision_log(
+            path, fingerprint=cluster_fingerprint(cluster)
+        )
+        assert meta["dropped"] == 0
+        return ShadowReplayer(cluster, engine="oracle").run(loaded).as_dict()
+
+    first, second = replay(), replay()
+    assert first["agreementRate"] == 1.0
+    assert first["decisions"] == 6
+    assert first["taxonomy"]["agree"] == 6
+    assert first == second  # record -> replay -> identical report
+
+
+def test_replay_through_preemption_self_conformance():
+    """The recorded log carries eviction deltas for preemptor
+    decisions; replay applies them before probing, so even preemption
+    rounds replay to full agreement."""
+    cluster = _cluster([make_fake_node("solo", cpu="2", memory="4Gi")])
+    cluster.priority_classes = [
+        {
+            "kind": "PriorityClass",
+            "metadata": {"name": "high"},
+            "value": 1000,
+        }
+    ]
+    # filler occupies the node; the high-priority pod must preempt it
+    apps = [
+        _app("filler", [_pod("filler-0", cpu="1500m")]),
+        _app("vip", [_pod("vip-0", cpu="1500m", priority_class="high")]),
+    ]
+    steps = record_simulation(cluster, apps)
+    vip = [
+        s
+        for s in steps
+        if s.kind == "decision" and s.pod_key[1].startswith("vip")
+    ]
+    assert vip and vip[0].node == "solo"
+    assert any(op["op"] == "evict_pod" for op in vip[0].deltas)
+    report = ShadowReplayer(cluster, engine="oracle").run(steps)
+    assert report.agreement_rate == 1.0
+    # the evicted filler rejoins the queue and fails (recorded + agreed)
+    assert report.taxonomy["agree"] == report.decisions
+
+
+def test_decision_log_fingerprint_mismatch_refuses(tmp_path):
+    cluster = _small_cluster()
+    path = str(tmp_path / "log.jsonl")
+    with DecisionLogWriter(path, "not-this-cluster") as w:
+        w.append(Step(seq=0, kind="decision", pod=_pod("p"), node="big-0"))
+    with pytest.raises(JournalMismatch, match="fingerprint"):
+        read_decision_log(path, fingerprint=cluster_fingerprint(cluster))
+    # torn tail is tolerated, not refused
+    with open(path, "a") as f:
+        f.write('{"kind": "decision", "seq": 1, "pod": {')
+    steps, meta = read_decision_log(path)
+    assert len(steps) == 1 and meta["dropped"] == 1
+
+
+# ------------------------------------------------------- warm scan path
+
+
+def test_scan_engine_replay_warm_shapes():
+    """Same-shaped steps re-dispatch the warm compiled scan: every
+    recompile is attributed to a first-seen shape signature, and the
+    warm-miss count is zero (the PR-5 counter gate, per-step)."""
+    cluster = _small_cluster()
+    apps = [_app("web", [_pod(f"web-{i}", cpu="1") for i in range(8)])]
+    steps = record_simulation(cluster, apps)
+    report = ShadowReplayer(cluster, engine="tpu").run(steps)
+    assert report.agreement_rate == 1.0
+    assert report.warm_recompiles == 0
+    assert report.obs["jaxDispatches"] >= report.decisions
+    # 8 content-identical pods -> one shape; misses only on step 0
+    assert all(s == 0 for s in report.recompile_steps)
+
+
+# --------------------------------------------------- divergence classes
+
+
+def _seeded_cluster():
+    cluster = _cluster(
+        [
+            make_fake_node("big-0", cpu="16", memory="32Gi"),
+            make_fake_node("small-0", cpu="2", memory="4Gi"),
+        ]
+    )
+    cluster.priority_classes = [
+        {"kind": "PriorityClass", "metadata": {"name": "high"}, "value": 1000}
+    ]
+    return cluster
+
+
+def test_seeded_divergences_all_classified():
+    cluster = _seeded_cluster()
+    steps = [
+        # simon's Simon-score binpacks toward the tight small node; the
+        # "real" scheduler spread onto the big one -> node-divergence
+        Step(seq=0, kind="decision", pod=_pod("nd"), node="big-0"),
+        # nothing fits 100 cpu, yet the log claims big-0 ->
+        # feasibility-divergence (simon says infeasible)
+        Step(seq=1, kind="decision", pod=_pod("fd-a", cpu="100"), node="big-0"),
+        # trivially placeable pod the real scheduler failed ->
+        # feasibility-divergence (simon finds a node)
+        Step(
+            seq=2,
+            kind="decision",
+            pod=_pod("fd-b", cpu="100m"),
+            node=None,
+            reason="0/2 nodes are available",
+        ),
+        # fill the cluster, then a high-priority pod the real scheduler
+        # placed by preempting — but the log carries no eviction delta
+        # -> ordering-divergence (preemption-capable probe failure)
+        Step(
+            seq=3,
+            kind="delta",
+            deltas=[
+                {"op": "place_pod", "pod": _pod("squat-big", cpu="15500m", node_name="big-0")},
+                {"op": "place_pod", "pod": _pod("squat-small", cpu="1900m", node_name="small-0")},
+            ],
+        ),
+        Step(
+            seq=4,
+            kind="decision",
+            pod=_pod("vip", cpu="1500m", priority_class="high"),
+            node="small-0",
+        ),
+    ]
+    report = ShadowReplayer(cluster, engine="oracle").run(steps)
+    payload = report.as_dict()
+    assert payload["taxonomy"] == {
+        "agree": 0,
+        "node-divergence": 1,
+        "feasibility-divergence": 2,
+        "ordering-divergence": 1,
+    }
+    by_pod = {d["pod"]: d for d in payload["divergences"]}
+    assert set(by_pod) == {"d/nd", "d/fd-a", "d/fd-b", "d/vip"}
+    # every divergence is classified (no unknown) and carries per-node
+    # verdicts + score-vector entries for both disputed choices
+    for d in payload["divergences"]:
+        assert d["class"] in (
+            "node-divergence",
+            "feasibility-divergence",
+            "ordering-divergence",
+        )
+        assert d["disputedNodes"]
+        for name, v in d["disputedNodes"].items():
+            assert v["verdict"]
+    nd = by_pod["d/nd"]
+    assert nd["simon"]["node"] == "small-0" and nd["real"]["node"] == "big-0"
+    assert {"big-0", "small-0"} <= set(nd["disputedNodes"])
+    # both choices were feasible: both appear in the score vector
+    vec = {row["node"]: row["score"] for row in nd["scoreVector"]}
+    assert "big-0" in vec and "small-0" in vec
+    assert nd["disputedNodes"]["big-0"]["score"] == vec["big-0"]
+    fda = by_pod["d/fd-a"]
+    assert fda["simon"]["node"] is None
+    assert "Insufficient cpu" in fda["disputedNodes"]["big-0"]["verdict"]
+    assert "Insufficient cpu" in fda["simon"]["reason"]
+    vip = by_pod["d/vip"]
+    assert vip["class"] == "ordering-divergence"
+    assert "preemption" in vip["evidence"]
+
+
+def test_ordering_divergence_cites_real_evictions():
+    """A decision whose deltas evict a pod, but which still disagrees
+    after applying them, classifies as ordering-divergence citing the
+    real scheduler's victims."""
+    cluster = _seeded_cluster()
+    steps = [
+        Step(
+            seq=0,
+            kind="delta",
+            deltas=[
+                {"op": "place_pod", "pod": _pod("victim", cpu="1", node_name="small-0")}
+            ],
+        ),
+        Step(
+            seq=1,
+            kind="decision",
+            pod=_pod("pusher", cpu="100m"),
+            # even after applying the eviction, simon binpacks onto the
+            # freed small node while the log says big-0: the surviving
+            # disagreement cites the real scheduler's preemption
+            node="big-0",
+            deltas=[
+                {
+                    "op": "evict_pod",
+                    "namespace": "d",
+                    "name": "victim",
+                    "node": "small-0",
+                }
+            ],
+        ),
+    ]
+    report = ShadowReplayer(cluster, engine="oracle").run(steps)
+    (div,) = report.divergences
+    assert div.cls == "ordering-divergence"
+    assert "d/victim" in div.evidence
+
+
+def test_node_churn_deltas_and_reload():
+    cluster = _cluster([make_fake_node("n-0", cpu="4", memory="8Gi")])
+    steps = [
+        Step(
+            seq=0,
+            kind="delta",
+            deltas=[
+                {"op": "add_node", "node": make_fake_node("n-1", cpu="4", memory="8Gi")}
+            ],
+        ),
+        Step(seq=1, kind="decision", pod=_pod("a"), node="n-0"),
+        Step(seq=2, kind="delta", deltas=[{"op": "remove_node", "name": "n-0"}]),
+        Step(seq=3, kind="decision", pod=_pod("b"), node="n-1"),
+    ]
+    replayer = ShadowReplayer(cluster, engine="oracle")
+    report = replayer.run(steps)
+    assert report.reloads == 1
+    assert report.decisions == 2
+    # the mirror survived the reload: n-1 holds pod b, n-0 is gone
+    assert [ns.name for ns in replayer.oracle.nodes] == ["n-1"]
+    assert [p["metadata"]["name"] for p in replayer.oracle.nodes[0].pods] == ["b"]
+
+
+# ------------------------------------------------------------- explain
+
+
+def test_explain_json_carries_preemption_victims():
+    """Satellite: the --explain JSON payload for a pod scheduled after
+    a preemption round names the node and its namespace-qualified
+    victims in a structured `preemption` block."""
+    from open_simulator_tpu.obs.explain import EXPLAIN, explanations_dict
+    from open_simulator_tpu.scheduler.core import simulate
+
+    cluster = _cluster([make_fake_node("solo", cpu="2", memory="4Gi")])
+    cluster.priority_classes = [
+        {"kind": "PriorityClass", "metadata": {"name": "high"}, "value": 1000}
+    ]
+    apps = [
+        _app("filler", [_pod("filler-0", cpu="1500m")]),
+        _app("vip", [_pod("vip-0", cpu="1500m", priority_class="high")]),
+    ]
+    EXPLAIN.enable("d/vip-0")
+    try:
+        simulate(cluster, apps, engine="oracle")
+        (rec,) = [
+            r for r in explanations_dict() if r["name"] == "vip-0"
+        ]
+    finally:
+        EXPLAIN.disable()
+    assert rec["scheduled"] is True
+    assert rec["preemption"]["node"] == "solo"
+    assert rec["preemption"]["victims"] == ["d/filler-0"]
+    # the free-form provenance map still carries the raw facts too
+    assert rec["provenance"]["preemption_node"] == "solo"
+
+
+def test_shadow_replay_explain_capture():
+    """--explain armed during replay captures the step's decision with
+    shadow provenance (class + both nodes)."""
+    from open_simulator_tpu.obs.explain import EXPLAIN, explanations_dict
+
+    cluster = _small_cluster()
+    steps = [Step(seq=0, kind="decision", pod=_pod("watched"), node="big-0")]
+    EXPLAIN.enable("d/watched")
+    try:
+        ShadowReplayer(cluster, engine="oracle").run(steps)
+        (rec,) = explanations_dict()
+    finally:
+        EXPLAIN.disable()
+    assert rec["provenance"]["engine"] == "shadow-replay"
+    assert rec["provenance"]["shadow_class"] == "node-divergence"
+    assert rec["provenance"]["real_node"] == "big-0"
+    assert rec["chosenNode"] == "big-0"  # the committed (real) node
+    assert rec["verdicts"]  # per-node filter verdicts captured
+
+
+# ------------------------------------------------------------- metrics
+
+
+def test_serve_metrics_export_shadow_counters():
+    from open_simulator_tpu.serve.server import render_metrics
+
+    class _Stub:
+        depth = 0
+
+    text = render_metrics(_Stub()).decode()
+    assert "simon_shadow_steps_total" in text
+    assert "simon_shadow_divergence_ordering_total" in text
+    assert "simon_shadow_agreement_rate" in text
+
+
+# -------------------------------------------------------------- ingest
+
+
+class _FakePods:
+    """Minimal KubeClient stand-in: list() serves mutable fixtures."""
+
+    def __init__(self):
+        self.nodes = [make_fake_node("live-0", cpu="8", memory="16Gi")]
+        self.pods = []
+
+    def list(self, path):
+        if path.endswith("/nodes"):
+            return copy.deepcopy(self.nodes)
+        return copy.deepcopy(self.pods)
+
+    def list_with_rv(self, path):
+        return self.list(path), "7"
+
+
+def test_tailer_normalizes_bindings_failures_and_deletions():
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+
+    client = _FakePods()
+    bound = _pod("pre-bound", node_name="live-0")
+    bound["status"] = {"phase": "Running"}
+    client.pods = [bound]
+    tailer = ClusterTailer(client)
+    nodes, boot = tailer.bootstrap()
+    assert [n["metadata"]["name"] for n in nodes] == ["live-0"]
+    assert boot[0].deltas[0]["op"] == "place_pod"
+
+    # next poll: one new binding, one unschedulable pod
+    newly = _pod("fresh", node_name="live-0")
+    newly["status"] = {"phase": "Running"}
+    pending = _pod("stuck")
+    pending["status"] = {
+        "phase": "Pending",
+        "conditions": [
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "Unschedulable",
+                "message": "0/1 nodes are available: 1 Insufficient cpu.",
+            }
+        ],
+    }
+    client.pods = [bound, newly, pending]
+    steps = tailer.poll()
+    kinds = [(s.kind, s.node) for s in steps if s.kind == "decision"]
+    assert ("decision", "live-0") in kinds
+    assert ("decision", None) in kinds
+    fresh = next(s for s in steps if s.node == "live-0")
+    # the decision pod is recorded unbound (the replayer probes it)
+    assert "nodeName" not in fresh.pod["spec"]
+    stuck = next(s for s in steps if s.kind == "decision" and s.node is None)
+    assert "Insufficient cpu" in stuck.reason
+    # the failure is emitted once, not per poll
+    assert not [s for s in tailer.poll() if s.kind == "decision"]
+
+    # deletion -> evict delta; replay the whole observed stream
+    client.pods = [newly, pending]
+    steps2 = tailer.poll()
+    (evict,) = [s for s in steps2 if s.kind == "delta"]
+    assert evict.deltas[0]["op"] == "evict_pod"
+    assert evict.deltas[0]["name"] == "pre-bound"
+
+    cluster = _cluster(nodes)
+    replayer = ShadowReplayer(cluster, engine="oracle")
+    for st in boot + steps + steps2:
+        replayer.step(st)
+    report = replayer.finish()
+    assert report.decisions == 2
+    committed = [p["metadata"]["name"] for p in replayer.oracle.nodes[0].pods]
+    assert committed == ["fresh"]  # pre-bound evicted, fresh committed
+
+
+def test_tailer_defers_binding_until_node_is_listed():
+    """A pod bound to a node the same poll's node LIST has not shown
+    yet (pod LIST racing node creation) is deferred, not dropped: the
+    next poll emits the add_node delta and THEN the decision."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+
+    client = _FakePods()
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    racer = _pod("racer", node_name="live-new")
+    racer["status"] = {"phase": "Running"}
+    client.pods = [racer]
+    assert [s for s in tailer.poll() if s.kind == "decision"] == []
+    client.nodes.append(make_fake_node("live-new", cpu="4", memory="8Gi"))
+    steps = tailer.poll()
+    kinds = [s.kind for s in steps]
+    assert kinds == ["delta", "decision"]  # add_node first, then the bind
+    assert steps[0].deltas[0]["op"] == "add_node"
+    assert steps[1].node == "live-new"
+
+
+def test_tailer_reemits_failure_for_recreated_pod():
+    """Deleting an unschedulable pod clears its failure-dedup state, so
+    a recreated same-name pod that is again unschedulable produces a
+    fresh failure decision."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+
+    client = _FakePods()
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    stuck = _pod("web-0")
+    stuck["status"] = {
+        "phase": "Pending",
+        "conditions": [
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "Unschedulable",
+                "message": "0/1 nodes are available.",
+            }
+        ],
+    }
+    client.pods = [stuck]
+    assert sum(1 for s in tailer.poll() if s.kind == "decision") == 1
+    client.pods = []  # controller deletes it...
+    tailer.poll()
+    client.pods = [copy.deepcopy(stuck)]  # ...and recreates it, still stuck
+    assert sum(1 for s in tailer.poll() if s.kind == "decision") == 1

@@ -8,7 +8,12 @@
 #                  (tools/simonlint/, docs/STATIC_ANALYSIS.md): unused
 #                  imports, mutable defaults, broad/silent except, I/O
 #                  without timeouts, bare prints, JAX trace-safety +
-#                  recompile hazards, lock discipline
+#                  recompile hazards, lock discipline, and the dataflow
+#                  rules (lock-order/blocking-under-lock, dtype/transfer
+#                  drift, deadline discipline, error taxonomy).
+#                  Incremental: unchanged files answer from
+#                  .simonlint_cache/ (make lint NO_LINT_CACHE=1 or
+#                  --no-cache for a cold run)
 #   make check     lint + test
 #   make examples  run both quickstart configs end to end
 #   make bench     one bench line (SIMON_BENCH selects the scenario)
@@ -22,7 +27,7 @@ test:
 
 lint:
 	$(PY) -m compileall -q open_simulator_tpu tools tests bench.py __graft_entry__.py
-	$(PY) -m tools.simonlint
+	$(PY) -m tools.simonlint $(if $(NO_LINT_CACHE),--no-cache,)
 
 check: lint test
 

@@ -27,6 +27,8 @@ import yaml
 from ..models import storage as stor
 from ..models import workloads as wl
 from ..models.chart import process_chart
+from ..models.validation import InputError
+from ..runtime.errors import ConformanceError
 from ..models.cluster import cluster_from_config_dir, match_and_set_local_storage
 from ..models.decode import (
     ResourceTypes,
@@ -60,7 +62,7 @@ class SimonConfig:
         with open(path) as f:
             doc = yaml.safe_load(f)
         if not isinstance(doc, dict) or doc.get("kind") != "Config":
-            raise ValueError(f"{path}: not a simon Config object")
+            raise InputError(f"{path}: not a simon Config object")
         spec = doc.get("spec") or {}
         cluster = spec.get("cluster") or {}
         apps = [
@@ -81,18 +83,18 @@ class SimonConfig:
     def validate(self):
         """Path validation (apply.go:249-286)."""
         if bool(self.custom_cluster) == bool(self.kube_config):
-            raise ValueError(
+            raise InputError(
                 "only one of values of both kubeConfig and customConfig must exist"
             )
         if self.kube_config and not os.path.exists(os.path.expanduser(self.kube_config)):
-            raise ValueError(f"invalid path of kubeconfig: {self.kube_config}")
+            raise InputError(f"invalid path of kubeconfig: {self.kube_config}")
         if self.custom_cluster and not os.path.exists(self.custom_cluster):
-            raise ValueError(f"invalid path of customConfig: {self.custom_cluster}")
+            raise InputError(f"invalid path of customConfig: {self.custom_cluster}")
         if self.new_node and not os.path.exists(self.new_node):
-            raise ValueError(f"invalid path of newNode: {self.new_node}")
+            raise InputError(f"invalid path of newNode: {self.new_node}")
         for app in self.app_list:
             if not os.path.exists(app.path):
-                raise ValueError(f"invalid path of {app.name} app: {app.path}")
+                raise InputError(f"invalid path of {app.name} app: {app.path}")
 
 
 def _resource_caps():
@@ -251,10 +253,12 @@ def replay_masked(sweep, valid, placements):
             local = local_of_arr[place_arr[a:b]]
             if (local < 0).any():
                 # a placement names a node outside this scenario's mask
-                # — scan invariant violation; fail loudly (the per-pod
-                # path would have KeyError'd on the same input)
+                # — scan invariant violation; fail loudly with the
+                # taxonomy's internal-defect error
                 bad = int(place_arr[a:b][local < 0][0])
-                raise KeyError(f"placement on masked-off node index {bad}")
+                raise ConformanceError(
+                    f"placement on masked-off node index {bad}"
+                )
             # prios=None is exact here: CapacitySweep refuses any
             # priority-bearing pod at construction (PrioritySignalError,
             # parallel/sweep.py) and neither oracle carries priority
@@ -316,7 +320,9 @@ def replay_masked(sweep, valid, placements):
                 if local_i < 0:
                     # same loud failure as the bulk path: a negative
                     # index would silently wrap to the LAST node
-                    raise KeyError(f"placement on masked-off node index {idx}")
+                    raise ConformanceError(
+                        f"placement on masked-off node index {idx}"
+                    )
                 if (
                     EXPLAIN.enabled
                     and EXPLAIN.target is not None
@@ -443,7 +449,7 @@ def _finish_plan(
     # authoritative host-side check of the caps on real state
     ok, reason = satisfy_resource_setting(result.node_status, oracle=replay_oracle)
     if result.unscheduled_pods or not ok:  # pragma: no cover - defensive
-        raise RuntimeError(
+        raise ConformanceError(
             "probe replay disagreed with scan: "
             + (reason or f"{len(result.unscheduled_pods)} unscheduled")
         )

@@ -39,6 +39,8 @@ from typing import Dict, List, Optional, Tuple
 
 import yaml
 
+from .validation import InputError
+
 # helm releaseutil.InstallOrder (chart.go:84-118 sorts with this)
 INSTALL_ORDER = [
     "Namespace",
@@ -81,8 +83,11 @@ _ORDER_INDEX = {k: i for i, k in enumerate(INSTALL_ORDER)}
 _TOKEN = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
 
 
-class ChartError(Exception):
-    pass
+class ChartError(InputError):
+    """A template/chart evaluation error is an input error: the chart
+    the user pointed simon at does not render. Rooting it in
+    InputError (a ValueError) routes it to exit code 2 with a clean
+    `error:` line instead of a traceback."""
 
 
 class _Missing:

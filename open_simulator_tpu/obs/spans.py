@@ -104,7 +104,11 @@ class JsonlSink:
             }
         )
 
-    def _emit(self, rec: dict):
+    # audited: this lock exists ONLY to keep concurrent appends'
+    # write+flush+fsync sequences whole (torn lines are worse than
+    # queueing); it is single-purpose, leaf in the lock order, and the
+    # recorder deliberately never holds its own lock across emit()
+    def _emit(self, rec: dict):  # simonlint: disable=CONC002
         with self._lock:
             if self._f is None:  # closed concurrently (recorder disable)
                 return

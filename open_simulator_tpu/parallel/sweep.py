@@ -32,6 +32,7 @@ import numpy as np
 
 from ..models import workloads as wl
 from ..models.decode import ResourceTypes
+from ..models.validation import InputError
 from ..scheduler.core import AppResource, _sort_app_pods
 from ..scheduler.oracle import Oracle
 
@@ -44,7 +45,7 @@ from ..runtime.guard import run_chunked, run_laddered
 INACTIVE = -2
 
 
-class PrioritySignalError(ValueError):
+class PrioritySignalError(InputError):
     """Raised when a batched sweep is asked to plan a priority-bearing
     workload: the scan cannot model PrioritySort/preemption, and a
     silent non-preemptive plan would diverge from simulate() on the
@@ -1082,7 +1083,11 @@ def find_min_count_multi(jobs, on_probe=None, budget=None) -> List[Optional[Prob
                 bucket = 1 << (k - 1).bit_length()
                 rows_d = [it[3] for it in items]
                 rows_d += [rows_d[0]] * (bucket - k)
-                stacked = np.asarray(jnp.stack(rows_d))
+                # the ONE deliberate device->host sync per shape
+                # bucket (counted right below): stacking k probe rows
+                # and pulling them together is the batching that keeps
+                # a K-spec round at one relay round-trip
+                stacked = np.asarray(jnp.stack(rows_d))  # simonlint: disable=JAX003
                 syncs += 1
                 for row, (i, c, valid, _) in zip(stacked, items):
                     sweep = jobs[i][0]

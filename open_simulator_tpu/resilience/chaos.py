@@ -812,7 +812,9 @@ def raise_plan_to_nplusk(
             worst = report.worst()
             ok, newly = engine.confirm_serial(worst.scenario)
             if not ok:  # pragma: no cover - defensive
-                raise RuntimeError(
+                from ..runtime.errors import ConformanceError
+
+                raise ConformanceError(
                     f"N+{failures} serial confirmation disagreed with the "
                     f"batched sweep on [{worst.scenario.label()}]: {newly} "
                     "newly unschedulable pod(s) in the serial re-simulation"
@@ -848,6 +850,14 @@ def raise_plan_to_nplusk(
             return None, report
         count = probe.count + 1
         while count <= sweep.max_count:
+            # each escalation probe is a device scan; without a check
+            # here a deadline expiring mid-escalation would not halt
+            # until the next outer N+K boundary (RT001)
+            if budget is not None:
+                try:
+                    budget.check("N+K escalation probe")
+                except ExecutionHalted as e:
+                    raise _partial(e, report)
             candidate = sweep.probe(count)
             if feasible(candidate):
                 probe = candidate

@@ -76,6 +76,15 @@ class ExternalIOError(GuardError):
         self.argv = list(argv) if argv is not None else None
 
 
+class ConformanceError(GuardError, RuntimeError):
+    """Two engines (or a replay and its scan) disagreed, or a scan
+    invariant was violated — an internal defect, never an input
+    problem. Inherits RuntimeError so pre-taxonomy ``except
+    RuntimeError`` handlers keep catching it; raised by the defensive
+    cross-checks (probe replay vs scan, serial confirmation vs batched
+    sweep, masked-off placement indices)."""
+
+
 class ExecutionHalted(GuardError):
     """The run stopped early at a safe boundary. ``partial`` is a
     machine-readable payload describing the work that DID complete

@@ -33,6 +33,7 @@ from ..ops.encode import (
     encode_dynamic,
     features_of_batch,
 )
+from ..runtime.errors import GuardError
 from .oracle import Oracle
 
 __all__ = ["SampleRngOverflow", "TpuEngine"]
@@ -43,7 +44,7 @@ __all__ = ["SampleRngOverflow", "TpuEngine"]
 _BULK_MAX_ABS = 1 << 55
 
 
-class SampleRngOverflow(RuntimeError):
+class SampleRngOverflow(GuardError, RuntimeError):
     """A sample-mode Intn draw needed more rejection retries than the
     in-scan bound (ops/scan.py _RNG_KMAX; p < 1e-17 per draw). Raised
     BEFORE any commit is replayed, so the caller (core._schedule_pods)

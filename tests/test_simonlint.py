@@ -716,6 +716,605 @@ def test_lint_file_compat_shim(tmp_path):
     assert any(code == "F401" for _, _, code, _ in tuples)
 
 
+# ------------------------------------------------------------------ CONC002
+
+_INVERSION = (
+    "import threading\n\n"
+    "A_LOCK = threading.Lock()\n"
+    "B_LOCK = threading.Lock()\n\n"
+    "def ab():\n"
+    "    with A_LOCK:\n"
+    "        with B_LOCK:\n"
+    "            pass\n\n"
+    "def ba():\n"
+    "    with B_LOCK:\n"
+    "        with A_LOCK:\n"
+    "            pass\n"
+)
+
+
+def test_conc002_lock_order_inversion(tmp_path):
+    findings = _lint_src(tmp_path, _INVERSION)
+    inv = [(c, l) for c, l in findings if c == "CONC002"]
+    assert ("CONC002", 8) in inv  # B under A in ab()
+    assert ("CONC002", 13) in inv  # A under B in ba()
+
+
+def test_conc002_consistent_order_is_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import threading\n\n"
+        "A_LOCK = threading.Lock()\n"
+        "B_LOCK = threading.Lock()\n\n"
+        "def ab():\n"
+        "    with A_LOCK:\n"
+        "        with B_LOCK:\n"
+        "            pass\n\n"
+        "def ab2():\n"
+        "    with A_LOCK:\n"
+        "        with B_LOCK:\n"
+        "            pass\n",
+    )
+    assert not any(c == "CONC002" for c, _ in findings)
+
+
+def test_conc002_blocking_fsync_under_lock(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import os\n"
+        "import threading\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def emit(self, f):\n"
+        "        with self._lock:\n"
+        "            os.fsync(f)\n",
+    )
+    assert ("CONC002", 9) in findings
+
+
+def test_conc002_fsync_after_finally_release_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import os\n"
+        "import threading\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def emit(self, f):\n"
+        "        self._lock.acquire()\n"
+        "        try:\n"
+        "            x = 1\n"
+        "        finally:\n"
+        "            self._lock.release()\n"
+        "        os.fsync(f)\n",
+    )
+    assert not any(c == "CONC002" for c, _ in findings)
+
+
+def test_conc002_journal_append_under_lock(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import threading\n\n"
+        "class Recorder:\n"
+        "    def __init__(self, journal):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._journal = journal\n"
+        "    def record(self, rec):\n"
+        "        with self._lock:\n"
+        "            self._journal.append(rec)\n",
+    )
+    assert ("CONC002", 9) in findings
+
+
+def test_conc002_self_deadlock_direct_and_via_callee(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import threading\n\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def direct(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+        "    def helper(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.helper()\n",
+    )
+    conc = [(c, l) for c, l in findings if c == "CONC002"]
+    assert ("CONC002", 8) in conc  # nested with on the same lock
+    assert ("CONC002", 15) in conc  # helper re-acquires under outer
+
+
+def test_conc002_interprocedural_inversion_via_singleton(tmp_path):
+    """One side of the inversion is hidden inside a method on a
+    module-level singleton — the one-level callee summary surfaces
+    it."""
+    (tmp_path / "reg.py").write_text(
+        "import threading\n\n"
+        "OTHER_LOCK = threading.Lock()\n\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def mark(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def inverted(self):\n"
+        "        with self._lock:\n"
+        "            with OTHER_LOCK:\n"
+        "                pass\n\n"
+        "REGISTRY = Registry()\n"
+    )
+    (tmp_path / "user.py").write_text(
+        "import threading\n\n"
+        "from reg import REGISTRY, OTHER_LOCK\n\n"
+        "def use():\n"
+        "    with OTHER_LOCK:\n"
+        "        REGISTRY.mark()\n"
+    )
+    findings = _lint_tree(tmp_path)
+    assert any(c == "CONC002" for _, c, _ in findings)
+
+
+def test_conc002_blocking_outside_lock_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import os\n"
+        "import threading\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def emit(self, f, rec):\n"
+        "        with self._lock:\n"
+        "            self.buf = rec\n"
+        "        os.fsync(f)\n",
+    )
+    assert not any(c == "CONC002" for c, _ in findings)
+
+
+# -------------------------------------------------------------------- RT001
+
+
+def test_rt001_unchecked_probe_loop_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def run(budget, probe):\n"
+        "    count = 0\n"
+        "    while count < 100:\n"
+        "        probe(count)\n"
+        "        count += 1\n",
+    )
+    assert ("RT001", 3) in findings
+
+
+def test_rt001_checked_on_one_branch_only_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def run(budget, probe):\n"
+        "    count = 0\n"
+        "    while count < 100:\n"
+        "        if count % 2:\n"
+        "            budget.check('probe')\n"
+        "        probe(count)\n"
+        "        count += 1\n",
+    )
+    assert ("RT001", 3) in findings
+
+
+def test_rt001_guarded_none_check_idiom_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def run(budget, probe):\n"
+        "    count = 0\n"
+        "    while count < 100:\n"
+        "        if budget is not None:\n"
+        "            budget.check('probe')\n"
+        "        probe(count)\n"
+        "        count += 1\n",
+    )
+    assert not any(c == "RT001" for c, _ in findings)
+
+
+def test_rt001_callee_consult_counts(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "class Search:\n"
+        "    def step(self, budget):\n"
+        "        budget.check('step')\n"
+        "    def run(self, budget):\n"
+        "        while True:\n"
+        "            self.step(budget)\n",
+    )
+    assert not any(c == "RT001" for c, _ in findings)
+
+
+def test_rt001_for_loop_and_budgetless_function_exempt(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def bounded(budget, items, work):\n"
+        "    for it in items:\n"  # for loops are bounded: exempt
+        "        work(it)\n\n"
+        "def no_budget(work):\n"
+        "    while True:\n"  # nothing to consult: exempt
+        "        work()\n",
+    )
+    assert not any(c == "RT001" for c, _ in findings)
+
+
+# ------------------------------------------------------------------- JAX003
+
+
+def test_jax003_np_conversion_of_device_value_in_loop(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(xs):\n"
+        "    acc = jnp.zeros(4)\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        out.append(np.asarray(acc))\n"
+        "    return out\n",
+    )
+    assert ("JAX003", 8) in findings
+
+
+def test_jax003_conversion_outside_loop_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n\n"
+        "def f():\n"
+        "    acc = jnp.zeros(4)\n"
+        "    return np.asarray(acc)\n",  # one decode sync: legal
+    )
+    assert not any(c == "JAX003" for c, _ in findings)
+
+
+def test_jax003_jnp_conversion_of_numpy_in_loop(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(xs):\n"
+        "    table = np.ones(8)\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        out.append(jnp.asarray(table))\n"
+        "    return out\n",
+    )
+    assert ("JAX003", 8) in findings
+
+
+def test_jax003_weak_float_scan_carry(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n\n"
+        "def step(c, x):\n"
+        "    return c + x, c\n\n"
+        "def run(xs):\n"
+        "    return jax.lax.scan(step, 0.0, xs)\n",
+    )
+    assert ("JAX003", 7) in findings
+
+
+def test_jax003_explicit_dtype_scan_carry_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "def step(c, x):\n"
+        "    return c + x, c\n\n"
+        "def run(xs):\n"
+        "    init = jnp.asarray(0.0, dtype=jnp.float32)\n"
+        "    return jax.lax.scan(step, init, xs)\n",
+    )
+    assert not any(c == "JAX003" for c, _ in findings)
+
+
+def test_jax003_augassign_keeps_target_kind(tmp_path):
+    """`acc += 0.5` reads acc too: a strong np accumulator must not be
+    re-kinded as a weak Python float by the augmented RHS (review
+    finding — this produced a spurious weak-carry report)."""
+    findings = _lint_src(
+        tmp_path,
+        "import jax\n"
+        "import numpy as np\n\n"
+        "def run(xs, step):\n"
+        "    acc = np.float64(0)\n"
+        "    acc += 0.5\n"
+        "    return jax.lax.scan(step, acc, xs)\n",
+    )
+    assert not any(c == "JAX003" for c, _ in findings)
+
+
+def test_jax003_mixed_np_jnp_arithmetic_in_loop(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(xs):\n"
+        "    a = jnp.zeros(4)\n"
+        "    b = np.ones(4)\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        out.append(a + b)\n"
+        "    return out\n",
+    )
+    assert ("JAX003", 9) in findings
+
+
+def test_jax003_only_polices_engine_dirs_in_repo():
+    """serve/ et al are out of JAX003's scope — the engine dirs are
+    where the conformance/transfer contracts live."""
+    findings = [
+        f
+        for f in lint_paths([REPO / "open_simulator_tpu" / "serve"])
+        if f.rule == "JAX003"
+    ]
+    assert findings == []
+
+
+# ------------------------------------------------------------------- EXC001
+
+
+def test_exc001_runtime_error_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    raise RuntimeError('broke')\n",
+    )
+    assert ("EXC001", 2) in findings
+
+
+def test_exc001_value_error_needs_allowlist(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f(x):\n"
+        "    if x < 0:\n"
+        "        raise ValueError('x must be >= 0')\n",
+    )
+    assert ("EXC001", 3) in findings
+    # ... and the audited allowlist clears it
+    p = tmp_path / "mod2.py"
+    p.write_text(
+        "def f(x):\n"
+        "    if x < 0:\n"
+        "        raise ValueError('x must be >= 0')\n"
+    )
+    allowlists.EXC001_ALLOW.add(("mod2.py", "f"))
+    try:
+        findings = [(f.rule, f.line) for f in lint_paths([p])]
+    finally:
+        allowlists.EXC001_ALLOW.discard(("mod2.py", "f"))
+    assert not any(c == "EXC001" for c, _ in findings)
+
+
+def test_exc001_taxonomy_rooted_class_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "class GuardError(Exception):\n"
+        "    pass\n\n"
+        "class DeviceBroke(GuardError):\n"
+        "    pass\n\n"
+        "def f():\n"
+        "    raise DeviceBroke('gone')\n",
+    )
+    assert not any(c == "EXC001" for c, _ in findings)
+
+
+def test_exc001_unrooted_first_party_class_flagged(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "class StrayError(Exception):\n"
+        "    pass\n\n"
+        "def f():\n"
+        "    raise StrayError('lost')\n",
+    )
+    assert ("EXC001", 5) in findings
+
+
+def test_exc001_reraise_and_notimplemented_clean(tmp_path):
+    findings = _lint_src(
+        tmp_path,
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except OSError as e:\n"
+        "        raise\n\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except OSError as e:\n"
+        "        raise e\n\n"
+        "class Base:\n"
+        "    def api(self):\n"
+        "        raise NotImplementedError\n",
+    )
+    assert not any(c == "EXC001" for c, _ in findings)
+
+
+def test_exc001_real_tree_taxonomy_is_closed():
+    """Every raise in the package is taxonomy-rooted, allowlisted, or
+    pragma'd — pinned so new raise sites must pick a typed error."""
+    findings = [
+        f
+        for f in lint_paths([REPO / "open_simulator_tpu"])
+        if f.rule == "EXC001"
+    ]
+    assert findings == [], "\n".join(f.render() for f in findings)
+    for rel in allowlists.EXC001_VALIDATION_FILES:
+        assert (REPO / rel).exists(), rel
+    for rel, _fn in allowlists.EXC001_ALLOW:
+        assert (REPO / rel).exists(), rel
+
+
+# ----------------------------------------------------------- incremental cache
+
+
+def _cached_lint(tmp_path, root):
+    from tools.simonlint.cache import LintCache
+
+    cache = LintCache(root, enabled=True)
+    findings = lint_paths([root], root=root, cache=cache)
+    return findings, cache
+
+
+def test_cache_full_tree_hit_on_unchanged_tree(tmp_path):
+    (tmp_path / "a.py").write_text("import os\n")
+    (tmp_path / "b.py").write_text("X = 1\n")
+    first, c1 = _cached_lint(tmp_path, tmp_path)
+    assert c1.stats["full_hits"] == 0
+    second, c2 = _cached_lint(tmp_path, tmp_path)
+    assert c2.stats["full_hits"] == 1  # answered without re-analysis
+    assert [(f.rel, f.rule, f.line) for f in first] == [
+        (f.rel, f.rule, f.line) for f in second
+    ]
+    assert any(f.rule == "F401" for f in second)
+
+
+def test_cache_consistency_after_edit(tmp_path):
+    """The cache self-test: an edit must change the answer (no stale
+    findings served), and unchanged files ride the per-file tier."""
+    (tmp_path / "a.py").write_text("import os\n")
+    (tmp_path / "b.py").write_text("X = 1\n")
+    first, _ = _cached_lint(tmp_path, tmp_path)
+    assert any(f.rule == "F401" and f.rel == "a.py" for f in first)
+    (tmp_path / "a.py").write_text("import os\nprint(os.sep)\n")
+    second, c2 = _cached_lint(tmp_path, tmp_path)
+    assert c2.stats["full_hits"] == 0
+    assert c2.stats["file_hits"] >= 1  # b.py rode the per-file tier
+    assert not any(f.rule == "F401" for f in second)  # stale finding gone
+
+
+def test_cache_corrupt_file_degrades_to_cold_run(tmp_path):
+    (tmp_path / "a.py").write_text("import os\n")
+    _cached_lint(tmp_path, tmp_path)
+    cache_file = tmp_path / ".simonlint_cache" / "cache.json"
+    cache_file.write_text("{not json")
+    findings, c = _cached_lint(tmp_path, tmp_path)
+    assert c.stats["full_hits"] == 0
+    assert any(f.rule == "F401" for f in findings)
+
+
+def test_cache_ignores_dot_cache_dir_itself(tmp_path):
+    """The cache dir must not be linted (rglob would otherwise pick up
+    cache.json — not .py, but pin the tree stays stable)."""
+    (tmp_path / "a.py").write_text("X = 1\n")
+    first, _ = _cached_lint(tmp_path, tmp_path)
+    second, c2 = _cached_lint(tmp_path, tmp_path)
+    assert c2.stats["full_hits"] == 1
+    assert first == [] and second == []
+
+
+def test_cli_no_cache_flag(tmp_path):
+    from tools.simonlint.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import os\n")
+    assert main([str(dirty), "--no-cache"]) == 1
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def test_baseline_accepts_recorded_findings_and_ratchets(tmp_path):
+    from tools.simonlint.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import os\n")
+    base = tmp_path / "baseline.json"
+    # record the debt
+    assert main([str(dirty), "--write-baseline", str(base), "--no-cache"]) == 0
+    # baselined: the same tree is green
+    assert main([str(dirty), "--baseline", str(base), "--no-cache"]) == 0
+    # a NEW finding still fails
+    dirty.write_text("import os\nimport json\n")
+    assert main([str(dirty), "--baseline", str(base), "--no-cache"]) == 1
+    # debt paid: the stale entry is itself an error (SL002)
+    dirty.write_text("X = 1\n")
+    out = tmp_path / "f.json"
+    rc = main(
+        [str(dirty), "--baseline", str(base), "--no-cache", "--out", str(out)]
+    )
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert any(f["rule"] == "SL002" for f in doc["findings"])
+
+
+def test_write_baseline_still_writes_artifacts(tmp_path):
+    """--write-baseline must not swallow --out/--sarif-out (review
+    finding: CI records a baseline AND uploads the findings docs)."""
+    from tools.simonlint.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import os\n")
+    base = tmp_path / "b.json"
+    out = tmp_path / "f.json"
+    sarif = tmp_path / "f.sarif"
+    rc = main(
+        [
+            str(dirty), "--no-cache",
+            "--write-baseline", str(base),
+            "--out", str(out), "--sarif-out", str(sarif),
+        ]
+    )
+    assert rc == 0
+    assert json.loads(out.read_text())["count"] == 1
+    assert json.loads(sarif.read_text())["runs"][0]["results"]
+
+
+def test_baseline_bad_file_is_usage_error(tmp_path, capsys):
+    from tools.simonlint.__main__ import main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    bad = tmp_path / "bad.json"
+    bad.write_text("[]")
+    assert main([str(clean), "--baseline", str(bad), "--no-cache"]) == 2
+
+
+# --------------------------------------------------------------------- SARIF
+
+
+def test_sarif_document_shape(tmp_path):
+    from tools.simonlint.sarif import render_sarif
+
+    p = tmp_path / "mod.py"
+    p.write_text("import os\n")
+    findings = lint_paths([p])
+    doc = json.loads(render_sarif(findings))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"F401", "CONC002", "RT001", "JAX003", "EXC001", "SL001"} <= rules
+    result = run["results"][0]
+    assert result["ruleId"] == "F401"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "mod.py"
+    assert loc["region"]["startLine"] == 1
+
+
+def test_cli_sarif_out_and_format(tmp_path, capsys):
+    from tools.simonlint.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import os\n")
+    sarif = tmp_path / "lint.sarif"
+    rc = main(
+        [str(dirty), "--no-cache", "--format", "sarif", "--sarif-out", str(sarif)]
+    )
+    assert rc == 1
+    doc = json.loads(sarif.read_text())
+    assert doc["runs"][0]["results"]
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["version"] == "2.1.0"
+
+
 # ----------------------------------------------------------------- self-lint
 
 

@@ -1,0 +1,352 @@
+"""The simonlint dataflow ENGINE itself (tools/simonlint/cfg.py,
+dataflow.py, effects.py) — fixture CFGs exercising branch joins, loop
+back-edges, try/finally lock release, with-unwind, and early-return
+paths, asserted at the engine API level (not just through end-to-end
+rule fixtures, which live in test_simonlint.py)."""
+
+import ast
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.simonlint.cfg import build_cfg, iter_function_defs  # noqa: E402
+from tools.simonlint.dataflow import (  # noqa: E402
+    JAX,
+    NP,
+    PYFLOAT,
+    KindAnalysis,
+    LockAnalysis,
+    exit_state,
+    iter_event_states,
+    loop_unchecked_sources,
+)
+from tools.simonlint.effects import Effects, is_budget_consult  # noqa: E402
+from tools.simonlint.project import ProjectIndex, SourceFile  # noqa: E402
+
+
+def _sf(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return SourceFile(p, root=tmp_path)
+
+
+def _fn(sf, name):
+    for node in iter_function_defs(sf):
+        if node.name == name:
+            return node
+    raise AssertionError(f"no function {name!r}")
+
+
+def _held_at_call(sf, fn_name, callee_name):
+    """Lock set at the line of the call whose func name/attr is
+    `callee_name` inside `fn_name`."""
+    fn = _fn(sf, fn_name)
+    cfg = build_cfg(sf, fn)
+    states = LockAnalysis.solve(cfg)
+    from tools.simonlint.cfg import iter_event_calls
+
+    for _b, ev, held in iter_event_states(cfg, states, LockAnalysis.transfer):
+        if ev.kind != "stmt":
+            continue
+        for node in iter_event_calls(ev):
+            target = node.func
+            name = getattr(target, "attr", getattr(target, "id", ""))
+            if name == callee_name:
+                return held
+    raise AssertionError(f"no call to {callee_name!r} in {fn_name!r}")
+
+
+# ------------------------------------------------------------------ CFG shape
+
+
+def test_cfg_branch_join_and_early_return(tmp_path):
+    sf = _sf(
+        tmp_path,
+        "def f(x):\n"
+        "    if x:\n"
+        "        return 1\n"
+        "    y = 2\n"
+        "    return y\n",
+    )
+    cfg = build_cfg(sf, _fn(sf, "f"))
+    # both returns reach the exit block; the exit has no successors
+    assert cfg.exit.succs == []
+    preds = [b for b in cfg.blocks if cfg.exit in b.succs]
+    assert len(preds) >= 2  # early return + final return
+
+
+def test_cfg_loop_has_back_edge(tmp_path):
+    sf = _sf(
+        tmp_path,
+        "def f(xs):\n"
+        "    total = 0\n"
+        "    while xs:\n"
+        "        total += 1\n"
+        "    return total\n",
+    )
+    cfg = build_cfg(sf, _fn(sf, "f"))
+    (info,) = cfg.loops.values()
+    assert info.back_sources, "loop lost its back edge"
+    for src in info.back_sources:
+        assert info.head in src.succs
+
+
+# ------------------------------------------------------------- lock dataflow
+
+
+_LOCKED = (
+    "import threading\n"
+    "import os\n\n"
+    "class W:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n\n"
+    "    def inside(self, f):\n"
+    "        with self._lock:\n"
+    "            os.fsync(f)\n\n"
+    "    def after(self, f):\n"
+    "        with self._lock:\n"
+    "            x = 1\n"
+    "        os.fsync(f)\n\n"
+    "    def finally_release(self, f):\n"
+    "        self._lock.acquire()\n"
+    "        try:\n"
+    "            x = 1\n"
+    "        finally:\n"
+    "            self._lock.release()\n"
+    "        os.fsync(f)\n\n"
+    "    def held_in_try(self, f):\n"
+    "        self._lock.acquire()\n"
+    "        try:\n"
+    "            os.fsync(f)\n"
+    "        finally:\n"
+    "            self._lock.release()\n"
+)
+
+
+def test_lock_held_inside_with(tmp_path):
+    sf = _sf(tmp_path, _LOCKED)
+    assert _held_at_call(sf, "inside", "fsync") == {"mod.W._lock"}
+
+
+def test_lock_released_after_with(tmp_path):
+    sf = _sf(tmp_path, _LOCKED)
+    assert _held_at_call(sf, "after", "fsync") == frozenset()
+
+
+def test_try_finally_release_clears_lock(tmp_path):
+    sf = _sf(tmp_path, _LOCKED)
+    assert _held_at_call(sf, "finally_release", "fsync") == frozenset()
+
+
+def test_lock_held_inside_try_before_finally(tmp_path):
+    sf = _sf(tmp_path, _LOCKED)
+    assert _held_at_call(sf, "held_in_try", "fsync") == {"mod.W._lock"}
+
+
+def test_with_unwind_on_early_return(tmp_path):
+    """A return INSIDE `with self._lock:` must release before the exit
+    edge: the exit block's entry state holds no lock."""
+    sf = _sf(
+        tmp_path,
+        "import threading\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self, x):\n"
+        "        with self._lock:\n"
+        "            if x:\n"
+        "                return 1\n"
+        "        return 0\n",
+    )
+    fn = _fn(sf, "f")
+    cfg = build_cfg(sf, fn)
+    states = LockAnalysis.solve(cfg)
+    assert states[cfg.exit.bid] == frozenset()
+
+
+# ------------------------------------------------- budget loop dataflow
+
+
+def _unchecked(tmp_path, src, fn_name="run"):
+    sf = _sf(tmp_path, src)
+    fn = _fn(sf, fn_name)
+    cfg = build_cfg(sf, fn)
+    project = ProjectIndex([], root=tmp_path)
+    project.files.append(sf)
+    if sf.module:
+        project.by_module[sf.module] = sf
+    effects = Effects(project)
+
+    def consults(ev):
+        for expr_calls in _event_calls(ev):
+            if is_budget_consult(expr_calls):
+                return True
+        return False
+
+    out = []
+    for loop in cfg.loops:
+        if isinstance(loop, ast.While):
+            out.extend(loop_unchecked_sources(cfg, loop, consults))
+    return out
+
+
+def _event_calls(ev):
+    from tools.simonlint.cfg import iter_event_calls
+
+    return list(iter_event_calls(ev))
+
+
+def test_loop_checked_on_all_paths_is_clean(tmp_path):
+    assert not _unchecked(
+        tmp_path,
+        "def run(budget, work):\n"
+        "    i = 0\n"
+        "    while i < 10:\n"
+        "        budget.check('step')\n"
+        "        work(i)\n"
+        "        i += 1\n",
+    )
+
+
+def test_loop_checked_on_one_branch_only_is_flagged(tmp_path):
+    assert _unchecked(
+        tmp_path,
+        "def run(budget, work):\n"
+        "    i = 0\n"
+        "    while i < 10:\n"
+        "        if i % 2:\n"
+        "            budget.check('step')\n"
+        "        work(i)\n"
+        "        i += 1\n",
+    )
+
+
+def test_loop_continue_path_skipping_check_is_flagged(tmp_path):
+    assert _unchecked(
+        tmp_path,
+        "def run(budget, work):\n"
+        "    i = 0\n"
+        "    while i < 10:\n"
+        "        i += 1\n"
+        "        if i % 2:\n"
+        "            continue\n"  # back edge without a consult
+        "        budget.check('step')\n"
+        "        work(i)\n",
+    )
+
+
+def test_loop_check_in_condition_is_clean(tmp_path):
+    assert not _unchecked(
+        tmp_path,
+        "def run(budget, work):\n"
+        "    i = 0\n"
+        "    while budget.remaining() is None or i < 10:\n"
+        "        work(i)\n"
+        "        i += 1\n",
+    )
+
+
+# ------------------------------------------------------------ value kinds
+
+
+def _kinds_at_exit(tmp_path, src, fn_name="f"):
+    sf = _sf(tmp_path, src)
+    fn = _fn(sf, fn_name)
+    analysis = KindAnalysis(sf)
+    cfg = build_cfg(sf, fn)
+    states = analysis.solve(cfg)
+    return dict(exit_state(cfg, states, analysis.transfer, cfg.entry)), dict(
+        states.get(cfg.exit.bid, frozenset())
+    )
+
+
+def test_kind_assignment_and_join_agreement(tmp_path):
+    _, at_exit = _kinds_at_exit(
+        tmp_path,
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(flag):\n"
+        "    a = jnp.zeros(4)\n"
+        "    if flag:\n"
+        "        b = np.ones(2)\n"
+        "    else:\n"
+        "        b = np.zeros(2)\n"
+        "    c = 0.5\n"
+        "    return a, b, c\n",
+    )
+    assert at_exit["a"] == JAX
+    assert at_exit["b"] == NP  # both branches agree
+    assert at_exit["c"] == PYFLOAT
+
+
+def test_kind_join_disagreement_degrades_to_unknown(tmp_path):
+    _, at_exit = _kinds_at_exit(
+        tmp_path,
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n\n"
+        "def f(flag):\n"
+        "    if flag:\n"
+        "        b = np.ones(2)\n"
+        "    else:\n"
+        "        b = jnp.ones(2)\n"
+        "    return b\n",
+    )
+    assert "b" not in at_exit  # disagreement -> unknown, not a guess
+
+
+# --------------------------------------------------------------- effects
+
+
+def test_effect_summaries_direct_facts(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import os\n"
+        "import threading\n\n"
+        "_lock = threading.Lock()\n\n"
+        "def writer(f, budget):\n"
+        "    with _lock:\n"
+        "        pass\n"
+        "    os.fsync(f)\n"
+        "    budget.check('x')\n"
+        "    raise ValueError('nope')\n"
+    )
+    project = ProjectIndex([p], root=tmp_path)
+    effects = Effects(project)
+    sf = project.files[0]
+    summary = effects.direct(sf, _fn(sf, "writer"))
+    assert summary.locks == {"mod._lock"}
+    assert "os.fsync" in summary.blocking
+    assert summary.consults_budget
+    assert "ValueError" in summary.raises
+
+
+def test_effects_resolve_singleton_method(tmp_path):
+    (tmp_path / "reg.py").write_text(
+        "import threading\n\n"
+        "class Counters:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def inc(self, name):\n"
+        "        with self._lock:\n"
+        "            pass\n\n"
+        "COUNTERS = Counters()\n"
+    )
+    (tmp_path / "user.py").write_text(
+        "from reg import COUNTERS\n\n"
+        "def tick():\n"
+        "    COUNTERS.inc('x')\n"
+    )
+    project = ProjectIndex(
+        [tmp_path / "reg.py", tmp_path / "user.py"], root=tmp_path
+    )
+    effects = Effects(project)
+    user = project.by_module["user"]
+    call = next(
+        n
+        for n in ast.walk(user.tree)
+        if isinstance(n, ast.Call) and getattr(n.func, "attr", "") == "inc"
+    )
+    summary = effects.for_call(user, call)
+    assert summary is not None
+    assert summary.locks == {"reg.Counters._lock"}

@@ -14,13 +14,26 @@ pyflakes-class checker (the old tools/lint.py) is now a framework:
   analyses like JAX001 trace-safety;
 - a rule registry (`core.py`): each rule is a class registered under a
   stable id; `python -m tools.simonlint --list-rules` enumerates them;
+- a dataflow layer: per-function control-flow graphs (`cfg.py`) with
+  lock canonicalization and with/try-finally unwind modeling, a
+  forward abstract-interpretation solver with the lock-held /
+  budget-checked / value-kind lattices (`dataflow.py`), and one-level
+  callee effect summaries (`effects.py`) — the substrate of CONC002,
+  RT001, and JAX003;
 - inline pragmas (`pragmas.py`): `# simonlint: disable=RULE[,RULE]` on
   the finding's line (or on the enclosing `def`/`class` line to cover a
   whole body). A pragma that suppresses nothing is itself reported
   (SL001) so dead suppressions cannot rot. Legacy `# noqa` lines keep
   working for the migrated rules;
-- text and JSON output (`runner.py`), wired into `make lint` and CI
-  (the findings JSON is uploaded as a workflow artifact).
+- an incremental cache (`cache.py`, `.simonlint_cache/`): content-hash
+  keyed, full-tree and per-file tiers, invalidated by any change to
+  the simonlint sources themselves (`--no-cache` for a cold run);
+- a baseline ratchet (`baseline.py`): `--baseline`/`--write-baseline`
+  accept pre-existing findings for a newly enabled rule and fail only
+  on new ones; entries that stop firing are reported stale (SL002);
+- text, JSON, and SARIF output (`runner.py`, `sarif.py`), wired into
+  `make lint` and CI (JSON + SARIF uploaded as artifacts, SARIF pushed
+  to GitHub code scanning, cold runtime gated at 60 s).
 
 Rule inventory (docs/STATIC_ANALYSIS.md holds the full table):
 
@@ -34,8 +47,14 @@ Rule inventory (docs/STATIC_ANALYSIS.md holds the full table):
 - JAX (rules/jax_trace.py, rules/jax_compile.py): JAX001 host side
   effects reachable inside traced code, JAX002 per-call `jax.jit`
   wrappers that defeat the compile cache / non-hashable static args
-- concurrency (rules/concurrency.py): CONC001 lock-discipline — fields
-  guarded by `with self._lock` elsewhere must not be touched unlocked
+- concurrency (rules/concurrency.py, rules/lock_order.py): CONC001
+  lock-discipline — fields guarded by `with self._lock` elsewhere must
+  not be touched unlocked; CONC002 lock-order inversions, blocking
+  calls under a lock, and self-deadlocks, via the lock-held dataflow
+- dataflow (rules/jax_dtype.py, rules/deadline.py,
+  rules/exceptions.py): JAX003 dtype/transfer drift in the engine
+  directories, RT001 deadline discipline for budget-scoped while
+  loops, EXC001 error-taxonomy enforcement at raise sites
 
 Checks that need full runtime resolution (undefined names) stay out of
 scope — `compileall` plus the test suite carry those.

@@ -1,10 +1,12 @@
 """`python -m tools.simonlint` — the `make lint` / CI entry point.
 
-Exit status 1 when any finding survives suppression, 0 on a clean
-tree. `--format json` prints the machine-readable findings document;
-`--out PATH` writes that document to a file regardless of the stdout
-format (CI uploads it as a workflow artifact while keeping readable
-logs)."""
+Exit status 1 when any finding survives suppression (and the
+baseline, when one is given), 0 on a clean tree. `--format
+json|sarif` prints the machine-readable findings document; `--out
+PATH` writes the JSON document and `--sarif-out PATH` the SARIF one
+regardless of the stdout format (CI uploads both as artifacts while
+keeping readable logs). The incremental cache is on by default
+(`.simonlint_cache/`); `--no-cache` forces a cold run."""
 
 from __future__ import annotations
 
@@ -12,13 +14,17 @@ import argparse
 import sys
 from pathlib import Path
 
+from .baseline import apply_baseline, load_baseline, write_baseline
+from .cache import LintCache
 from .core import all_rules
+from .project import repo_root
 from .runner import (
     DEFAULT_ROOTS,
     lint_paths,
     render_json,
     render_text,
 )
+from .sarif import render_sarif
 
 
 def main(argv=None) -> int:
@@ -33,7 +39,7 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
         help="stdout format (default text)",
     )
@@ -43,9 +49,31 @@ def main(argv=None) -> int:
         help="also write the JSON findings document to PATH",
     )
     ap.add_argument(
+        "--sarif-out",
+        metavar="PATH",
+        help="also write the SARIF findings document to PATH",
+    )
+    ap.add_argument(
         "--rules",
         metavar="ID[,ID...]",
         help="restrict to a comma-separated subset of rule ids",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the incremental cache (.simonlint_cache/)",
+    )
+    ap.add_argument(
+        "--baseline",
+        metavar="PATH",
+        help="accepted-findings baseline: fail only on findings not in "
+        "it; stale entries are reported as SL002",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        metavar="PATH",
+        help="record the current findings as the accepted baseline and "
+        "exit 0",
     )
     ap.add_argument(
         "--list-rules",
@@ -58,12 +86,17 @@ def main(argv=None) -> int:
         for rule in all_rules():
             print(f"{rule.id:8s} {rule.title}")
             print(f"         {rule.rationale}")
-        # framework-level, not a registered rule: emitted by the
-        # pragma accounting pass itself
+        # framework-level, not registered rules: emitted by the pragma
+        # accounting pass and the baseline ratchet themselves
         print("SL001    unused suppression")
         print(
             "         a `# simonlint: disable=` pragma that silences "
             "nothing is itself an error — suppressions cannot rot"
+        )
+        print("SL002    stale baseline entry")
+        print(
+            "         a baseline entry whose finding no longer fires is "
+            "itself an error — the ratchet only tightens"
         )
         return 0
 
@@ -79,19 +112,46 @@ def main(argv=None) -> int:
             print(f"unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
     try:
-        findings = lint_paths(args.paths or DEFAULT_ROOTS, rules=rules)
+        cache = LintCache(repo_root(), enabled=not args.no_cache)
+        findings = lint_paths(
+            args.paths or DEFAULT_ROOTS, rules=rules, cache=cache
+        )
     except (OSError, UnicodeDecodeError) as e:
         # bad path / unreadable or undecodable file: a usage error
         # (2), distinct from "findings found" (1)
         print(f"simonlint: {e}", file=sys.stderr)
         return 2
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        # artifact flags still honored: a CI job recording a baseline
+        # usually uploads the findings documents in the same run
+        if args.out:
+            Path(args.out).write_text(render_json(findings) + "\n")
+        if args.sarif_out:
+            Path(args.sarif_out).write_text(render_sarif(findings) + "\n")
+        print(
+            f"baseline written: {len(findings)} accepted finding(s) -> "
+            f"{args.write_baseline}"
+        )
+        return 0
+    if args.baseline:
+        try:
+            entries = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"simonlint: {e}", file=sys.stderr)
+            return 2
+        findings = apply_baseline(findings, entries, args.baseline)
+        findings.sort(key=lambda f: (f.rel, f.line, f.rule))
     if args.out:
         Path(args.out).write_text(render_json(findings) + "\n")
-    print(
-        render_json(findings)
-        if args.format == "json"
-        else render_text(findings)
-    )
+    if args.sarif_out:
+        Path(args.sarif_out).write_text(render_sarif(findings) + "\n")
+    if args.format == "json":
+        print(render_json(findings))
+    elif args.format == "sarif":
+        print(render_sarif(findings))
+    else:
+        print(render_text(findings))
     return 1 if findings else 0
 
 

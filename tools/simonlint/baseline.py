@@ -1,0 +1,83 @@
+"""Baseline / ratchet — adopt a new rule without a flag-day cleanup.
+
+``--write-baseline PATH`` records the current findings as ACCEPTED
+debt; ``--baseline PATH`` then fails only on findings NOT in the
+baseline. The ratchet is the same contract as unused pragmas (SL001):
+an entry whose finding no longer fires is reported as **SL002 stale
+baseline entry**, so the baseline can only shrink — fixed debt cannot
+silently reappear, and the file cannot rot.
+
+Matching is by (file, rule, message) — deliberately NOT by line
+number, so unrelated edits above a finding do not un-baseline it; a
+message carries enough context (function names, lock names) that two
+distinct findings rarely collide, and when they do they are the same
+debt. Each entry matches any number of identical findings.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+STALE_BASELINE = "SL002"
+
+Key = Tuple[str, str, str]
+
+
+def write_baseline(path, findings: List[Finding]) -> None:
+    doc = {
+        "version": BASELINE_VERSION,
+        "entries": [
+            {"file": f.rel, "rule": f.rule, "message": f.message}
+            for f in findings
+        ],
+    }
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def load_baseline(path) -> List[dict]:
+    doc = json.loads(Path(path).read_text())
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: not a simonlint baseline (version 1)")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: baseline has no entries list")
+    return entries
+
+
+def apply_baseline(
+    findings: List[Finding], entries: List[dict], baseline_path
+) -> List[Finding]:
+    """Drop baselined findings; append SL002 for stale entries."""
+    accepted = {
+        (str(e.get("file")), str(e.get("rule")), str(e.get("message")))
+        for e in entries
+        if isinstance(e, dict)
+    }
+    matched = set()
+    kept = []
+    for f in findings:
+        key = (f.rel, f.rule, f.message)
+        if key in accepted:
+            matched.add(key)
+        else:
+            kept.append(f)
+    rel = str(baseline_path)
+    for key in sorted(accepted - matched):
+        file, rule, message = key
+        kept.append(
+            Finding(
+                Path(rel),
+                rel,
+                0,
+                STALE_BASELINE,
+                f"stale baseline entry: no current {rule} finding in "
+                f"{file} matches {message!r} — the debt was paid, remove "
+                "the entry (the ratchet only tightens)",
+            )
+        )
+    return kept

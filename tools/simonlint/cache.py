@@ -1,0 +1,140 @@
+"""Incremental lint cache — re-analyze only what changed.
+
+Two tiers, both keyed on CONTENT (sha256 of file bytes) plus a tool
+digest (sha256 over the simonlint sources themselves, allowlists
+included), so editing either the code or the linter invalidates
+exactly what it must:
+
+- **full-tree tier**: when the (file set, per-file digests, rule
+  subset) triple matches the stored run, the stored post-suppression
+  findings are returned without parsing anything — the repeat
+  ``make lint`` on an unchanged tree.
+- **per-file tier**: on a partial hit, unchanged files reuse their
+  cached FILE-scoped findings (pre-suppression) and only changed files
+  re-run the file rules. Project-scoped rules (JAX001, CONC002, RT001,
+  JAX003, EXC001) always re-run — their facts cross file boundaries,
+  so caching them per file would be unsound — and the
+  pragma/suppression pass always runs fresh so SL001 accounting stays
+  exact.
+
+Storage: one JSON document at ``<root>/.simonlint_cache/cache.json``.
+A corrupt or version-skewed cache degrades to a cold run, never an
+error. ``--no-cache`` bypasses read AND write.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Optional
+
+CACHE_VERSION = 2
+
+_FINDING_KEYS = ("rel", "line", "rule", "message")
+
+
+def _tool_digest() -> str:
+    h = hashlib.sha256()
+    pkg = Path(__file__).resolve().parent
+    for p in sorted(pkg.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        h.update(str(p.relative_to(pkg)).encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def file_digest(path: Path) -> str:
+    return hashlib.sha256(Path(path).read_bytes()).hexdigest()
+
+
+class LintCache:
+    """One cache instance per lint invocation. ``stats`` is the
+    observable contract the tests pin: full_hits / file_hits /
+    file_misses."""
+
+    def __init__(self, root: Path, enabled: bool = True):
+        self.root = Path(root)
+        self.enabled = enabled
+        self.path = self.root / ".simonlint_cache" / "cache.json"
+        self.tool_digest = _tool_digest() if enabled else ""
+        self.stats = {"full_hits": 0, "file_hits": 0, "file_misses": 0}
+        self._doc = self._load() if enabled else {}
+        self._new_files: Dict[str, dict] = {}
+
+    # -- storage ------------------------------------------------------------
+
+    def _load(self) -> dict:
+        try:
+            doc = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return {}
+        if not isinstance(doc, dict):
+            return {}
+        if doc.get("version") != CACHE_VERSION:
+            return {}
+        if doc.get("tool_digest") != self.tool_digest:
+            return {}  # the linter itself changed: everything stale
+        return doc
+
+    def save(self) -> None:
+        if not self.enabled:
+            return
+        doc = {
+            "version": CACHE_VERSION,
+            "tool_digest": self.tool_digest,
+            "files": {**self._doc.get("files", {}), **self._new_files},
+            "full": self._doc.get("full"),
+        }
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(doc))
+            tmp.replace(self.path)
+        except OSError:
+            pass  # a read-only tree still lints, just never warm
+
+    # -- full-tree tier ------------------------------------------------------
+
+    def full_key(self, digests: Dict[str, str], rules_key: str) -> str:
+        h = hashlib.sha256()
+        h.update(self.tool_digest.encode())
+        h.update(rules_key.encode())
+        for rel in sorted(digests):
+            h.update(rel.encode())
+            h.update(digests[rel].encode())
+        return h.hexdigest()
+
+    def load_full(self, key: str) -> Optional[List[dict]]:
+        if not self.enabled:
+            return None
+        full = self._doc.get("full")
+        if isinstance(full, dict) and full.get("key") == key:
+            findings = full.get("findings")
+            if isinstance(findings, list):
+                self.stats["full_hits"] += 1
+                return findings
+        return None
+
+    def store_full(self, key: str, findings: List[dict]) -> None:
+        if self.enabled:
+            self._doc["full"] = {"key": key, "findings": findings}
+
+    # -- per-file tier -------------------------------------------------------
+
+    def load_file(self, rel: str, digest: str) -> Optional[List[dict]]:
+        if not self.enabled:
+            return None
+        entry = self._doc.get("files", {}).get(rel)
+        if isinstance(entry, dict) and entry.get("digest") == digest:
+            findings = entry.get("findings")
+            if isinstance(findings, list):
+                self.stats["file_hits"] += 1
+                return findings
+        self.stats["file_misses"] += 1
+        return None
+
+    def store_file(self, rel: str, digest: str, findings: List[dict]) -> None:
+        if self.enabled:
+            self._new_files[rel] = {"digest": digest, "findings": findings}

@@ -1,0 +1,432 @@
+"""Per-function control-flow graphs — the substrate of the dataflow
+rules (CONC002 / JAX003 / RT001).
+
+One ``CFG`` per function: basic blocks of ordered **events**, edges for
+branches, loop back-edges, exception paths, and ``finally`` chains.
+Events are deliberately coarser than expressions and finer than
+statements:
+
+- ``stmt``    — one simple statement (or the *header* expression of a
+  compound one: an ``if``/``while`` test, a ``for`` iterable). Rules
+  scan the event's executed expressions via ``event_exprs`` — nested
+  statement bodies are NOT part of the event (they have their own
+  blocks), and nested ``def`` bodies are skipped entirely.
+- ``acquire`` / ``release`` — a lock edge: ``with <lockish>:`` entry and
+  exit, or an explicit ``.acquire()`` / ``.release()`` call statement.
+  ``lock`` carries the canonical cross-module name (see
+  ``canonical_lock_name``); the with-protocol's release-on-unwind is
+  modeled (return / break / continue / raise inside a ``with`` emit the
+  release before the abnormal edge).
+- ``loop_head`` — the head of a ``while``/``for``; its block is the
+  join point of the entry edge and every back-edge, which is what lets
+  RT001 phrase "reaches the back-edge without a budget check" as a
+  plain forward dataflow fact.
+
+Exception flow is over-approximated the cheap way: every block created
+inside a ``try`` body gets an edge to each of that try's handlers
+(with the with-unwind releases for locks opened since the ``try``).
+``finally`` bodies are lowered once; normal and abnormal paths both
+route through them, and the finally exit conservatively reaches both
+the continuation and the function exit. All three dataflow clients are
+tolerant of this over-approximation by construction: CONC002 uses a
+may-analysis (union join), RT001's unchecked-path analysis only gains
+paths that also exist dynamically, and JAX003's kind lattice degrades
+to "unknown" on a bad join.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+def is_lockish(name: str) -> bool:
+    """Heuristic lock detector: the codebase's locks all carry "lock"
+    in the name (``_lock``, ``_breakers_lock``, ``_inflight_lock``,
+    ``_REGISTRY_LOCK``)."""
+    return "lock" in name.lower()
+
+
+def canonical_lock_name(sf, expr: ast.AST) -> Optional[str]:
+    """Cross-module canonical name of a lock expression, or None when
+    the expression is not lock-shaped.
+
+    - ``self._lock``          -> ``<module>.<Class>._lock``
+    - module-level ``_lock``  -> ``<module>._lock`` (through the import
+      alias map, so ``trace._lock`` in another file canonicalizes to
+      the defining module)
+    - ``mod_alias._lock``     -> ``<target module>._lock``
+    """
+    if isinstance(expr, ast.Attribute):
+        if not is_lockish(expr.attr):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            cls = sf.enclosing_class(expr)
+            mod = sf.module or sf.rel
+            if cls is not None:
+                return f"{mod}.{cls.name}.{expr.attr}"
+            return f"{mod}.{expr.attr}"
+        dotted = sf.dotted_call_name(expr)
+        return dotted or None
+    if isinstance(expr, ast.Name):
+        if not is_lockish(expr.id):
+            return None
+        target = sf.imports.get(expr.id)
+        if target:
+            return target
+        mod = sf.module or sf.rel
+        return f"{mod}.{expr.id}"
+    return None
+
+
+@dataclass
+class Event:
+    kind: str  # "stmt" | "acquire" | "release" | "loop_head"
+    node: ast.AST
+    lock: Optional[str] = None
+
+
+class Block:
+    __slots__ = ("bid", "events", "succs")
+
+    def __init__(self, bid: int):
+        self.bid = bid
+        self.events: List[Event] = []
+        self.succs: List["Block"] = []
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"B{self.bid}->{[s.bid for s in self.succs]}"
+
+
+@dataclass
+class LoopInfo:
+    head: Block
+    break_target: Block
+    #: blocks whose edge to `head` is a back-edge (fallthrough bottoms
+    #: and `continue` sites)
+    back_sources: List[Block] = field(default_factory=list)
+
+
+@dataclass
+class CFG:
+    fn: ast.AST
+    entry: Block
+    exit: Block
+    blocks: List[Block]
+    loops: Dict[ast.AST, LoopInfo]
+
+
+def event_exprs(ev: Event) -> List[ast.AST]:
+    """The AST subtrees that actually EXECUTE at this event (header
+    expressions for compound statements; the whole node for simple
+    ones). Nested statement bodies and nested ``def`` bodies are
+    excluded — they have their own events (or are separate CFGs)."""
+    node = ev.node
+    if ev.kind in ("acquire", "release"):
+        return [node]
+    if isinstance(node, (ast.If, ast.While)):
+        return [node.test]
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        return [node.iter, node.target]
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in node.items]
+    if isinstance(node, ast.Try):
+        return []
+    if isinstance(node, _FUNC_NODES + (ast.ClassDef,)):
+        # decorators/defaults run here; the body does not
+        out: List[ast.AST] = list(node.decorator_list)
+        if isinstance(node, _FUNC_NODES):
+            out.extend(d for d in node.args.defaults)
+            out.extend(d for d in node.args.kw_defaults if d is not None)
+        return out
+    if isinstance(node, ast.Return):
+        return [node.value] if node.value is not None else []
+    if isinstance(node, ast.Match):
+        return [node.subject]
+    return [node]
+
+
+def iter_event_calls(ev: Event):
+    """Every Call node executing at this event (nested defs excluded —
+    ``event_exprs`` never yields a def body)."""
+    for expr in event_exprs(ev):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+
+class _Builder:
+    def __init__(self, sf, fn_node: ast.AST):
+        self.sf = sf
+        self.fn = fn_node
+        self.blocks: List[Block] = []
+        self.exit = self._raw_block()
+        self.loops: Dict[ast.AST, LoopInfo] = {}
+        #: (loop_node, head, break_target, with_depth)
+        self.loop_stack: List[tuple] = []
+        #: canonical lock names of lexically-open `with` items (None for
+        #: non-lock withs)
+        self.with_stack: List[Optional[str]] = []
+        #: (handler_entry_blocks, with_depth, finally_entry|None)
+        self.handler_stack: List[tuple] = []
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _raw_block(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def new_block(self) -> Block:
+        """A block plus the conservative exception edge to the
+        innermost enclosing try's handlers/finally (with with-unwind
+        releases for locks opened since that try)."""
+        b = self._raw_block()
+        if self.handler_stack:
+            entries, depth, fin = self.handler_stack[-1]
+            unwind = self._unwind_block(depth)
+            src = b
+            if unwind is not None:
+                b.succs.append(unwind)
+                src = unwind
+            for h in entries:
+                src.succs.append(h)
+            if not entries and fin is not None:
+                src.succs.append(fin)
+        return b
+
+    def _unwind_block(self, to_depth: int) -> Optional[Block]:
+        """Synthetic block releasing every with-held lock above
+        `to_depth` (None when there is nothing to release)."""
+        locks = [l for l in self.with_stack[to_depth:] if l is not None]
+        if not locks:
+            return None
+        u = self._raw_block()
+        for lock in reversed(locks):
+            u.events.append(Event("release", self.fn, lock))
+        return u
+
+    def _abnormal_edge(self, cur: Block, target: Block, to_depth: int):
+        """Route an abnormal exit (return/break/continue) to `target`,
+        releasing with-held locks above `to_depth` on the way."""
+        unwind = self._unwind_block(to_depth)
+        if unwind is not None:
+            cur.succs.append(unwind)
+            unwind.succs.append(target)
+            return unwind
+        cur.succs.append(target)
+        return cur
+
+    def _innermost_finally(self) -> Optional[Block]:
+        for entries, _depth, fin in reversed(self.handler_stack):
+            if fin is not None:
+                return fin
+        return None
+
+    # -- lowering -----------------------------------------------------------
+
+    def build(self) -> CFG:
+        entry = self.new_block()
+        end = self.lower_body(list(self.fn.body), entry)
+        if end is not None:
+            end.succs.append(self.exit)
+        return CFG(self.fn, entry, self.exit, self.blocks, self.loops)
+
+    def lower_body(self, body: List[ast.stmt], cur: Block) -> Optional[Block]:
+        for stmt in body:
+            if cur is None:
+                break  # unreachable tail (after return/raise)
+            cur = self.lower_stmt(stmt, cur)
+        return cur
+
+    def lower_stmt(self, stmt: ast.stmt, cur: Block) -> Optional[Block]:
+        if isinstance(stmt, ast.If):
+            return self._lower_if(stmt, cur)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._lower_loop(stmt, cur)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._lower_with(stmt, cur)
+        if isinstance(stmt, ast.Try):
+            return self._lower_try(stmt, cur)
+        if isinstance(stmt, ast.Match):
+            return self._lower_match(stmt, cur)
+        if isinstance(stmt, ast.Return):
+            cur.events.append(Event("stmt", stmt))
+            target = self._innermost_finally() or self.exit
+            self._abnormal_edge(cur, target, 0)
+            return None
+        if isinstance(stmt, ast.Raise):
+            cur.events.append(Event("stmt", stmt))
+            # the handler edge exists from block creation; add the
+            # uncaught path (through finally when present)
+            target = self._innermost_finally() or self.exit
+            self._abnormal_edge(cur, target, 0)
+            return None
+        if isinstance(stmt, ast.Break):
+            if self.loop_stack:
+                _node, _head, brk, depth = self.loop_stack[-1]
+                self._abnormal_edge(cur, brk, depth)
+            else:  # pragma: no cover - syntactically invalid input
+                cur.succs.append(self.exit)
+            return None
+        if isinstance(stmt, ast.Continue):
+            if self.loop_stack:
+                node, head, _brk, depth = self.loop_stack[-1]
+                src = self._abnormal_edge(cur, head, depth)
+                self.loops[node].back_sources.append(src)
+            else:  # pragma: no cover - syntactically invalid input
+                cur.succs.append(self.exit)
+            return None
+        # acquire()/release() call statements become lock events
+        lock_ev = self._lock_call_event(stmt)
+        if lock_ev is not None:
+            cur.events.append(lock_ev)
+            return cur
+        cur.events.append(Event("stmt", stmt))
+        return cur
+
+    def _lock_call_event(self, stmt: ast.stmt) -> Optional[Event]:
+        if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+            return None
+        call = stmt.value
+        if not (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("acquire", "release")
+        ):
+            return None
+        lock = canonical_lock_name(self.sf, call.func.value)
+        if lock is None:
+            return None
+        return Event(call.func.attr, stmt, lock)
+
+    def _lower_if(self, stmt: ast.If, cur: Block) -> Optional[Block]:
+        cur.events.append(Event("stmt", stmt))  # test evaluation
+        then_entry = self.new_block()
+        cur.succs.append(then_entry)
+        then_end = self.lower_body(stmt.body, then_entry)
+        if stmt.orelse:
+            else_entry = self.new_block()
+            cur.succs.append(else_entry)
+            else_end = self.lower_body(stmt.orelse, else_entry)
+        else:
+            else_end = cur
+        if then_end is None and else_end is None:
+            return None
+        join = self.new_block()
+        for end in (then_end, else_end):
+            if end is not None:
+                end.succs.append(join)
+        return join
+
+    def _lower_loop(self, stmt, cur: Block) -> Block:
+        head = self.new_block()
+        cur.succs.append(head)
+        head.events.append(Event("loop_head", stmt))
+        after = self.new_block()  # break target / loop exit join
+        info = LoopInfo(head, after)
+        self.loops[stmt] = info
+        if stmt.orelse:
+            else_entry = self.new_block()
+            head.succs.append(else_entry)
+            else_end = self.lower_body(stmt.orelse, else_entry)
+            if else_end is not None:
+                else_end.succs.append(after)
+        else:
+            head.succs.append(after)
+        body_entry = self.new_block()
+        head.succs.append(body_entry)
+        self.loop_stack.append((stmt, head, after, len(self.with_stack)))
+        body_end = self.lower_body(stmt.body, body_entry)
+        self.loop_stack.pop()
+        if body_end is not None:
+            body_end.succs.append(head)
+            info.back_sources.append(body_end)
+        return after
+
+    def _lower_with(self, stmt, cur: Block) -> Optional[Block]:
+        cur.events.append(Event("stmt", stmt))  # context expr evaluation
+        opened = 0
+        for item in stmt.items:
+            lock = canonical_lock_name(self.sf, item.context_expr)
+            self.with_stack.append(lock)
+            opened += 1
+            if lock is not None:
+                cur.events.append(Event("acquire", item.context_expr, lock))
+        end = self.lower_body(stmt.body, cur)
+        for _ in range(opened):
+            lock = self.with_stack.pop()
+            if lock is not None and end is not None:
+                end.events.append(Event("release", stmt, lock))
+        return end
+
+    def _lower_try(self, stmt: ast.Try, cur: Block) -> Optional[Block]:
+        cur.events.append(Event("stmt", stmt))
+        fin_entry = fin_end = None
+        if stmt.finalbody:
+            fin_entry = self._raw_block()  # no self-exception edges
+            fin_end = self.lower_body(stmt.finalbody, fin_entry)
+        handler_entries = [self.new_block() for _ in stmt.handlers]
+        self.handler_stack.append(
+            (handler_entries, len(self.with_stack), fin_entry)
+        )
+        body_entry = self.new_block()
+        cur.succs.append(body_entry)
+        body_end = self.lower_body(stmt.body, body_entry)
+        if body_end is not None and stmt.orelse:
+            body_end = self.lower_body(stmt.orelse, body_end)
+        self.handler_stack.pop()
+        ends = [body_end]
+        for handler, entry in zip(stmt.handlers, handler_entries):
+            entry.events.append(Event("stmt", handler.type or handler))
+            ends.append(self.lower_body(handler.body, entry))
+        live = [e for e in ends if e is not None]
+        if fin_entry is not None:
+            for e in live:
+                e.succs.append(fin_entry)
+            if fin_end is None:
+                return None
+            # abnormal paths resume past the finally conservatively
+            fin_end.succs.append(self.exit)
+            if not live:
+                return None
+            join = self.new_block()
+            fin_end.succs.append(join)
+            return join
+        if not live:
+            return None
+        join = self.new_block()
+        for e in live:
+            e.succs.append(join)
+        return join
+
+    def _lower_match(self, stmt: ast.Match, cur: Block) -> Optional[Block]:
+        cur.events.append(Event("stmt", stmt))
+        join = self.new_block()
+        any_live = False
+        for case in stmt.cases:
+            entry = self.new_block()
+            cur.succs.append(entry)
+            end = self.lower_body(case.body, entry)
+            if end is not None:
+                end.succs.append(join)
+                any_live = True
+        cur.succs.append(join)  # no case matched
+        return join if (any_live or stmt.cases is not None) else None
+
+
+def build_cfg(sf, fn_node: ast.AST) -> CFG:
+    """CFG of one FunctionDef (nested defs are NOT inlined — build
+    their own CFGs; their bodies run when called, not here)."""
+    return _Builder(sf, fn_node).build()
+
+
+def iter_function_defs(sf):
+    """Every function/method (incl. nested) in a parsed file."""
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if isinstance(node, _FUNC_NODES):
+            yield node

@@ -1,0 +1,269 @@
+"""Forward abstract interpretation over ``cfg.CFG``.
+
+One generic worklist solver (``forward``) plus the three concrete
+lattices the rules instantiate:
+
+- **lock-held sets** (``LockAnalysis``): may-analysis over frozensets
+  of canonical lock names; join = union. Feeds CONC002's
+  blocking-while-locked check, the cross-function lock-order edge
+  collection, and the self-deadlock check.
+- **checked-since-loop-head** (``loop_unchecked_sources``): per-loop
+  may-analysis of "this path has NOT consulted the budget since the
+  loop head"; join = unchecked-dominates. A back-edge source that can
+  be unchecked is an RT001 finding.
+- **abstract value kinds** (``KindAnalysis``): variables mapped into
+  the tiny lattice {JAX, NP, PYFLOAT} (absent = unknown); join drops
+  disagreeing entries to unknown. Feeds JAX003's transfer/dtype
+  checks.
+
+All lattices are finite, so the fixpoint terminates; a generous
+iteration bound guards against a builder bug turning into a hang.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from .cfg import CFG, Block, Event, event_exprs, iter_event_calls
+
+
+def forward(
+    cfg: CFG,
+    init,
+    transfer: Callable,
+    join: Callable,
+) -> Dict[int, object]:
+    """Solve a forward dataflow problem; returns block-id -> state at
+    block ENTRY. ``transfer(state, event) -> state`` must be pure;
+    ``join(a, b)`` must be commutative/associative/idempotent."""
+    entry_states: Dict[int, object] = {cfg.entry.bid: init}
+    worklist: List[Block] = [cfg.entry]
+    budget = max(64, len(cfg.blocks) * 64)
+    while worklist and budget > 0:
+        budget -= 1
+        block = worklist.pop()
+        state = entry_states[block.bid]
+        for ev in block.events:
+            state = transfer(state, ev)
+        for succ in block.succs:
+            if succ.bid not in entry_states:
+                entry_states[succ.bid] = state
+                worklist.append(succ)
+            else:
+                merged = join(entry_states[succ.bid], state)
+                if merged != entry_states[succ.bid]:
+                    entry_states[succ.bid] = merged
+                    worklist.append(succ)
+    return entry_states
+
+
+def iter_event_states(
+    cfg: CFG, entry_states: Dict[int, object], transfer: Callable
+) -> Iterator[Tuple[Block, Event, object]]:
+    """Replay the transfer over each reachable block, yielding
+    (block, event, state-BEFORE-event) — the reporting pass every
+    analysis shares after the fixpoint converges."""
+    for block in cfg.blocks:
+        if block.bid not in entry_states:
+            continue  # unreachable
+        state = entry_states[block.bid]
+        for ev in block.events:
+            yield block, ev, state
+            state = transfer(state, ev)
+
+
+def exit_state(
+    cfg: CFG, entry_states: Dict[int, object], transfer: Callable, block: Block
+):
+    """State at the END of `block` (after all its events)."""
+    state = entry_states[block.bid]
+    for ev in block.events:
+        state = transfer(state, ev)
+    return state
+
+
+# ------------------------------------------------------------------ locks
+
+
+class LockAnalysis:
+    """May-held lock sets: state = frozenset of canonical lock names."""
+
+    init: frozenset = frozenset()
+
+    @staticmethod
+    def transfer(state: frozenset, ev: Event) -> frozenset:
+        if ev.kind == "acquire":
+            return state | {ev.lock}
+        if ev.kind == "release":
+            return state - {ev.lock}
+        return state
+
+    @staticmethod
+    def join(a: frozenset, b: frozenset) -> frozenset:
+        return a | b
+
+    @classmethod
+    def solve(cls, cfg: CFG) -> Dict[int, frozenset]:
+        return forward(cfg, cls.init, cls.transfer, cls.join)
+
+
+# ------------------------------------------------------- budget discipline
+
+
+def loop_unchecked_sources(
+    cfg: CFG,
+    loop_node: ast.AST,
+    consults: Callable[[Event], bool],
+) -> List[Block]:
+    """Back-edge source blocks of `loop_node` that some path reaches
+    WITHOUT a budget consult since the loop head.
+
+    State: "unchecked" / "checked" (plus the implicit bottom of an
+    unreachable block). The loop head RESETS to unchecked (each
+    iteration must re-consult); `consults(event)` promotes to checked;
+    join lets unchecked dominate — exactly "exists a consult-free
+    path"."""
+    info = cfg.loops[loop_node]
+
+    def transfer(state: str, ev: Event) -> str:
+        if ev.kind == "loop_head" and ev.node is loop_node:
+            state = "unchecked"
+        if consults(ev):
+            return "checked"
+        return state
+
+    def join(a: str, b: str) -> str:
+        return "unchecked" if "unchecked" in (a, b) else "checked"
+
+    entry_states = forward(cfg, "checked", transfer, join)
+    out = []
+    for src in info.back_sources:
+        if src.bid not in entry_states:
+            continue  # unreachable back edge
+        if exit_state(cfg, entry_states, transfer, src) == "unchecked":
+            out.append(src)
+    return out
+
+
+# ------------------------------------------------------------ value kinds
+
+JAX = "jax"
+NP = "np"
+PYFLOAT = "pyfloat"
+
+#: dotted-prefix -> kind for call results (alias-normalized names)
+_CALL_KIND_PREFIXES = (
+    ("jax.numpy.", JAX),
+    ("jax.", JAX),
+    ("numpy.", NP),
+)
+
+
+class KindAnalysis:
+    """Variable -> abstract value kind. State is a dict-as-frozenset of
+    (name, kind) pairs; absent = unknown. Join intersects (a variable
+    keeps its kind only when every path agrees)."""
+
+    def __init__(self, sf, seed: Optional[Dict[str, str]] = None):
+        self.sf = sf
+        self.init = frozenset((seed or {}).items())
+
+    # -- expression kind ----------------------------------------------------
+
+    def expr_kind(self, state: frozenset, expr: ast.AST) -> Optional[str]:
+        env = dict(state)
+        return self._kind(env, expr)
+
+    def _kind(self, env: Dict[str, str], expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, float):
+                return PYFLOAT
+            return None
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id)
+        if isinstance(expr, ast.Call):
+            dotted = self.sf.dotted_call_name(expr.func)
+            for prefix, kind in _CALL_KIND_PREFIXES:
+                if dotted.startswith(prefix):
+                    return kind
+            return None
+        if isinstance(expr, ast.BinOp):
+            lk = self._kind(env, expr.left)
+            rk = self._kind(env, expr.right)
+            if JAX in (lk, rk):
+                return JAX
+            if NP in (lk, rk):
+                return NP
+            if lk == rk:
+                return lk
+            return None
+        if isinstance(expr, ast.Attribute):
+            # np-array methods that preserve kind (x.astype, x.sum ...)
+            return None
+        return None
+
+    # -- dataflow -----------------------------------------------------------
+
+    def transfer(self, state: frozenset, ev: Event) -> frozenset:
+        node = ev.node
+        if ev.kind != "stmt" or not isinstance(
+            node, (ast.Assign, ast.AnnAssign, ast.AugAssign)
+        ):
+            return state
+        env = dict(state)
+        value = node.value
+        if value is None:  # bare annotation
+            return state
+        kind = self._kind(env, value)
+        if isinstance(node, ast.AugAssign):
+            # `acc += rhs` reads acc too: combine with the target's
+            # current kind exactly like a BinOp (array kinds dominate a
+            # scalar RHS), instead of letting the RHS overwrite it
+            target_kind = (
+                env.get(node.target.id)
+                if isinstance(node.target, ast.Name)
+                else None
+            )
+            if JAX in (kind, target_kind):
+                kind = JAX
+            elif NP in (kind, target_kind):
+                kind = NP
+            elif kind != target_kind:
+                kind = None
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if kind is None:
+                    env.pop(t.id, None)
+                else:
+                    env[t.id] = kind
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    if isinstance(elt, ast.Name):
+                        env.pop(elt.id, None)
+        return frozenset(env.items())
+
+    @staticmethod
+    def join(a: frozenset, b: frozenset) -> frozenset:
+        return a & b
+
+    def solve(self, cfg: CFG) -> Dict[int, frozenset]:
+        return forward(cfg, self.init, self.transfer, self.join)
+
+
+__all__ = [
+    "forward",
+    "iter_event_states",
+    "exit_state",
+    "LockAnalysis",
+    "loop_unchecked_sources",
+    "KindAnalysis",
+    "JAX",
+    "NP",
+    "PYFLOAT",
+    "event_exprs",
+    "iter_event_calls",
+]

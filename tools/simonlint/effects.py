@@ -1,0 +1,392 @@
+"""Callee effect summaries — one-level interprocedural facts.
+
+For every function the project index can see, a ``Summary`` of its
+DIRECT effects (no transitive closure — facts propagate exactly one
+call level, which bounds both cost and wrongness):
+
+- ``locks``: canonical lock names it acquires (``with`` or
+  ``.acquire()``),
+- ``blocking``: labels of blocking operations it performs (fsync,
+  sleep, sockets/HTTP, subprocess, journal appends, jit dispatches),
+- ``consults_budget``: whether it calls ``Budget.check`` /
+  ``.expired()`` / ``.remaining()`` on a budget-shaped receiver,
+- ``raises``: alias-normalized dotted names of exceptions it raises.
+
+``Effects.for_call`` resolves a Call node to its callee summary through
+the shared ``callgraph.Resolver`` plus one extra step the resolver
+does not do: methods invoked on MODULE-LEVEL SINGLETONS
+(``COUNTERS.inc`` -> ``utils.trace.Counters.inc``), which is how the
+serve/obs lock-order edges become visible.
+
+Also here because every whole-program rule needs it: the project class
+hierarchy (``class_index`` / ``taxonomy_classes``) that EXC001 uses to
+decide whether a raised class is rooted in the runtime error taxonomy.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .callgraph import Resolver
+from .cfg import canonical_lock_name, is_lockish
+from .project import ProjectIndex, SourceFile
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# ------------------------------------------------------------- blocking ops
+
+#: alias-normalized dotted names that block on I/O or the device
+BLOCKING_CALLS = {
+    "os.fsync": "os.fsync",
+    "os.fdatasync": "os.fdatasync",
+    "time.sleep": "time.sleep",
+    "urllib.request.urlopen": "urlopen",
+    "socket.create_connection": "socket connect",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "jax.block_until_ready": "device sync",
+    "jax.device_get": "device transfer",
+    "jax.device_put": "device transfer",
+}
+
+#: method name -> (receiver-substring requirement, label); receiver
+#: substring "" matches any receiver
+BLOCKING_METHODS = {
+    "fsync": ("", "fsync"),
+    "append": ("journal", "Journal.append (fsync'd)"),
+    "wait": ("", "blocking wait"),
+    "block_until_ready": ("", "device sync"),
+}
+
+
+def _receiver_text(func: ast.Attribute) -> str:
+    parts = []
+    node = func.value
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
+
+def blocking_label(sf: SourceFile, call: ast.Call, jit_names: Set[str]) -> Optional[str]:
+    """Label when this call is a known blocking operation (None
+    otherwise). `jit_names` are the module-qualified names of known
+    module-level jit wrappers (dispatching one is a device round-trip
+    the caller should not take under a lock)."""
+    dotted = sf.dotted_call_name(call.func)
+    if dotted in BLOCKING_CALLS:
+        return BLOCKING_CALLS[dotted]
+    if dotted and dotted in jit_names:
+        return f"jit dispatch ({dotted.rsplit('.', 1)[-1]})"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        hit = BLOCKING_METHODS.get(attr)
+        if hit is not None:
+            needle, label = hit
+            recv = _receiver_text(call.func)
+            if needle in recv:
+                return label
+        # instance-cached jits: self._many_jit(...), cls._scan_jit(...)
+        if attr.endswith("_jit"):
+            return f"jit dispatch ({attr})"
+    elif isinstance(call.func, ast.Name) and call.func.id.endswith("_jit"):
+        return f"jit dispatch ({call.func.id})"
+    return None
+
+
+# --------------------------------------------------------- budget consults
+
+_BUDGET_CONSULT_METHODS = {"check", "expired", "remaining"}
+
+
+def _budgetish(expr: ast.AST) -> bool:
+    """Does this receiver expression look like a Budget? (`budget`,
+    `self._budget`, `req.budget`, `deadline_budget`, ...)"""
+    name = None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    return name is not None and "budget" in name.lower()
+
+
+def is_budget_consult(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _BUDGET_CONSULT_METHODS
+        and _budgetish(call.func.value)
+    )
+
+
+def mentions_budget(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)) and _budgetish(sub):
+            return True
+    return False
+
+
+# ----------------------------------------------------------------- summary
+
+
+@dataclass
+class Summary:
+    locks: FrozenSet[str] = frozenset()
+    blocking: Tuple[str, ...] = ()
+    consults_budget: bool = False
+    raises: FrozenSet[str] = frozenset()
+
+
+class Effects:
+    """Per-project effect summaries + the class hierarchy. Build once
+    per lint invocation via ``get_effects(project)``."""
+
+    def __init__(self, project: ProjectIndex):
+        self.project = project
+        self.resolver = Resolver(project)
+        self.jit_names = self._module_jit_names()
+        #: (rel, fn lineno) -> Summary of DIRECT effects
+        self._direct: Dict[Tuple[str, int], Summary] = {}
+        self._singletons = self._module_singletons()
+        self.class_bases = self._class_index()
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, _FUNC_NODES):
+                    self._direct[(sf.rel, node.lineno)] = self._summarize(
+                        sf, node
+                    )
+
+    # -- module-level discovery --------------------------------------------
+
+    def _module_jit_names(self) -> Set[str]:
+        """Module-qualified names bound at module level to a jit
+        wrapper (``NAME = jax.jit(...)`` or ``NAME =
+        wrap(jax.jit(...))``) — calling one is a device dispatch."""
+        out: Set[str] = set()
+        for sf in self.project.files:
+            if sf.tree is None or sf.module is None:
+                continue
+            for stmt in sf.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if not self._wraps_jit(sf, stmt.value):
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(f"{sf.module}.{t.id}")
+                        out.add(t.id)
+        return out
+
+    def _wraps_jit(self, sf: SourceFile, expr: ast.AST, depth: int = 0) -> bool:
+        if depth > 3 or not isinstance(expr, ast.Call):
+            return False
+        if sf.dotted_call_name(expr.func) == "jax.jit":
+            return True
+        return any(
+            self._wraps_jit(sf, a, depth + 1) for a in expr.args
+        )
+
+    def _module_singletons(self) -> Dict[str, Tuple[str, str]]:
+        """module-qualified instance name -> (module, ClassName) for
+        module-level ``NAME = ClassName(...)`` assignments whose class
+        is defined in the same module."""
+        out: Dict[str, Tuple[str, str]] = {}
+        for sf in self.project.files:
+            if sf.tree is None or sf.module is None:
+                continue
+            classes = {
+                n.name for n in sf.tree.body if isinstance(n, ast.ClassDef)
+            }
+            for stmt in sf.tree.body:
+                if not (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Name)
+                    and stmt.value.func.id in classes
+                ):
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out[f"{sf.module}.{t.id}"] = (
+                            sf.module,
+                            stmt.value.func.id,
+                        )
+        return out
+
+    # -- class hierarchy (EXC001) ------------------------------------------
+
+    def _class_index(self) -> Dict[str, List[str]]:
+        """dotted class name -> alias-normalized base names."""
+        out: Dict[str, List[str]] = {}
+        for sf in self.project.files:
+            if sf.tree is None:
+                continue
+            mod = sf.module or sf.rel
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = []
+                for b in node.bases:
+                    dotted = sf.dotted_call_name(b)
+                    if dotted:
+                        bases.append(dotted)
+                out[f"{mod}.{node.name}"] = bases
+        return out
+
+    def taxonomy_classes(self, root_names: Set[str]) -> Set[str]:
+        """Dotted names of classes transitively rooted in a class whose
+        BARE name is in `root_names` (bare-name matching keeps fixture
+        trees exercisable without replicating the package layout)."""
+        roots = {
+            dotted
+            for dotted in self.class_bases
+            if dotted.rsplit(".", 1)[-1] in root_names
+        }
+        taxo = set(roots)
+        changed = True
+        while changed:
+            changed = False
+            for dotted, bases in self.class_bases.items():
+                if dotted in taxo:
+                    continue
+                for b in bases:
+                    base_leaf = b.rsplit(".", 1)[-1]
+                    if (
+                        b in taxo
+                        or base_leaf in root_names
+                        or any(t.endswith("." + base_leaf) or t == base_leaf
+                               for t in taxo)
+                    ):
+                        taxo.add(dotted)
+                        changed = True
+                        break
+        return taxo
+
+    # -- summaries ----------------------------------------------------------
+
+    def _summarize(self, sf: SourceFile, fn: ast.AST) -> Summary:
+        locks: Set[str] = set()
+        blocking: List[str] = []
+        consults = False
+        raises: Set[str] = set()
+        for node in self._own_nodes(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = canonical_lock_name(sf, item.context_expr)
+                    if lock is not None:
+                        locks.add(lock)
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    lock = canonical_lock_name(sf, node.func.value)
+                    if lock is not None:
+                        locks.add(lock)
+                label = blocking_label(sf, node, self.jit_names)
+                if label is not None and label not in blocking:
+                    blocking.append(label)
+                if is_budget_consult(node):
+                    consults = True
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                cls_expr = exc.func if isinstance(exc, ast.Call) else exc
+                dotted = sf.dotted_call_name(cls_expr)
+                if dotted:
+                    raises.add(dotted)
+        return Summary(
+            locks=frozenset(locks),
+            blocking=tuple(blocking),
+            consults_budget=consults,
+            raises=frozenset(raises),
+        )
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST):
+        """Walk a function body EXCLUDING nested def/class bodies (they
+        execute when called, not when this function runs)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, _FUNC_NODES + (ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- lookup -------------------------------------------------------------
+
+    def blocking_label_for(self, sf: SourceFile, call: ast.Call) -> Optional[str]:
+        return blocking_label(sf, call, self.jit_names)
+
+    def direct(self, sf: SourceFile, fn: ast.AST) -> Summary:
+        return self._direct.get((sf.rel, getattr(fn, "lineno", 0)), Summary())
+
+    def for_call(self, sf: SourceFile, call: ast.Call) -> Optional[Summary]:
+        """Summary of the function this call invokes, when resolvable
+        (one level: the callee's DIRECT effects only)."""
+        hit = self.resolver.resolve_call(sf, call)
+        if hit is None:
+            hit = self._resolve_singleton_method(sf, call)
+        if hit is None:
+            return None
+        callee_sf, callee = hit
+        return self.direct(callee_sf, callee)
+
+    def _resolve_singleton_method(
+        self, sf: SourceFile, call: ast.Call
+    ) -> Optional[Tuple[SourceFile, ast.AST]]:
+        """``COUNTERS.inc(...)`` -> Counters.inc in utils/trace.py: an
+        attribute call on an imported module-level singleton."""
+        func = call.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+        ):
+            return None
+        dotted = sf.imports.get(func.value.id)
+        if dotted is None and sf.module is not None:
+            dotted = f"{sf.module}.{func.value.id}"
+        if dotted is None:
+            return None
+        hit = self._singletons.get(dotted)
+        if hit is None:
+            return None
+        mod, cls_name = hit
+        target_sf = self.project.by_module.get(mod)
+        if target_sf is None or target_sf.tree is None:
+            return None
+        for node in target_sf.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                for meth in node.body:
+                    if isinstance(meth, _FUNC_NODES) and meth.name == func.attr:
+                        return target_sf, meth
+        return None
+
+
+def get_effects(project: ProjectIndex) -> Effects:
+    """Per-invocation cached Effects (the index is immutable for the
+    lifetime of one lint run)."""
+    eff = getattr(project, "_simonlint_effects", None)
+    if eff is None:
+        eff = Effects(project)
+        project._simonlint_effects = eff
+    return eff
+
+
+__all__ = [
+    "Effects",
+    "Summary",
+    "get_effects",
+    "blocking_label",
+    "is_budget_consult",
+    "mentions_budget",
+    "is_lockish",
+]

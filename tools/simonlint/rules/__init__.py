@@ -2,6 +2,10 @@
 
 from . import basic  # noqa: F401
 from . import concurrency  # noqa: F401
+from . import deadline  # noqa: F401
+from . import exceptions  # noqa: F401
 from . import hygiene  # noqa: F401
 from . import jax_compile  # noqa: F401
+from . import jax_dtype  # noqa: F401
 from . import jax_trace  # noqa: F401
+from . import lock_order  # noqa: F401

@@ -1,0 +1,160 @@
+"""RT001 — deadline discipline: budget-scoped ``while`` loops must
+consult the Budget on EVERY path through an iteration.
+
+The runtime contract (runtime/budget.py, docs/ROBUSTNESS.md): every
+long loop in a guarded subsystem — probe search, chaos chunks, N+K
+escalation, the serve dispatcher, the shadow tailer — calls
+``budget.check(<boundary>)`` between units of work, so ``--deadline``
+and SIGINT stop the run at a safe boundary instead of minutes later.
+The bug class is the loop that checks on ONE branch (or not at all):
+a retry path or escalation arm that keeps dispatching device scans
+long after the deadline expired.
+
+Mechanics: a function is **budget-scoped** when it mentions a
+budget-shaped name (``budget``, ``self._budget``, ``req.budget``) or
+calls a resolvable callee whose one-level summary consults a budget.
+In each budget-scoped function, every ``while`` loop runs the
+"checked-since-loop-head" dataflow (dataflow.loop_unchecked_sources):
+the loop head resets to unchecked, consult events promote to checked,
+and any back-edge source still reachable as unchecked is a finding.
+
+What counts as a consult:
+
+- ``<budgetish>.check/expired/remaining(...)`` anywhere in the event;
+- an ``if``/``while`` test that MENTIONS the budget and whose body
+  contains a consult (the ``if budget is not None: budget.check(...)``
+  idiom: the no-budget branch is vacuously checked — there is nothing
+  to consult);
+- a call to a resolvable first-party callee whose summary consults
+  (the loop may delegate its boundary to a helper).
+
+``for`` loops are exempt (bounded iteration over materialized work —
+the chunking helpers own their boundaries); so are functions with no
+budget in reach (nothing to consult). Audited escapes use a
+usage-checked ``# simonlint: disable=RT001`` pragma or
+allowlists.RT001_ALLOW.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .. import allowlists
+from ..cfg import build_cfg, iter_event_calls, iter_function_defs
+from ..core import Finding, Rule, register
+from ..dataflow import loop_unchecked_sources
+from ..effects import get_effects, is_budget_consult, mentions_budget
+from ..project import ProjectIndex
+
+
+@register
+class DeadlineDiscipline(Rule):
+    id = "RT001"
+    title = "budget-scoped while loop missing a deadline check on a path"
+    rationale = (
+        "a loop that only checks the Budget on one branch keeps "
+        "dispatching work after the deadline expired — every iteration "
+        "path needs a safe boundary"
+    )
+    scope = "project"
+
+    def check_project(self, project: ProjectIndex) -> Iterable[Finding]:
+        effects = get_effects(project)
+        findings: List[Finding] = []
+        for sf in project.files:
+            if sf.tree is None or not sf.is_runtime_scope:
+                continue
+            for fn in iter_function_defs(sf):
+                if (sf.rel, fn.name) in allowlists.RT001_ALLOW:
+                    continue
+                # cheap gates first: a function with no while loop has
+                # nothing to check, and one without a budget in reach
+                # has nothing to check WITH — the call-resolution pass
+                # only runs for the few loop-bearing candidates
+                own = list(effects._own_nodes(fn))
+                if not any(isinstance(n, ast.While) for n in own):
+                    continue
+                if not self._budget_scoped(sf, own, effects):
+                    continue
+                self._check_function(sf, fn, effects, findings)
+        return findings
+
+    # -- scoping ------------------------------------------------------------
+
+    def _budget_scoped(self, sf, own, effects) -> bool:
+        from ..effects import _budgetish
+
+        for node in own:
+            if isinstance(node, (ast.Name, ast.Attribute)) and _budgetish(
+                node
+            ):
+                return True
+            # a `budget` PARAMETER alone puts the function in scope —
+            # an unused one is exactly the bug (it was passed to be
+            # consulted)
+            if isinstance(node, ast.arg) and "budget" in node.arg.lower():
+                return True
+        for node in own:
+            if isinstance(node, ast.Call):
+                summary = effects.for_call(sf, node)
+                if summary is not None and summary.consults_budget:
+                    return True
+        return False
+
+    # -- the per-loop dataflow ----------------------------------------------
+
+    def _check_function(self, sf, fn, effects, findings) -> None:
+        cfg = build_cfg(sf, fn)
+        whiles = [n for n in cfg.loops if isinstance(n, ast.While)]
+        if not whiles:
+            return
+
+        def consults(ev) -> bool:
+            return self._event_consults(sf, ev, effects)
+
+        for loop in whiles:
+            unchecked = loop_unchecked_sources(cfg, loop, consults)
+            if not unchecked:
+                continue
+            findings.append(
+                Finding(
+                    sf.path,
+                    sf.rel,
+                    loop.lineno,
+                    self.id,
+                    f"while loop in '{fn.name}' can complete an iteration "
+                    "without consulting the Budget — add a "
+                    "budget.check(<boundary>) reachable on every path "
+                    "through the loop body (runtime/budget.py contract; "
+                    "audited exceptions: `# simonlint: disable=RT001`)",
+                )
+            )
+
+    def _event_consults(self, sf, ev, effects) -> bool:
+        node = ev.node
+        # guard idiom: a branch/loop test that mentions the budget and
+        # whose body contains a consult — the budget-less arm is vacuous
+        if (
+            isinstance(node, (ast.If, ast.While))
+            and mentions_budget(node.test)
+            and self._subtree_consults(sf, node, effects)
+        ):
+            return True
+        for call in iter_event_calls(ev):
+            if is_budget_consult(call):
+                return True
+            summary = effects.for_call(sf, call)
+            if summary is not None and summary.consults_budget:
+                return True
+        return False
+
+    def _subtree_consults(self, sf, node, effects) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                if is_budget_consult(sub):
+                    return True
+                summary = effects.for_call(sf, sub)
+                if summary is not None and summary.consults_budget:
+                    return True
+        return False

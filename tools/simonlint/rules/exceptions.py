@@ -1,0 +1,163 @@
+"""EXC001 — error-taxonomy enforcement at ``raise`` sites.
+
+The CLI exit-code contract (runtime/errors.py, docs/ROBUSTNESS.md)
+only works if every way a plan can die maps to a typed error the
+handlers can route: GuardError subclasses for execution failures,
+InputError (a ValueError) for bad inputs, each with its exit code. A
+stray ``raise RuntimeError(...)`` bypasses the whole taxonomy — it
+renders as a traceback instead of a typed report, and callers cannot
+catch it without catching everything.
+
+Accepted at a ``raise`` site (runtime scope only):
+
+- a first-party class transitively rooted in **GuardError** or
+  **InputError** (bare-name roots, so fixture trees can define their
+  own); the hierarchy comes from effects.Effects.class_bases;
+- bare ``raise`` and ``raise <variable>`` (re-raise of a caught or
+  constructed exception — untyped names are opaque by design);
+- ``NotImplementedError`` (the abstract-interface marker);
+- stdlib **ValueError/TypeError** at audited validation boundaries:
+  the whole-file allowlist ``EXC001_VALIDATION_FILES`` (modules whose
+  job is parsing/validation) or per-function ``EXC001_ALLOW``. These
+  stay stdlib on purpose — a parser's internal ``except ValueError``
+  cascade must keep catching its own raises, and constructor
+  arg-validation is the Python idiom.
+
+Everything else — ``RuntimeError``, ``KeyError``, bare ``Exception``,
+first-party classes rooted outside the taxonomy — is a finding: root
+the class in the taxonomy (multiple inheritance keeps compatibility,
+e.g. ``class SampleRngOverflow(GuardError, RuntimeError)``), or use a
+usage-checked ``# simonlint: disable=EXC001`` pragma with the
+justification next to it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .. import allowlists
+from ..core import Finding, Rule, register
+from ..effects import get_effects
+from ..project import ProjectIndex
+
+TAXONOMY_ROOTS = {"GuardError", "InputError"}
+
+#: stdlib exceptions allowed only via the validation allowlists
+_VALIDATION_OK = {"ValueError", "TypeError"}
+#: always acceptable
+_ALWAYS_OK = {"NotImplementedError"}
+
+_PY_BUILTIN_EXCEPTIONS = {
+    "BaseException", "Exception", "ArithmeticError", "AssertionError",
+    "AttributeError", "BufferError", "EOFError", "FloatingPointError",
+    "ImportError", "IndexError", "KeyError", "KeyboardInterrupt",
+    "LookupError", "MemoryError", "ModuleNotFoundError", "NameError",
+    "NotImplementedError", "OSError", "IOError", "OverflowError",
+    "RecursionError", "ReferenceError", "RuntimeError", "StopIteration",
+    "StopAsyncIteration", "SyntaxError", "SystemError", "SystemExit",
+    "TimeoutError", "TypeError", "UnboundLocalError", "UnicodeDecodeError",
+    "UnicodeEncodeError", "UnicodeError", "ValueError", "ZeroDivisionError",
+}
+
+
+@register
+class ErrorTaxonomy(Rule):
+    id = "EXC001"
+    title = "raise outside the runtime error taxonomy"
+    rationale = (
+        "untyped raises bypass the exit-code contract; execution errors "
+        "root in GuardError, input errors in InputError, validation "
+        "boundaries keep stdlib ValueError/TypeError via the audited "
+        "allowlist"
+    )
+    scope = "project"
+
+    def check_project(self, project: ProjectIndex) -> Iterable[Finding]:
+        effects = get_effects(project)
+        taxonomy: Set[str] = effects.taxonomy_classes(TAXONOMY_ROOTS)
+        taxonomy_leaves = {t.rsplit(".", 1)[-1] for t in taxonomy}
+        findings: List[Finding] = []
+        for sf in project.files:
+            if sf.tree is None or not sf.is_runtime_scope:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                self._check_raise(
+                    sf, node, taxonomy, taxonomy_leaves, findings
+                )
+        return findings
+
+    def _check_raise(self, sf, node, taxonomy, taxonomy_leaves, findings):
+        exc = node.exc
+        cls_expr = exc.func if isinstance(exc, ast.Call) else exc
+        dotted = sf.dotted_call_name(cls_expr)
+        if not dotted:
+            return  # dynamic (raise cls(...), raise e.with_traceback(...))
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf in _ALWAYS_OK or leaf in TAXONOMY_ROOTS:
+            return
+        if dotted in taxonomy or leaf in taxonomy_leaves:
+            return
+        fn = sf.enclosing_function(node)
+        if dotted in _PY_BUILTIN_EXCEPTIONS:
+            if leaf in _VALIDATION_OK:
+                if sf.rel in allowlists.EXC001_VALIDATION_FILES:
+                    return
+                if (sf.rel, fn) in allowlists.EXC001_ALLOW:
+                    return
+                findings.append(
+                    Finding(
+                        sf.path, sf.rel, node.lineno, self.id,
+                        f"raise {leaf} in '{fn}' outside the audited "
+                        "validation-boundary allowlist — raise InputError "
+                        "(models/validation.py) for bad input, a GuardError "
+                        "subclass (runtime/errors.py) for execution "
+                        "failures, or audit the boundary in "
+                        "tools/simonlint/allowlists.py EXC001_*",
+                    )
+                )
+                return
+            findings.append(
+                Finding(
+                    sf.path, sf.rel, node.lineno, self.id,
+                    f"raise {leaf} in '{fn}' bypasses the error taxonomy "
+                    "(runtime/errors.py) — callers cannot route it to an "
+                    "exit code; use a GuardError/InputError subclass "
+                    "(multiple inheritance keeps except-compatibility)",
+                )
+            )
+            return
+        if _is_first_party(dotted, sf):
+            findings.append(
+                Finding(
+                    sf.path, sf.rel, node.lineno, self.id,
+                    f"raise {leaf} in '{fn}': first-party exception not "
+                    "rooted in the GuardError/InputError taxonomy "
+                    "(runtime/errors.py) — re-root the class (multiple "
+                    "inheritance keeps compatibility) or document the "
+                    "escape with `# simonlint: disable=EXC001`",
+                )
+            )
+
+    # fall through: unknown external name (yaml.YAMLError etc.) — opaque
+
+
+def _is_first_party(dotted: str, sf) -> bool:
+    """Is this class plausibly defined in the linted tree? True for
+    names resolving into the package or defined in the same file /
+    fixture tree (single-segment names that are classes here)."""
+    if dotted.startswith("open_simulator_tpu."):
+        return True
+    head = dotted.split(".", 1)[0]
+    if head == dotted:
+        # unqualified: defined-or-imported name; treat as first-party
+        # when a class of that name exists in this file
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name == dotted:
+                return True
+        # or when the import map sent it to another first-party module
+        target = sf.imports.get(dotted, "")
+        return target.startswith("open_simulator_tpu.")
+    return False

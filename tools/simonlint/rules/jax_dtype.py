@@ -1,0 +1,203 @@
+"""JAX003 — dtype drift and implicit host<->device transfers in the
+engine directories (``ops/``, ``scheduler/``, ``parallel/``).
+
+The engine's conformance contract is bit-exactness against the serial
+oracle with x64 ENABLED (ops/__init__.py); its performance contract is
+that warm paths stay transfer-free (the ``jax_transfer_bytes`` counter
+and ROADMAP items 1/4 both gate on it). Three statically-visible ways
+code drifts off both:
+
+- **device -> host in a loop**: ``np.asarray(x)`` / ``np.array(x)``
+  where the kind dataflow proves ``x`` is a JAX value, inside a
+  ``for``/``while`` body — every iteration forces a blocking device
+  sync. (One conversion at decode time is the normal pattern and stays
+  legal; JAX001 separately polices conversions inside traced code.)
+- **host -> device in a loop**: ``jnp.asarray(x)`` / ``jnp.array(x)``
+  on a proven-numpy value inside a loop — a fresh host->device
+  transfer per iteration; hoist the conversion.
+- **weak Python floats into scan carries**: a bare float literal (or a
+  variable the dataflow proves is a Python float) in the ``init`` of
+  ``lax.scan`` — the carry dtype is then decided by promotion, not by
+  the engine's layout, and a carry/output dtype mismatch re-traces or
+  silently widens. Spell the dtype: ``jnp.asarray(0.0, dtype=...)``.
+- **mixed np/jnp arithmetic in a loop**: a BinOp whose operands are
+  proven JAX and proven numpy inside a loop — an implicit per-iteration
+  transfer plus strong-dtype promotion (np scalars are strong; they
+  override the jnp operand's dtype).
+
+Value kinds come from the forward kind dataflow
+(dataflow.KindAnalysis): ``jnp.*``/``jax.*`` call results are JAX,
+``np.*`` results are numpy, float literals are Python floats; joins
+drop disagreeing kinds to unknown, so only proven drift is reported.
+
+Audited escapes: usage-checked ``# simonlint: disable=JAX003`` pragma
+or allowlists.JAX003_ALLOW keyed (file, function).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .. import allowlists
+from ..cfg import build_cfg, iter_function_defs
+from ..core import Finding, Rule, register
+from ..dataflow import JAX, NP, PYFLOAT, KindAnalysis, iter_event_states
+from ..project import ProjectIndex, SourceFile
+
+_SCOPED_DIRS = (
+    "open_simulator_tpu/ops/",
+    "open_simulator_tpu/scheduler/",
+    "open_simulator_tpu/parallel/",
+)
+
+_NP_CONVERTERS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+_JNP_CONVERTERS = {"jax.numpy.asarray", "jax.numpy.array"}
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    if not sf.is_runtime_scope:
+        return False
+    rel = sf.rel.replace("\\", "/")
+    if rel.startswith("open_simulator_tpu/"):
+        return rel.startswith(_SCOPED_DIRS)
+    return True  # out-of-repo fixtures are live, like every other rule
+
+
+@register
+class DtypeTransferDrift(Rule):
+    id = "JAX003"
+    title = "dtype drift / implicit host<->device transfer in engine code"
+    rationale = (
+        "per-iteration np<->jnp conversions force transfers and syncs; "
+        "weak Python floats in scan carries hand the carry dtype to "
+        "promotion — both break the warm-path and conformance contracts"
+    )
+    scope = "project"
+
+    def check_project(self, project: ProjectIndex) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            if sf.tree is None or not _in_scope(sf):
+                continue
+            for fn in iter_function_defs(sf):
+                if (sf.rel, fn.name) in allowlists.JAX003_ALLOW:
+                    continue
+                self._check_function(sf, fn, findings)
+        return findings
+
+    def _check_function(self, sf, fn, findings) -> None:
+        analysis = KindAnalysis(sf)
+        cfg = build_cfg(sf, fn)
+        entry_states = analysis.solve(cfg)
+        in_loop = _loop_membership(fn)
+        reported = set()
+
+        def report(line, msg):
+            key = (line, msg)
+            if key not in reported:
+                reported.add(key)
+                findings.append(Finding(sf.path, sf.rel, line, self.id, msg))
+
+        for _block, ev, state in iter_event_states(
+            cfg, entry_states, analysis.transfer
+        ):
+            for expr in _event_subtrees(ev):
+                for node in ast.walk(expr):
+                    if isinstance(node, ast.Call):
+                        self._check_call(
+                            sf, fn, analysis, state, node, in_loop, report
+                        )
+                    elif isinstance(node, ast.BinOp) and in_loop.get(
+                        id(node)
+                    ):
+                        self._check_binop(
+                            sf, fn, analysis, state, node, report
+                        )
+
+    # -- checks -------------------------------------------------------------
+
+    def _check_call(self, sf, fn, analysis, state, call, in_loop, report):
+        dotted = sf.dotted_call_name(call.func)
+        if dotted in _NP_CONVERTERS and call.args:
+            kind = analysis.expr_kind(state, call.args[0])
+            if kind == JAX and in_loop.get(id(call)):
+                report(
+                    call.lineno,
+                    f"np conversion of a device value inside a loop in "
+                    f"'{fn.name}' — every iteration forces a blocking "
+                    "device->host sync; pull the value to host once, "
+                    "outside the loop",
+                )
+        elif dotted in _JNP_CONVERTERS and call.args:
+            kind = analysis.expr_kind(state, call.args[0])
+            if kind == NP and in_loop.get(id(call)):
+                report(
+                    call.lineno,
+                    f"jnp conversion of a numpy value inside a loop in "
+                    f"'{fn.name}' — a fresh host->device transfer per "
+                    "iteration; hoist the conversion out of the loop",
+                )
+        elif dotted in ("jax.lax.scan", "lax.scan") and len(call.args) >= 2:
+            self._check_scan_carry(sf, fn, analysis, state, call, report)
+
+    def _check_scan_carry(self, sf, fn, analysis, state, call, report):
+        init = call.args[1]
+        elements = (
+            list(init.elts) if isinstance(init, (ast.Tuple, ast.List)) else [init]
+        )
+        for elt in elements:
+            weak = isinstance(elt, ast.Constant) and isinstance(
+                elt.value, float
+            )
+            if not weak and isinstance(elt, ast.Name):
+                weak = analysis.expr_kind(state, elt) == PYFLOAT
+            if weak:
+                report(
+                    elt.lineno,
+                    f"weak Python float in a lax.scan carry init in "
+                    f"'{fn.name}' — the carry dtype is left to promotion "
+                    "(re-trace or silent widening on mismatch); make it "
+                    "explicit: jnp.asarray(x, dtype=...)",
+                )
+
+    def _check_binop(self, sf, fn, analysis, state, node, report):
+        env = dict(state)
+        lk = analysis._kind(env, node.left)
+        rk = analysis._kind(env, node.right)
+        if {lk, rk} == {JAX, NP}:
+            report(
+                node.lineno,
+                f"arithmetic mixing a device value and a numpy value "
+                f"inside a loop in '{fn.name}' — an implicit per-iteration "
+                "host->device transfer with strong-dtype promotion; "
+                "convert once outside the loop",
+            )
+
+
+def _event_subtrees(ev):
+    from ..cfg import event_exprs
+
+    return event_exprs(ev)
+
+
+def _loop_membership(fn) -> dict:
+    """id(node) -> True for every node lexically inside a for/while of
+    this function (nested defs excluded — their loops are their own)."""
+    out = {}
+
+    def walk(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            child_in = in_loop or isinstance(
+                child, (ast.For, ast.AsyncFor, ast.While)
+            )
+            out[id(child)] = child_in
+            walk(child, child_in)
+
+    out[id(fn)] = False
+    walk(fn, False)
+    return out

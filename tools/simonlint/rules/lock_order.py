@@ -1,0 +1,206 @@
+"""CONC002 — lock-order inversions, blocking calls under a lock, and
+self-deadlocks, via the lock-held dataflow.
+
+`simon serve` holds several locks in one process (the coalescer queue
+lock, the Counters/Trace registry locks, the span recorder lock, the
+JSONL sink lock). Two failure modes no per-class rule (CONC001) can
+see:
+
+1. **Lock-order inversion**: thread 1 takes A then B, thread 2 takes B
+   then A — a deadlock that only fires under contention. The rule
+   computes may-held lock sets per function (forward dataflow over the
+   CFG, ``with``/``acquire()`` both modeled, try/finally and
+   with-unwind release included), collects every "acquired X while
+   holding Y" edge project-wide — one interprocedural level deep, so
+   ``COUNTERS.inc(...)`` under the coalescer lock contributes a
+   ``Coalescer._lock -> Counters._lock`` edge — and reports every pair
+   of sites whose edges point in opposite directions.
+2. **Blocking call while a lock is held**: fsync, sleep, sockets/HTTP,
+   subprocess, ``Journal.append`` (fsync'd), jit dispatches (a device
+   round-trip), or a call whose one-level callee summary blocks. Every
+   thread needing that lock then queues behind disk/network/device
+   latency — the serve tail-latency bug class.
+
+Also flagged: acquiring a lock already in the may-held set
+(``threading.Lock`` is not reentrant — immediate self-deadlock).
+
+Audited escapes: usage-checked ``# simonlint: disable=CONC002``
+pragmas at the site (preferred), or allowlists.CONC002_ALLOW keyed
+(file, function). The canonical acquisition order itself is documented
+in docs/STATIC_ANALYSIS.md (lock-order policy).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+from .. import allowlists
+from ..cfg import build_cfg, iter_event_calls, iter_function_defs
+from ..core import Finding, Rule, register
+from ..dataflow import LockAnalysis, iter_event_states
+from ..effects import get_effects
+from ..project import ProjectIndex
+
+
+@register
+class LockOrder(Rule):
+    id = "CONC002"
+    title = "lock-order inversion / blocking call under a lock"
+    rationale = (
+        "opposite-order nested acquisitions deadlock under contention; "
+        "fsync/socket/subprocess/jit work under a lock serializes every "
+        "thread behind the slow operation"
+    )
+    scope = "project"
+
+    def check_project(self, project: ProjectIndex) -> Iterable[Finding]:
+        effects = get_effects(project)
+        findings: List[Finding] = []
+        #: (held, acquired) -> [(sf, line, fn_name, via)]
+        edges: Dict[Tuple[str, str], List[tuple]] = {}
+        for sf in project.files:
+            if sf.tree is None or not sf.is_runtime_scope:
+                continue
+            for fn in iter_function_defs(sf):
+                self._scan_function(sf, fn, effects, edges, findings)
+        findings.extend(self._inversions(edges))
+        return findings
+
+    # -- per-function dataflow ----------------------------------------------
+
+    def _scan_function(self, sf, fn, effects, edges, findings) -> None:
+        fn_name = fn.name
+        if (sf.rel, fn_name) in allowlists.CONC002_ALLOW:
+            return
+        cfg = build_cfg(sf, fn)
+        entry_states = LockAnalysis.solve(cfg)
+        for _block, ev, held in iter_event_states(
+            cfg, entry_states, LockAnalysis.transfer
+        ):
+            if ev.kind == "acquire":
+                for h in sorted(held):
+                    line = getattr(ev.node, "lineno", fn.lineno)
+                    if h == ev.lock:
+                        findings.append(
+                            Finding(
+                                sf.path,
+                                sf.rel,
+                                line,
+                                self.id,
+                                f"'{_leaf(ev.lock)}' acquired in "
+                                f"'{fn_name}' while already held on some "
+                                "path — threading.Lock is not reentrant "
+                                "(self-deadlock)",
+                            )
+                        )
+                    else:
+                        edges.setdefault((h, ev.lock), []).append(
+                            (sf, line, fn_name, "with")
+                        )
+                continue
+            if ev.kind != "stmt" or not held:
+                continue
+            for call in iter_event_calls(ev):
+                self._check_call_under_lock(
+                    sf, fn_name, call, held, effects, edges, findings
+                )
+
+    def _check_call_under_lock(
+        self, sf, fn_name, call, held, effects, edges, findings
+    ) -> None:
+        label = effects.blocking_label_for(sf, call)
+        summary = None
+        if label is None:
+            summary = effects.for_call(sf, call)
+            if summary is not None and summary.blocking:
+                label = summary.blocking[0] + " (via callee)"
+        if label is not None:
+            findings.append(
+                Finding(
+                    sf.path,
+                    sf.rel,
+                    call.lineno,
+                    self.id,
+                    f"blocking operation [{label}] in '{fn_name}' while "
+                    f"holding {_held_str(held)} — move the slow work "
+                    "outside the lock (or document the audited exception "
+                    "with `# simonlint: disable=CONC002`)",
+                )
+            )
+        if summary is None:
+            summary = effects.for_call(sf, call)
+        if summary is not None:
+            for acquired in summary.locks:
+                for h in sorted(held):
+                    if h == acquired:
+                        findings.append(
+                            Finding(
+                                sf.path,
+                                sf.rel,
+                                call.lineno,
+                                self.id,
+                                f"call in '{fn_name}' re-acquires "
+                                f"'{_leaf(acquired)}' already held here — "
+                                "threading.Lock is not reentrant "
+                                "(self-deadlock through the callee)",
+                            )
+                        )
+                    else:
+                        edges.setdefault((h, acquired), []).append(
+                            (sf, call.lineno, fn_name, "call")
+                        )
+
+    # -- cross-function inversion detection ---------------------------------
+
+    def _inversions(self, edges) -> List[Finding]:
+        out: List[Finding] = []
+        seen_pairs = set()
+        for (a, b), sites in sorted(edges.items()):
+            if (b, a) not in edges:
+                continue
+            pair = tuple(sorted((a, b)))
+            if pair in seen_pairs:
+                continue
+            seen_pairs.add(pair)
+            other_sf, _other_line, other_fn, _ = edges[(b, a)][0]
+            for sf, line, fn_name, _via in sites:
+                out.append(
+                    Finding(
+                        sf.path,
+                        sf.rel,
+                        line,
+                        self.id,
+                        f"lock-order inversion: '{_leaf(b)}' is acquired "
+                        f"while holding '{_leaf(a)}' here in '{fn_name}', "
+                        f"but '{_leaf(a)}' is acquired while holding "
+                        f"'{_leaf(b)}' in {other_sf.rel} "
+                        f"('{other_fn}') — pick one canonical order "
+                        "(docs/STATIC_ANALYSIS.md lock-order policy)",
+                    )
+                )
+            for sf, line, fn_name, _via in edges[(b, a)]:
+                first_sf, _first_line, first_fn, _ = sites[0]
+                out.append(
+                    Finding(
+                        sf.path,
+                        sf.rel,
+                        line,
+                        self.id,
+                        f"lock-order inversion: '{_leaf(a)}' is acquired "
+                        f"while holding '{_leaf(b)}' here in '{fn_name}', "
+                        f"but '{_leaf(b)}' is acquired while holding "
+                        f"'{_leaf(a)}' in {first_sf.rel} "
+                        f"('{first_fn}') — pick one canonical order "
+                        "(docs/STATIC_ANALYSIS.md lock-order policy)",
+                    )
+                )
+        return out
+
+
+def _leaf(lock: str) -> str:
+    parts = lock.rsplit(".", 2)
+    return ".".join(parts[-2:]) if len(parts) >= 2 else lock
+
+
+def _held_str(held) -> str:
+    return " + ".join(f"'{_leaf(h)}'" for h in sorted(held))

@@ -1,10 +1,14 @@
 """Lint driver: build the project index once, run every registered
-rule, apply pragmas, render text/JSON.
+rule, apply pragmas, render text/JSON/SARIF.
 
 `lint_paths` is the API surface the tests drive (they point it at tmp
 fixture trees with `root=` overriding the repo root so the runtime-
 scope policy applies to fixtures); `lint_repo` is what
-`python -m tools.simonlint` and `make lint` run.
+`python -m tools.simonlint` and `make lint` run — with the incremental
+cache (tools/simonlint/cache.py) on by default so an unchanged tree
+answers from `.simonlint_cache/` and a partial edit re-runs file rules
+only on the changed files (project-scoped rules always re-run; the
+suppression pass always runs fresh so SL001 stays exact).
 """
 
 from __future__ import annotations
@@ -13,6 +17,7 @@ import json
 from pathlib import Path
 from typing import List, Optional, Sequence
 
+from .cache import LintCache, file_digest
 from .core import FileContext, Finding, all_rules
 from .pragmas import apply_suppressions
 from .project import ProjectIndex, repo_root
@@ -45,17 +50,54 @@ def _expand(paths: Sequence, root: Path) -> List[Path]:
     return out
 
 
+def _rel_of(path: Path, root: Path) -> str:
+    try:
+        return str(path.resolve().relative_to(root.resolve()))
+    except ValueError:
+        return path.name
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return {
+        "path": str(f.path),
+        "rel": f.rel,
+        "line": f.line,
+        "rule": f.rule,
+        "message": f.message,
+    }
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(
+        Path(d["path"]), d["rel"], int(d["line"]), d["rule"], d["message"]
+    )
+
+
 def lint_paths(
     paths: Sequence,
     root: Optional[Path] = None,
     rules: Optional[Sequence[str]] = None,
+    cache: Optional[LintCache] = None,
 ) -> List[Finding]:
     """Lint an explicit set of files/directories. `root` anchors
     repo-relative names and the runtime-scope policy (defaults to the
     real repo root). `rules` optionally restricts to a subset of rule
-    ids. Returns post-suppression findings, sorted."""
+    ids. `cache` (a cache.LintCache) enables the incremental tiers.
+    Returns post-suppression findings, sorted."""
     root = Path(root) if root is not None else repo_root()
-    project = ProjectIndex(_expand(paths, root), root)
+    files = _expand(paths, root)
+
+    digests = {}
+    full_key = None
+    if cache is not None and cache.enabled:
+        digests = {_rel_of(p, root): file_digest(p) for p in files}
+        rules_key = ",".join(sorted(rules)) if rules else "*"
+        full_key = cache.full_key(digests, rules_key)
+        stored = cache.load_full(full_key)
+        if stored is not None:
+            return [_finding_from_dict(d) for d in stored]
+
+    project = ProjectIndex(files, root)
     findings: List[Finding] = []
     active = [
         r for r in all_rules() if rules is None or r.id in set(rules)
@@ -74,13 +116,31 @@ def lint_paths(
             )
     file_rules = [r for r in active if r.scope == "file"]
     project_rules = [r for r in active if r.scope == "project"]
+    # the per-file tier only serves full-rule runs: its entries hold
+    # the complete file-rule finding set for one content digest, which
+    # a subset run could neither use nor refresh soundly
+    use_file_tier = cache is not None and cache.enabled and rules is None
     for sf in project.files:
         if sf.tree is None:
+            continue
+        cached = (
+            cache.load_file(sf.rel, digests.get(sf.rel, ""))
+            if use_file_tier
+            else None
+        )
+        if cached is not None:
+            findings.extend(_finding_from_dict(d) for d in cached)
             continue
         ctx = FileContext(sf, project)
         for rule in file_rules:
             rule.check_file(ctx)
         findings.extend(ctx.findings)
+        if use_file_tier:
+            cache.store_file(
+                sf.rel,
+                digests.get(sf.rel, ""),
+                [_finding_to_dict(f) for f in ctx.findings],
+            )
     for rule in project_rules:
         findings.extend(rule.check_project(project))
     findings = apply_suppressions(
@@ -89,12 +149,19 @@ def lint_paths(
         active_rules=None if rules is None else {r.id for r in active},
     )
     findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    if cache is not None and cache.enabled and full_key is not None:
+        cache.store_full(full_key, [_finding_to_dict(f) for f in findings])
+        cache.save()
     return findings
 
 
-def lint_repo(rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    """The `make lint` entry: DEFAULT_ROOTS under the real repo root."""
-    return lint_paths(DEFAULT_ROOTS, rules=rules)
+def lint_repo(
+    rules: Optional[Sequence[str]] = None, use_cache: bool = True
+) -> List[Finding]:
+    """The `make lint` entry: DEFAULT_ROOTS under the real repo root,
+    incremental cache on."""
+    cache = LintCache(repo_root(), enabled=use_cache)
+    return lint_paths(DEFAULT_ROOTS, rules=rules, cache=cache)
 
 
 def lint_file(path) -> List[tuple]:

@@ -1,0 +1,92 @@
+"""SARIF 2.1.0 rendering — the format GitHub code scanning ingests,
+so CI-uploaded findings annotate PR diffs inline instead of living in
+a log nobody opens.
+
+Minimal but valid: one run, the registered rule inventory as
+``tool.driver.rules`` (id + short/full description), one ``result``
+per finding with a physical location. Framework-level findings (SL001
+unused suppression, SL002 stale baseline entry) get synthesized rule
+entries so every result's ruleId resolves.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .core import Finding, all_rules
+
+SARIF_VERSION = "2.1.0"
+_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+_META_RULES = {
+    "E999": "syntax error",
+    "SL001": "unused suppression — a pragma that silences nothing",
+    "SL002": "stale baseline entry — the accepted finding no longer fires",
+}
+
+
+def render_sarif(findings: List[Finding]) -> str:
+    rules = []
+    seen = set()
+    for rule in all_rules():
+        seen.add(rule.id)
+        rules.append(
+            {
+                "id": rule.id,
+                "shortDescription": {"text": rule.title},
+                "fullDescription": {"text": rule.rationale or rule.title},
+                "defaultConfiguration": {"level": "error"},
+            }
+        )
+    for rid, title in _META_RULES.items():
+        if rid not in seen:
+            rules.append(
+                {
+                    "id": rid,
+                    "shortDescription": {"text": title},
+                    "defaultConfiguration": {"level": "error"},
+                }
+            )
+            seen.add(rid)
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.rel.replace("\\", "/"),
+                            "uriBaseId": "%SRCROOT%",
+                        },
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        if f.rule in index:
+            result["ruleIndex"] = index[f.rule]
+        results.append(result)
+    doc = {
+        "$schema": _SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "simonlint",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

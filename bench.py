@@ -573,6 +573,89 @@ def run_shadow_replay(n_nodes=200, n_pods=400) -> dict:
     }
 
 
+def run_timeline(n_arrivals=1000, n_nodes=48) -> dict:
+    """SIMON_BENCH=timeline: the discrete-event timeline
+    (docs/TIMELINE.md) playing a 1000-arrival seeded synthetic trace
+    (Poisson arrivals, exponential lifetimes, spot reclaims) through
+    three autoscaler policies — static / threshold / capacity-probe —
+    as batched scenario rows. Measures arrival steps/s end to end and
+    the windowed-batching contract: device dispatches per window and
+    per policy (the point of the stepper — a 1000-step trace must cost
+    a handful of dispatches, not 1000 simulate() calls), with zero
+    warm recompiles asserted, not assumed (the pinned-scenario jit is
+    process-wide, parallel/sweep.py _scenario_rows_jit)."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.obs import profile as obs_profile
+    from open_simulator_tpu.timeline.autoscaler import parse_policies
+    from open_simulator_tpu.timeline.compare import run_policies
+    from open_simulator_tpu.timeline.events import (
+        SyntheticSpec,
+        generate_synthetic,
+    )
+
+    nodes = [
+        _make_node(f"tl-n-{i:04d}", 16, 64, {"zone": f"z{i % 8}"})
+        for i in range(n_nodes)
+    ]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    new_node = _make_node("tl-template", 32, 128)
+    spec = SyntheticSpec(
+        arrivals=n_arrivals,
+        arrival_rate=1.0,
+        mean_lifetime_s=300.0,
+        long_running_frac=0.7,
+        spot_frac=0.1,
+        spot_hazard=1 / 2500.0,
+        seed=11,
+    )
+    events = generate_synthetic(spec, [n["metadata"]["name"] for n in nodes])
+    n_policies = 3
+
+    def once():
+        cmp_ = run_policies(
+            cluster,
+            events,
+            parse_policies(["static:4", "threshold", "probe"]),
+            new_node_spec=new_node,
+            max_nodes=16,
+            cadence_s=100.0,
+            warmup_s=30.0,
+            engine="tpu",
+        )
+        for tl in cmp_.policies:
+            assert tl.final is not None and tl.final.pending == 0, (
+                f"{tl.policy}: {tl.final.pending} pods still pending at the "
+                "horizon — the bench workload must end drained"
+            )
+        return cmp_
+
+    once()  # cold: compiles the window scan shapes
+    obs0 = obs_profile.snapshot()
+    elapsed, spread, cmp_ = _timed(once)
+    prof = obs_profile.delta(obs0)
+    assert prof["jax_recompiles_total"] == 0, (
+        f"warm timeline runs recompiled {prof['jax_recompiles_total']}x"
+    )
+    runs = spread["runs"]
+    per_policy = prof["jax_dispatches_total"] / runs / n_policies
+    return {
+        "nodes": n_nodes,
+        "arrivals": n_arrivals,
+        "events": cmp_.events,
+        "windows": cmp_.windows,
+        "policies": n_policies,
+        "steps_per_sec": round(n_arrivals / elapsed, 1),
+        "elapsed_s": round(elapsed, 3),
+        "dispatches_per_window": round(
+            prof["jax_dispatches_total"] / runs / max(cmp_.windows, 1), 2
+        ),
+        "dispatches_per_policy": round(per_policy, 1),
+        "warm_recompiles": prof["jax_recompiles_total"],
+        "spread": spread,
+    }
+
+
 def run_sample() -> dict:
     """SIMON_BENCH=sample: select_host="sample" (reservoir sampling
     with the Go math/rand stream carried in the scan state, r5) vs the
@@ -1579,6 +1662,23 @@ def main():
             "agreement_rate": sh["agreement_rate"],
             "dispatches_per_step": sh["dispatches_per_step"],
         }
+    elif scenario == "timeline":
+        tl = run_timeline()
+        out = {
+            "metric": f"timeline steps/s, {tl['arrivals']} arrivals / "
+            f"{tl['events']} events x {tl['nodes']} nodes through "
+            f"{tl['policies']} policies in {tl['windows']} windows "
+            f"({tl['dispatches_per_policy']} dispatches/policy, "
+            f"{tl['dispatches_per_window']} dispatches/window, zero warm "
+            f"recompiles; median of {tl['spread']['runs']})",
+            "value": tl["steps_per_sec"],
+            "unit": "steps/s",
+            "vs_baseline": None,
+            "steps_per_sec": tl["steps_per_sec"],
+            "windows": tl["windows"],
+            "dispatches_per_policy": tl["dispatches_per_policy"],
+            "dispatches_per_window": tl["dispatches_per_window"],
+        }
     elif scenario == "serve-qps":
         s = run_serve_qps()
         out = {
@@ -1652,6 +1752,7 @@ def main():
         sm = isolated(run_sample)
         sq = isolated(run_serve_qps)
         sh = isolated(run_shadow_replay)
+        tl = isolated(run_timeline)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -1689,7 +1790,11 @@ def main():
             f"shadow-replay {sh['steps_per_sec']:.0f} steps/s over "
             f"{sh['decisions']} recorded decisions (agreement "
             f"{sh['agreement_rate']:.2f}, {sh['dispatches_per_step']} "
-            f"dispatches/step); "
+            f"dispatches/step), "
+            f"timeline {tl['steps_per_sec']:.0f} steps/s over "
+            f"{tl['arrivals']} arrivals x {tl['policies']} policies "
+            f"({tl['windows']} windows, {tl['dispatches_per_policy']} "
+            f"dispatches/policy, zero warm recompiles); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"

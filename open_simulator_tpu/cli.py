@@ -840,6 +840,189 @@ def _shadow_tail(args, config, budget):
     return replayer.finish()
 
 
+@_with_obs("timeline")
+def cmd_timeline(args) -> int:
+    """Discrete-event cluster timeline (timeline/; docs/TIMELINE.md):
+    play a trace of pod arrivals/departures, node churn, and spot
+    reclamations through N autoscaler policies as batched scenario rows
+    over one encoding, and emit per-step cost/utilization/pending
+    curves per policy. Exit 0 on a completed run, 2 on input errors,
+    3/4 on deadline/interrupt partials."""
+    import json
+
+    from .apply.applier import Applier, SimonConfig
+    from .models.validation import InputError
+    from .parallel.sweep import PrioritySignalError
+    from .runtime import (
+        Budget,
+        ExecutionHalted,
+        ExternalIOError,
+        Interrupted,
+        Journal,
+        sigint_to_budget,
+    )
+    from .runtime.journal import config_fingerprint
+    from .timeline.autoscaler import parse_policies
+    from .timeline.compare import run_policies
+    from .timeline.events import (
+        SyntheticSpec,
+        events_from_decision_log,
+        generate_synthetic,
+        read_trace,
+        trace_fingerprint,
+        write_trace,
+    )
+    from .utils.trace import GLOBAL
+
+    _force_platform()
+    try:
+        sources = sum(
+            1 for m in (args.synthetic, args.trace, args.from_decision_log)
+            if m
+        )
+        if sources != 1:
+            raise InputError(
+                "pick exactly one trace source: --synthetic N (seeded "
+                "generator), --trace PATH (timeline-trace JSONL), or "
+                "--from-decision-log PATH (shadow decision log)"
+            )
+        if args.synthetic < 0:
+            raise InputError(
+                f"--synthetic N must be >= 1, got {args.synthetic}"
+            )
+        config = SimonConfig.from_file(args.simon_config)
+        applier = Applier(config)
+        cluster = applier.load_cluster()
+        new_node = applier.load_new_node()
+        specs = list(args.policy or [])
+        for group in args.compare or []:
+            specs.extend(s for s in group.split(",") if s)
+        policies = parse_policies(specs or ["threshold"])
+        budget = Budget(args.deadline)
+
+        if args.synthetic:
+            node_names = [
+                (n.get("metadata") or {}).get("name") or ""
+                for n in cluster.nodes
+            ]
+            events = generate_synthetic(
+                SyntheticSpec(
+                    arrivals=args.synthetic,
+                    arrival_rate=args.arrival_rate,
+                    mean_lifetime_s=args.mean_lifetime,
+                    long_running_frac=args.long_running_frac,
+                    spot_frac=args.spot_frac,
+                    spot_hazard=args.spot_hazard,
+                    seed=args.seed,
+                ),
+                node_names,
+            )
+        elif args.trace:
+            events, meta = read_trace(args.trace)
+            if meta.get("dropped"):
+                print(
+                    f"note: dropped {meta['dropped']} torn trailing trace "
+                    "record",
+                    file=sys.stderr,
+                )
+        else:
+            from .shadow.log import cluster_fingerprint, read_decision_log
+
+            steps, _meta = read_decision_log(
+                args.from_decision_log,
+                fingerprint=None
+                if args.allow_fingerprint_mismatch
+                else cluster_fingerprint(cluster),
+            )
+            events = events_from_decision_log(steps)
+        if args.save_trace:
+            fp = write_trace(args.save_trace, events)
+            print(
+                f"timeline trace ({len(events)} events, fingerprint {fp}) "
+                f"written to {args.save_trace}",
+                file=sys.stderr,
+            )
+    except (OSError, ValueError, ExternalIOError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    journal = None
+    journal_path = args.resume or args.journal
+    GLOBAL.reset()
+    try:
+        if journal_path:
+            from .shadow.log import cluster_fingerprint
+
+            # cluster + newNode identity MUST be in the fingerprint:
+            # journaled placements are node indices of one encoding,
+            # and replaying them against a different cluster would be
+            # silently wrong (the plan_fingerprint rule in apply/chaos)
+            fp = config_fingerprint(
+                cluster_fingerprint(cluster),
+                new_node,
+                trace_fingerprint(events),
+                [p.name for p in policies],
+                {
+                    "cadence": args.cadence,
+                    "warmup": args.warmup,
+                    "maxNodes": args.max_nodes,
+                    "windowArrivals": args.window_arrivals,
+                    "engine": args.engine,
+                },
+            )
+            journal = (
+                Journal.resume(args.resume, fp)
+                if args.resume
+                else Journal.open(args.journal, fp)
+            )
+        with sigint_to_budget(budget):
+            comparison = run_policies(
+                cluster,
+                events,
+                policies,
+                new_node_spec=new_node,
+                max_nodes=args.max_nodes,
+                cadence_s=args.cadence,
+                warmup_s=args.warmup,
+                window_arrivals=args.window_arrivals,
+                engine=args.engine,
+                budget=budget,
+                journal=journal,
+            )
+    except ExecutionHalted as e:
+        return _emit_partial(e, args, journal_path)
+    except KeyboardInterrupt:
+        return _emit_partial(
+            Interrupted("interrupted before any safe boundary"),
+            args,
+            journal_path,
+        )
+    except PrioritySignalError as e:
+        print(
+            f"error: the timeline needs the batched scan path: {e}",
+            file=sys.stderr,
+        )
+        return 2
+    except (OSError, InputError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        if journal is not None:
+            journal.close()
+    if args.trace_phases:
+        print(GLOBAL.as_json(), file=sys.stderr)
+    if args.format == "json":
+        payload = comparison.as_dict()
+        explain = _explanations_payload(args)
+        if explain is not None:
+            payload["explain"] = explain
+        print(json.dumps(payload))
+    else:
+        print(comparison.render_text())
+        _print_explanations(args)
+    return 0
+
+
 def cmd_version(_args) -> int:
     print(f"simon-tpu version {__version__}")
     return 0
@@ -1295,6 +1478,137 @@ def build_parser() -> argparse.ArgumentParser:
         help="report output format",
     )
     p_shadow.set_defaults(func=cmd_shadow)
+
+    p_timeline = sub.add_parser(
+        "timeline",
+        help="discrete-event cluster timeline with autoscaler policy comparison",
+        description="Play a trace of pod arrivals/departures, node "
+        "churn, and spot reclamations through pluggable autoscaler "
+        "policies (static:K / threshold / probe, optionally @nospread) "
+        "over the config's cluster, with the config's newNode spec as "
+        "the candidate pool. Consecutive arrivals batch into "
+        "encode-once masked scan windows and every policy rides the "
+        "same batched dispatch as one scenario row, so a 1000-step "
+        "trace costs a handful of device dispatches (docs/TIMELINE.md). "
+        "Emits per-step cost/utilization/pending curves per policy. "
+        "Exit 0 on a completed run, 2 on input errors, 3/4 on "
+        "deadline/interrupt partials.",
+    )
+    p_timeline.add_argument(
+        "-f", "--simon-config", required=True, help="simon config file path"
+    )
+    p_timeline.add_argument(
+        "--synthetic",
+        type=int,
+        default=0,
+        metavar="N",
+        help="generate a seeded synthetic trace of N Poisson pod "
+        "arrivals with exponential lifetimes (and spot reclaims when "
+        "--spot-frac > 0)",
+    )
+    p_timeline.add_argument(
+        "--trace",
+        default="",
+        metavar="PATH",
+        help="replay this timeline-trace JSONL (written by --save-trace)",
+    )
+    p_timeline.add_argument(
+        "--from-decision-log",
+        default="",
+        metavar="PATH",
+        help="convert a shadow decision log (simon shadow --record / "
+        "--tail-record) into a timeline trace and replay REAL cluster "
+        "history through the policies (decisions become arrivals, "
+        "evictions departures, node churn joins/drains)",
+    )
+    p_timeline.add_argument(
+        "--allow-fingerprint-mismatch",
+        action="store_true",
+        help="accept a --from-decision-log whose cluster fingerprint "
+        "does not match the config's cluster",
+    )
+    p_timeline.add_argument(
+        "--save-trace",
+        default="",
+        metavar="PATH",
+        help="also write the (generated or converted) trace as "
+        "fingerprinted timeline-trace JSONL",
+    )
+    p_timeline.add_argument(
+        "--seed", type=int, default=1, help="synthetic-trace seed (deterministic)"
+    )
+    p_timeline.add_argument(
+        "--arrival-rate", type=float, default=1.0, metavar="PODS/S",
+        help="synthetic Poisson arrival rate",
+    )
+    p_timeline.add_argument(
+        "--mean-lifetime", type=float, default=120.0, metavar="SECONDS",
+        help="synthetic mean pod lifetime (exponential)",
+    )
+    p_timeline.add_argument(
+        "--long-running-frac", type=float, default=0.5, metavar="FRAC",
+        help="fraction of synthetic pods that never depart",
+    )
+    p_timeline.add_argument(
+        "--spot-frac", type=float, default=0.0, metavar="FRAC",
+        help="fraction of base nodes that are spot instances (0 = none)",
+    )
+    p_timeline.add_argument(
+        "--spot-hazard", type=float, default=1.0 / 300.0, metavar="RATE",
+        help="spot reclaim hazard rate per node per second",
+    )
+    p_timeline.add_argument(
+        "--policy",
+        action="append",
+        metavar="SPEC",
+        help="policy to run (repeatable): static:K, threshold"
+        "[:lo=30,patience=2,step=0], probe; append @nospread for the "
+        "PodTopologySpread-off score profile. Default: threshold",
+    )
+    p_timeline.add_argument(
+        "--compare",
+        action="append",
+        metavar="SPEC,SPEC,...",
+        help="comma-separated policy list (same specs as --policy)",
+    )
+    p_timeline.add_argument(
+        "--cadence", type=float, default=60.0, metavar="SECONDS",
+        help="autoscaler decision cadence (decisions run at t=0 too)",
+    )
+    p_timeline.add_argument(
+        "--warmup", type=float, default=0.0, metavar="SECONDS",
+        help="node warm-up delay: a scale-up's candidates become "
+        "schedulable this long after the decision",
+    )
+    p_timeline.add_argument(
+        "--max-nodes", type=int, default=8, metavar="K",
+        help="autoscaler candidate pool size (copies of the config's "
+        "newNode spec; 0 disables scaling)",
+    )
+    p_timeline.add_argument(
+        "--window-arrivals", type=int, default=256, metavar="N",
+        help="max arrivals batched into one scan window",
+    )
+    p_timeline.add_argument(
+        "--engine",
+        choices=["tpu", "oracle"],
+        default="tpu",
+        help="window engine: tpu = batched masked scan rows, oracle = "
+        "the serial host walk (the conformance reference)",
+    )
+    _add_guard_flags(p_timeline)
+    _add_obs_flags(p_timeline)
+    p_timeline.add_argument(
+        "--format", choices=["table", "json"], default="table",
+        help="result output format",
+    )
+    p_timeline.add_argument(
+        "--trace-phases",
+        action="store_true",
+        help="print per-phase wall-clock JSON to stderr (--trace is the "
+        "trace-file input here, unlike the other commands)",
+    )
+    p_timeline.set_defaults(func=cmd_timeline)
 
     p_version = sub.add_parser("version", help="print version")
     p_version.set_defaults(func=cmd_version)

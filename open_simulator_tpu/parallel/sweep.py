@@ -259,7 +259,6 @@ class CapacitySweep:
             if target is not None and target in name_to_idx:
                 self._ds_target[p_i] = name_to_idx[target]
         self._probe_jit = None
-        self._chaos_jit = None
         self._many_jit = None
         # optional resumable journal (runtime/journal.py): probe()
         # serves journaled counts without touching the device and
@@ -312,35 +311,6 @@ class CapacitySweep:
             valid, active, jnp.asarray(self.batch.pinned_node), self.features
         )
 
-    def _scenario_pinned(self, valid, active, pinned):
-        """TWO chained masked scans with a PER-SCENARIO pin vector —
-        the resilience engine's substrate (outage scenario = node mask
-        + surviving pods pinned at their committed nodes, displaced
-        pods free to reschedule). The passes model reality: surviving
-        pods never unbind, so ALL pins commit before any displaced pod
-        reschedules — a single interleaved scan would let an early
-        displaced pod take capacity a later survivor's unconditional
-        pin then overcommits. Pins are force-enabled in the features:
-        the original batch may have carried none."""
-        import jax.numpy as jnp
-
-        from ..ops import scan as scan_ops
-
-        features = self.features._replace(pins=True)
-        cls = jnp.asarray(self.batch.class_of_pod)
-        p1, state1 = scan_ops.run_scan_masked(
-            self.static, self.init, cls, pinned, valid,
-            active & (pinned >= 0), features=features,
-        )
-        p2, final = scan_ops.run_scan_masked(
-            self.static, state1, cls, pinned, valid,
-            active & (pinned < 0), features=features,
-        )
-        placements = jnp.where(pinned >= 0, p1, p2)
-        unsched = jnp.sum(placements == -1)
-        cpu_util, mem_util, _vg = self._utilization(valid, final)
-        return placements, unsched, cpu_util, mem_util
-
     def _scenario_impl(self, valid, active, pinned, features):
         import jax.numpy as jnp
 
@@ -360,21 +330,7 @@ class CapacitySweep:
         return placements, unsched, cpu_util, mem_util, vg_util
 
     def _utilization(self, valid, final):
-        import jax.numpy as jnp
-
-        denom_cpu = jnp.sum(jnp.where(valid, self.static.alloc_mcpu, 0))
-        denom_mem = jnp.sum(jnp.where(valid, self.static.alloc_mem, 0))
-        cpu_util = (
-            100.0 * jnp.sum(jnp.where(valid, final.used_mcpu, 0)) / jnp.maximum(denom_cpu, 1)
-        )
-        mem_util = (
-            100.0 * jnp.sum(jnp.where(valid, final.used_mem, 0)) / jnp.maximum(denom_mem, 1)
-        )
-        denom_vg = jnp.sum(jnp.where(valid[:, None], self.static.vg_cap, 0))
-        vg_util = (
-            100.0 * jnp.sum(jnp.where(valid[:, None], final.vg_used, 0)) / jnp.maximum(denom_vg, 1)
-        )
-        return cpu_util, mem_util, vg_util
+        return _utilization_impl(self.static, valid, final)
 
     def attach_journal(self, journal):
         """Serve journaled probes without device work; append fresh
@@ -619,7 +575,7 @@ class CapacitySweep:
         through the full filter+score cycle. Defaults to the batch's
         original pins. `pins_first` commits every pinned pod before any
         free pod schedules — the chaos model's two-pass order
-        (_scenario_pinned); the default interleaves in pod order like
+        (_scenario_pinned_impl); the default interleaves in pod order like
         the single-pass capacity scan. Returns (placements[P] in SWEEP
         node indices with the scan's -1/-2 conventions,
         {pod_index: reason} for unscheduled pods)."""
@@ -707,38 +663,42 @@ class CapacitySweep:
             np.float64(100.0 * used_v / denom_v),
         )
 
-    def probe_scenarios(self, node_valid, pod_active, pinned, budget=None):
+    def probe_scenarios(self, node_valid, pod_active, pinned, budget=None,
+                        site: str = "chaos"):
         """Batched masked scans with PER-SCENARIO pin vectors — the
-        fault-injection substrate (resilience/chaos.py). Each row of
-        `node_valid` [Sc, N] / `pod_active` [Sc, P] / `pinned` [Sc, P]
-        is one outage scenario; rides the same chunked executor as
-        probe_many (OOM halving-retry, serial-oracle floor). Returns
-        (placements [Sc, P], unscheduled [Sc], cpu_util [Sc],
-        mem_util [Sc]) as numpy arrays.
+        fault-injection substrate (resilience/chaos.py) and the
+        timeline stepper's window entry point (timeline/stepper.py:
+        each policy's window is one row). Each row of `node_valid`
+        [Sc, N] / `pod_active` [Sc, P] / `pinned` [Sc, P] is one
+        scenario; rides the same chunked executor as probe_many (OOM
+        halving-retry, serial-oracle floor). Returns (placements
+        [Sc, P], unscheduled [Sc], cpu_util [Sc], mem_util [Sc],
+        vg_util [Sc]) as numpy arrays. `site` names the
+        instrumented-jit counter family (obs) so each caller's
+        dispatches stay attributable.
 
         Runs on the XLA masked scan (the Pallas plan is compiled for
         the batch's original pin feature set); chaos batches are
         scenario-bound, not pod-throughput-bound, so this is the
         latency-appropriate path."""
-        import jax
         import jax.numpy as jnp
 
         node_valid = np.asarray(node_valid)
         pod_active = np.asarray(pod_active)
         pinned = np.asarray(pinned)
         sc = node_valid.shape[0]
-        if self._chaos_jit is None:
-            from ..obs import profile
-
-            self._chaos_jit = profile.instrument_jit(
-                jax.jit(jax.vmap(self._scenario_pinned)), "chaos_sweep"
-            )
+        site_jit = _scenario_rows_jit(site)
+        cls = jnp.asarray(self.batch.class_of_pod)
 
         def evaluate(lo, hi):
-            out = self._chaos_jit(
+            out = site_jit(
+                self.static,
+                self.init,
+                cls,
                 jnp.asarray(node_valid[lo:hi]),
                 jnp.asarray(pod_active[lo:hi]),
                 jnp.asarray(pinned[lo:hi]),
+                self.features,
             )
             return list(zip(*(np.asarray(o) for o in out)))
 
@@ -746,17 +706,18 @@ class CapacitySweep:
             placements, _ = self.serial_scenario(
                 node_valid[i], pod_active[i], pinned[i], pins_first=True
             )
-            return self._host_scenario_stats(node_valid[i], placements)[:4]
+            return self._host_scenario_stats(node_valid[i], placements)
 
         rows = run_chunked(
-            evaluate, sc, label="chaos", serial_fallback=serial_fallback,
+            evaluate, sc, label=site, serial_fallback=serial_fallback,
             budget=budget,
         )
         placements = np.stack([np.asarray(r[0]) for r in rows])
         unsched = np.array([int(r[1]) for r in rows], dtype=np.int64)
         cpu = np.array([float(r[2]) for r in rows])
         mem = np.array([float(r[3]) for r in rows])
-        return placements, unsched, cpu, mem
+        vg = np.array([float(r[4]) for r in rows])
+        return placements, unsched, cpu, mem, vg
 
     # -- resource lower bound ----------------------------------------------
 
@@ -961,6 +922,89 @@ class CapacitySweep:
                 req = gen.send(got)
         except StopIteration as stop:
             return stop.value
+
+
+def _utilization_impl(static, valid, final):
+    import jax.numpy as jnp
+
+    denom_cpu = jnp.sum(jnp.where(valid, static.alloc_mcpu, 0))
+    denom_mem = jnp.sum(jnp.where(valid, static.alloc_mem, 0))
+    cpu_util = (
+        100.0 * jnp.sum(jnp.where(valid, final.used_mcpu, 0)) / jnp.maximum(denom_cpu, 1)
+    )
+    mem_util = (
+        100.0 * jnp.sum(jnp.where(valid, final.used_mem, 0)) / jnp.maximum(denom_mem, 1)
+    )
+    denom_vg = jnp.sum(jnp.where(valid[:, None], static.vg_cap, 0))
+    vg_util = (
+        100.0 * jnp.sum(jnp.where(valid[:, None], final.vg_used, 0)) / jnp.maximum(denom_vg, 1)
+    )
+    return cpu_util, mem_util, vg_util
+
+
+def _scenario_pinned_impl(static, init, cls, valid, active, pinned, features):
+    """TWO chained masked scans with a PER-SCENARIO pin vector — the
+    resilience engine's substrate (outage scenario = node mask +
+    surviving pods pinned at their committed nodes, displaced pods free
+    to reschedule) and the timeline's window step. The passes model
+    reality: surviving pods never unbind, so ALL pins commit before any
+    displaced pod reschedules — a single interleaved scan would let an
+    early displaced pod take capacity a later survivor's unconditional
+    pin then overcommits. Pins are force-enabled in the features: the
+    original batch may have carried none."""
+    import jax.numpy as jnp
+
+    from ..ops import scan as scan_ops
+
+    features = features._replace(pins=True)
+    p1, state1 = scan_ops.run_scan_masked(
+        static, init, cls, pinned, valid,
+        active & (pinned >= 0), features=features,
+    )
+    p2, final = scan_ops.run_scan_masked(
+        static, state1, cls, pinned, valid,
+        active & (pinned < 0), features=features,
+    )
+    placements = jnp.where(pinned >= 0, p1, p2)
+    unsched = jnp.sum(placements == -1)
+    cpu_util, mem_util, vg_util = _utilization_impl(static, valid, final)
+    return placements, unsched, cpu_util, mem_util, vg_util
+
+
+def _scenario_rows_impl(static, init, cls, valids, actives, pinneds, features):
+    import jax
+
+    def one(valid, active, pinned):
+        return _scenario_pinned_impl(
+            static, init, cls, valid, active, pinned, features
+        )
+
+    return jax.vmap(one)(valids, actives, pinneds)
+
+
+# per-site PROCESS-WIDE jits over the pinned scenario rows (chaos,
+# timeline): static/init/masks are traced pytree arguments — not
+# closures — so same-shaped batches from DIFFERENT sweep instances
+# (each ChaosEngine run, each timeline stepper) hit one compiled
+# executable instead of recompiling per instance; per-site wrappers
+# keep dispatch/recompile attribution separate (obs/profile.py) —
+# "how many window dispatches did this timeline cost" must not hide
+# inside the chaos counters.
+_SCENARIO_ROWS_JITS: dict = {}
+
+
+def _scenario_rows_jit(site: str):
+    jit = _SCENARIO_ROWS_JITS.get(site)
+    if jit is None:
+        import jax
+
+        from ..obs import profile
+
+        jit = _SCENARIO_ROWS_JITS[site] = profile.instrument_jit(
+            jax.jit(_scenario_rows_impl, static_argnums=(6,)),
+            f"{site}_sweep",
+        )
+    return jit
 
 
 def _search_partial(fulfilled: dict, feasible) -> dict:

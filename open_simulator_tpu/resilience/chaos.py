@@ -297,6 +297,23 @@ def perturbed_cluster(cluster, cordon=(), taints=(), degrade=None):
     return out
 
 
+def displaced_free_mask(placed, valid, had, active) -> np.ndarray:
+    """Scheduler-placed pods whose node is outside `valid`: freed to
+    reschedule through the full filter+score cycle — the chaos
+    displacement rule, shared with the timeline stepper's node-drain /
+    spot-reclaim application (timeline/stepper.py). Node-bound pods
+    (`had` — original spec.nodeName) and pods inactive in the scenario
+    (daemonset pods of the failed node) are NOT displaced: they are
+    lost with the node."""
+    placed = np.asarray(placed)
+    return (
+        (~np.asarray(had))
+        & (placed >= 0)
+        & ~np.asarray(valid)[np.clip(placed, 0, None)]
+        & np.asarray(active)
+    )
+
+
 def _pod_identity(pods) -> list:
     out = []
     for p in pods:
@@ -458,9 +475,7 @@ class ChaosEngine:
             ).astype(np.int64)
             # pods inactive in the scenario (daemonset pods of failed
             # nodes) die with the node — lost, not displaced
-            displaced = (
-                (~self.had) & (b >= 0) & ~valid[np.clip(b, 0, None)] & active
-            )
+            displaced = displaced_free_mask(b, valid, self.had, active)
             pinned[displaced] = -1
         return valid, active, pinned, displaced
 
@@ -588,7 +603,7 @@ class ChaosEngine:
         if eval_idx:
             try:
                 with phase("chaos/sweep"):
-                    placements, _unsched, cpu, mem = self.scen.probe_scenarios(
+                    placements, _unsched, cpu, mem, _vg = self.scen.probe_scenarios(
                         np.stack([masks[i][0] for i in eval_idx]),
                         np.stack([masks[i][1] for i in eval_idx]),
                         np.stack([masks[i][2] for i in eval_idx]),

@@ -103,7 +103,7 @@ def test_chaos_survivors_stay_put():
     scens, _ = engine.build_scenarios(1)
     for scen in scens:
         valid, active, pinned, displaced = engine._masks(scen)
-        placements, unsched, _cpu, _mem = engine.scen.probe_scenarios(
+        placements, unsched, _cpu, _mem, _vg = engine.scen.probe_scenarios(
             valid[None], active[None], pinned[None]
         )
         row = placements[0]
@@ -118,7 +118,7 @@ def test_serial_scenario_matches_batched_scan():
     scens, _ = engine.build_scenarios(1)
     for scen in scens:
         valid, active, pinned, _ = engine._masks(scen)
-        batched, _, _, _ = engine.scen.probe_scenarios(
+        batched, _, _, _, _ = engine.scen.probe_scenarios(
             valid[None], active[None], pinned[None]
         )
         serial, reasons = engine.scen.serial_scenario(

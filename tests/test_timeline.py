@@ -1,0 +1,624 @@
+"""Discrete-event timeline (timeline/; docs/TIMELINE.md): event-heap
+and synthetic-trace determinism, trace-file round trips riding the
+journal discipline, windowed-stepper-vs-serial conformance, spot-reclaim
+displacement equivalence with the chaos replay, the windowed-batching
+dispatch budget (the acceptance gate: a 1000-step trace in <= 25 device
+dispatches per policy), the shadow decision-log converter, and the
+`simon timeline` CLI."""
+
+import json
+
+import numpy as np
+import pytest
+import yaml as _yaml
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.resilience.chaos import ChaosEngine
+from open_simulator_tpu.runtime.journal import JournalMismatch
+from open_simulator_tpu.timeline.autoscaler import (
+    parse_policies,
+    parse_policy,
+)
+from open_simulator_tpu.timeline.compare import run_policies
+from open_simulator_tpu.timeline.events import (
+    NODE_DRAIN,
+    NODE_JOIN,
+    POD_ARRIVAL,
+    POD_DEPARTURE,
+    SPOT_RECLAIM,
+    Event,
+    EventHeap,
+    SyntheticSpec,
+    events_from_decision_log,
+    generate_synthetic,
+    read_trace,
+    trace_fingerprint,
+    write_trace,
+)
+from open_simulator_tpu.timeline.stepper import TimelineStepper
+
+
+def _node(name, cpu="4", mem="8Gi", labels=None):
+    node = {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}},
+    }
+    if labels:
+        node["metadata"]["labels"].update(labels)
+    return node
+
+
+def _pod(name, cpu="1", mem="1Gi", node_name=None, ns="tl"):
+    pod = {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "i",
+                    "resources": {"requests": {"cpu": cpu, "memory": mem}},
+                }
+            ]
+        },
+    }
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    return pod
+
+
+def _cluster(n_nodes, cpu="4"):
+    cluster = ResourceTypes()
+    cluster.nodes = [_node(f"base-{i}", cpu=cpu) for i in range(n_nodes)]
+    return cluster
+
+
+def _arrivals(n, t0=1.0, dt=1.0, cpu="1"):
+    return [
+        Event(time=t0 + i * dt, kind=POD_ARRIVAL, seq=i,
+              pod=_pod(f"p{i:03d}", cpu=cpu))
+        for i in range(n)
+    ]
+
+
+# ------------------------------------------------------------- event model
+
+
+def test_event_heap_fifo_on_equal_times():
+    heap = EventHeap()
+    for i in range(5):
+        heap.push(Event(time=7.0, kind=POD_ARRIVAL, pod=_pod(f"p{i}")))
+    names = [ev.pod["metadata"]["name"] for ev in heap.drain()]
+    assert names == [f"p{i}" for i in range(5)]
+
+
+def test_event_heap_orders_by_time_then_seq():
+    heap = EventHeap()
+    heap.push(Event(time=3.0, kind=POD_DEPARTURE, pod_ref="tl/a"))
+    heap.push(Event(time=1.0, kind=POD_ARRIVAL, pod=_pod("a")))
+    heap.push(Event(time=2.0, kind=SPOT_RECLAIM, node_name="base-0"))
+    kinds = [ev.kind for ev in heap.drain()]
+    assert kinds == [POD_ARRIVAL, SPOT_RECLAIM, POD_DEPARTURE]
+
+
+def test_synthetic_trace_deterministic_and_byte_identical(tmp_path):
+    """Same (spec, node list) -> the same events, the same fingerprint,
+    and byte-identical serialized trace files."""
+    spec = SyntheticSpec(arrivals=40, spot_frac=0.5, spot_hazard=1 / 50.0,
+                         seed=7)
+    names = [f"base-{i}" for i in range(4)]
+    a = generate_synthetic(spec, names)
+    b = generate_synthetic(spec, names)
+    assert [ev.as_record() for ev in a] == [ev.as_record() for ev in b]
+    assert trace_fingerprint(a) == trace_fingerprint(b)
+    assert any(ev.kind == SPOT_RECLAIM for ev in a)
+    assert any(ev.kind == POD_DEPARTURE for ev in a)
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    write_trace(str(pa), a)
+    write_trace(str(pb), b)
+    assert pa.read_bytes() == pb.read_bytes()
+    # a different seed is a different trace
+    c = generate_synthetic(
+        SyntheticSpec(arrivals=40, spot_frac=0.5, spot_hazard=1 / 50.0,
+                      seed=8),
+        names,
+    )
+    assert trace_fingerprint(c) != trace_fingerprint(a)
+
+
+def test_trace_round_trip_and_torn_tail(tmp_path):
+    events = _arrivals(6) + [
+        Event(time=10.0, kind=SPOT_RECLAIM, seq=6, node_name="base-1",
+              reason="hazard")
+    ]
+    path = tmp_path / "t.jsonl"
+    fp = write_trace(str(path), events)
+    back, meta = read_trace(str(path), fingerprint=fp)
+    assert [ev.as_record() for ev in back] == [ev.as_record() for ev in events]
+    assert meta["dropped"] == 0
+    # torn final append: tolerated, reported
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "event", "event": "PodArr')
+    back2, meta2 = read_trace(str(path))
+    assert len(back2) == len(events) and meta2["dropped"] == 1
+    # interior damage refuses loudly
+    lines = path.read_text().splitlines()
+    lines[2] = lines[2][: len(lines[2]) // 2]
+    (tmp_path / "bad.jsonl").write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalMismatch, match="corrupt trace record"):
+        read_trace(str(tmp_path / "bad.jsonl"))
+    # fingerprint mismatch refuses loudly
+    with pytest.raises(JournalMismatch, match="fingerprint"):
+        read_trace(str(path), fingerprint="not-the-fingerprint")
+
+
+def test_trace_rejects_out_of_order_events(tmp_path):
+    events = [
+        Event(time=5.0, kind=POD_ARRIVAL, seq=0, pod=_pod("a")),
+        Event(time=2.0, kind=POD_ARRIVAL, seq=1, pod=_pod("b")),
+    ]
+    path = tmp_path / "o.jsonl"
+    write_trace(str(path), events)
+    with pytest.raises(JournalMismatch, match="out of order"):
+        read_trace(str(path))
+
+
+# ------------------------------------------------------- policy parsing
+
+
+def test_parse_policy_specs():
+    assert parse_policy("static:3").name == "static:3"
+    assert parse_policy("threshold:lo=20,patience=3").lo == 20.0
+    assert parse_policy("probe@nospread").name == "probe@nospread"
+    assert parse_policy("probe@nospread").weights.spread == 0
+    for bad in ("static", "static:x", "mystery", "threshold:bogus",
+                "threshold:lo=1,zz=2", "probe:1", "probe@nope"):
+        with pytest.raises(Exception):
+            parse_policy(bad)
+    with pytest.raises(Exception, match="duplicate"):
+        parse_policies(["threshold", "threshold"])
+
+
+# ------------------------------------------------------------ the stepper
+
+
+def test_report_determinism_same_inputs_same_report():
+    """Two in-process runs over the same trace produce the identical
+    report dict — the determinism contract the journal rides on."""
+    cluster = _cluster(3)
+    events = _arrivals(12) + [
+        Event(time=20.0, kind=SPOT_RECLAIM, seq=12, node_name="base-2",
+              reason="hazard"),
+    ]
+    kwargs = dict(
+        new_node_spec=_node("tpl"), max_nodes=2, cadence_s=8.0,
+        warmup_s=4.0, engine="tpu",
+    )
+    a = run_policies(cluster, events, parse_policies(["threshold"]), **kwargs)
+    b = run_policies(cluster, events, parse_policies(["threshold"]), **kwargs)
+    assert a.as_dict() == b.as_dict()
+    assert a.dispatches > 0
+
+
+def test_windowed_vs_serial_conformance():
+    """The windowed batched-scan stepper and the serial host-oracle
+    stepper agree sample-for-sample on a trace with arrivals,
+    departures, a spot reclaim, and an autoscaling policy."""
+    cluster = _cluster(3)
+    events = _arrivals(10) + [
+        Event(time=12.0, kind=POD_DEPARTURE, seq=10, pod_ref="tl/p002"),
+        Event(time=14.0, kind=SPOT_RECLAIM, seq=11, node_name="base-1"),
+        Event(time=15.0, kind=POD_ARRIVAL, seq=12, pod=_pod("late")),
+    ]
+    kwargs = dict(
+        new_node_spec=_node("tpl"), max_nodes=2, cadence_s=6.0,
+        warmup_s=3.0,
+    )
+    policies = ["static:1", "threshold"]
+    tpu = run_policies(cluster, events, parse_policies(policies),
+                       engine="tpu", **kwargs)
+    oracle = run_policies(cluster, events, parse_policies(policies),
+                          engine="oracle", **kwargs)
+    dt, do = tpu.as_dict(), oracle.as_dict()
+    assert dt.pop("engine") == "tpu" and do.pop("engine") == "oracle"
+    assert dt == do
+
+
+def test_spot_reclaim_matches_chaos_replay():
+    """A SpotReclaim displaces exactly the pods the chaos engine's
+    outage scenario displaces, and the requeued placement equals the
+    chaos replay of the same outage over the same committed state."""
+    cluster = _cluster(3)
+    arrivals = _arrivals(9)
+    reclaim = Event(time=60.0, kind=SPOT_RECLAIM, seq=9,
+                    node_name="base-1", reason="hazard")
+
+    full = TimelineStepper(cluster, arrivals + [reclaim],
+                           parse_policies(["static:0"]), None, 0,
+                           cadence_s=1e6)
+    full.run()
+    base = TimelineStepper(cluster, list(arrivals),
+                           parse_policies(["static:0"]), None, 0,
+                           cadence_s=1e6)
+    base.run()
+    baseline = base.states[0].placed.copy()
+    assert (baseline >= 0).all()  # 9x1cpu fits 3x4cpu
+
+    engine = ChaosEngine(base.sweep, 0, baseline)
+    report = engine.run(failures=1)
+    outcome = next(
+        o for o in report.outcomes if o.scenario.failed_names == ("base-1",)
+    )
+    tl = full.comparison().policies[0]
+    assert tl.displaced_total == outcome.displaced > 0
+    assert tl.displaced_by == {SPOT_RECLAIM: outcome.displaced}
+    assert tl.final.pending == outcome.unschedulable
+
+    # placement-level equality with the chaos masks + batched replay
+    scens, _ = engine.build_scenarios(failures=1)
+    scen = next(s for s in scens if s.failed_names == ("base-1",))
+    valid, active, pinned, _disp = engine._masks(scen)
+    rows, _u, _c, _m, _v = base.sweep.probe_scenarios(
+        valid[None], active[None], pinned[None]
+    )
+    expect = np.asarray(rows[0], dtype=np.int64)
+    np.testing.assert_array_equal(
+        full.states[0].placed, np.where(expect >= 0, expect, -1)
+    )
+
+
+def test_departure_frees_capacity_and_unknown_ref_refused():
+    cluster = _cluster(1, cpu="2500m")  # room for 2 one-cpu pods
+    events = [
+        Event(time=1.0, kind=POD_ARRIVAL, seq=0, pod=_pod("a")),
+        Event(time=2.0, kind=POD_ARRIVAL, seq=1, pod=_pod("b")),
+        Event(time=3.0, kind=POD_ARRIVAL, seq=2, pod=_pod("c")),  # pends
+        Event(time=10.0, kind=POD_DEPARTURE, seq=3, pod_ref="tl/a"),
+        Event(time=20.0, kind=POD_ARRIVAL, seq=4, pod=_pod("d", cpu="250m")),
+    ]
+    cmp_ = run_policies(cluster, events, parse_policies(["static:0"]),
+                        engine="tpu", cadence_s=5.0)
+    tl = cmp_.policies[0]
+    assert tl.peak_pending >= 1
+    # a's departure frees its slot at that window's close; c takes it
+    # in the next window and d fits alongside
+    assert tl.final.pending == 0 and tl.final.running == 3
+
+    from open_simulator_tpu.models.validation import InputError
+
+    bad = [Event(time=1.0, kind=POD_DEPARTURE, seq=0, pod_ref="tl/ghost")]
+    with pytest.raises(InputError, match="not present"):
+        run_policies(cluster, bad, parse_policies(["static:0"]))
+
+
+def test_node_join_and_drain():
+    """A NodeJoin opens capacity mid-trace; a NodeDrain requeues the
+    drained node's pods (displacement accounting on the report)."""
+    cluster = _cluster(1)
+    events = _arrivals(8) + [
+        Event(time=20.0, kind=NODE_JOIN, seq=8, node=_node("joiner"),
+              reason="churn"),
+        Event(time=40.0, kind=NODE_DRAIN, seq=9, node_name="base-0"),
+    ]
+    cmp_ = run_policies(cluster, events, parse_policies(["static:0"]))
+    tl = cmp_.policies[0]
+    # 8x1cpu against one 4cpu node: pods pend until the join
+    assert tl.peak_pending >= 4
+    assert tl.displaced_total > 0  # drain requeued base-0's pods
+    assert tl.displaced_by == {NODE_DRAIN: tl.displaced_total}
+    assert tl.final.nodes_up == 1
+
+
+def test_autoscaler_threshold_scales_up_and_down_with_warmup():
+    cluster = _cluster(1)
+    events = _arrivals(10) + [
+        Event(time=float(30 + i), kind=POD_DEPARTURE, seq=10 + i,
+              pod_ref=f"tl/p{i:03d}")
+        for i in range(10)
+    ] + [
+        # a late tiny arrival extends the horizon so the calm ticks
+        # after the departures have room to drain every candidate
+        Event(time=200.0, kind=POD_ARRIVAL, seq=20,
+              pod=_pod("late", cpu="100m")),
+    ]
+    cmp_ = run_policies(
+        cluster, events,
+        parse_policies(["threshold:lo=40,patience=2", "static:0"]),
+        new_node_spec=_node("tpl"), max_nodes=4,
+        cadence_s=5.0, warmup_s=2.0,
+    )
+    th = cmp_.policy("threshold")
+    st = cmp_.policy("static:0")
+    ups = [d for d in th.decisions if d["delta"] > 0]
+    downs = [d for d in th.decisions if d["delta"] < 0]
+    assert ups and downs
+    for d in ups:  # warm-up delay stamped on every scale-up
+        assert d["effective"] == pytest.approx(d["time"] + 2.0)
+    assert th.peak_nodes > 1 and th.final.nodes_up == 1
+    # the autoscaler clears the backlog the static baseline cannot
+    assert th.pending_seconds() < st.pending_seconds()
+    assert st.peak_nodes == 1 and not st.decisions
+
+
+def test_probe_policy_jumps_to_feasible_count():
+    """The capacity-probe policy lands every pod at its first decision
+    after the backlog appears (min-count search semantics)."""
+    cluster = _cluster(1)
+    events = _arrivals(12)
+    cmp_ = run_policies(
+        cluster, events, parse_policies(["probe"]),
+        new_node_spec=_node("tpl"), max_nodes=4, cadence_s=6.0,
+    )
+    tl = cmp_.policies[0]
+    assert tl.final.pending == 0
+    assert any(d["delta"] > 0 for d in tl.decisions)
+
+
+def test_profile_groups_share_trace_but_not_encoding():
+    """@nospread policies run on their own encoding; the merged report
+    keeps the requested order and sums the groups' dispatches."""
+    cluster = _cluster(2)
+    events = _arrivals(6)
+    cmp_ = run_policies(
+        cluster, events,
+        parse_policies(["static:0", "static:0@nospread"]),
+    )
+    assert [p.policy for p in cmp_.policies] == [
+        "static:0", "static:0@nospread"
+    ]
+    assert cmp_.meta.get("profileGroups") == 2
+    assert cmp_.dispatches >= 2
+    # the curve table renders across groups (cells aligned by time,
+    # not sample index — the groups' sample counts differ)
+    text = cmp_.render_text()
+    assert "static:0@nospread" in text and "per-step curves" in text
+
+
+def test_budget_halt_attaches_partial_report():
+    from open_simulator_tpu.runtime import Budget, ExecutionHalted
+
+    cluster = _cluster(2)
+    events = _arrivals(6)
+    budget = Budget(0.0)  # already expired: first boundary halts
+    with pytest.raises(ExecutionHalted) as ei:
+        run_policies(cluster, events, parse_policies(["static:0"]),
+                     budget=budget)
+    partial = ei.value.partial
+    assert partial["phase"] == "timeline"
+    assert partial["report"]["partial"] is True
+
+
+def test_journal_resume_reexecutes_zero_dispatches(tmp_path):
+    from open_simulator_tpu.runtime import Journal
+
+    cluster = _cluster(3)
+    events = _arrivals(10) + [
+        Event(time=30.0, kind=SPOT_RECLAIM, seq=10, node_name="base-1"),
+    ]
+    path = str(tmp_path / "tl.journal")
+    j1 = Journal.open(path, "tl-fp")
+    first = TimelineStepper(cluster, events, parse_policies(["static:0"]),
+                            None, 0, cadence_s=1e6, journal=j1)
+    r1 = first.run()
+    j1.close()
+    assert first.dispatches > 0
+
+    j2 = Journal.resume(path, "tl-fp")
+    second = TimelineStepper(cluster, events, parse_policies(["static:0"]),
+                             None, 0, cadence_s=1e6, journal=j2)
+    r2 = second.run()
+    j2.close()
+    assert second.dispatches == 0  # every window served from the journal
+    d1, d2 = r1.as_dict(), r2.as_dict()
+    d1.pop("dispatches"), d2.pop("dispatches")
+    assert d1 == d2
+
+
+# ----------------------------------------------- the windowed-batching gate
+
+
+def test_thousand_step_trace_dispatch_budget():
+    """The acceptance gate: a 1000-step synthetic trace through three
+    policies costs <= 25 DEVICE dispatches per policy (obs counter, not
+    the stepper's own bookkeeping) — windowed batching is the subsystem's
+    reason to exist."""
+    from open_simulator_tpu.obs import profile as obs_profile
+
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        _node(f"base-{i}", cpu="16", mem="64Gi") for i in range(8)
+    ]
+    spec = SyntheticSpec(
+        arrivals=1000, arrival_rate=2.0, mean_lifetime_s=120.0,
+        long_running_frac=0.6, spot_frac=0.25, spot_hazard=1 / 4000.0,
+        seed=3,
+    )
+    events = generate_synthetic(
+        spec, [n["metadata"]["name"] for n in cluster.nodes]
+    )
+    assert sum(ev.kind == POD_ARRIVAL for ev in events) == 1000
+    policies = parse_policies(["static:2", "threshold", "probe"])
+    obs0 = obs_profile.snapshot()
+    cmp_ = run_policies(
+        cluster, events, policies,
+        new_node_spec=_node("tpl", cpu="16", mem="64Gi"), max_nodes=4,
+        cadence_s=120.0, warmup_s=30.0,
+    )
+    prof = obs_profile.delta(obs0)
+    n_policies = len(policies)
+    assert len(cmp_.policies) == n_policies
+    assert prof["jax_dispatches_total"] <= 25 * n_policies, (
+        f"{prof['jax_dispatches_total']} device dispatches for "
+        f"{n_policies} policies over a 1000-step trace — windowed "
+        "batching regressed"
+    )
+    # every policy has a full curve over the horizon
+    for tl in cmp_.policies:
+        assert len(tl.samples) >= 1000
+        assert tl.final.cost_node_s > 0
+
+
+# ------------------------------------------------- decision-log converter
+
+
+def test_events_from_decision_log_mapping():
+    from open_simulator_tpu.shadow.log import Step
+
+    bound = _pod("bound", node_name="base-0")
+    steps = [
+        Step(seq=0, kind="delta", deltas=[
+            {"op": "add_node", "node": _node("joiner")},
+            {"op": "place_pod", "pod": bound},
+        ]),
+        Step(seq=1, kind="decision", pod=_pod("decided"), node="base-1",
+             deltas=[{"op": "evict_pod", "namespace": "tl", "name": "old"}]),
+        Step(seq=2, kind="delta", deltas=[
+            {"op": "remove_node", "name": "base-2"},
+        ]),
+    ]
+    events = events_from_decision_log(steps)
+    kinds = [ev.kind for ev in events]
+    assert kinds == [
+        NODE_JOIN, POD_ARRIVAL, POD_DEPARTURE, POD_ARRIVAL, NODE_DRAIN
+    ]
+    assert [ev.seq for ev in events] == list(range(5))
+    assert events[0].node["metadata"]["name"] == "joiner"
+    # pre-bound arrivals keep their pin; decision pods arrive UNBOUND
+    # (the timeline re-decides placement — that is the point)
+    assert events[1].pod["spec"]["nodeName"] == "base-0"
+    assert events[3].reason == "decision"
+    assert "nodeName" not in events[3].pod["spec"]
+    assert events[2].pod_ref == "tl/old"
+    assert events[4].node_name == "base-2"
+
+    with pytest.raises(JournalMismatch, match="no timeline mapping"):
+        events_from_decision_log(
+            [Step(seq=0, kind="delta", deltas=[{"op": "mystery"}])]
+        )
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def _write_cli_config(tmp_path, n_nodes=3):
+    cluster_dir = tmp_path / "cluster"
+    cluster_dir.mkdir(exist_ok=True)
+    for i in range(n_nodes):
+        (cluster_dir / f"n{i}.yaml").write_text(
+            _yaml.safe_dump(_node(f"base-{i}"))
+        )
+    newnode_dir = tmp_path / "newnode"
+    newnode_dir.mkdir(exist_ok=True)
+    (newnode_dir / "node.yaml").write_text(_yaml.safe_dump(_node("template")))
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        _yaml.safe_dump(
+            {
+                "apiVersion": "simon/v1alpha1",
+                "kind": "Config",
+                "metadata": {"name": "t"},
+                "spec": {
+                    "cluster": {"customConfig": str(cluster_dir)},
+                    "newNode": str(newnode_dir),
+                },
+            }
+        )
+    )
+    return str(cfg)
+
+
+def test_cli_timeline_compare_json_deterministic(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path)
+    argv = [
+        "timeline", "-f", cfg, "--synthetic", "40", "--seed", "5",
+        "--compare", "static:1,threshold,probe", "--cadence", "20",
+        "--warmup", "5", "--max-nodes", "2", "--format", "json",
+    ]
+    rc = main(argv)
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert [p["policy"] for p in doc["policies"]] == [
+        "static:1", "threshold", "probe"
+    ]
+    for p in doc["policies"]:  # per-step curves for every policy
+        assert len(p["samples"]) >= 40
+        s = p["samples"][-1]
+        assert {"time", "pending", "cpuUtil", "costNodeSeconds"} <= set(s)
+    assert doc["arrivals"] == 40 and not doc["partial"]
+    rc2 = main(argv)
+    assert rc2 == 0 and json.loads(capsys.readouterr().out) == doc
+
+
+def test_cli_timeline_save_and_replay_trace(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path)
+    trace = str(tmp_path / "trace.jsonl")
+    rc = main([
+        "timeline", "-f", cfg, "--synthetic", "30", "--seed", "9",
+        "--policy", "static:0", "--save-trace", trace, "--format", "json",
+    ])
+    out1 = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    events, meta = read_trace(trace)
+    assert meta["fingerprint"] == out1["traceFingerprint"]
+    rc2 = main([
+        "timeline", "-f", cfg, "--trace", trace,
+        "--policy", "static:0", "--format", "json",
+    ])
+    out2 = json.loads(capsys.readouterr().out)
+    assert rc2 == 0 and out2 == out1
+
+
+def test_cli_timeline_from_decision_log(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+    from open_simulator_tpu.shadow.log import DecisionLogWriter, Step
+
+    cfg = _write_cli_config(tmp_path)
+    log = str(tmp_path / "decisions.jsonl")
+    with DecisionLogWriter(log, "some-other-cluster") as w:
+        for i in range(4):
+            w.append(Step(seq=i, kind="decision", pod=_pod(f"real-{i}"),
+                          node=f"base-{i % 3}"))
+    # fingerprint mismatch refuses loudly ...
+    rc = main(["timeline", "-f", cfg, "--from-decision-log", log,
+               "--policy", "static:0"])
+    assert rc == 2
+    assert "error:" in capsys.readouterr().err
+    # ... unless explicitly allowed
+    rc2 = main([
+        "timeline", "-f", cfg, "--from-decision-log", log,
+        "--allow-fingerprint-mismatch", "--policy", "static:0",
+        "--format", "json",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc2 == 0 and doc["arrivals"] == 4
+    assert doc["policies"][0]["finalPending"] == 0
+
+
+def test_cli_timeline_input_errors(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path)
+    cases = [
+        (["timeline", "-f", cfg], "exactly one trace source"),
+        (["timeline", "-f", cfg, "--synthetic", "5", "--trace", "x"],
+         "exactly one trace source"),
+        (["timeline", "-f", cfg, "--synthetic", "-5"],
+         "must be >= 1"),
+        (["timeline", "-f", cfg, "--synthetic", "5", "--policy", "bogus"],
+         "unknown policy"),
+        (["timeline", "-f", cfg, "--synthetic", "5", "--cadence", "0"],
+         "cadence"),
+        (["timeline", "-f", cfg, "--trace", str(tmp_path / "missing.jsonl")],
+         "No such file"),
+    ]
+    for argv, needle in cases:
+        rc = main(argv)
+        err = capsys.readouterr().err
+        assert rc == 2, argv
+        assert "error:" in err and needle in err, (argv, err)
+        assert "Traceback" not in err

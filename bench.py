@@ -67,8 +67,10 @@ benchmark falls back to CPU if the probe fails.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import sys
 import time
 
 N_NODES = 10_000
@@ -1458,7 +1460,45 @@ def run_capacity() -> dict:
     }
 
 
+def _parse_args(argv=None):
+    """Scenario selection stays on SIMON_BENCH (so every recorded
+    ``cmd`` in BENCH_r*.json keeps working); flags are the regression
+    doctor's diff mode — the library half of ``simon doctor``."""
+    p = argparse.ArgumentParser(
+        description="simon bench harness (scenario via SIMON_BENCH env)"
+    )
+    p.add_argument(
+        "--against", metavar="BENCH_rXX.json",
+        help="diff this run against a recorded bench file (raw line, "
+        "JSONL, or BENCH_r*.json wrapper) and exit 1 past thresholds",
+    )
+    p.add_argument(
+        "--time-tolerance", type=float, default=0.5,
+        help="fractional slack on the headline value (default 0.5 = "
+        "±50%%; wall-clock on shared runners is noisy)",
+    )
+    p.add_argument(
+        "--dispatch-tolerance", type=int, default=0,
+        help="absolute slack on device dispatches (default 0: dispatch "
+        "counts are semantic on a fixed scenario)",
+    )
+    p.add_argument(
+        "--recompile-tolerance", type=int, default=0,
+        help="absolute slack on XLA recompiles (default 0)",
+    )
+    p.add_argument(
+        "--hbm-tolerance", type=float, default=0.5,
+        help="fractional slack on the ledger peak-HBM watermark",
+    )
+    p.add_argument(
+        "--p95-tolerance", type=float, default=0.5,
+        help="fractional slack on per-site latency p95s",
+    )
+    return p.parse_args(argv)
+
+
 def main():
+    args = _parse_args()
     if not _tpu_healthy():
         # wedged axon relay: force CPU so the bench still reports
         import jax
@@ -1815,6 +1855,11 @@ def main():
         "transfer_h2d_bytes": prof["device_transfer_h2d_bytes_total"],
         "top_spans_exclusive_ms": obs_spans.top_spans(recorded, 5),
     }
+    # compiled-cost / memory-ledger / latency-histogram observatory
+    # blocks (docs/OBSERVABILITY.md): what each executable costs, where
+    # the HBM peak sat, and the per-site latency distributions — the
+    # dimensions `bench.py --against` / `simon doctor` gate on
+    out["obs"].update(obs_spans.observatory_block())
     # shadow auditor counters ride the same registry (shadow/replay.py);
     # present whenever the run replayed decisions
     from open_simulator_tpu.utils.trace import COUNTERS
@@ -1827,6 +1872,22 @@ def main():
             "warm_recompiles": COUNTERS.get("shadow_warm_recompiles_total"),
         }
     print(json.dumps(out))
+    if args.against:
+        # the doctor's diff (obs/doctor.py): value + dispatches +
+        # recompiles + peak HBM + per-site p95s vs the recorded run;
+        # report on stderr so the JSON record line above stays parseable
+        from open_simulator_tpu.obs import doctor
+
+        base = doctor.load_bench_record(args.against)
+        report = doctor.diff_records(
+            base, out, doctor.Thresholds.from_args(args)
+        )
+        print(
+            doctor.render_text(report, args.against, "this run"),
+            file=sys.stderr,
+        )
+        if not report.ok:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -652,6 +652,20 @@ def cmd_serve(args) -> int:
         flush=True,
     )
     code = daemon.run_until_signaled()
+    # observatory drain dump: one JSON line on stderr with the per-site
+    # latency histograms, the HBM ledger, and the AOT cost table — the
+    # daemon's lifetime observability survives the process even when
+    # nobody scraped /metrics (per-request output stays untouched)
+    import json as _json
+
+    from .obs.spans import observatory_block
+
+    observatory = observatory_block()
+    if observatory:
+        print(
+            "simon serve observatory: " + _json.dumps(observatory),
+            file=sys.stderr,
+        )
     if args.explain is not None:
         # daemon mode: explanations accumulated across requests land on
         # stderr at drain (per-request output must stay byte-identical
@@ -1021,6 +1035,44 @@ def cmd_timeline(args) -> int:
         print(comparison.render_text())
         _print_explanations(args)
     return 0
+
+
+def cmd_doctor(args) -> int:
+    """Perf-regression doctor (obs/doctor.py): diff a candidate bench
+    record against a baseline — headline value, device dispatches,
+    XLA recompiles, ledger peak HBM, per-site latency p95s — and exit
+    1 on any regression past thresholds. CI runs this over the
+    checked-in BENCH_r*.json trajectory so the bench history is an
+    enforced contract, not a pile of JSON files."""
+    import json
+
+    from .models.validation import InputError
+    from .obs import doctor
+
+    try:
+        base = doctor.load_bench_record(args.baseline)
+        cand = doctor.load_bench_record(args.candidate)
+    except (OSError, InputError) as e:
+        print(f"simon doctor: {e}", file=sys.stderr)
+        return 2
+    report = doctor.diff_records(
+        base, cand, doctor.Thresholds.from_args(args)
+    )
+    doc = report.as_dict()
+    doc["baseline"] = args.baseline
+    doc["candidate"] = args.candidate
+    if args.format == "json":
+        print(json.dumps(doc, indent=2))
+    else:
+        print(doctor.render_text(report, args.baseline, args.candidate))
+    if args.out:
+        try:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=2)
+        except OSError as e:
+            print(f"simon doctor: cannot write --out: {e}", file=sys.stderr)
+            return 2
+    return 0 if report.ok else 1
 
 
 def cmd_version(_args) -> int:
@@ -1609,6 +1661,58 @@ def build_parser() -> argparse.ArgumentParser:
         "trace-file input here, unlike the other commands)",
     )
     p_timeline.set_defaults(func=cmd_timeline)
+
+    p_doctor = sub.add_parser(
+        "doctor",
+        help="diff two bench records and gate on perf regressions",
+        description="Diff a candidate bench record against a baseline "
+        "(headline value, device dispatches, XLA recompiles, peak HBM "
+        "from the memory ledger, per-site latency p95s) and exit 1 on "
+        "any regression past thresholds. Accepts raw bench JSON lines, "
+        "JSONL runs, or the checked-in BENCH_r*.json wrappers. Counts "
+        "use ABSOLUTE slack (default 0 — dispatches are semantic on a "
+        "fixed scenario); times/rates/bytes use FRACTIONAL slack "
+        "(default 0.5 — wall-clock on shared runners is noisy). "
+        "Dimensions absent from either record are skipped, never "
+        "invented. `bench.py --against` is the same diff run in-process "
+        "against a fresh measurement.",
+    )
+    p_doctor.add_argument(
+        "baseline", help="recorded bench file to diff against"
+    )
+    p_doctor.add_argument(
+        "candidate", help="fresh bench record (file) to judge"
+    )
+    p_doctor.add_argument(
+        "--time-tolerance", type=float, default=0.5, metavar="FRAC",
+        help="fractional slack on the headline value (default 0.5; "
+        "direction from the unit — seconds regress up, rates down)",
+    )
+    p_doctor.add_argument(
+        "--dispatch-tolerance", type=int, default=0, metavar="N",
+        help="absolute slack on device dispatches (default 0)",
+    )
+    p_doctor.add_argument(
+        "--recompile-tolerance", type=int, default=0, metavar="N",
+        help="absolute slack on XLA recompiles (default 0)",
+    )
+    p_doctor.add_argument(
+        "--hbm-tolerance", type=float, default=0.5, metavar="FRAC",
+        help="fractional slack on the ledger peak-HBM watermark",
+    )
+    p_doctor.add_argument(
+        "--p95-tolerance", type=float, default=0.5, metavar="FRAC",
+        help="fractional slack on per-site latency p95s",
+    )
+    p_doctor.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="report format (default text)",
+    )
+    p_doctor.add_argument(
+        "--out", default="", metavar="PATH",
+        help="also write the JSON report to PATH (CI artifact)",
+    )
+    p_doctor.set_defaults(func=cmd_doctor)
 
     p_version = sub.add_parser("version", help="print version")
     p_version.set_defaults(func=cmd_version)

@@ -14,10 +14,26 @@ hot path until a CLI flag turns them on:
   transfer-bytes accounting through the ``utils.trace.Counters``
   registry, plus the ``--profile-dir`` JAX profiler capture.
 
-``obs.profile`` is deliberately NOT imported here: it imports
-``utils.trace`` for the counter registry, and ``utils.trace`` imports
-``obs.spans`` for the phase shim — importing profile at package level
-would close that cycle while ``utils.trace`` is still initializing.
+The compiled-cost & memory observatory (r10) layers four more pieces
+on the same registry, all always-on:
+
+- ``obs.costs``: per-site AOT compile cache — ``jit(...).lower()
+  .compile()`` per shape-signature with ``cost_analysis()`` /
+  ``memory_analysis()`` extracted and the artifact reused for the
+  dispatch;
+- ``obs.ledger``: device-memory ledger — ``memory_stats()`` /
+  live-buffer polling, per-top-level-span HBM watermarks, and
+  ``predict_fit`` feeding the guard's predictive degradation ladder;
+- ``obs.histo``: fixed-64-bucket streaming latency histograms per jit
+  site and serve request phase (p50/p95/p99, Prometheus exposition);
+- ``obs.doctor``: the bench-record regression differ behind
+  ``simon doctor`` and ``bench.py --against``.
+
+``obs.profile`` (and the cost/ledger/histo trio it wires together) is
+deliberately NOT imported here: it imports ``utils.trace`` for the
+counter registry, and ``utils.trace`` imports ``obs.spans`` for the
+phase shim — importing profile at package level would close that
+cycle while ``utils.trace`` is still initializing.
 """
 
 from . import explain, spans

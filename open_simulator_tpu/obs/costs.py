@@ -1,0 +1,250 @@
+"""AOT compiled-cost ledger: what each compiled executable costs.
+
+The flight recorder (obs/profile.py) counts *that* a dispatch happened;
+this module records *what it costs*: for every ``InstrumentedJit``
+site, the first call of each shape-signature lowers and compiles the
+function ahead of time (``jit(...).lower(...).compile()``) and extracts
+
+- ``cost_analysis()``: FLOPs and bytes accessed of the compiled
+  executable, and
+- ``memory_analysis()``: argument / output / temp / generated-code
+  bytes — the compiler's own statement of how much device memory one
+  dispatch of this shape needs.
+
+The AOT artifact is then REUSED for the dispatch itself (the first
+step toward ROADMAP item 4's persisted compile cache: the executable
+exists as a named object keyed by shape-signature, not an invisible
+entry in the pjit cache), so cost capture adds zero extra compiles.
+Records land in a process-wide registry exported as ``Counters``
+gauges (``jax_cost_*``), a ``costs`` sub-block in every bench obs
+line, and ``simon_jax_cost_*`` lines in serve ``/metrics``.
+
+The memory ledger (obs/ledger.py) reads ``estimate_bytes`` /
+``chunk_estimator`` to predict whether a dispatch will fit in device
+memory BEFORE launching it — the predictive half of the degradation
+ladder (runtime/guard.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from ..utils.trace import COUNTERS
+
+
+@dataclass
+class CostRecord:
+    """One compiled executable's cost/memory analysis. ``lead_dim`` is
+    the compile's row count along the CHUNKED axis (the batched
+    argument's leading dimension when the site declares one via
+    ``instrument_jit(lead_argnum=...)``, else the largest leading
+    dimension among all array leaves) — the scaling proxy
+    ``estimate_bytes`` uses to extrapolate a chunk of a different row
+    count from a known compile."""
+
+    site: str
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    generated_code_bytes: int = 0
+    lead_dim: int = 0
+
+    @property
+    def workspace_bytes(self) -> int:
+        """Device bytes one dispatch allocates beyond its arguments:
+        outputs + XLA temp buffers."""
+        return int(self.output_bytes) + int(self.temp_bytes)
+
+    @property
+    def dispatch_bytes(self) -> int:
+        """Upper bound on fresh device bytes one dispatch needs when
+        none of its arguments are live yet: arguments + outputs + XLA
+        temp buffers. The chunked executors (guard.run_chunked callers)
+        build each chunk's argument arrays AFTER the fit prediction, so
+        predictions must budget for them."""
+        return int(self.argument_bytes) + self.workspace_bytes
+
+    def as_dict(self) -> dict:
+        return {
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "lead_dim": self.lead_dim,
+        }
+
+
+def _merge_cost_analysis(raw) -> dict:
+    """``Compiled.cost_analysis()`` is a dict on current JAX and a
+    list-of-dicts (one per computation) on older releases; merge to
+    one {metric: summed value} map either way."""
+    if raw is None:
+        return {}
+    if isinstance(raw, dict):
+        entries = [raw]
+    else:
+        try:
+            entries = [e for e in raw if isinstance(e, dict)]
+        except TypeError:
+            return {}
+    out: dict = {}
+    for e in entries:
+        for k, v in e.items():
+            if isinstance(v, (int, float)):
+                out[k] = out.get(k, 0.0) + float(v)
+    return out
+
+
+def extract_record(site: str, compiled, lead_dim: int = 0) -> CostRecord:
+    """Build a CostRecord from a ``jax.stages.Compiled`` artifact.
+    Backends without one of the analyses (or raising NotImplemented)
+    degrade to zeros for that half — the record stays usable."""
+    rec = CostRecord(site=site, lead_dim=int(lead_dim))
+    try:
+        cost = _merge_cost_analysis(compiled.cost_analysis())
+    except Exception:  # noqa: BLE001 - backend-optional analysis: absent/unimplemented on some platforms, never load-bearing
+        cost = {}
+    rec.flops = float(cost.get("flops", 0.0))
+    rec.bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - backend-optional analysis: absent/unimplemented on some platforms, never load-bearing
+        mem = None
+    if mem is not None:
+        rec.argument_bytes = int(
+            getattr(mem, "argument_size_in_bytes", 0) or 0
+        )
+        rec.output_bytes = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+        rec.temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+        rec.generated_code_bytes = int(
+            getattr(mem, "generated_code_size_in_bytes", 0) or 0
+        )
+    return rec
+
+
+class CostRegistry:
+    """Process-wide (site, signature) -> CostRecord store plus per-site
+    aggregates, mirrored into the ``Counters`` registry so serve
+    ``/metrics`` and the bench harness read the same numbers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: Dict[str, Dict[object, CostRecord]] = {}
+
+    def record(self, site: str, sig, rec: CostRecord) -> None:
+        with self._lock:
+            self._records.setdefault(site, {})[sig] = rec
+        COUNTERS.inc("jax_cost_compiles_total")
+        COUNTERS.inc(f"jax_cost_compiles_{site}")
+        # last-compiled cost per site as gauges: the newest signature
+        # is almost always the workload's live shape
+        COUNTERS.gauge(f"jax_cost_flops_{site}", rec.flops)
+        COUNTERS.gauge(f"jax_cost_bytes_accessed_{site}", rec.bytes_accessed)
+        COUNTERS.gauge(f"jax_cost_argument_bytes_{site}", rec.argument_bytes)
+        COUNTERS.gauge(f"jax_cost_output_bytes_{site}", rec.output_bytes)
+        COUNTERS.gauge(f"jax_cost_temp_bytes_{site}", rec.temp_bytes)
+        COUNTERS.gauge(
+            f"jax_cost_generated_code_bytes_{site}", rec.generated_code_bytes
+        )
+
+    def on_dispatch(self, rec: CostRecord) -> None:
+        """Accumulate the itemized totals a dispatch of this executable
+        moves: the "what did this run actually cost" counters."""
+        if rec.flops:
+            COUNTERS.inc("jax_cost_flops_dispatched_total", int(rec.flops))
+        if rec.bytes_accessed:
+            COUNTERS.inc(
+                "jax_cost_bytes_dispatched_total", int(rec.bytes_accessed)
+            )
+
+    def sites(self):
+        with self._lock:
+            return sorted(self._records)
+
+    def records_for(self, site: str) -> Dict[object, CostRecord]:
+        with self._lock:
+            return dict(self._records.get(site, {}))
+
+    def signatures(self, site: str) -> int:
+        with self._lock:
+            return len(self._records.get(site, ()))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+    def estimate_bytes(
+        self, site: str, lead_dim: Optional[int] = None
+    ) -> Optional[int]:
+        """Predicted fresh device bytes for one dispatch of ``site``
+        at ``lead_dim`` rows (None = the largest known shape),
+        arguments included — the chunked executors allocate each
+        chunk's argument arrays after asking, so a prediction that
+        omitted them would bless dispatches whose inputs alone bust
+        the budget. Exact when a record of that lead_dim exists;
+        shrinking below the largest known record scales only the
+        workspace (outputs + temps grow with the row count by
+        construction) and keeps the argument bytes whole, an upper
+        bound for the splitting direction; growing past it scales
+        everything linearly. None when the site has never compiled —
+        the caller falls back to the reactive ladder."""
+        recs = [r for r in self.records_for(site).values()]
+        if not recs:
+            return None
+        if lead_dim is not None:
+            exact = [r for r in recs if r.lead_dim == lead_dim]
+            if exact:
+                return max(r.dispatch_bytes for r in exact)
+        best = max(recs, key=lambda r: r.lead_dim)
+        if lead_dim is None or best.lead_dim <= 0:
+            return best.dispatch_bytes
+        if lead_dim <= best.lead_dim:
+            return best.argument_bytes + int(
+                best.workspace_bytes * (lead_dim / best.lead_dim)
+            )
+        return int(best.dispatch_bytes * (lead_dim / best.lead_dim))
+
+    def chunk_estimator(self, site: str) -> Callable[[int, int], Optional[int]]:
+        """An ``estimate(lo, hi)`` callable for guard.run_chunked:
+        predicted fresh device bytes (arguments + workspace) of
+        dispatching rows [lo, hi) at this site (None until the site's
+        first compile)."""
+
+        def estimate(lo: int, hi: int) -> Optional[int]:
+            return self.estimate_bytes(site, hi - lo)
+
+        return estimate
+
+    def summary(self) -> dict:
+        """Per-site cost table for bench obs blocks / trace artifacts:
+        the max-shape record's analysis plus the signature count and
+        the dispatched-flops running total."""
+        out = {}
+        for site in self.sites():
+            recs = list(self.records_for(site).values())
+            if not recs:
+                continue
+            best = max(recs, key=lambda r: (r.lead_dim, r.workspace_bytes))
+            d = best.as_dict()
+            d["signatures"] = len(recs)
+            out[site] = d
+        if out:
+            out["_totals"] = {
+                "compiles": COUNTERS.get("jax_cost_compiles_total"),
+                "flops_dispatched": COUNTERS.get(
+                    "jax_cost_flops_dispatched_total"
+                ),
+                "bytes_dispatched": COUNTERS.get(
+                    "jax_cost_bytes_dispatched_total"
+                ),
+            }
+        return out
+
+
+COSTS = CostRegistry()

@@ -1,0 +1,254 @@
+"""Device-memory ledger: how much HBM is in use, peaked, and predicted.
+
+Today the degradation ladder learns about the memory wall by CATCHING
+RESOURCE_EXHAUSTED and halving (runtime/guard.py) — every OOM costs a
+doomed dispatch plus a recompile at the smaller shape. This module
+makes device memory a first-class observable and turns OOM handling
+predictive:
+
+- ``poll()``: current device bytes in use, from the backend's
+  ``memory_stats()`` (TPU/GPU: allocator truth incl. ``bytes_limit``)
+  with a live-buffer fallback (CPU: sum of ``jax.live_arrays()``
+  nbytes — the backend reports no allocator stats there). Polled at
+  every instrumented jit dispatch and at top-level span boundaries,
+  maintaining the process peak and per-top-level-span watermarks
+  ("which command phase owned the memory high-water mark").
+- ``predict_fit(estimate_bytes)``: would a dispatch needing
+  ``estimate_bytes`` of fresh workspace (the AOT ``memory_analysis``
+  totals, obs/costs.py) fit next to what is live right now, under the
+  device budget? Three-valued: True / False / None (no budget known —
+  the caller stays reactive). ``guard.run_chunked`` asks before every
+  chunk and splits proactively; ``guard.run_laddered`` asks per rung
+  and skips rungs that cannot fit — zero doomed dispatches, with
+  reactive halving unchanged underneath as the fallback.
+- predicted-vs-actual counters (``ledger_predict_*``) so CI can gate
+  on ledger accuracy instead of trusting it.
+
+The budget comes from ``memory_stats()['bytes_limit']`` when the
+backend reports one, else the ``SIMON_DEVICE_MEM_BUDGET`` env var
+(bytes; how operators bound the CPU/test ladder), else None.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..utils.trace import COUNTERS
+
+# fraction of the budget a predicted dispatch may fill: allocator
+# fragmentation and untracked framework buffers mean "exactly fits" is
+# already an OOM in practice
+DEFAULT_HEADROOM = 0.92
+
+# on backends without allocator stats, each poll enumerates EVERY live
+# array in the process (jax.live_arrays()); unthrottled, a dispatch-hot
+# sweep pays that sweep per dispatch and the overhead lands in the very
+# latency histograms the doctor gates on — so hot-path polls on that
+# source are rate-limited, while span boundaries always sample
+LIVE_POLL_MIN_INTERVAL_S = 0.05
+
+
+def device_memory_stats():
+    """(bytes_in_use, bytes_limit, source) for the process's devices.
+    ``bytes_limit``/``bytes_in_use`` sum across local devices when the
+    backend reports allocator stats; otherwise in-use falls back to
+    live-buffer accounting and the limit to SIMON_DEVICE_MEM_BUDGET."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 - no backend at all: the ledger reports unknown rather than failing the caller
+        return 0, None, "unavailable"
+    in_use = 0
+    limit = 0
+    saw_stats = False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 - some platforms raise instead of returning None
+            stats = None
+        if stats:
+            saw_stats = True
+            in_use += int(stats.get("bytes_in_use", 0) or 0)
+            limit += int(stats.get("bytes_limit", 0) or 0)
+    if saw_stats:
+        return in_use, (limit or None), "memory_stats"
+    import jax
+
+    in_use = sum(int(a.nbytes) for a in jax.live_arrays())
+    env = os.environ.get("SIMON_DEVICE_MEM_BUDGET")
+    try:
+        limit = int(env) if env else None
+    except ValueError:
+        limit = None
+    return in_use, limit, "live_arrays"
+
+
+class MemoryLedger:
+    """Process-wide memory observatory. All mutation under one lock;
+    ``poll()`` is the only device-touching call and runs outside it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peak_bytes = 0
+        self.samples = 0
+        self.source = "unpolled"
+        # open top-level spans: frame id -> [name, peak-while-open];
+        # closed frames fold into `watermarks` (max per name)
+        self._frames: Dict[int, list] = {}
+        self._next_frame = 1
+        self.watermarks: Dict[str, int] = {}
+        self._last_poll = 0.0
+        self._last_in_use = 0
+
+    # -- sampling -----------------------------------------------------------
+
+    def poll(self, force: bool = False) -> int:
+        """Sample current device bytes; update the process peak, every
+        open span frame, and the exported gauges. Unforced polls on the
+        live-buffer source (CPU fallback — O(live arrays) per sample)
+        are rate-limited to LIVE_POLL_MIN_INTERVAL_S and answer the
+        last sample; allocator-stats backends and forced polls (span
+        boundaries) always sample."""
+        with self._lock:
+            if (
+                not force
+                and self.source == "live_arrays"
+                and time.monotonic() - self._last_poll
+                < LIVE_POLL_MIN_INTERVAL_S
+            ):
+                return self._last_in_use
+        in_use, limit, source = device_memory_stats()
+        with self._lock:
+            self._last_poll = time.monotonic()
+            self._last_in_use = in_use
+            self.samples += 1
+            self.source = source
+            if in_use > self.peak_bytes:
+                self.peak_bytes = in_use
+            peak = self.peak_bytes
+            for frame in self._frames.values():
+                if in_use > frame[1]:
+                    frame[1] = in_use
+        COUNTERS.gauge("device_mem_bytes_in_use", float(in_use))
+        COUNTERS.gauge("device_mem_peak_bytes", float(peak))
+        if limit:
+            COUNTERS.gauge("device_mem_bytes_limit", float(limit))
+        return in_use
+
+    def span_open(self, name: str) -> int:
+        """Begin a top-level-span watermark frame (spans.py boundary
+        hook). Returns the frame id to close with."""
+        in_use = self.poll(force=True)
+        with self._lock:
+            fid = self._next_frame
+            self._next_frame += 1
+            self._frames[fid] = [name, in_use]
+        return fid
+
+    def span_close(self, fid: int) -> None:
+        self.poll(force=True)
+        with self._lock:
+            frame = self._frames.pop(fid, None)
+            if frame is None:
+                return
+            name, peak = frame
+            if peak > self.watermarks.get(name, 0):
+                self.watermarks[name] = peak
+
+    # -- prediction ---------------------------------------------------------
+
+    def budget_bytes(self) -> Optional[int]:
+        _in_use, limit, _src = device_memory_stats()
+        return limit
+
+    def predict_fit(
+        self,
+        estimate_bytes: int,
+        *,
+        headroom: float = DEFAULT_HEADROOM,
+        label: str = "",
+    ) -> Optional[bool]:
+        """Would a dispatch allocating ``estimate_bytes`` of fresh
+        workspace fit right now? None when no budget is known (the
+        caller must stay reactive); every real verdict is counted so
+        predicted-vs-actual accuracy is a number, not a hope."""
+        in_use, limit, _src = device_memory_stats()
+        if not limit:
+            return None
+        fits = in_use + int(estimate_bytes) <= limit * headroom
+        COUNTERS.inc("ledger_predictions_total")
+        COUNTERS.inc(
+            "ledger_predict_fit_total" if fits else "ledger_predict_unfit_total"
+        )
+        if not fits and label:
+            COUNTERS.inc(f"ledger_predict_unfit_{label}")
+        return fits
+
+    def rung_predictor(
+        self, estimators: Dict[str, Callable[[], Optional[int]]]
+    ) -> Callable[[str], Optional[bool]]:
+        """A ``predictor(rung)`` for guard.run_laddered: rungs with an
+        estimator get a predict_fit verdict; unknown rungs (or unknown
+        budget/estimate) return None and run normally."""
+
+        def predictor(rung: str) -> Optional[bool]:
+            est_fn = estimators.get(rung)
+            if est_fn is None:
+                return None
+            est = est_fn()
+            if est is None:
+                return None
+            return self.predict_fit(int(est), label=rung)
+
+        return predictor
+
+    # -- reporting ----------------------------------------------------------
+
+    def reset(self) -> None:
+        with self._lock:
+            self.peak_bytes = 0
+            self.samples = 0
+            self.source = "unpolled"
+            self._frames.clear()
+            self.watermarks.clear()
+            self._last_poll = 0.0
+            self._last_in_use = 0
+
+    def summary(self, top: int = 8) -> dict:
+        """The ``ledger`` block for bench obs lines, trace artifacts,
+        and the serve drain dump."""
+        with self._lock:
+            marks = sorted(
+                self.watermarks.items(), key=lambda kv: -kv[1]
+            )[:top]
+            out = {
+                "peak_bytes": self.peak_bytes,
+                "samples": self.samples,
+                "source": self.source,
+                "watermarks": {k: v for k, v in marks},
+            }
+        out["predictions"] = {
+            "total": COUNTERS.get("ledger_predictions_total"),
+            "fit": COUNTERS.get("ledger_predict_fit_total"),
+            "unfit": COUNTERS.get("ledger_predict_unfit_total"),
+            "miss": COUNTERS.get("ledger_predict_miss_total"),
+            "hit": COUNTERS.get("ledger_predict_hit_total"),
+        }
+        return out
+
+
+LEDGER = MemoryLedger()
+
+
+def _span_boundary(event: str, name: str, token=None):
+    """obs.spans boundary hook: top-level spans open/close ledger
+    watermark frames (installed by obs/profile.py at import — the
+    first module that can touch jax safely)."""
+    if event == "open":
+        return LEDGER.span_open(name)
+    LEDGER.span_close(token)
+    return None

@@ -48,6 +48,17 @@ _parent: contextvars.ContextVar = contextvars.ContextVar(
     "simon_obs_parent_span", default=None
 )
 
+# top-level-span boundary hook (obs/ledger.py: HBM watermark frames).
+# A settable slot rather than an import so this module stays
+# stdlib-only at load time; obs/profile.py installs the ledger's hook.
+# Signature: hook("open", name) -> token; hook("close", name, token).
+_BOUNDARY_HOOK = None
+
+
+def set_boundary_hook(fn) -> None:
+    global _BOUNDARY_HOOK
+    _BOUNDARY_HOOK = fn
+
 
 @dataclass
 class SpanRecord:
@@ -190,12 +201,24 @@ class Recorder:
             epoch = self._epoch
         parent = _parent.get()
         token = _parent.set(sid)
+        hook = _BOUNDARY_HOOK if parent is None else None
+        hook_token = None
+        if hook is not None:
+            try:
+                hook_token = hook("open", name)
+            except Exception:  # noqa: BLE001 - observability must never fail the traced work
+                hook = None
         t0 = time.perf_counter()
         try:
             yield sid
         finally:
             t1 = time.perf_counter()
             _parent.reset(token)
+            if hook is not None:
+                try:
+                    hook("close", name, hook_token)
+                except Exception:  # noqa: BLE001,S110 - watermark bookkeeping must never fail (or mask an exception from) the traced work; the open-side hook already disarms itself on error
+                    pass
             rec = SpanRecord(
                 span_id=sid,
                 parent_id=parent,
@@ -292,8 +315,38 @@ def export_chrome_trace(path: str, spans: Optional[List[SpanRecord]] = None):
             }
         )
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    observatory = observatory_block()
+    if observatory:
+        doc["simonObservatory"] = observatory
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f)
+
+
+def observatory_block() -> dict:
+    """The compiled-cost / memory-ledger / histogram snapshot attached
+    to trace artifacts and merged into bench obs lines (both validated
+    by tools/validate_trace.py). Lazy sibling imports keep this module
+    stdlib-only at load time; an unimportable observatory (partial
+    install) degrades to {} rather than failing the trace export."""
+    try:
+        from .costs import COSTS
+        from .histo import HISTOS
+        from .ledger import LEDGER
+    except Exception:  # noqa: BLE001 - trace export must survive a broken sibling import
+        return {}
+    out = {}
+    costs = COSTS.summary()
+    if costs:
+        out["costs"] = costs
+    ledger = LEDGER.summary()
+    if ledger.get("samples"):
+        out["ledger"] = ledger
+    # buckets included: tools/validate_trace.py cross-checks bucket
+    # sums against counts, an arithmetic gate that is dead without them
+    histos = HISTOS.summary(with_buckets=True)
+    if histos:
+        out["histograms"] = histos
+    return out
 
 
 def export_jsonl(path: str, spans: Optional[List[SpanRecord]] = None):

@@ -1080,5 +1080,6 @@ def _run_scan_compiled_impl(
 # recompile — the warm-cache contract the tiered engine and `simon
 # serve` rely on is pinned by tests/test_obs.py through these counters.
 _run_scan_compiled = _obs_profile.instrument_jit(
-    jax.jit(_run_scan_compiled_impl, static_argnums=0), "scan"
+    jax.jit(_run_scan_compiled_impl, static_argnums=0), "scan",
+    static_argnums=(0,),
 )

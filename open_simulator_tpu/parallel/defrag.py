@@ -292,8 +292,14 @@ def plan_defrag(
             )
             return [int(x) for x in np.asarray(out)]
 
+        from ..obs.costs import COSTS
+
         unsched = np.asarray(
-            run_chunked(evaluate, sc, label="defrag"), dtype=np.int64
+            run_chunked(
+                evaluate, sc, label="defrag",
+                estimate=COSTS.chunk_estimator("defrag_sweep"),
+            ),
+            dtype=np.int64,
         )
 
     def placements_for(depth):
@@ -346,6 +352,8 @@ def _defrag_sweep_jit():
         _DEFRAG_SWEEP_JIT = profile.instrument_jit(
             jax.jit(_defrag_sweep_impl, static_argnums=(6,)),
             "defrag_sweep",
+            static_argnums=(6,),
+            lead_argnum=3,  # pins: the batched drain-depth axis
         )
     return _DEFRAG_SWEEP_JIT
 

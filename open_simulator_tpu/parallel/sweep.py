@@ -354,6 +354,9 @@ class CapacitySweep:
         return res
 
     def _probe_device(self, count: int) -> ProbeResult:
+        from ..obs.costs import COSTS
+        from ..obs.ledger import LEDGER
+
         valid = self.node_valid(count)
         steps = []
         if self._pallas_plan is not None:
@@ -365,7 +368,17 @@ class CapacitySweep:
             if rung == "pallas":
                 self._pallas_plan = None  # retire the dead rung
 
-        return run_laddered(steps, label="sweep-probe", on_downgrade=on_downgrade)
+        # predictive rung gate: once a rung's shape has compiled, the
+        # memory ledger can veto re-dispatching it into a device that
+        # no longer has room — the doomed dispatch is skipped instead
+        # of caught (no-op until the backend/env reports a budget)
+        predictor = LEDGER.rung_predictor(
+            {"xla-scan": lambda: COSTS.estimate_bytes("sweep_probe")}
+        )
+        return run_laddered(
+            steps, label="sweep-probe", on_downgrade=on_downgrade,
+            predictor=predictor,
+        )
 
     def _probe_pallas(self, count: int, valid) -> ProbeResult:
         from ..ops import pallas_scan
@@ -508,7 +521,8 @@ class CapacitySweep:
             from ..obs import profile
 
             self._many_jit = profile.instrument_jit(
-                jax.jit(jax.vmap(self._scenario)), "sweep_many"
+                jax.jit(jax.vmap(self._scenario)), "sweep_many",
+                lead_argnum=0,
             )
 
         def evaluate(lo, hi):
@@ -541,9 +555,11 @@ class CapacitySweep:
             placements, _ = self.serial_scenario(node_valid[i], pod_active[i])
             return self._host_scenario_stats(node_valid[i], placements)
 
+        from ..obs.costs import COSTS
+
         rows = run_chunked(
             evaluate, sc, label="sweep", serial_fallback=serial_fallback,
-            budget=budget,
+            budget=budget, estimate=COSTS.chunk_estimator("sweep_many"),
         )
         placements, unsched, cpu_util, mem_util, vg_util = (
             np.stack([np.asarray(r[k]) for r in rows]) for k in range(5)
@@ -708,9 +724,11 @@ class CapacitySweep:
             )
             return self._host_scenario_stats(node_valid[i], placements)
 
+        from ..obs.costs import COSTS
+
         rows = run_chunked(
             evaluate, sc, label=site, serial_fallback=serial_fallback,
-            budget=budget,
+            budget=budget, estimate=COSTS.chunk_estimator(f"{site}_sweep"),
         )
         placements = np.stack([np.asarray(r[0]) for r in rows])
         unsched = np.array([int(r[1]) for r in rows], dtype=np.int64)
@@ -1003,6 +1021,8 @@ def _scenario_rows_jit(site: str):
         jit = _SCENARIO_ROWS_JITS[site] = profile.instrument_jit(
             jax.jit(_scenario_rows_impl, static_argnums=(6,)),
             f"{site}_sweep",
+            static_argnums=(6,),
+            lead_argnum=3,  # valids: the batched scenario-rows axis
         )
     return jit
 

@@ -120,23 +120,50 @@ def run_laddered(
     label: str,
     trace=None,
     on_downgrade: Optional[Callable[[str, BaseException], None]] = None,
+    predictor: Optional[Callable[[str], Optional[bool]]] = None,
 ):
     """Run the first rung; on a classified device error fall to the
     next, trace-noting the downgrade. ``steps`` is [(rung_name,
     thunk)] in ladder order; ``on_downgrade(rung, error)`` lets the
     caller retire state tied to the failed rung (e.g. drop a Pallas
-    plan so later probes skip the dead rung). Unclassified errors
-    propagate; a classified error on the LAST rung is re-raised as its
-    taxonomy type."""
+    plan so later probes skip the dead rung; ``error`` is None for a
+    predicted skip). Unclassified errors propagate; a classified error
+    on the LAST rung is re-raised as its taxonomy type.
+
+    ``predictor(rung)`` is the memory ledger's predictive gate
+    (obs/ledger.py rung_predictor): False means the AOT memory
+    analysis plus current live bytes say this rung cannot fit in
+    device memory, so it is skipped WITHOUT dispatching the doomed
+    executable — the observable difference from the reactive ladder,
+    counted in ``guard_rung_predicted_skips_total``. True/None run the
+    rung normally (reactive downgrade stays as the fallback), and the
+    LAST rung always runs (the serial oracle never OOMs)."""
     if not steps:
         raise ValueError("run_laddered needs at least one rung")
+    from ..utils.trace import COUNTERS
+
     for i, (rung, thunk) in enumerate(steps):
+        if (
+            predictor is not None
+            and i + 1 < len(steps)
+            and predictor(rung) is False
+        ):
+            COUNTERS.inc("guard_rung_predicted_skips_total")
+            note_downgrade(
+                label, rung, steps[i + 1][0],
+                "memory ledger predicts it will not fit", trace,
+            )
+            if on_downgrade is not None:
+                on_downgrade(rung, None)
+            continue
         try:
             return thunk()
         except Exception as e:  # audited: classified, then re-raised or downgraded
             cls = classify_device_error(e)
             if cls is None:
                 raise
+            if cls is DeviceOOM:
+                COUNTERS.inc("guard_oom_reactive_total")
             if i + 1 >= len(steps):
                 raise cls(f"{label}: {rung} failed: {_reason(e)}") from e
             note_downgrade(label, rung, steps[i + 1][0], _reason(e), trace)
@@ -152,6 +179,7 @@ def run_chunked(
     serial_fallback=None,
     trace=None,
     budget=None,
+    estimate=None,
 ):
     """Evaluate items [0, n_items) in device batches with bounded
     halving-retry on device OOM (a 10k-scenario vmap that exhausts
@@ -168,11 +196,23 @@ def run_chunked(
     typed when there is none). Every degradation is trace-noted with
     its reason and logged; errors that classify to nothing propagate.
 
+    ``estimate(lo, hi)`` is the predictive half (obs/costs.py
+    chunk_estimator): predicted device workspace bytes for dispatching
+    that chunk, from the site's AOT ``memory_analysis``. When the
+    memory ledger (obs/ledger.py) says the chunk will NOT fit next to
+    what is live right now, the chunk is split WITHOUT dispatching the
+    doomed executable (``guard_oom_predicted_total``) — the correct
+    chunk size is chosen before the first RESOURCE_EXHAUSTED instead
+    of after it. Prediction accuracy is counted
+    (``ledger_predict_hit_total`` / ``ledger_predict_miss_total``) so
+    CI can gate on the ledger staying honest; estimate=None (or an
+    unknown budget) leaves the reactive behavior exactly as before.
+
     ``budget.check`` runs between chunks (the executor's safe
     boundary); on expiry/interrupt the raised ``ExecutionHalted``
     carries ``partial_results`` (the per-item result list, None where
     incomplete) so callers can report the completed prefix."""
-    from ..utils.trace import GLOBAL
+    from ..utils.trace import COUNTERS, GLOBAL
 
     tr = trace or GLOBAL
     out = [None] * n_items
@@ -204,6 +244,42 @@ def run_chunked(
                 ]
                 raise
         lo, hi = pending.pop()
+        predicted_fit = None
+        if estimate is not None:
+            est = estimate(lo, hi)
+            if est is not None:
+                from ..obs.ledger import LEDGER
+
+                predicted_fit = LEDGER.predict_fit(int(est), label=label)
+                if predicted_fit is False and hi - lo > 1:
+                    COUNTERS.inc("guard_oom_predicted_total")
+                    mid = (lo + hi) // 2
+                    halvings += 1
+                    tr.append_note(
+                        f"{label}-chunk-predicted-split",
+                        f"[{lo},{hi}) -> [{lo},{mid})+[{mid},{hi}): ledger "
+                        f"predicts {est} workspace bytes will not fit",
+                    )
+                    log.info(
+                        "%s: ledger predicts chunk [%d,%d) (%d workspace "
+                        "bytes) will not fit; splitting before dispatch",
+                        label, lo, hi, est,
+                    )
+                    pending.append((mid, hi))
+                    pending.append((lo, mid))
+                    continue
+                if predicted_fit is False:
+                    # single item predicted not to fit: route straight
+                    # to the serial rung, zero doomed dispatches
+                    if serial_fallback is not None:
+                        COUNTERS.inc("guard_oom_predicted_total")
+                        run_serial(
+                            lo, hi,
+                            f"ledger predicted {est} bytes will not fit",
+                            "predicted OOM",
+                        )
+                        continue
+                    predicted_fit = None  # nothing to degrade to: try it
         try:
             if _OOM_INJECT is not None:
                 _OOM_INJECT(hi - lo)
@@ -223,6 +299,12 @@ def run_chunked(
             if cls is None:
                 raise
             reason = _reason(e)
+            if cls is DeviceOOM:
+                COUNTERS.inc("guard_oom_reactive_total")
+                if predicted_fit is True:
+                    # the ledger said this would fit and it did not:
+                    # count the miss so accuracy is gateable, not lore
+                    COUNTERS.inc("ledger_predict_miss_total")
             if cls is not DeviceOOM:
                 # halving cannot fix a compiler/backend fault: the
                 # whole remaining chunk drops to the serial rung
@@ -249,6 +331,8 @@ def run_chunked(
             pending.append((mid, hi))
             pending.append((lo, mid))
             continue
+        if predicted_fit is True:
+            COUNTERS.inc("ledger_predict_hit_total")
         out[lo:hi] = results
         done[lo:hi] = [True] * (hi - lo)
     if halvings or serial:

@@ -364,6 +364,8 @@ def _scenario_scan_jit():
         _SCENARIO_SCAN_JIT = profile.instrument_jit(
             jax.jit(_scan_scenarios_impl, static_argnums=(6,)),
             "scenario_scan",
+            static_argnums=(6,),
+            lead_argnum=5,  # actives: the batched request-rows axis
         )
     return _SCENARIO_SCAN_JIT
 

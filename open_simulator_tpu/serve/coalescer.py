@@ -42,6 +42,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..obs.histo import HISTOS
 from ..runtime.budget import Budget
 from ..utils.trace import COUNTERS
 from .session import Session, WhatIfReply, WhatIfRequest
@@ -142,6 +143,10 @@ class Coalescer:
         daemon is shedding."""
         latency = time.monotonic() - pending.enqueued_at
         COUNTERS.observe("serve_latency_seconds", latency)
+        # the long-memory histogram complement of the bounded-window
+        # observation above: never evicts, exported as Prometheus
+        # histogram exposition with p50/p95/p99 (obs/histo.py)
+        HISTOS.observe("serve/request", latency)
         COUNTERS.mark("serve_completions")
         COUNTERS.inc("serve_requests_total")
         pending.finish(reply)
@@ -192,6 +197,8 @@ class Coalescer:
             t0 = time.monotonic()
             COUNTERS.observe("serve_batch_fill", len(batch))
             COUNTERS.inc("serve_batches_total")
+            for p in batch:
+                HISTOS.observe("serve/queue_wait", t0 - p.enqueued_at)
             try:
                 replies = self.session.evaluate_batch(
                     [p.request for p in batch]
@@ -213,6 +220,7 @@ class Coalescer:
                 ]
             tick_s = time.monotonic() - t0
             COUNTERS.observe("serve_tick_seconds", tick_s)
+            HISTOS.observe("serve/evaluate", tick_s)
             for pending, reply in zip(batch, replies):
                 reply.meta.setdefault("batchSize", len(batch))
                 reply.meta["queueSeconds"] = round(

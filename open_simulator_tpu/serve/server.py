@@ -235,8 +235,107 @@ def render_metrics(coalescer: Coalescer) -> bytes:
         "Agreement rate of the most recent shadow replay (1.0 = full).",
         snap["gauges"].get("shadow_agreement_rate", 1.0),
     )
+    lines.extend(_observatory_lines(snap))
     lines.append("")
     return "\n".join(lines).encode()
+
+
+def _observatory_lines(snap: dict) -> List[str]:
+    """Compiled-cost / memory-ledger / latency-histogram exposition
+    (docs/OBSERVABILITY.md): the ``simon_jax_cost_*`` per-site gauges
+    from the AOT cost registry, the device-memory gauges and
+    predictive-ladder counters, per-site latency histograms with
+    p50/p95/p99, and the top spans by exclusive time when the span
+    recorder is armed (--trace-out) — the long-running daemon's
+    hot-span view, previously bench-only."""
+    from ..obs import histo, spans
+    from ..obs.costs import COSTS
+
+    counts, gauges = snap["counts"], snap["gauges"]
+    lines: List[str] = []
+
+    def metric(name, kind, help_text, value):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+
+    # -- AOT compiled-cost table (obs/costs.py)
+    sites = COSTS.sites()
+    if sites:
+        for field, help_text in (
+            ("flops", "FLOPs per dispatch of the site's last-compiled executable."),
+            ("bytes_accessed", "Bytes accessed per dispatch (last compile)."),
+            ("argument_bytes", "Argument HBM bytes of the last compile."),
+            ("output_bytes", "Output HBM bytes of the last compile."),
+            ("temp_bytes", "XLA temp-buffer HBM bytes of the last compile."),
+        ):
+            lines.append(
+                f"# HELP simon_jax_cost_{field} {help_text}"
+            )
+            lines.append(f"# TYPE simon_jax_cost_{field} gauge")
+            for site in sites:
+                lines.append(
+                    f'simon_jax_cost_{field}{{site="{site}"}} '
+                    f"{gauges.get(f'jax_cost_{field}_{site}', 0)}"
+                )
+        lines.append(
+            "# HELP simon_jax_cost_signatures Compiled shape-signatures per site."
+        )
+        lines.append("# TYPE simon_jax_cost_signatures gauge")
+        for site in sites:
+            lines.append(
+                f'simon_jax_cost_signatures{{site="{site}"}} '
+                f"{COSTS.signatures(site)}"
+            )
+    metric(
+        "simon_jax_cost_compiles_total", "counter",
+        "Ahead-of-time compiles (one per new shape-signature per site).",
+        counts.get("jax_cost_compiles_total", 0),
+    )
+    metric(
+        "simon_jax_cost_flops_dispatched_total", "counter",
+        "FLOPs itemized across every AOT dispatch.",
+        counts.get("jax_cost_flops_dispatched_total", 0),
+    )
+    # -- device-memory ledger (obs/ledger.py)
+    metric(
+        "simon_device_mem_bytes_in_use", "gauge",
+        "Device bytes in use at the last ledger poll.",
+        gauges.get("device_mem_bytes_in_use", 0),
+    )
+    metric(
+        "simon_device_mem_peak_bytes", "gauge",
+        "Peak device bytes observed by the ledger this process.",
+        gauges.get("device_mem_peak_bytes", 0),
+    )
+    for key, help_text in (
+        ("ledger_predictions_total", "predict_fit verdicts issued."),
+        ("ledger_predict_fit_total", "Dispatches predicted to fit."),
+        ("ledger_predict_unfit_total", "Dispatches predicted NOT to fit (split/skipped before launch)."),
+        ("ledger_predict_hit_total", "Predicted-fit chunks that ran without OOM."),
+        ("ledger_predict_miss_total", "Predicted-fit chunks that OOMed anyway."),
+        ("guard_oom_predicted_total", "Chunks split/degraded predictively, zero doomed dispatches."),
+        ("guard_oom_reactive_total", "Device OOMs caught reactively (the halving fallback)."),
+        ("guard_rung_predicted_skips_total", "Ladder rungs skipped on a ledger verdict."),
+    ):
+        metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
+    # -- latency histograms (obs/histo.py)
+    lines.extend(histo.prometheus_lines())
+    # -- hot spans by exclusive time (span recorder armed only)
+    if spans.RECORDER.enabled:
+        top = spans.top_spans(spans.RECORDER.snapshot(), 5)
+        if top:
+            lines.append(
+                "# HELP simon_span_exclusive_seconds Top spans by exclusive "
+                "(self) wall-clock since the recorder was armed."
+            )
+            lines.append("# TYPE simon_span_exclusive_seconds gauge")
+            for row in top:
+                lines.append(
+                    f'simon_span_exclusive_seconds{{span="{row["name"]}"}} '
+                    f"{row['exclusive_ms'] / 1e3:.6f}"
+                )
+    return lines
 
 
 class ServeDaemon:

@@ -319,11 +319,14 @@ class Session:
             def serial_fallback(i):
                 return self._serial_placements(actives[i], batch_idx, all_pods)
 
+            from ..obs.costs import COSTS
+
             rows = run_chunked(
                 evaluate,
                 len(batched),
                 label="serve",
                 serial_fallback=serial_fallback,
+                estimate=COSTS.chunk_estimator("scenario_scan"),
             )
         else:
             rows = [np.zeros(0, dtype=np.int64) for _ in batched]

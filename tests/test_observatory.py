@@ -1,0 +1,693 @@
+"""Compiled-cost & memory observatory (docs/OBSERVABILITY.md).
+
+Pins the PR-10 acceptance contracts:
+
+- AOT cost capture is cached by shape-signature: the second same-shape
+  call compiles NOTHING (the recompile counter and the cost registry
+  both stand still) and the artifact's answers match the plain jit;
+- the forced-OOM predictive ladder: with a device budget known, the
+  memory ledger's ``predict_fit`` picks the surviving chunk size /
+  ladder rung BEFORE dispatch — zero failing dispatches, asserted via
+  the obs counters, versus >= 1 caught RESOURCE_EXHAUSTED on the
+  reactive path;
+- streaming histogram percentiles against a numpy reference (within
+  the documented one-bucket precision bound) including concurrent
+  recorders;
+- the perf-regression doctor: seeded regressions trip, matched records
+  pass, and every on-disk bench-record shape loads.
+"""
+
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+import open_simulator_tpu.obs.ledger as ledger_mod
+import open_simulator_tpu.runtime.guard as guard_mod
+from open_simulator_tpu.obs import histo as histo_mod
+from open_simulator_tpu.obs.costs import COSTS, CostRecord, CostRegistry
+from open_simulator_tpu.obs.doctor import (
+    Thresholds,
+    diff_records,
+    load_bench_record,
+    render_text,
+)
+from open_simulator_tpu.obs.histo import HISTOS, Histogram, bucket_of
+from open_simulator_tpu.obs.ledger import LEDGER, MemoryLedger
+from open_simulator_tpu.obs.profile import instrument_jit
+from open_simulator_tpu.runtime.guard import run_chunked, run_laddered
+from open_simulator_tpu.utils.trace import COUNTERS, GLOBAL
+
+
+class _CounterDelta:
+    """Snapshot the process-wide counters so assertions are deltas,
+    not absolutes (the registry is shared across the test session)."""
+
+    KEYS = (
+        "guard_oom_predicted_total",
+        "guard_oom_reactive_total",
+        "guard_rung_predicted_skips_total",
+        "ledger_predictions_total",
+        "ledger_predict_fit_total",
+        "ledger_predict_unfit_total",
+        "ledger_predict_hit_total",
+        "ledger_predict_miss_total",
+    )
+
+    def __init__(self):
+        self._before = {k: COUNTERS.get(k) for k in self.KEYS}
+
+    def __getitem__(self, key):
+        return COUNTERS.get(key) - self._before[key]
+
+
+def _fixed_stats(in_use, limit):
+    def stats():
+        return in_use, limit, "test"
+
+    return stats
+
+
+def _oom_injector(fail_above, calls):
+    def inject(chunk_len):
+        calls.append(chunk_len)
+        if chunk_len > fail_above:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: fake out of device memory (test)"
+            )
+
+    return inject
+
+
+# ---------------------------------------------------------------- predictive
+
+
+def test_predictive_chunking_zero_failed_dispatches(monkeypatch):
+    """With the AOT memory estimate + a known budget, run_chunked picks
+    the surviving chunk size BEFORE the first dispatch: the injector
+    (standing in for the device allocator) never sees a chunk it would
+    OOM, while the reactive control path eats >= 1 real failure."""
+    # budget 1000B at 92% headroom = 920B usable; 300B per row means
+    # chunks of 4+ (1200B) cannot fit but chunks of 2 (600B) can
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(0, 1000)
+    )
+    calls = []
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _oom_injector(2, calls))
+    delta = _CounterDelta()
+    GLOBAL.reset()
+    out = run_chunked(
+        lambda lo, hi: [i * 10 for i in range(lo, hi)],
+        8,
+        label="obstest",
+        estimate=lambda lo, hi: (hi - lo) * 300,
+    )
+    assert out == [i * 10 for i in range(8)]
+    # the whole point: every chunk that reached the device fit
+    assert calls and max(calls) <= 2
+    assert delta["guard_oom_reactive_total"] == 0
+    assert delta["guard_oom_predicted_total"] >= 1
+    assert delta["ledger_predict_unfit_total"] >= 1
+    # chunks predicted to fit did fit — accuracy counters agree
+    assert delta["ledger_predict_hit_total"] == len(calls)
+    assert delta["ledger_predict_miss_total"] == 0
+    assert "obstest-chunk-predicted-split" in GLOBAL.notes
+
+    # reactive control: same workload, no estimate -> the injector
+    # catches a doomed full-batch dispatch (the pre-observatory world)
+    calls_reactive = []
+    monkeypatch.setattr(
+        guard_mod, "_OOM_INJECT", _oom_injector(2, calls_reactive)
+    )
+    delta2 = _CounterDelta()
+    out2 = run_chunked(
+        lambda lo, hi: [i * 10 for i in range(lo, hi)], 8, label="obstest"
+    )
+    assert out2 == out
+    assert max(calls_reactive) == 8  # the doomed dispatch happened
+    assert delta2["guard_oom_reactive_total"] >= 1
+    assert delta2["guard_oom_predicted_total"] == 0
+
+
+def test_predictive_single_row_routes_to_serial(monkeypatch):
+    """A single item predicted not to fit goes straight to the serial
+    rung — no doomed dispatch, no reactive catch."""
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(0, 1000)
+    )
+    calls = []
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _oom_injector(0, calls))
+    delta = _CounterDelta()
+    GLOBAL.reset()
+    out = run_chunked(
+        lambda lo, hi: list(range(lo, hi)),
+        3,
+        label="obstest",
+        serial_fallback=lambda i: -i,
+        estimate=lambda lo, hi: (hi - lo) * 5000,  # nothing fits
+    )
+    assert out == [0, -1, -2]
+    assert calls == []  # the device never saw a dispatch
+    assert delta["guard_oom_reactive_total"] == 0
+    assert delta["guard_oom_predicted_total"] >= 1
+
+
+def test_predict_miss_is_counted(monkeypatch):
+    """The ledger said it would fit and the device OOMed anyway: the
+    miss is a counter, so CI can gate on ledger honesty."""
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(0, 10**9)
+    )
+    monkeypatch.setattr(guard_mod, "_OOM_INJECT", _oom_injector(2, []))
+    delta = _CounterDelta()
+    GLOBAL.reset()
+    out = run_chunked(
+        lambda lo, hi: list(range(lo, hi)),
+        4,
+        label="obstest",
+        estimate=lambda lo, hi: 1,  # wildly optimistic
+    )
+    assert out == [0, 1, 2, 3]
+    assert delta["ledger_predict_miss_total"] >= 1
+    assert delta["guard_oom_reactive_total"] >= 1
+
+
+def test_laddered_predictive_rung_skip(monkeypatch):
+    """run_laddered skips a rung the ledger vetoes without dispatching
+    it; the last rung always runs (the serial oracle never OOMs)."""
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(900, 1000)
+    )
+    dispatched = []
+
+    def doomed():
+        dispatched.append("xla-scan")
+        raise RuntimeError("RESOURCE_EXHAUSTED: should never run")
+
+    def serial():
+        dispatched.append("serial")
+        return "ok"
+
+    downgrades = []
+    predictor = LEDGER.rung_predictor({"xla-scan": lambda: 500})
+    delta = _CounterDelta()
+    GLOBAL.reset()
+    out = run_laddered(
+        [("xla-scan", doomed), ("serial-oracle", serial)],
+        label="obstest",
+        trace=GLOBAL,
+        on_downgrade=lambda rung, err: downgrades.append((rung, err)),
+        predictor=predictor,
+    )
+    assert out == "ok"
+    assert dispatched == ["serial"]  # zero failing dispatches
+    assert downgrades == [("xla-scan", None)]
+    assert delta["guard_rung_predicted_skips_total"] == 1
+    assert delta["guard_oom_reactive_total"] == 0
+    assert "obstest-downgrade" in GLOBAL.notes
+
+
+def test_laddered_last_rung_never_skipped(monkeypatch):
+    """Even a vetoing predictor cannot skip the final rung."""
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(999, 1000)
+    )
+    out = run_laddered(
+        [("xla-scan", lambda: "ran")],
+        label="obstest",
+        predictor=lambda rung: False,
+    )
+    assert out == "ran"
+
+
+def test_rung_predictor_unknown_returns_none(monkeypatch):
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(0, 1000)
+    )
+    predictor = LEDGER.rung_predictor({"xla-scan": lambda: None})
+    assert predictor("xla-scan") is None  # no estimate yet
+    assert predictor("pallas") is None  # no estimator registered
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(0, None)
+    )
+    predictor2 = LEDGER.rung_predictor({"xla-scan": lambda: 10})
+    assert predictor2("xla-scan") is None  # no budget known
+
+
+# ------------------------------------------------------------------- ledger
+
+
+def test_ledger_span_watermarks(monkeypatch):
+    led = MemoryLedger()
+    readings = iter([100, 700, 300, 900, 50])
+    monkeypatch.setattr(
+        ledger_mod,
+        "device_memory_stats",
+        lambda: (next(readings), 1000, "test"),
+    )
+    fid = led.span_open("apply")  # 100
+    led.poll()  # 700
+    fid2 = led.span_open("apply/probe")  # 300
+    led.span_close(fid2)  # 900
+    led.span_close(fid)  # 50
+    assert led.peak_bytes == 900
+    assert led.watermarks["apply"] == 900
+    assert led.watermarks["apply/probe"] == 900
+    summary = led.summary()
+    assert summary["peak_bytes"] == 900
+    assert summary["samples"] == 5
+    assert summary["watermarks"]["apply"] == 900
+
+
+def test_predict_fit_three_valued(monkeypatch):
+    led = MemoryLedger()
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(500, 1000)
+    )
+    assert led.predict_fit(100) is True  # 600 <= 920
+    assert led.predict_fit(500) is False  # 1000 > 920
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", _fixed_stats(500, None)
+    )
+    assert led.predict_fit(100) is None  # no budget -> stay reactive
+
+
+def test_device_memory_stats_env_budget(monkeypatch):
+    """On backends without allocator stats (CPU) the budget comes from
+    SIMON_DEVICE_MEM_BUDGET and in-use from live-buffer accounting."""
+    monkeypatch.setenv("SIMON_DEVICE_MEM_BUDGET", "123456")
+    in_use, limit, source = ledger_mod.device_memory_stats()
+    if source == "live_arrays":  # CPU test env
+        assert limit == 123456
+        assert in_use >= 0
+
+
+# ----------------------------------------------------------------- AOT cost
+
+
+def test_aot_cost_cache_second_same_shape_compiles_nothing():
+    import jax
+    import jax.numpy as jnp
+
+    site = "obstest_aot"
+    fn = instrument_jit(jax.jit(lambda x: x * 2 + 1), site)
+    a = jnp.arange(8, dtype=jnp.float32)
+    out1 = fn(a)
+    compiles_after_first = COUNTERS.get(f"jax_recompiles_{site}")
+    assert compiles_after_first == 1
+    assert COSTS.signatures(site) == 1
+    out2 = fn(a + 1)  # same signature, different values
+    assert COUNTERS.get(f"jax_recompiles_{site}") == 1  # cache hit
+    assert COSTS.signatures(site) == 1
+    np.testing.assert_allclose(np.asarray(out1), np.arange(8) * 2 + 1)
+    np.testing.assert_allclose(np.asarray(out2), (np.arange(8) + 1) * 2 + 1)
+    # a new shape is a new signature -> exactly one more compile
+    fn(jnp.arange(16, dtype=jnp.float32))
+    assert COUNTERS.get(f"jax_recompiles_{site}") == 2
+    assert COSTS.signatures(site) == 2
+    assert COUNTERS.get(f"jax_dispatches_{site}") == 3
+    # the dispatch latency histogram recorded every call
+    h = HISTOS.peek(f"jit/{site}")
+    assert h is not None and h.count == 3
+
+
+def test_aot_static_argnums_and_record_fields():
+    import jax
+    import jax.numpy as jnp
+
+    site = "obstest_aot_static"
+    fn = instrument_jit(
+        jax.jit(lambda k, x: x * k, static_argnums=0), site,
+        static_argnums=(0,),
+    )
+    x = jnp.ones((32, 4), dtype=jnp.float32)
+    out = fn(3, x)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    recs = COSTS.records_for(site)
+    assert len(recs) == 1
+    rec = next(iter(recs.values()))
+    assert rec.lead_dim == 32
+    assert rec.output_bytes >= 0 and rec.workspace_bytes >= 0
+    # distinct static value = distinct signature/executable
+    fn(4, x)
+    assert COSTS.signatures(site) == 2
+
+
+def test_aot_disabled_by_env(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("SIMON_AOT", "0")
+    site = "obstest_aot_off"
+    fn = instrument_jit(jax.jit(lambda x: x + 1), site)
+    fn(jnp.ones(3))
+    assert COSTS.signatures(site) == 0  # no AOT capture
+    # the fallback recompile counter still saw the pjit cache grow
+    assert COUNTERS.get(f"jax_recompiles_{site}") == 1
+
+
+def test_cost_registry_estimate_scaling():
+    reg = CostRegistry()
+    reg.record(
+        "s", ("sig", 128),
+        CostRecord(
+            site="s", output_bytes=1000, temp_bytes=3000, lead_dim=128
+        ),
+    )
+    assert reg.estimate_bytes("s", 128) == 4000  # exact signature
+    assert reg.estimate_bytes("s", 64) == 2000  # linear extrapolation
+    assert reg.estimate_bytes("s", 256) == 8000
+    assert reg.estimate_bytes("s") == 4000  # largest known
+    assert reg.estimate_bytes("missing") is None
+    est = reg.chunk_estimator("s")
+    assert est(0, 64) == 2000
+    assert reg.chunk_estimator("missing")(0, 64) is None
+    # argument bytes count toward the prediction (the chunked executors
+    # build each chunk's argument arrays AFTER predict_fit runs): whole
+    # when shrinking below the compiled shape (upper bound for the
+    # splitting direction), linearly scaled when growing past it
+    reg.record(
+        "a", ("sig", 100),
+        CostRecord(
+            site="a", argument_bytes=500, output_bytes=1000,
+            temp_bytes=3000, lead_dim=100,
+        ),
+    )
+    assert reg.estimate_bytes("a", 100) == 4500  # exact: args included
+    assert reg.estimate_bytes("a", 50) == 2500  # workspace/2 + args whole
+    assert reg.estimate_bytes("a", 200) == 9000  # everything doubles
+
+
+def test_cost_summary_shape():
+    reg = CostRegistry()
+    reg.record(
+        "site_a", "sig1",
+        CostRecord(site="site_a", flops=10.0, output_bytes=5, lead_dim=4),
+    )
+    s = reg.summary()
+    assert s["site_a"]["signatures"] == 1
+    assert s["site_a"]["flops"] == 10.0
+    assert "_totals" in s
+
+
+# --------------------------------------------------------------- histograms
+
+
+def test_bucket_boundaries_are_half_open():
+    from open_simulator_tpu.obs.histo import _UPPER, N_BUCKETS
+
+    assert bucket_of(0.0) == 0
+    assert bucket_of(histo_mod.LOW / 2) == 0
+    assert bucket_of(histo_mod.HIGH) == N_BUCKETS - 1
+    assert bucket_of(1e9) == N_BUCKETS - 1
+    for i in range(1, N_BUCKETS - 1):
+        lo = _UPPER[i - 1]
+        assert bucket_of(lo) == i, f"lower edge of bucket {i}"
+        assert bucket_of(lo * 1.0001) == i
+
+
+def test_histogram_percentiles_vs_numpy_reference():
+    rng = np.random.default_rng(7)
+    samples = rng.lognormal(mean=-5.0, sigma=1.5, size=20_000)
+    h = Histogram()
+    for s in samples:
+        h.record(float(s))
+    assert h.count == len(samples)
+    assert math.isclose(h.mean(), float(samples.mean()), rel_tol=1e-9)
+    # documented precision contract: exact to within one bucket, i.e.
+    # relative error bounded by RATIO - 1
+    tol = histo_mod.RATIO - 1.0
+    for q in (10, 50, 90, 95, 99):
+        ref = float(np.percentile(samples, q))
+        got = h.percentile(q)
+        assert abs(got - ref) <= ref * tol + 1e-12, (
+            f"p{q}: histogram {got} vs numpy {ref} (tol {tol:.3f})"
+        )
+    # p0/p100 clamp to the observed extremes exactly
+    assert h.percentile(0) == float(samples.min())
+    assert h.percentile(100) == float(samples.max())
+
+
+def test_histogram_concurrent_recorders():
+    h = Histogram()
+    values = [0.001, 0.01, 0.1, 1.0]
+    n_threads, per_thread = 8, 2500
+
+    def work(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(per_thread):
+            h.record(values[rng.integers(len(values))])
+
+    threads = [
+        threading.Thread(target=work, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert h.count == total
+    assert sum(h.counts) == total  # no lost increments
+    assert h.min == min(values) and h.max == max(values)
+    # sum is consistent with the recorded mix (all values are exact
+    # binary-representable floats... 0.1/0.001 are not, so tolerance)
+    assert 0 < h.sum < total * max(values)
+
+
+def test_histogram_rejects_negative_and_nan():
+    h = Histogram()
+    h.record(-1.0)
+    h.record(float("nan"))
+    assert h.count == 0
+    h.record(0.5)
+    assert h.count == 1
+
+
+def test_registry_summary_and_prometheus_exposition():
+    HISTOS.reset()
+    try:
+        for v in (0.002, 0.004, 0.008, 5.0):
+            HISTOS.observe("obstest/phase", v)
+        s = HISTOS.summary()
+        assert s["obstest/phase"]["count"] == 4
+        assert "buckets" not in s["obstest/phase"]
+        s2 = HISTOS.summary(with_buckets=True)
+        assert sum(s2["obstest/phase"]["buckets"]) == 4
+        lines = histo_mod.prometheus_lines()
+        text = "\n".join(lines)
+        assert 'simon_latency_seconds_count{site="obstest/phase"} 4' in text
+        assert '_bucket{site="obstest/phase",le="+Inf"} 4' in text
+        assert 'simon_latency_p95_seconds{site="obstest/phase"}' in text
+        # cumulative bucket counts never decrease
+        cums = [
+            int(line.rsplit(" ", 1)[1])
+            for line in lines
+            if line.startswith("simon_latency_seconds_bucket")
+        ]
+        assert cums == sorted(cums)
+    finally:
+        HISTOS.reset()
+
+
+# ------------------------------------------------------------------- doctor
+
+
+def _bench_record(value=100.0, unit="pods/s", dispatches=10, recompiles=2,
+                  peak=1000, p95=5.0):
+    return {
+        "metric": "test metric",
+        "value": value,
+        "unit": unit,
+        "obs": {
+            "jax_dispatches": dispatches,
+            "jax_recompiles": recompiles,
+            "ledger": {"peak_bytes": peak, "samples": 3, "watermarks": {}},
+            "histograms": {"jit/scan": {"count": 4, "p95_ms": p95}},
+        },
+    }
+
+
+def test_doctor_passes_identical_records():
+    r = _bench_record()
+    report = diff_records(r, r)
+    assert report.ok and not report.skipped
+    dims = {row.dimension for row in report.rows}
+    assert {"value (pods/s)", "jax_dispatches", "jax_recompiles",
+            "ledger.peak_bytes", "p95 jit/scan"} <= dims
+
+
+def test_doctor_detects_seeded_regressions():
+    base = _bench_record()
+    # rate unit: LOWER is a regression
+    report = diff_records(base, _bench_record(value=40.0))
+    assert [r.dimension for r in report.regressions] == ["value (pods/s)"]
+    # dispatches: absolute, default slack 0
+    report = diff_records(base, _bench_record(dispatches=11))
+    assert [r.dimension for r in report.regressions] == ["jax_dispatches"]
+    # recompiles with slack: +1 allowed, +2 trips
+    th = Thresholds(recompile_abs=1)
+    assert diff_records(base, _bench_record(recompiles=3), th).ok
+    assert not diff_records(base, _bench_record(recompiles=4), th).ok
+    # peak HBM: fractional, one-sided up
+    assert diff_records(base, _bench_record(peak=1400)).ok
+    report = diff_records(base, _bench_record(peak=1600))
+    assert [r.dimension for r in report.regressions] == ["ledger.peak_bytes"]
+    # p95 per site
+    report = diff_records(base, _bench_record(p95=9.0))
+    assert [r.dimension for r in report.regressions] == ["p95 jit/scan"]
+    # getting FASTER / dispatching LESS never trips
+    assert diff_records(
+        base, _bench_record(value=400.0, dispatches=5, peak=10, p95=0.1)
+    ).ok
+
+
+def test_doctor_seconds_unit_regresses_upward():
+    base = _bench_record(value=10.0, unit="s")
+    assert diff_records(base, _bench_record(value=14.0, unit="s")).ok
+    assert not diff_records(base, _bench_record(value=16.0, unit="s")).ok
+    # and DOWN is an improvement for seconds
+    assert diff_records(base, _bench_record(value=2.0, unit="s")).ok
+
+
+def test_doctor_skips_dimensions_absent_from_either_side():
+    base = _bench_record()
+    cand = {"metric": "m", "value": 100.0, "unit": "pods/s",
+            "obs": {"jax_dispatches": 10}}
+    report = diff_records(base, cand)
+    assert report.ok
+    assert "jax_recompiles" in report.skipped
+    assert "ledger.peak_bytes" in report.skipped
+    assert "histograms" in report.skipped
+
+
+def test_doctor_render_text_marks_regressions():
+    base = _bench_record()
+    report = diff_records(base, _bench_record(dispatches=12))
+    text = render_text(report, "BASE", "CAND")
+    assert "REGRESSED" in text and "jax_dispatches" in text
+    assert "RESULT: 1 regression(s)" in text
+
+
+def test_load_bench_record_shapes(tmp_path):
+    rec = _bench_record()
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(rec))
+    assert load_bench_record(str(raw))["value"] == 100.0
+    # JSONL with progress noise: last record with a "metric" key wins
+    jsonl = tmp_path / "run.jsonl"
+    jsonl.write_text(
+        "starting up\n"
+        + json.dumps({"progress": 1})
+        + "\n"
+        + json.dumps(dict(rec, value=1.0))
+        + "\n"
+        + json.dumps(dict(rec, value=2.0))
+        + "\n"
+    )
+    assert load_bench_record(str(jsonl))["value"] == 2.0
+    # checked-in BENCH_r*.json wrapper: the record is in "tail"
+    wrapper = tmp_path / "BENCH_rXX.json"
+    wrapper.write_text(
+        json.dumps({"n": 1, "cmd": "x", "rc": 0, "tail": json.dumps(rec)})
+    )
+    assert load_bench_record(str(wrapper))["value"] == 100.0
+    from open_simulator_tpu.models.validation import InputError
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json at all")
+    with pytest.raises(InputError):
+        load_bench_record(str(bad))
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    with pytest.raises(InputError):
+        load_bench_record(str(empty))
+
+
+def test_doctor_cli_exit_codes(tmp_path):
+    from open_simulator_tpu.cli import build_parser
+
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_bench_record()))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_bench_record(value=110.0)))
+    doctored = tmp_path / "doctored.json"
+    doctored.write_text(json.dumps(_bench_record(dispatches=13)))
+    out = tmp_path / "report.json"
+    parser = build_parser()
+
+    args = parser.parse_args(["doctor", str(base), str(good)])
+    assert args.func(args) == 0
+    args = parser.parse_args(
+        ["doctor", str(base), str(doctored), "--format", "json",
+         "--out", str(out)]
+    )
+    assert args.func(args) == 1  # seeded regression -> exit 1
+    report = json.loads(out.read_text())
+    assert report["ok"] is False
+    assert any(
+        r["dimension"] == "jax_dispatches" and r["regressed"]
+        for r in report["rows"]
+    )
+    # absolute slack waves the same diff through
+    args = parser.parse_args(
+        ["doctor", str(base), str(doctored), "--dispatch-tolerance", "3"]
+    )
+    assert args.func(args) == 0
+    args = parser.parse_args(["doctor", str(tmp_path / "nope"), str(good)])
+    assert args.func(args) == 2  # input error
+
+
+# ----------------------------------------------------------- artifact gates
+
+
+def test_validate_trace_observatory_blocks():
+    from tools.validate_trace import validate_observatory
+
+    block = {
+        "costs": {
+            "scan": {
+                "flops": 10.0, "bytes_accessed": 20.0,
+                "argument_bytes": 1, "output_bytes": 2, "temp_bytes": 3,
+                "generated_code_bytes": 0, "lead_dim": 8, "signatures": 1,
+            },
+            "_totals": {"compiles": 1},
+        },
+        "ledger": {
+            "peak_bytes": 900, "samples": 4,
+            "watermarks": {"apply": 900, "apply/probe": 100},
+        },
+        "histograms": {
+            "jit/scan": {
+                "count": 3, "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+                "buckets": [1, 2] + [0] * 62,
+            },
+        },
+    }
+    summary = validate_observatory(block, require=True, require_peak=True)
+    assert "1 cost site(s)" in summary and "900B" in summary
+
+    with pytest.raises(ValueError, match="no observatory"):
+        validate_observatory({}, require=True)
+    with pytest.raises(ValueError, match="nonzero"):
+        validate_observatory(
+            {"ledger": {"peak_bytes": 0, "samples": 1, "watermarks": {}}},
+            require_peak=True,
+        )
+    with pytest.raises(ValueError, match="bucket sum"):
+        bad = json.loads(json.dumps(block))
+        bad["histograms"]["jit/scan"]["buckets"][0] = 5
+        validate_observatory(bad)
+    with pytest.raises(ValueError, match="not ordered"):
+        bad = json.loads(json.dumps(block))
+        bad["histograms"]["jit/scan"]["p50_ms"] = 9.0
+        validate_observatory(bad)
+    with pytest.raises(ValueError, match="outside"):
+        bad = json.loads(json.dumps(block))
+        bad["ledger"]["watermarks"]["apply"] = 9999  # > peak
+        validate_observatory(bad)
+    with pytest.raises(ValueError, match="signature"):
+        bad = json.loads(json.dumps(block))
+        bad["costs"]["scan"]["signatures"] = 0
+        validate_observatory(bad)

@@ -1,11 +1,29 @@
-"""Validate a `--trace-out` Chrome trace file (CI artifact gate).
+"""Validate `--trace-out` traces and bench records (CI artifact gate).
 
-Checks that the file is well-formed trace-event JSON, contains span
-("X") events, and that the span forest reaches a minimum nesting depth
-— the observable proof that the flight recorder captured a real
-hierarchy (command root -> phase -> device dispatch), not a flat list.
+Two artifact shapes, auto-detected:
+
+- a Chrome trace file (``--trace-out``): well-formed trace-event JSON
+  with span ("X") events whose forest reaches a minimum nesting depth
+  — the observable proof that the flight recorder captured a real
+  hierarchy (command root -> phase -> device dispatch), not a flat
+  list. Since the compiled-cost observatory the exporter also attaches
+  a ``simonObservatory`` block (costs / ledger / histograms), which is
+  structurally validated when present (or required via
+  ``--require-observatory``).
+- a bench record (``bench.py`` output line, JSONL run, or checked-in
+  BENCH_r*.json wrapper): the ``obs`` block's ``costs`` / ``ledger`` /
+  ``histograms`` sub-blocks are validated the same way.
+
+Observatory checks are structural AND arithmetic: cost rows carry the
+full analysis field set with non-negative values, the ledger's
+watermarks never exceed its process peak, and each histogram's bucket
+counts sum to its total with ordered p50 <= p95 <= p99.
+``--require-peak`` additionally asserts a NONZERO ledger peak
+watermark — the CI smoke's proof that the memory ledger actually
+sampled live device memory rather than vacuously passing.
 
     python tools/validate_trace.py TRACE.json [--min-depth 3]
+        [--require-observatory] [--require-peak]
 
 Exit 0 on success (prints a one-line summary), 1 with a diagnostic
 otherwise.
@@ -22,11 +40,172 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from open_simulator_tpu.obs.spans import SpanRecord, nesting_depth  # noqa: E402
 
+_COST_FIELDS = (
+    "flops",
+    "bytes_accessed",
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "generated_code_bytes",
+    "lead_dim",
+)
 
-def validate(path: str, min_depth: int = 3) -> str:
+
+def _validate_costs(costs) -> int:
+    if not isinstance(costs, dict):
+        raise ValueError("costs block is not an object")
+    sites = 0
+    for site, row in costs.items():
+        if site == "_totals":
+            continue
+        if not isinstance(row, dict):
+            raise ValueError(f"costs[{site!r}] is not an object")
+        for field in _COST_FIELDS:
+            v = row.get(field)
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ValueError(
+                    f"costs[{site!r}].{field} missing or negative: {v!r}"
+                )
+        if int(row.get("signatures", 0)) < 1:
+            raise ValueError(
+                f"costs[{site!r}]: a recorded site must have >= 1 "
+                f"compiled signature"
+            )
+        sites += 1
+    return sites
+
+
+def _validate_ledger(ledger, require_peak: bool) -> None:
+    if not isinstance(ledger, dict):
+        raise ValueError("ledger block is not an object")
+    peak = ledger.get("peak_bytes")
+    if not isinstance(peak, (int, float)) or peak < 0:
+        raise ValueError(f"ledger.peak_bytes missing or negative: {peak!r}")
+    if int(ledger.get("samples", 0)) < 1:
+        raise ValueError("ledger recorded zero samples")
+    marks = ledger.get("watermarks")
+    if not isinstance(marks, dict):
+        raise ValueError("ledger.watermarks is not an object")
+    for name, v in marks.items():
+        if not isinstance(v, (int, float)) or v < 0 or v > peak:
+            raise ValueError(
+                f"ledger.watermarks[{name!r}] = {v!r} outside [0, "
+                f"peak={peak}]"
+            )
+    if require_peak and not (peak > 0 and marks):
+        raise ValueError(
+            f"ledger peak watermark must be nonzero (peak_bytes={peak}, "
+            f"{len(marks)} span watermark(s)) — the memory ledger never "
+            "observed live device memory"
+        )
+
+
+def _validate_histograms(histos) -> int:
+    if not isinstance(histos, dict):
+        raise ValueError("histograms block is not an object")
+    for site, row in histos.items():
+        if not isinstance(row, dict):
+            raise ValueError(f"histograms[{site!r}] is not an object")
+        count = row.get("count")
+        if not isinstance(count, int) or count < 1:
+            raise ValueError(
+                f"histograms[{site!r}].count missing or < 1: {count!r}"
+            )
+        buckets = row.get("buckets")
+        if buckets is not None:
+            if not isinstance(buckets, list) or any(
+                not isinstance(c, int) or c < 0 for c in buckets
+            ):
+                raise ValueError(
+                    f"histograms[{site!r}].buckets malformed"
+                )
+            if sum(buckets) != count:
+                raise ValueError(
+                    f"histograms[{site!r}]: bucket sum {sum(buckets)} "
+                    f"!= count {count}"
+                )
+        qs = []
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            v = row.get(q)
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ValueError(
+                    f"histograms[{site!r}].{q} missing or negative: {v!r}"
+                )
+            qs.append(v)
+        if not (qs[0] <= qs[1] <= qs[2]):
+            raise ValueError(
+                f"histograms[{site!r}]: percentiles not ordered "
+                f"(p50={qs[0]}, p95={qs[1]}, p99={qs[2]})"
+            )
+    return len(histos)
+
+
+def validate_observatory(
+    block, *, require: bool = False, require_peak: bool = False
+) -> str:
+    """Validate a costs/ledger/histograms observatory block (a trace's
+    ``simonObservatory`` or a bench record's ``obs``). Returns a short
+    summary fragment; raises ValueError on structural damage or — with
+    ``require``/``require_peak`` — on absence."""
+    block = block or {}
+    parts = []
+    if "costs" in block:
+        parts.append(f"{_validate_costs(block['costs'])} cost site(s)")
+    if "ledger" in block:
+        _validate_ledger(block["ledger"], require_peak)
+        parts.append(
+            f"ledger peak {int(block['ledger']['peak_bytes'])}B"
+        )
+    elif require_peak:
+        raise ValueError("no ledger block (peak watermark required)")
+    if "histograms" in block:
+        parts.append(
+            f"{_validate_histograms(block['histograms'])} histogram(s)"
+        )
+    if require and not parts:
+        raise ValueError(
+            "no observatory blocks (costs/ledger/histograms) found"
+        )
+    return ", ".join(parts) if parts else "no observatory blocks"
+
+
+def _load_bench_doc(path: str):
+    """A bench record if the file is one (raw line / JSONL / BENCH
+    wrapper), else None. Reuses the doctor's loader so both gates
+    accept exactly the same shapes."""
+    from open_simulator_tpu.obs.doctor import load_bench_record
+
+    try:
+        return load_bench_record(path)
+    except Exception:  # noqa: BLE001 - not a bench record: fall through to the trace shape
+        return None
+
+
+def validate(
+    path: str,
+    min_depth: int = 3,
+    require_observatory: bool = False,
+    require_peak: bool = False,
+) -> str:
     """Returns the summary line; raises ValueError on any failure."""
     with open(path, encoding="utf-8") as f:
-        doc = json.load(f)
+        text = f.read()
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if not (isinstance(doc, dict) and "traceEvents" in doc):
+        bench = _load_bench_doc(path)
+        if bench is not None:
+            summary = validate_observatory(
+                bench.get("obs"),
+                require=require_observatory,
+                require_peak=require_peak,
+            )
+            return f"{path}: OK — bench record, {summary}"
+    if doc is None:
+        raise ValueError("not JSON (and not a bench record)")
     events = doc.get("traceEvents")
     if not isinstance(events, list) or not events:
         raise ValueError("no traceEvents array (or empty)")
@@ -59,19 +238,45 @@ def validate(path: str, min_depth: int = 3) -> str:
             f"span nesting depth {depth} < required {min_depth} "
             f"({len(recs)} spans: {sorted({r.name for r in recs})})"
         )
+    obs_summary = validate_observatory(
+        doc.get("simonObservatory"),
+        require=require_observatory,
+        require_peak=require_peak,
+    )
     return (
         f"{path}: OK — {len(recs)} spans, nesting depth {depth}, "
-        f"{len({r.tid for r in recs})} thread(s)"
+        f"{len({r.tid for r in recs})} thread(s); {obs_summary}"
     )
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("trace", help="Chrome trace JSON from --trace-out")
+    ap.add_argument(
+        "trace", help="Chrome trace JSON from --trace-out, or a bench record"
+    )
     ap.add_argument("--min-depth", type=int, default=3)
+    ap.add_argument(
+        "--require-observatory",
+        action="store_true",
+        help="fail unless at least one costs/ledger/histograms block is "
+        "present (and valid)",
+    )
+    ap.add_argument(
+        "--require-peak",
+        action="store_true",
+        help="fail unless the memory ledger recorded a NONZERO peak "
+        "watermark (CI smoke: proof the ledger sampled real memory)",
+    )
     args = ap.parse_args()
     try:
-        print(validate(args.trace, args.min_depth))
+        print(
+            validate(
+                args.trace,
+                args.min_depth,
+                require_observatory=args.require_observatory,
+                require_peak=args.require_peak,
+            )
+        )
     except (OSError, ValueError, KeyError) as e:
         print(f"{args.trace}: INVALID — {e}", file=sys.stderr)
         return 1

@@ -859,6 +859,11 @@ class Applier:
             # than the node pool): a clean CLI error, not a silent
             # serial fallback
             raise
+        except ConformanceError:
+            # engines disagreed: an internal defect that must stay LOUD
+            # (docs/ROBUSTNESS.md) — degrading to serial would hide the
+            # exact evidence the cross-check exists to surface
+            raise
         except Exception as e:  # pragma: no cover - diagnostic path
             logging.getLogger(__name__).warning(
                 "batched capacity plan failed, falling back to serial escalation: %s", e

@@ -616,6 +616,20 @@ def cmd_serve(args) -> int:
             raise InputError("--default-deadline must be > 0 seconds")
         if args.drain_timeout < 0:
             raise InputError("--drain-timeout must be >= 0 seconds")
+        if args.tick_budget is not None and args.tick_budget <= 0:
+            raise InputError("--tick-budget must be > 0 seconds")
+        if args.max_request_pods is not None and args.max_request_pods < 1:
+            raise InputError("--max-request-pods must be >= 1")
+        if args.max_sessions < 1:
+            raise InputError("--max-sessions must be >= 1")
+        # resident service: circuit breakers get a recovery cooldown so
+        # an apiserver/extender flap degrades, not dooms, the daemon.
+        # SIMON_BREAKER_COOLDOWN wins when set (0 restores the one-shot
+        # stay-open posture); the 30s default applies only without it
+        from .runtime.retry import BREAKER_COOLDOWN_ENV, enable_breaker_recovery
+
+        if not os.environ.get(BREAKER_COOLDOWN_ENV):
+            enable_breaker_recovery(30.0)
         config = SimonConfig.from_file(args.simon_config)
         applier = Applier(config)
         cluster = applier.load_cluster()
@@ -628,6 +642,10 @@ def cmd_serve(args) -> int:
             queue_depth=args.queue_depth,
             default_deadline_s=args.default_deadline,
             drain_timeout_s=args.drain_timeout,
+            tick_budget_s=args.tick_budget,
+            max_request_pods=args.max_request_pods,
+            max_sessions=args.max_sessions,
+            snapshot_path=args.snapshot or None,
         )
     except (OSError, ValueError, ExternalIOError, InputError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -714,6 +732,11 @@ def cmd_shadow(args) -> int:
                 "--tail needs a kubeConfig cluster in the simon config "
                 "(customConfig clusters have no scheduler to shadow)"
             )
+        if args.max_catchup < 1:
+            raise InputError(
+                "--max-catchup must be >= 1 (0 would never replay the "
+                "backlog and the mirror would stop advancing)"
+            )
     except (OSError, ValueError, InputError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -799,16 +822,29 @@ def cmd_shadow(args) -> int:
 
 def _shadow_tail(args, config, budget):
     """Live shadow loop: bootstrap the mirror from the first LIST, then
-    poll-diff-replay until --max-polls / --max-steps / deadline."""
+    poll-diff-replay until --max-polls / --max-steps / deadline.
+
+    Resident-service hardening (docs/ROBUSTNESS.md): the apiserver's
+    circuit breaker gets a recovery cooldown (--breaker-cooldown), a
+    failed poll counts a flap and the loop BACKS OFF and continues
+    instead of aborting the audit, and a recovered flap's backlog
+    replays at most --max-catchup steps per round (bounded catch-up:
+    the mirror converges without one giant stop-the-world replay)."""
+    import collections
     import time
 
     from .models.decode import ResourceTypes
     from .models.kubeclient import KubeClient
-    from .runtime import ExecutionHalted
+    from .runtime import ExecutionHalted, ExternalIOError
+    from .runtime import inject as _inject
+    from .runtime.retry import backoff_delay, enable_breaker_recovery
     from .shadow.ingest import ClusterTailer
     from .shadow.log import DecisionLogWriter, cluster_fingerprint
     from .shadow.replay import ShadowReplayer
+    from .utils.trace import COUNTERS, GLOBAL
 
+    if args.breaker_cooldown and args.breaker_cooldown > 0:
+        enable_breaker_recovery(args.breaker_cooldown)
     with KubeClient(config.kube_config) as client:
         tailer = ClusterTailer(client)
         nodes, boot_steps = tailer.bootstrap()
@@ -820,12 +856,17 @@ def _shadow_tail(args, config, budget):
             writer = DecisionLogWriter(
                 args.tail_record, cluster_fingerprint(cluster)
             )
+        pending = collections.deque()  # observed, not yet replayed
+
+        def apply_step(st):
+            if writer is not None:
+                writer.append(st)
+            replayer.step(st)
+
         try:
             for st in boot_steps:
-                if writer is not None:
-                    writer.append(st)
-                replayer.step(st)
-            polls = 0
+                apply_step(st)
+            polls = flaps = 0
             while True:
                 if budget is not None:
                     budget.check(f"shadow tail (poll {polls})")
@@ -838,11 +879,76 @@ def _shadow_tail(args, config, budget):
                     break
                 if polls:
                     time.sleep(args.poll_interval)
-                for st in tailer.poll():
-                    if writer is not None:
-                        writer.append(st)
-                    replayer.step(st)
+                try:
+                    # chaos seam: `shadow.poll` faults (reset/timeout/
+                    # http:NNN/exio) land like a real apiserver flap
+                    _inject.fire("shadow.poll", poll=polls)
+                    pending.extend(tailer.poll())
+                except (ExternalIOError, OSError) as e:
+                    # apiserver flap: count it, note it, back off
+                    # (bounded, deterministic), keep the audit alive —
+                    # the breaker behind tailer.poll() fails further
+                    # calls fast until its cooldown elapses
+                    flaps += 1
+                    COUNTERS.inc("shadow_tail_flaps_total")
+                    GLOBAL.append_note(
+                        "shadow-tail-flap",
+                        f"poll {polls}: {str(e)[:100]}",
+                    )
+                    logging.warning(
+                        "shadow tail poll failed (%s); continuing", e
+                    )
+                    time.sleep(
+                        min(backoff_delay("shadow-tail", min(flaps, 6)),
+                            args.poll_interval)
+                    )
+                else:
+                    flaps = 0
+                # bounded catch-up: a big post-flap diff replays across
+                # rounds; the backlog depth is observable
+                applied = 0
+                while pending and applied < args.max_catchup:
+                    if budget is not None:
+                        budget.check(f"shadow tail (poll {polls}, catch-up)")
+                    apply_step(pending.popleft())
+                    applied += 1
+                if pending:
+                    COUNTERS.inc("shadow_tail_deferred_steps_total", len(pending))
+                    GLOBAL.append_note(
+                        "shadow-tail-catchup",
+                        f"poll {polls}: {applied} applied, "
+                        f"{len(pending)} deferred to the next round",
+                    )
+                COUNTERS.gauge("shadow_tail_backlog", float(len(pending)))
                 polls += 1
+            # drain any deferred backlog before reporting: everything
+            # observed is audited (budget still owns the halt) — but
+            # --max-steps stays a hard cap: past it the remainder is
+            # RECORDED (--tail-record holds every observed step), not
+            # replayed, so a recovered flap's giant diff cannot blow
+            # through the user's explicit bound
+            while pending:
+                if (
+                    args.max_steps is not None
+                    and replayer.report.decisions >= args.max_steps
+                ):
+                    if writer is not None:
+                        for st in pending:
+                            writer.append(st)
+                    COUNTERS.inc(
+                        "shadow_tail_deferred_steps_total", len(pending)
+                    )
+                    GLOBAL.append_note(
+                        "shadow-tail-catchup",
+                        f"final drain stopped at --max-steps "
+                        f"{args.max_steps}; {len(pending)} observed "
+                        "step(s) recorded but not audited",
+                    )
+                    pending.clear()
+                    break
+                if budget is not None:
+                    budget.check("shadow tail (final catch-up)")
+                apply_step(pending.popleft())
         except ExecutionHalted as e:
             # everything audited before the halt is the partial result
             # (the --tail-record log already holds the observed steps)
@@ -1190,9 +1296,45 @@ def _add_obs_flags(p: argparse.ArgumentParser):
     )
 
 
+def _add_inject_flag(p: argparse.ArgumentParser):
+    """Chaos fault-injection flag shared by every guarded command
+    (runtime/inject.py, docs/ROBUSTNESS.md failure-mode matrix)."""
+    p.add_argument(
+        "--inject",
+        default="",
+        metavar="SPEC",
+        help="arm deterministic fault injection at the named guard "
+        "seams (equivalent to SIMON_INJECT). SPEC is ';'-separated "
+        "SITE=FAULT[:PARAM][@N][xCOUNT][%%EVERY][~PROB] clauses, e.g. "
+        "'jit.scenario_scan=oom@2' (device OOM at the 2nd dispatch) or "
+        "'io.kube*=reset@1x3' (3 connection resets). Sites: jit.<site>, "
+        "io.<label>, journal.fsync.<subsystem>, budget.check, "
+        "ledger.predict_fit, serve.tick, shadow.poll, timeline.tick. "
+        "Production paths are unmodified when unset "
+        "(docs/ROBUSTNESS.md)",
+    )
+
+
+def _arm_injection(args) -> None:
+    """Arm the injector from --inject (overriding any SIMON_INJECT the
+    process imported with). Bad specs raise InputError -> exit 2,
+    including a malformed SIMON_INJECT the import stashed instead of
+    crashing on (runtime/inject.py IMPORT_SPEC_ERROR)."""
+    from .runtime import inject as _inject
+
+    spec = getattr(args, "inject", "")
+    if spec:
+        _inject.INJECT.configure(spec)
+    elif _inject.IMPORT_SPEC_ERROR is not None:
+        # the stashed value IS an InputError (taxonomy-rooted); the
+        # lint cannot see through the variable
+        raise _inject.IMPORT_SPEC_ERROR  # simonlint: disable=EXC001
+
+
 def _add_guard_flags(p: argparse.ArgumentParser):
     """Execution-guard flags shared by the long-running commands
     (docs/ROBUSTNESS.md): wall-clock budget + resumable journal."""
+    _add_inject_flag(p)
     p.add_argument(
         "--deadline",
         type=float,
@@ -1424,6 +1566,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the pre-listen warmup request (faster start, slower "
         "first request)",
     )
+    p_serve.add_argument(
+        "--tick-budget", type=float, default=None, metavar="SECONDS",
+        help="admission latency budget: a request whose predicted wait "
+        "(p95 coalescer tick x ticks queued ahead) exceeds this is shed "
+        "with 429 + Retry-After before it takes a queue slot "
+        "(docs/SERVING.md admission control; default: off)",
+    )
+    p_serve.add_argument(
+        "--max-request-pods", type=int, default=None, metavar="N",
+        help="requests whose estimated pod count exceeds N are routed "
+        "to the serial oracle instead of the batched scan (one giant "
+        "request must not recompile the scan for everyone; default: off)",
+    )
+    p_serve.add_argument(
+        "--max-sessions", type=int, default=8, metavar="N",
+        help="warm-session LRU capacity (multi-tenant fleets); the "
+        "configured cluster is pinned, secondaries evict LRU-first and "
+        "under device-memory ledger pressure",
+    )
+    p_serve.add_argument(
+        "--snapshot", default="", metavar="PATH",
+        help="append session admit/evict/drain records to this "
+        "crash-safe JSONL snapshot journal (resumed across restarts; "
+        "torn tail recovered, interior damage refused)",
+    )
+    _add_inject_flag(p_serve)
     _add_obs_flags(p_serve)
     p_serve.set_defaults(func=cmd_serve)
 
@@ -1524,6 +1692,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="wall-clock budget: on expiry (or SIGINT) the audit stops "
         "at the next step boundary and reports what it has (exit 3/4)",
     )
+    p_shadow.add_argument(
+        "--max-catchup",
+        type=int,
+        default=500,
+        metavar="N",
+        help="--tail: apply at most N observed steps per poll round; "
+        "the backlog a recovered apiserver flap dumps on the tailer "
+        "replays across rounds instead of stalling the loop "
+        "(docs/ROBUSTNESS.md)",
+    )
+    p_shadow.add_argument(
+        "--breaker-cooldown",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="--tail: circuit-breaker recovery cooldown — after an "
+        "apiserver outage opens the breaker, a half-open probe retries "
+        "this often; the tail survives the flap instead of failing "
+        "forever (0 disables recovery: one-shot CLI posture)",
+    )
+    _add_inject_flag(p_shadow)
     _add_obs_flags(p_shadow)
     p_shadow.add_argument(
         "--format", choices=["table", "json"], default="table",
@@ -1730,6 +1919,11 @@ def main(argv=None) -> int:
     if not getattr(args, "func", None):
         parser.print_help()
         return 0
+    try:
+        _arm_injection(args)
+    except ValueError as e:  # InputError: a typo'd --inject is exit 2
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     return args.func(args)
 
 

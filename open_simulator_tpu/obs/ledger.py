@@ -175,7 +175,27 @@ class MemoryLedger:
         """Would a dispatch allocating ``estimate_bytes`` of fresh
         workspace fit right now? None when no budget is known (the
         caller must stay reactive); every real verdict is counted so
-        predicted-vs-actual accuracy is a number, not a hope."""
+        predicted-vs-actual accuracy is a number, not a hope.
+
+        ``ledger.predict_fit`` is an injection point: a ``lie:low``
+        clause answers True (everything fits — the predictive path is
+        blinded, the reactive ladder must still save the run) and
+        ``lie:high`` answers False (nothing fits — splits and serial
+        routing happen with zero real OOMs). The lies flow through the
+        same verdict counters, so ``ledger_predict_miss_total``
+        records exactly how often the liar was caught."""
+        from ..runtime import inject as _inject
+
+        lie = _inject.value("ledger.predict_fit")
+        if lie in ("low", "high"):
+            fits = lie == "low"
+            COUNTERS.inc("ledger_predictions_total")
+            COUNTERS.inc(
+                "ledger_predict_fit_total" if fits else "ledger_predict_unfit_total"
+            )
+            if not fits and label:
+                COUNTERS.inc(f"ledger_predict_unfit_{label}")
+            return fits
         in_use, limit, _src = device_memory_stats()
         if not limit:
             return None

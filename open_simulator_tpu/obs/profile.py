@@ -50,6 +50,7 @@ import threading
 import time
 from typing import Optional
 
+from ..runtime import inject as _inject
 from ..utils.trace import COUNTERS
 from . import spans as _spans
 from .costs import COSTS, extract_record
@@ -259,6 +260,10 @@ class InstrumentedJit:
     def __call__(self, *args, **kwargs):
         COUNTERS.inc("jax_dispatches_total")
         COUNTERS.inc(f"jax_dispatches_{self.name}")
+        # chaos seam: `jit.<site>` raises the configured device fault
+        # at the Nth dispatch of this site — the raw RuntimeError
+        # shapes the guard ladder classifies (runtime/inject.py)
+        _inject.fire(f"jit.{self.name}")
         from .spans import RECORDER
 
         t0 = time.perf_counter()

@@ -20,6 +20,7 @@ from .errors import (
     EXIT_PARTIAL_DEADLINE,
     BackendUnavailable,
     CompileFailure,
+    ConformanceError,
     DeadlineExceeded,
     DeviceOOM,
     ExecutionHalted,
@@ -27,9 +28,12 @@ from .errors import (
     GuardError,
     Interrupted,
 )
+from .inject import INJECT, InjectedCrash
 from .journal import Journal, JournalMismatch, config_fingerprint
 
 __all__ = [
+    "INJECT",
+    "InjectedCrash",
     "Budget",
     "sigint_to_budget",
     "Journal",
@@ -39,6 +43,7 @@ __all__ = [
     "DeviceOOM",
     "CompileFailure",
     "BackendUnavailable",
+    "ConformanceError",
     "DeadlineExceeded",
     "Interrupted",
     "ExecutionHalted",

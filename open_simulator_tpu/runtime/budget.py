@@ -26,6 +26,7 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
+from . import inject as _inject
 from .errors import DeadlineExceeded, Interrupted
 
 
@@ -67,7 +68,13 @@ class Budget:
     def check(self, boundary: str):
         """Raise ``Interrupted`` / ``DeadlineExceeded`` when the run
         must stop; a no-op otherwise. ``boundary`` names the safe point
-        for the partial report and the trace."""
+        for the partial report and the trace.
+
+        ``budget.check`` is itself an injection point (the chaos
+        matrix forces deadline/interrupt partials at exact boundaries
+        without racing a wall clock): a ``deadline``/``interrupt``
+        fault raises here exactly as an expired budget would."""
+        _inject.fire("budget.check", boundary=boundary)
         if self._interrupted:
             raise Interrupted(f"interrupted at {boundary}")
         if self.expired():

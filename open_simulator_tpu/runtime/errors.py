@@ -50,7 +50,15 @@ EXIT_INTERRUPTED = 4
 
 
 class GuardError(Exception):
-    """Base of the execution-guard taxonomy."""
+    """Base of the execution-guard taxonomy.
+
+    ``descent`` carries the degradation ladder's descent trace when
+    the error left ``guard.run_laddered`` after every rung failed:
+    one ``"<rung>: <why>"`` entry per rung tried, so a caller (or an
+    operator reading the typed report) sees the whole path down, not
+    just the final failure."""
+
+    descent: tuple = ()
 
 
 class DeviceOOM(GuardError):

@@ -142,6 +142,7 @@ def run_laddered(
         raise ValueError("run_laddered needs at least one rung")
     from ..utils.trace import COUNTERS
 
+    descent: List[str] = []
     for i, (rung, thunk) in enumerate(steps):
         if (
             predictor is not None
@@ -153,6 +154,7 @@ def run_laddered(
                 label, rung, steps[i + 1][0],
                 "memory ledger predicts it will not fit", trace,
             )
+            descent.append(f"{rung}: skipped on ledger verdict")
             if on_downgrade is not None:
                 on_downgrade(rung, None)
             continue
@@ -164,8 +166,18 @@ def run_laddered(
                 raise
             if cls is DeviceOOM:
                 COUNTERS.inc("guard_oom_reactive_total")
+            descent.append(f"{rung}: {cls.__name__}: {_reason(e)}")
             if i + 1 >= len(steps):
-                raise cls(f"{label}: {rung} failed: {_reason(e)}") from e
+                # the LAST rung failed: the raw backend exception must
+                # not escape — callers route taxonomy types to exit
+                # codes, so re-raise typed, carrying the full descent
+                # trace (every rung tried and why it fell)
+                wrapped = cls(
+                    f"{label}: ladder exhausted at {rung}: {_reason(e)} "
+                    f"(descent: {' | '.join(descent)})"
+                )
+                wrapped.descent = tuple(descent)
+                raise wrapped from e
             note_downgrade(label, rung, steps[i + 1][0], _reason(e), trace)
             if on_downgrade is not None:
                 on_downgrade(rung, e)
@@ -314,7 +326,14 @@ def run_chunked(
                 continue
             if hi - lo == 1:
                 if serial_fallback is None:
-                    raise
+                    # no serial floor: the failure leaves here typed
+                    # (never the raw XLA RuntimeError) so exit codes
+                    # stay within the taxonomy
+                    wrapped = DeviceOOM(
+                        f"{label}: single-item chunk still exhausts "
+                        f"device memory: {reason}"
+                    )
+                    raise wrapped from e
                 run_serial(lo, hi, reason, "device OOM even alone")
                 continue
             mid = (lo + hi) // 2

@@ -33,6 +33,7 @@ import os
 from typing import Dict, Optional
 
 from ..models.validation import InputError
+from . import inject as _inject
 
 JOURNAL_VERSION = 1
 
@@ -52,6 +53,11 @@ def config_fingerprint(*parts) -> str:
 class Journal:
     """One open journal file. Use ``create`` for a fresh run,
     ``resume`` to continue an interrupted one."""
+
+    #: fault-injection crash point fired before each durable append
+    #: (runtime/inject.py; subclass-style overrides per subsystem:
+    #: the serve session snapshot sets "journal.fsync.serve")
+    inject_site = "journal.fsync.apply"
 
     def __init__(self, path: str, fingerprint: str):
         self.path = path
@@ -181,7 +187,12 @@ class Journal:
             self.scenarios[str(rec["key"])] = rec
 
     def _write(self, rec: dict):
-        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        # chaos crash point: when armed with a `crash` clause, a torn
+        # prefix of `line` lands durably and InjectedCrash propagates —
+        # exactly the state a mid-append process death leaves behind
+        _inject.crash_write(self.inject_site, self._f, line)
+        self._f.write(line)
         self._f.flush()
         os.fsync(self._f.fileno())
 

@@ -29,6 +29,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
+from . import inject as _inject
 from .errors import ExternalIOError
 
 DEFAULT_ATTEMPTS = 3
@@ -39,6 +40,7 @@ BREAKER_THRESHOLD = 5  # consecutive failed calls before the circuit opens
 SUBPROCESS_TIMEOUT_ENV = "SIMON_SUBPROCESS_TIMEOUT"
 HTTP_TIMEOUT_ENV = "SIMON_HTTP_TIMEOUT"
 ATTEMPTS_ENV = "SIMON_IO_ATTEMPTS"
+BREAKER_COOLDOWN_ENV = "SIMON_BREAKER_COOLDOWN"
 
 DEFAULT_SUBPROCESS_TIMEOUT_S = 60.0
 DEFAULT_HTTP_TIMEOUT_S = 30.0
@@ -84,51 +86,140 @@ def backoff_delay(key: str, attempt: int, base: float = BASE_DELAY_S,
 
 @dataclass
 class CircuitBreaker:
-    """Consecutive-failure breaker for one endpoint. Once open it stays
-    open for the rest of the process: a plan run is one-shot, and a
-    flapping dependency mid-plan is worse than a skipped one."""
+    """Consecutive-failure breaker for one endpoint.
+
+    One-shot CLI runs keep the original posture: once open it stays
+    open for the rest of the process (``cooldown_s=None``) — a plan
+    run is one-shot, and a flapping dependency mid-plan is worse than
+    a skipped one. RESIDENT services (serve, the shadow tailer) set a
+    cooldown (``enable_breaker_recovery``): after ``cooldown_s`` the
+    breaker goes HALF-OPEN — one probe call is allowed through; its
+    success re-closes the circuit (the API server came back), its
+    failure re-opens a fresh cooldown window. A daemon mirroring a
+    live cluster must survive an apiserver flap, not sulk forever."""
 
     endpoint: str
     threshold: int = BREAKER_THRESHOLD
     failures: int = 0
     opened: bool = False
+    cooldown_s: Optional[float] = None
+    opened_at: float = 0.0
+    half_open: bool = False
 
     @property
     def is_open(self) -> bool:
         return self.opened
 
-    def record_success(self):
+    def allow_call(self) -> bool:
+        """False = fail fast (open, cooldown not elapsed). True either
+        means closed, or HALF-OPEN: the cooldown elapsed and this call
+        is the probe (record_success re-closes, record_failure
+        re-opens the window)."""
+        if not self.opened:
+            return True
+        if self.cooldown_s is None:
+            return False
+        if time.monotonic() - self.opened_at >= self.cooldown_s:
+            # re-arm the window BEFORE granting: concurrent callers in
+            # a multi-threaded daemon fail fast while THIS probe is in
+            # flight instead of all storming the still-dead endpoint
+            # (unsynchronized — a same-instant race lets a second probe
+            # through, which is bounded and benign; N-per-cooldown is
+            # the failure mode this prevents)
+            self.opened_at = time.monotonic()
+            self.half_open = True
+            return True
+        return False
+
+    def record_success(self, trace=None):
+        if self.opened:
+            from ..utils.trace import COUNTERS, GLOBAL
+
+            COUNTERS.inc("breaker_recloses_total")
+            (trace or GLOBAL).append_note(
+                "io-circuit-close",
+                f"{self.endpoint}: half-open probe succeeded; circuit "
+                "re-closed",
+            )
+            self.opened = False
+        self.half_open = False
         self.failures = 0
 
     def record_failure(self, trace=None):
         self.failures += 1
-        if not self.opened and self.failures >= self.threshold:
-            self.opened = True
-            from ..utils.trace import GLOBAL
+        reopen = self.half_open
+        if reopen or (not self.opened and self.failures >= self.threshold):
+            from ..utils.trace import COUNTERS, GLOBAL
 
+            self.opened = True
+            self.opened_at = time.monotonic()
+            self.half_open = False
+            COUNTERS.inc("breaker_opens_total")
             (trace or GLOBAL).append_note(
                 "io-circuit-open",
-                f"{self.endpoint}: open after {self.failures} consecutive "
-                "failures; further calls skip fast",
+                f"{self.endpoint}: "
+                + (
+                    "half-open probe failed; circuit re-opened"
+                    if reopen
+                    else f"open after {self.failures} consecutive "
+                    "failures; further calls skip fast"
+                ),
             )
 
 
 _breakers: Dict[str, CircuitBreaker] = {}
 _breakers_lock = threading.Lock()
+_default_cooldown: Optional[float] = None
+
+
+def _configured_cooldown() -> Optional[float]:
+    if _default_cooldown is not None:
+        return _default_cooldown
+    v = _env_float(BREAKER_COOLDOWN_ENV, 0.0)
+    return v if v > 0 else None
+
+
+def enable_breaker_recovery(cooldown_s: Optional[float]):
+    """Give every breaker (current and future) a half-open recovery
+    cooldown — the resident-service posture. ``None`` restores the
+    one-shot stay-open-forever default for new breakers."""
+    global _default_cooldown
+    _default_cooldown = cooldown_s
+    with _breakers_lock:
+        for b in _breakers.values():
+            b.cooldown_s = cooldown_s
 
 
 def breaker_for(endpoint: str) -> CircuitBreaker:
     with _breakers_lock:
         b = _breakers.get(endpoint)
         if b is None:
-            b = _breakers[endpoint] = CircuitBreaker(endpoint)
+            b = _breakers[endpoint] = CircuitBreaker(
+                endpoint, cooldown_s=_configured_cooldown()
+            )
         return b
+
+
+def breaker_states() -> Dict[str, dict]:
+    """Snapshot of every breaker for /metrics and /healthz: state is
+    0 closed / 1 open / 0.5 half-open (probe window)."""
+    with _breakers_lock:
+        return {
+            b.endpoint: {
+                "state": 0.5 if b.half_open else (1.0 if b.opened else 0.0),
+                "failures": b.failures,
+                "open": b.opened,
+            }
+            for b in _breakers.values()
+        }
 
 
 def reset_io_state():
     """Forget all breaker state (tests / long-lived embedders)."""
+    global _default_cooldown
     with _breakers_lock:
         _breakers.clear()
+    _default_cooldown = None
 
 
 def retry_io(
@@ -149,12 +240,23 @@ def retry_io(
     always) says so; non-retryable ones re-raise unchanged and do not
     count against the breaker (an HTTP 404 is an answer, not an
     outage). One exhausted call counts ONE breaker failure; an open
-    breaker fails fast with ``ExternalIOError`` and a trace note."""
-    from ..utils.trace import GLOBAL
+    breaker fails fast with ``ExternalIOError`` and a trace note
+    (unless its recovery cooldown elapsed — then one half-open probe
+    goes through; see CircuitBreaker).
+
+    Each failed attempt counts in ``retry_attempts_total`` and the
+    per-endpoint ``retry_attempts_ep:<endpoint>`` counter (exported as
+    ``simon_retry_attempts_total{endpoint=...}`` at serve /metrics).
+
+    ``io.<label>`` is an injection point (runtime/inject.py): armed
+    ``reset``/``timeout``/``http:CODE``/``slow`` clauses fail (or
+    delay) the attempt exactly as the real transport would, so the
+    retry/breaker path is chaos-testable without a flaky network."""
+    from ..utils.trace import COUNTERS, GLOBAL
 
     tr = trace or GLOBAL
     breaker = breaker_for(endpoint or label)
-    if breaker.is_open:
+    if not breaker.allow_call():
         tr.append_note("io-skip", f"{label}: circuit open, skipping call")
         raise ExternalIOError(
             f"{label}: circuit breaker open after {breaker.failures} "
@@ -162,15 +264,23 @@ def retry_io(
             endpoint=endpoint,
             argv=argv,
         )
+    if breaker.half_open:
+        tr.append_note(
+            "io-half-open",
+            f"{label}: breaker cooldown elapsed; probing the endpoint",
+        )
     n = attempts if attempts is not None else io_attempts()
     last: Optional[BaseException] = None
     for attempt in range(1, n + 1):
         try:
+            _inject.fire(f"io.{label}")
             out = fn()
         except catch as e:
             if retryable is not None and not retryable(e):
                 raise
             last = e
+            COUNTERS.inc("retry_attempts_total")
+            COUNTERS.inc(f"retry_attempts_ep:{endpoint or label}")
             if attempt < n:
                 delay = backoff_delay(label, attempt)
                 tr.append_note(
@@ -180,7 +290,7 @@ def retry_io(
                 )
                 sleep(delay)
         else:
-            breaker.record_success()
+            breaker.record_success(trace=tr)
             return out
     breaker.record_failure(trace=tr)
     raise ExternalIOError(

@@ -36,6 +36,7 @@ counters, and their own parsed request.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from collections import deque
@@ -43,9 +44,17 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..obs.histo import HISTOS
+from ..runtime import inject as _inject
 from ..runtime.budget import Budget
 from ..utils.trace import COUNTERS
 from .session import Session, WhatIfReply, WhatIfRequest
+
+log = logging.getLogger(__name__)
+
+# dispatcher-death watchdog poll interval: cheap enough to always run,
+# fast enough that a died dispatcher answers its casualties typed well
+# before any client's deadline
+WATCHDOG_INTERVAL_S = 0.25
 
 
 def partial_body(reason: str, message: str) -> bytes:
@@ -60,10 +69,17 @@ def partial_body(reason: str, message: str) -> bytes:
 @dataclass
 class PendingRequest:
     """One enqueued question plus its rendezvous with the handler
-    thread (`done` fires when `reply` is set)."""
+    thread (`done` fires when `reply` is set). ``route`` is the
+    admission verdict: "batch" rides the coalesced scan, "serial"
+    was routed to the host oracle (predicted-HBM / oversize —
+    serve/admission.py). ``tenant`` attributes the request's
+    counters."""
 
     request: WhatIfRequest
     budget: Budget
+    route: str = "batch"
+    tenant: str = "default"
+    route_reason: str = ""
     enqueued_at: float = field(default_factory=time.monotonic)
     done: threading.Event = field(default_factory=threading.Event)
     reply: Optional[WhatIfReply] = None
@@ -79,6 +95,7 @@ class Coalescer:
         session: Session,
         max_batch: int = 16,
         queue_depth: int = 64,
+        on_tick=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -87,20 +104,91 @@ class Coalescer:
         self.session = session
         self.max_batch = max_batch
         self.queue_depth = queue_depth
+        self.on_tick = on_tick  # daemon hook (session-cache pressure check)
         self._queue: deque = deque()
         self._lock = threading.Lock()
         self._wakeup = threading.Event()
         self._closing = False
         self._drained = threading.Event()
+        # requests popped from the queue but not yet answered: if the
+        # dispatcher thread DIES mid-batch, the watchdog fails exactly
+        # these typed instead of leaving their handlers waiting forever
+        self._inflight_batch: List[PendingRequest] = []
         # tests set this to hold the dispatcher between ticks, so a
         # burst enqueued while held provably coalesces into one tick
         self.hold: Optional[threading.Event] = None
-        self._thread = threading.Thread(
+        # dispatcher-thread management is its own lock: the watchdog
+        # swaps the thread while handler threads keep using _lock for
+        # the queue (consistent order: _restart_lock before _lock)
+        self._restart_lock = threading.Lock()
+        self._thread = self._new_dispatcher()
+        self._watchdog_thread = threading.Thread(
+            target=self._watch, name="simon-serve-watchdog", daemon=True
+        )
+        self.restarts = 0
+
+    def _new_dispatcher(self) -> threading.Thread:
+        return threading.Thread(
             target=self._run, name="simon-serve-dispatcher", daemon=True
         )
 
     def start(self):
-        self._thread.start()
+        with self._restart_lock:
+            self._thread.start()
+        self._watchdog_thread.start()
+
+    # -- dispatcher watchdog ------------------------------------------------
+
+    def _watch(self):
+        """Monitor loop: as long as the coalescer is live, a died
+        dispatcher thread is restarted and its in-flight requests are
+        failed typed (docs/SERVING.md). Exits once the drain
+        completes."""
+        while not self._drained.wait(timeout=WATCHDOG_INTERVAL_S):
+            self.ensure_dispatcher()
+
+    def ensure_dispatcher(self) -> bool:
+        """Restart a died dispatcher; returns True when a restart
+        happened. The died thread's picked-but-unanswered requests are
+        answered 500 with a typed body — a dead dispatcher must fail
+        loudly, never wedge the queue behind handler threads waiting
+        on replies that will never come."""
+        with self._restart_lock:
+            t = self._thread
+            if t.is_alive() or not t.ident or self._drained.is_set():
+                return False
+            with self._lock:
+                casualties = self._inflight_batch
+                self._inflight_batch = []
+            self.restarts += 1
+            fresh = self._new_dispatcher()
+            self._thread = fresh
+        COUNTERS.inc("serve_watchdog_restarts_total")
+        log.error(
+            "serve dispatcher thread died; restarting (restart #%d, "
+            "%d in-flight request(s) failed typed)",
+            self.restarts, len(casualties),
+        )
+        for p in casualties:
+            COUNTERS.inc("serve_dispatcher_casualties_total")
+            self._finish_counted(
+                p,
+                WhatIfReply(
+                    status=500,
+                    body=json.dumps(
+                        {
+                            "error": "dispatcher thread died while this "
+                            "request was being evaluated; the watchdog "
+                            "restarted it",
+                            "errorType": "ConformanceError",
+                        }
+                    ).encode(),
+                    meta={"engine": "watchdog"},
+                ),
+            )
+        fresh.start()
+        self._wakeup.set()
+        return True
 
     # -- intake (handler threads) -------------------------------------------
 
@@ -188,46 +276,94 @@ class Coalescer:
                 self.hold.wait()
             self._wakeup.wait(timeout=0.05)
             self._wakeup.clear()
+            # chaos seam: `serve.tick` faults land HERE, on the
+            # dispatcher thread — a `crash` clause kills the thread
+            # (InjectedCrash is a BaseException) and the watchdog must
+            # restart it; Exception-shaped faults ride the per-batch
+            # recovery below once a batch is in flight
+            _inject.fire("serve.tick")
             batch = self._drain_tick()
             if not batch:
                 with self._lock:
                     if self._closing and not self._queue:
                         break
                 continue
-            t0 = time.monotonic()
-            COUNTERS.observe("serve_batch_fill", len(batch))
-            COUNTERS.inc("serve_batches_total")
-            for p in batch:
-                HISTOS.observe("serve/queue_wait", t0 - p.enqueued_at)
+            with self._lock:
+                self._inflight_batch = list(batch)
+            # NO finally here: if _evaluate_tick dies (a crash-shaped
+            # BaseException, or a bug in the reply bookkeeping), the
+            # batch must STAY in _inflight_batch so the watchdog can
+            # fail exactly these requests typed — clearing it on the
+            # way down would strand their handlers waiting forever
+            self._evaluate_tick(batch)
+            with self._lock:
+                self._inflight_batch = []
+            if self.on_tick is not None:
+                try:
+                    self.on_tick()
+                except Exception:  # noqa: BLE001 - a failing pressure hook must not kill the dispatcher
+                    log.exception("serve on_tick hook failed")
+        self._drained.set()
+
+    def _evaluate_tick(self, batch: List[PendingRequest]):
+        """Answer one tick's worth of picked requests: admission-
+        routed serial requests individually through the host oracle,
+        everything else in ONE coalesced device dispatch."""
+        t0 = time.monotonic()
+        COUNTERS.observe("serve_batch_fill", len(batch))
+        COUNTERS.inc("serve_batches_total")
+        for p in batch:
+            HISTOS.observe("serve/queue_wait", t0 - p.enqueued_at)
+        scan = [p for p in batch if p.route != "serial"]
+        serial = [p for p in batch if p.route == "serial"]
+        replies: List[WhatIfReply] = []
+        if scan:
             try:
                 replies = self.session.evaluate_batch(
-                    [p.request for p in batch]
+                    [p.request for p in scan]
                 )
             except Exception as e:  # noqa: BLE001 - the daemon must outlive any one batch
                 # a failed batch answers its waiters (500) and the
                 # dispatcher keeps serving; an unhandled raise here
                 # would strand every queued request forever
                 COUNTERS.inc("serve_batch_errors_total")
-                replies = [
-                    WhatIfReply(
-                        status=500,
-                        body=json.dumps(
-                            {"error": f"evaluation failed: {e}"}
-                        ).encode(),
-                        meta={"engine": "error"},
+                replies = [self._error_reply(e) for _ in scan]
+        serial_replies: List[WhatIfReply] = []
+        for p in serial:
+            try:
+                serial_replies.append(
+                    self.session.evaluate_serial(
+                        p.request, reason=p.route_reason or "admission"
                     )
-                    for _ in batch
-                ]
-            tick_s = time.monotonic() - t0
-            COUNTERS.observe("serve_tick_seconds", tick_s)
-            HISTOS.observe("serve/evaluate", tick_s)
-            for pending, reply in zip(batch, replies):
-                reply.meta.setdefault("batchSize", len(batch))
-                reply.meta["queueSeconds"] = round(
-                    t0 - pending.enqueued_at, 6
                 )
-                self._finish_counted(pending, reply)
-        self._drained.set()
+            except Exception as e:  # noqa: BLE001 - ditto: one bad serial request must not strand the rest
+                COUNTERS.inc("serve_batch_errors_total")
+                serial_replies.append(self._error_reply(e))
+        tick_s = time.monotonic() - t0
+        COUNTERS.observe("serve_tick_seconds", tick_s)
+        HISTOS.observe("serve/evaluate", tick_s)
+        for pending, reply in list(zip(scan, replies)) + list(
+            zip(serial, serial_replies)
+        ):
+            reply.meta.setdefault("batchSize", len(batch))
+            reply.meta["queueSeconds"] = round(t0 - pending.enqueued_at, 6)
+            self._finish_counted(pending, reply)
+
+    @staticmethod
+    def _error_reply(e: Exception) -> WhatIfReply:
+        """Typed 500 body: the taxonomy class name rides along so a
+        client (and the chaos matrix) can route on the failure kind
+        without parsing message text."""
+        return WhatIfReply(
+            status=500,
+            body=json.dumps(
+                {
+                    "error": f"evaluation failed: {e}",
+                    "errorType": type(e).__name__,
+                }
+            ).encode(),
+            meta={"engine": "error"},
+        )
 
     # -- shutdown -----------------------------------------------------------
 

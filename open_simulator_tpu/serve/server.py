@@ -33,8 +33,14 @@ from ..runtime.budget import Budget
 from ..runtime.errors import EXIT_OK, EXIT_PARTIAL_DEADLINE
 from ..scheduler.core import AppResource
 from ..utils.trace import COUNTERS
+from .admission import (
+    AdmissionController,
+    estimate_request_pods,
+    sanitize_tenant,
+)
 from .coalescer import Coalescer, PendingRequest
 from .session import Session, WhatIfRequest
+from .sessions import SessionCache, open_snapshot
 
 log = logging.getLogger(__name__)
 
@@ -90,7 +96,13 @@ def parse_request_body(raw: bytes, content_type: str):
                     resource=_decode_app_yaml(a["yaml"], i),
                 )
             )
-        return WhatIfRequest(apps=apps), deadline, want_trace
+        return (
+            WhatIfRequest(
+                apps=apps, tenant=sanitize_tenant(doc.get("tenant"))
+            ),
+            deadline,
+            want_trace,
+        )
     # raw YAML: one unnamed app
     try:
         text = raw.decode("utf-8")
@@ -235,9 +247,118 @@ def render_metrics(coalescer: Coalescer) -> bytes:
         "Agreement rate of the most recent shadow replay (1.0 = full).",
         snap["gauges"].get("shadow_agreement_rate", 1.0),
     )
+    lines.extend(_resilience_lines(snap))
     lines.extend(_observatory_lines(snap))
     lines.append("")
     return "\n".join(lines).encode()
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _resilience_lines(snap: dict) -> List[str]:
+    """Circuit-breaker / retry / watchdog / admission / session-cache
+    exposition (docs/ROBUSTNESS.md, docs/SERVING.md): the degradation
+    machinery's own state, so 'is the daemon degraded and why' is one
+    scrape, not a log dive."""
+    from ..runtime.retry import breaker_states
+
+    counts = snap["counts"]
+    gauges = snap["gauges"]
+    lines: List[str] = []
+
+    def metric(name, kind, help_text, value):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+
+    # -- circuit breakers (runtime/retry.py)
+    states = breaker_states()
+    lines.append(
+        "# HELP simon_breaker_state Circuit-breaker state per endpoint "
+        "(0 closed, 1 open, 0.5 half-open probe window)."
+    )
+    lines.append("# TYPE simon_breaker_state gauge")
+    for endpoint, st in sorted(states.items()):
+        lines.append(
+            f'simon_breaker_state{{endpoint="{_escape_label(endpoint)}"}} '
+            f"{st['state']}"
+        )
+    for key, help_text in (
+        ("breaker_opens_total", "Circuit-breaker open transitions."),
+        ("breaker_recloses_total", "Breakers re-closed after a successful half-open probe."),
+    ):
+        metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
+    # -- retry attempts (per endpoint only: a bare aggregate sample in
+    # the same family would make sum() over the family double-count)
+    lines.append(
+        "# HELP simon_retry_attempts_total Failed I/O attempts that "
+        "entered the retry/backoff path, per endpoint."
+    )
+    lines.append("# TYPE simon_retry_attempts_total counter")
+    ep_keys = sorted(
+        k for k in counts if k.startswith("retry_attempts_ep:")
+    )
+    for key in ep_keys:
+        endpoint = key.split(":", 1)[1]
+        lines.append(
+            f'simon_retry_attempts_total{{endpoint="{_escape_label(endpoint)}"}} '
+            f"{counts[key]}"
+        )
+    if not ep_keys:
+        # zero-activity daemons still expose the family (scrape
+        # continuity): one sample, no endpoint has retried yet
+        lines.append(
+            f'simon_retry_attempts_total{{endpoint=""}} '
+            f"{counts.get('retry_attempts_total', 0)}"
+        )
+    # -- dispatcher watchdog (serve/coalescer.py)
+    for key, help_text in (
+        ("serve_watchdog_restarts_total", "Dispatcher threads restarted by the watchdog."),
+        ("serve_dispatcher_casualties_total", "In-flight requests failed typed by a dispatcher death."),
+    ):
+        metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
+    # -- admission control (serve/admission.py)
+    for key, help_text in (
+        ("serve_admission_total", "Admission verdicts issued."),
+        ("serve_admission_serial_total", "Requests serially routed by admission (predicted HBM / oversize)."),
+        ("serve_admission_shed_total", "Requests shed 429 by admission (predicted latency past the tick budget)."),
+    ):
+        metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
+    # -- per-tenant accounting
+    for prefix, name, help_text in (
+        ("serve_tenant_requests:", "simon_serve_tenant_requests_total",
+         "Requests received per tenant (any verdict)."),
+        ("serve_tenant_shed:", "simon_serve_tenant_shed_total",
+         "Requests shed per tenant (admission 429 + overload/drain 503)."),
+    ):
+        keys = sorted(k for k in counts if k.startswith(prefix))
+        if keys:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} counter")
+            for key in keys:
+                tenant = key.split(":", 1)[1]
+                lines.append(
+                    f'{name}{{tenant="{_escape_label(tenant)}"}} {counts[key]}'
+                )
+    # -- session cache (serve/sessions.py)
+    metric(
+        "simon_serve_sessions", "gauge",
+        "Warm sessions resident in the LRU.", gauges.get("serve_sessions", 1),
+    )
+    metric(
+        "simon_serve_session_evictions_total", "counter",
+        "Warm sessions evicted (capacity + ledger pressure).",
+        counts.get("serve_session_evictions_total", 0),
+    )
+    # -- fault injection (runtime/inject.py): nonzero only when armed
+    metric(
+        "simon_inject_fired_total", "counter",
+        "Chaos faults fired by the armed SIMON_INJECT spec (0 in production).",
+        counts.get("inject_fired_total", 0),
+    )
+    return lines
 
 
 def _observatory_lines(snap: dict) -> List[str]:
@@ -350,12 +471,29 @@ class ServeDaemon:
         queue_depth: int = 64,
         default_deadline_s: Optional[float] = None,
         drain_timeout_s: float = 30.0,
+        tick_budget_s: Optional[float] = None,
+        max_request_pods: Optional[int] = None,
+        max_sessions: int = 8,
+        snapshot_path: Optional[str] = None,
     ):
         self.session = session
         self.default_deadline_s = default_deadline_s
         self.drain_timeout_s = drain_timeout_s
+        self.admission = AdmissionController(
+            max_batch=max_batch,
+            tick_budget_s=tick_budget_s,
+            max_request_pods=max_request_pods,
+        )
+        snapshot = open_snapshot(snapshot_path) if snapshot_path else None
+        self.sessions = SessionCache(capacity=max_sessions, snapshot=snapshot)
+        # the configured cluster is pinned: ledger pressure and
+        # capacity evict secondaries only (serve/sessions.py)
+        self.sessions.add(session, pinned=True)
         self.coalescer = Coalescer(
-            session, max_batch=max_batch, queue_depth=queue_depth
+            session,
+            max_batch=max_batch,
+            queue_depth=queue_depth,
+            on_tick=self.sessions.check_pressure,
         )
         self._shutdown = threading.Event()
         # simulate requests currently inside do_POST (parse -> reply
@@ -386,13 +524,18 @@ class ServeDaemon:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    status, reasons = daemon.readiness()
                     self._send(
                         200,
                         json.dumps(
                             {
                                 "ok": True,
+                                "status": status,
+                                "degraded": bool(reasons),
+                                "reasons": reasons,
                                 "cluster": daemon.session.fingerprint,
                                 "queueDepth": daemon.coalescer.depth,
+                                "sessions": daemon.sessions.stats(),
                                 "draining": daemon._shutdown.is_set(),
                             }
                         ).encode(),
@@ -433,11 +576,44 @@ class ServeDaemon:
                     return
                 if deadline is None:
                     deadline = daemon.default_deadline_s
-                pending = PendingRequest(request=req, budget=Budget(deadline))
-                if not daemon.coalescer.submit(pending):
-                    from .coalescer import partial_body
+                from .coalescer import partial_body
 
+                header_tenant = self.headers.get("X-Simon-Tenant")
+                tenant = (
+                    sanitize_tenant(header_tenant)
+                    if header_tenant
+                    else req.tenant
+                )
+                COUNTERS.inc(f"serve_tenant_requests:{tenant}")
+                # cost-predictive admission BEFORE the queue: 429 when
+                # the predicted wait busts the tick budget, serial
+                # routing when the predicted HBM would not fit
+                verdict = daemon.admission.decide(
+                    est_pods=estimate_request_pods(req),
+                    queue_depth=daemon.coalescer.depth,
+                )
+                if verdict.action == "shed":
+                    # serve_admission_shed_total counted by decide()
+                    COUNTERS.inc("serve_shed_total")
+                    COUNTERS.inc(f"serve_tenant_shed:{tenant}")
+                    self._send(
+                        429,
+                        partial_body("admission", verdict.reason),
+                        headers=(
+                            ("Retry-After", str(verdict.retry_after_s)),
+                        ),
+                    )
+                    return
+                pending = PendingRequest(
+                    request=req,
+                    budget=Budget(deadline),
+                    route="serial" if verdict.action == "serial" else "batch",
+                    tenant=tenant,
+                    route_reason=verdict.reason,
+                )
+                if not daemon.coalescer.submit(pending):
                     draining = daemon._shutdown.is_set()
+                    COUNTERS.inc(f"serve_tenant_shed:{tenant}")
                     self._send(
                         503,
                         partial_body(
@@ -483,6 +659,32 @@ class ServeDaemon:
         self._server_thread.start()
         log.info("simon serve listening on %s:%d", self.host, self.port)
 
+    def readiness(self):
+        """-> (status, reasons): "ok" or "degraded" with one reason
+        per degradation the daemon is living with — an open circuit
+        breaker, a dispatcher the watchdog had to restart, or the
+        device-memory ledger past its budget. Liveness stays "ok":
+        true either way (the process IS up); readiness-aware clients
+        route on ``status`` (docs/SERVING.md)."""
+        from ..obs.ledger import device_memory_stats
+        from ..runtime.retry import breaker_states
+
+        reasons = []
+        for endpoint, st in sorted(breaker_states().items()):
+            if st["open"]:
+                reasons.append(f"circuit breaker open: {endpoint}")
+        if self.coalescer.restarts:
+            reasons.append(
+                f"dispatcher watchdog fired {self.coalescer.restarts} "
+                "time(s) this process"
+            )
+        in_use, limit, _src = device_memory_stats()
+        if limit and in_use > limit:
+            reasons.append(
+                f"device memory over budget ({in_use} > {limit} bytes)"
+            )
+        return ("degraded" if reasons else "ok"), reasons
+
     def begin_shutdown(self):
         """Stop intake (new submits shed as draining); idempotent."""
         self._shutdown.set()
@@ -498,6 +700,7 @@ class ServeDaemon:
         # handler threads to finish WRITING those answers (bounded: a
         # wedged client socket must not hold the exit hostage)
         self._inflight_zero.wait(timeout=min(self.drain_timeout_s, 10.0))
+        self.sessions.drain()  # journal surviving warm sessions
         self.httpd.shutdown()
         self.httpd.server_close()
         if not drained:

@@ -62,9 +62,13 @@ INACTIVE = -2
 
 @dataclass
 class WhatIfRequest:
-    """One decoded /v1/simulate question: apps in deployment order."""
+    """One decoded /v1/simulate question: apps in deployment order.
+    ``tenant`` is the accounting identity (JSON envelope ``tenant``
+    key / X-Simon-Tenant header) — it never changes the answer, only
+    whose counters the request lands in (serve/admission.py)."""
 
     apps: List[AppResource]
+    tenant: str = "default"
 
 
 @dataclass
@@ -401,6 +405,15 @@ class Session:
             name, _reason = oracle.schedule_pod(pod2)
             out[pos] = -1 if name is None else node_index[name]
         return out
+
+    def evaluate_serial(self, req: WhatIfRequest, reason: str) -> WhatIfReply:
+        """Admission-routed serial evaluation (serve/admission.py):
+        the same full-fidelity path the scan-ineligible requests take,
+        exposed for requests ROUTED serial by policy (predicted HBM
+        pressure, oversize) rather than by semantics. The body stays
+        byte-identical to the coalesced answer — only the engine
+        header and the latency differ."""
+        return self._evaluate_serial(req, reason=reason)
 
     def _evaluate_serial(self, req: WhatIfRequest, reason: str) -> WhatIfReply:
         """The full-fidelity path for requests the batched scan cannot

@@ -199,7 +199,13 @@ class TraceWriter:
         self._emit(header)
 
     def _emit(self, rec: dict):
-        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        from ..runtime import inject as _inject
+
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        # chaos crash point (runtime/inject.py): a `crash` clause
+        # leaves a durable torn prefix, like a real mid-append death
+        _inject.crash_write("journal.fsync.timeline", self._f, line)
+        self._f.write(line)
         if self._fsync_each:
             self._f.flush()
             os.fsync(self._f.fileno())

@@ -294,6 +294,12 @@ class TimelineStepper:
         next_tick = 0.0  # decisions run at t=0 too (initial provisioning)
         i = 0
         while True:
+            # chaos seam: deterministic faults at the window boundary
+            # (runtime/inject.py; ExecutionHalted here carries the
+            # partial report through run()'s handler like a deadline)
+            from ..runtime import inject as _inject
+
+            _inject.fire("timeline.tick", window=self.windows)
             if self.budget is not None:
                 self.budget.check(f"timeline window {self.windows}")
             t_start = self._last_close if self.windows else 0.0

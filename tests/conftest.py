@@ -43,3 +43,65 @@ def _fresh_io_state():
 
     reset_io_state()
     yield
+
+
+# ---- chaos-matrix artifact (CI uploads it per PR) -------------------
+# SIMON_CHAOS_MATRIX_OUT=<path> collects per-cell outcomes from the
+# chaos suites into one machine-readable JSON artifact.
+
+_CHAOS_FILES = (
+    "tests/test_chaos_matrix.py",
+    "tests/test_inject.py",
+    "tests/test_torn_tail.py",
+    "tests/test_serve_hardening.py",
+)
+_chaos_outcomes = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when != "call":
+        return
+    if any(report.nodeid.startswith(f) for f in _CHAOS_FILES):
+        _chaos_outcomes.append(
+            {
+                "cell": report.nodeid,
+                "outcome": report.outcome,
+                "seconds": round(report.duration, 3),
+            }
+        )
+
+
+def pytest_sessionfinish(session):
+    out = os.environ.get("SIMON_CHAOS_MATRIX_OUT")
+    if not out or not _chaos_outcomes:
+        return
+    import json
+
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "cells": _chaos_outcomes,
+                "total": len(_chaos_outcomes),
+                "passed": sum(
+                    1 for c in _chaos_outcomes if c["outcome"] == "passed"
+                ),
+                "failed": sum(
+                    1 for c in _chaos_outcomes if c["outcome"] == "failed"
+                ),
+            },
+            f,
+            indent=2,
+        )
+
+
+@pytest.fixture(autouse=True)
+def _inject_disarmed():
+    # the chaos injector is process-global (runtime/inject); a test
+    # that died with a spec armed must not fault every later test
+    from open_simulator_tpu.runtime.inject import INJECT
+    from open_simulator_tpu.serve.admission import reset_tenant_registry
+
+    INJECT.clear()
+    reset_tenant_registry()
+    yield
+    INJECT.clear()

@@ -1,0 +1,545 @@
+"""The chaos matrix (docs/ROBUSTNESS.md): every taxonomy error x every
+subsystem, each injection asserting its DOCUMENTED degradation — exit
+code, HTTP status, PARTIAL body, or loud propagation — with a global
+per-test hang watchdog and zero journal corruption.
+
+``INJECTION_COVERAGE`` is the canonical registry simonlint rule RT002
+reads: every GuardError subtype in the taxonomy must appear here with
+at least one live matrix cell, so a new error type cannot land without
+an injection test. ``test_registry_is_closed_over_cells`` pins the
+registry to the actual cell table — a stale entry fails the suite, so
+the static rule checks an honest document.
+"""
+
+import json
+import signal
+
+import pytest
+import yaml as _yaml
+
+from open_simulator_tpu.runtime import ConformanceError, Journal
+from open_simulator_tpu.runtime.inject import INJECT
+from open_simulator_tpu.utils.trace import COUNTERS
+
+# per-test hang watchdog: the acceptance gate is ZERO hangs — a wedged
+# queue or a poll loop that stopped consulting its budget must fail
+# the cell, not stall the suite
+CELL_TIMEOUT_S = 300
+
+
+@pytest.fixture(autouse=True)
+def _hang_watchdog():
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"chaos cell exceeded {CELL_TIMEOUT_S}s — a hang IS the bug "
+            "this matrix exists to catch"
+        )
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(CELL_TIMEOUT_S)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+# --------------------------------------------------------------- the matrix
+#
+# One row per (taxonomy error x subsystem) seam. ``expect`` kinds:
+#   ("exit", code, reason)   CLI run: exit code + partial-report reason
+#   ("ok",)                  CLI run: exit 0, graceful degradation
+#   ("raises", cls)          CLI run: loud typed propagation (never
+#                            degraded around)
+# serve/ and io/ cells are driven in-process below (HTTP status /
+# typed-raise assertions), listed here for the registry + artifact.
+
+APPLY = ("apply",)
+CHAOS = ("chaos",)
+SHADOW = ("shadow",)
+TIMELINE = ("timeline",)
+
+CLI_CELLS = [
+    # error, subsystem, inject spec, expectation
+    ("DeadlineExceeded", "apply", "budget.check=deadline@1", ("exit", 3, "deadline")),
+    ("DeadlineExceeded", "chaos", "budget.check=deadline@1", ("exit", 3, "deadline")),
+    ("DeadlineExceeded", "shadow", "budget.check=deadline@1", ("exit", 3, "deadline")),
+    ("DeadlineExceeded", "timeline", "budget.check=deadline@1", ("exit", 3, "deadline")),
+    ("Interrupted", "apply", "budget.check=interrupt@1", ("exit", 4, "interrupt")),
+    ("Interrupted", "chaos", "budget.check=interrupt@1", ("exit", 4, "interrupt")),
+    ("Interrupted", "shadow", "budget.check=interrupt@1", ("exit", 4, "interrupt")),
+    ("Interrupted", "timeline", "budget.check=interrupt@1", ("exit", 4, "interrupt")),
+    ("ExecutionHalted", "apply", "budget.check=raise:ExecutionHalted@1", ("exit", 3, "halted")),
+    ("ExecutionHalted", "timeline", "budget.check=raise:ExecutionHalted@1", ("exit", 3, "halted")),
+    ("DeviceOOM", "apply", "jit.*=oom@1", ("ok",)),
+    ("DeviceOOM", "chaos", "jit.*=oom@1", ("ok",)),
+    ("DeviceOOM", "timeline", "jit.*=oom@1", ("ok",)),
+    ("CompileFailure", "apply", "jit.*=compile@1", ("ok",)),
+    ("CompileFailure", "chaos", "jit.*=compile@1", ("ok",)),
+    ("CompileFailure", "timeline", "jit.*=compile@1", ("ok",)),
+    ("BackendUnavailable", "apply", "jit.*=backend@1", ("ok",)),
+    ("BackendUnavailable", "timeline", "jit.*=backend@1", ("ok",)),
+    ("ConformanceError", "apply", "jit.*=conformance@1", ("raises", ConformanceError)),
+]
+
+# serve/io cells are functions below; ids here for the registry
+SERVE_CELLS = [
+    ("DeviceOOM", "serve", "jit.scenario_scan=oom@1", 200),
+    ("CompileFailure", "serve", "jit.scenario_scan=compile@1", 200),
+    ("BackendUnavailable", "serve", "jit.scenario_scan=backend@1", 200),
+    ("ConformanceError", "serve", "jit.scenario_scan=conformance@1", 500),
+    ("GuardError", "serve", "jit.scenario_scan=raise:GuardError@1", 500),
+    ("SampleRngOverflow", "serve", "jit.scenario_scan=raise:SampleRngOverflow@1", 500),
+    ("DeadlineExceeded", "serve", None, 503),  # queue-expired budget
+]
+
+IO_CELLS = [
+    ("ExternalIOError", "io", "io.matrix-reset=reset@1x*"),
+    ("ExternalIOError", "io", "io.matrix-timeout=timeout@1x*"),
+    ("ExtenderError", "io", "io.matrix-extender=raise:ExtenderError@1x*"),
+]
+
+#: taxonomy class name -> matrix cell ids proving its injection
+#: coverage. simonlint RT002 statically requires every GuardError
+#: subtype to appear here; test_registry_is_closed_over_cells keeps
+#: the ids honest against the live cell tables above.
+INJECTION_COVERAGE = {
+    "GuardError": ["GuardError/serve"],
+    "DeviceOOM": [
+        "DeviceOOM/apply", "DeviceOOM/chaos", "DeviceOOM/timeline",
+        "DeviceOOM/serve",
+    ],
+    "CompileFailure": [
+        "CompileFailure/apply", "CompileFailure/chaos",
+        "CompileFailure/timeline", "CompileFailure/serve",
+    ],
+    "BackendUnavailable": [
+        "BackendUnavailable/apply", "BackendUnavailable/timeline",
+        "BackendUnavailable/serve",
+    ],
+    "ExternalIOError": ["ExternalIOError/io", "ExternalIOError/io"],
+    "ConformanceError": ["ConformanceError/apply", "ConformanceError/serve"],
+    "ExecutionHalted": ["ExecutionHalted/apply", "ExecutionHalted/timeline"],
+    "DeadlineExceeded": [
+        "DeadlineExceeded/apply", "DeadlineExceeded/chaos",
+        "DeadlineExceeded/shadow", "DeadlineExceeded/timeline",
+        "DeadlineExceeded/serve",
+    ],
+    "Interrupted": [
+        "Interrupted/apply", "Interrupted/chaos", "Interrupted/shadow",
+        "Interrupted/timeline",
+    ],
+    "SampleRngOverflow": ["SampleRngOverflow/serve"],
+    "ExtenderError": ["ExtenderError/io"],
+}
+
+
+def test_registry_is_closed_over_cells():
+    """Every registry id names a live cell and every cell is
+    registered — the RT002 contract stays a fact, not a claim."""
+    live = {f"{e}/{s}" for e, s, *_ in CLI_CELLS}
+    live |= {f"{e}/{s}" for e, s, *_ in SERVE_CELLS}
+    live |= {f"{e}/{s}" for e, s, *_ in IO_CELLS}
+    registered = {cid for ids in INJECTION_COVERAGE.values() for cid in ids}
+    assert registered == live, (
+        f"registry drift: only-registered={sorted(registered - live)} "
+        f"unregistered={sorted(live - registered)}"
+    )
+    # and the registry itself covers the full live taxonomy
+    from open_simulator_tpu.runtime import errors as errs
+    from open_simulator_tpu.scheduler.engine import SampleRngOverflow
+    from open_simulator_tpu.scheduler.extender import ExtenderError
+
+    subtypes = {
+        c.__name__
+        for c in vars(errs).values()
+        if isinstance(c, type) and issubclass(c, errs.GuardError)
+    }
+    subtypes |= {SampleRngOverflow.__name__, ExtenderError.__name__}
+    assert set(INJECTION_COVERAGE) == subtypes, (
+        f"uncovered taxonomy: {sorted(subtypes - set(INJECTION_COVERAGE))}; "
+        f"stale registry: {sorted(set(INJECTION_COVERAGE) - subtypes)}"
+    )
+
+
+# --------------------------------------------------------------- CLI cells
+
+
+def _cli_argv(subsystem, cfg, tmp_path, spec):
+    base = {
+        "apply": ["apply", "-f", cfg, "--tolerate-node-failures", "1"],
+        "chaos": ["chaos", "-f", cfg, "--new-node-count", "0"],
+        "shadow": ["shadow", "-f", cfg, "--record",
+                   str(tmp_path / "decisions.jsonl")],
+        "timeline": ["timeline", "-f", cfg, "--synthetic", "12", "--seed",
+                     "5", "--arrival-rate", "2.0", "--policy", "static:1",
+                     "--cadence", "30", "--max-nodes", "1"],
+    }[subsystem]
+    return base + ["--format", "json", "--inject", spec]
+
+
+@pytest.mark.parametrize(
+    "error,subsystem,spec,expect",
+    CLI_CELLS,
+    ids=[f"{e}-{s}" for e, s, *_ in CLI_CELLS],
+)
+def test_cli_cell(error, subsystem, spec, expect, tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path, tag=subsystem)
+    argv = _cli_argv(subsystem, cfg, tmp_path, spec)
+    if expect[0] == "raises":
+        with pytest.raises(expect[1]):
+            main(argv)
+        return
+    rc = main(argv)
+    out = capsys.readouterr().out
+    if expect[0] == "exit":
+        _, code, reason = expect
+        assert rc == code, f"{error}/{subsystem}: exit {rc} != {code}\n{out}"
+        doc = json.loads(out)
+        assert doc["partial"] is True and doc["reason"] == reason
+        assert doc["exitCode"] == code
+    else:  # ("ok",): graceful degradation, not an error surface
+        assert rc == 0, f"{error}/{subsystem}: exit {rc}\n{out}"
+        assert json.loads(out), "no JSON answer"
+
+
+def test_device_faults_leave_resumable_journal(tmp_path, capsys):
+    """A degraded (OOM-injected) apply run with --journal completes AND
+    its journal resumes cleanly — zero corruption through the ladder."""
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path, tag="journal")
+    journal = str(tmp_path / "plan.jsonl")
+    rc = main(
+        ["apply", "-f", cfg, "--tolerate-node-failures", "1",
+         "--journal", journal, "--format", "json",
+         "--inject", "jit.*=oom@1"]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["success"]
+    oom_reactive = COUNTERS.get("guard_oom_reactive_total")
+    assert oom_reactive > 0, "the injected OOM was never seen reactively"
+    # the journal survived the degradation untorn
+    j = Journal.resume(journal, _journal_fp(journal))
+    assert j.dropped == 0 and j.replayed > 0
+    j.close()
+
+
+def _journal_fp(path):
+    return json.loads(open(path).readline())["fingerprint"]
+
+
+def test_unclassified_error_propagates_loudly(tmp_path, capsys):
+    """The control cell: an UNclassified injected fault must never be
+    degraded around by the guard — it reaches the operator as the raw
+    error. (Driven through `simon timeline`, whose device path rides
+    run_chunked with no broad diagnostic catch above it; `simon
+    apply`'s batched-plan builder keeps its own logged serial-fallback
+    diagnostic, which is a different, intentional posture.)"""
+    from open_simulator_tpu.cli import main
+
+    cfg = _write_cli_config(tmp_path, tag="loud")
+    with pytest.raises(RuntimeError, match="injected error"):
+        main(
+            _cli_argv("timeline", cfg, tmp_path, "jit.timeline_sweep=error@1")
+        )
+
+
+# --------------------------------------------------------------- serve cells
+
+
+def _serve_session():
+    from open_simulator_tpu.serve.session import Session
+
+    cluster = _build_serve_cluster()
+    return Session(cluster), cluster
+
+
+@pytest.mark.parametrize(
+    "error,spec",
+    [(e, sp) for e, _s, sp, st in SERVE_CELLS if st == 200],
+    ids=[e for e, _s, sp, st in SERVE_CELLS if st == 200],
+)
+def test_serve_cell_classified_faults_degrade_to_200(error, spec):
+    """Injected CLASSIFIED device faults during a coalesced tick ride
+    the guard ladder down to the serial floor: the answer stays 200
+    and byte-identical to a standalone simulate() — memory pressure
+    degrades throughput, never availability."""
+    session, cluster = _serve_session()
+    req = _serve_request("cell", 3)
+    INJECT.configure(spec)
+    replies = session.evaluate_batch([req])
+    INJECT.clear()
+    assert replies[0].status == 200
+    assert replies[0].body == _serve_serial_body(cluster, req)
+    # the session survives: a clean follow-up request answers 200 too
+    follow = session.evaluate_batch([_serve_request("follow", 2)])
+    assert follow[0].status == 200
+
+
+def test_serve_typed_500_and_dispatcher_survives():
+    """Unclassifiable taxonomy faults escape the guard, the coalescer
+    answers 500 with errorType, and the dispatcher keeps serving."""
+    import threading
+
+    from open_simulator_tpu.runtime.budget import Budget
+    from open_simulator_tpu.serve.coalescer import Coalescer, PendingRequest
+
+    session, cluster = _serve_session()
+    coal = Coalescer(session, max_batch=4, queue_depth=8)
+    coal.start()
+    try:
+        for error, spec in [
+            ("ConformanceError", "jit.scenario_scan=conformance@1x*"),
+            ("GuardError", "jit.scenario_scan=raise:GuardError@1x*"),
+            ("SampleRngOverflow",
+             "jit.scenario_scan=raise:SampleRngOverflow@1x*"),
+        ]:
+            INJECT.configure(spec)
+            p = PendingRequest(
+                request=_serve_request("doomed", 2), budget=Budget(None)
+            )
+            assert coal.submit(p)
+            assert p.done.wait(timeout=CELL_TIMEOUT_S), "request wedged"
+            INJECT.clear()
+            assert p.reply.status == 500
+            body = json.loads(p.reply.body)
+            assert body["errorType"] == error, body
+            # the daemon outlives the fault: clean request answers 200
+            ok = PendingRequest(
+                request=_serve_request("after", 2), budget=Budget(None)
+            )
+            assert coal.submit(ok)
+            assert ok.done.wait(timeout=CELL_TIMEOUT_S)
+            assert ok.reply.status == 200
+            assert ok.reply.body == _serve_serial_body(
+                cluster, ok.request
+            )
+    finally:
+        INJECT.clear()
+        coal.close()
+
+
+def test_serve_deadline_cell_sheds_503_partial():
+    """DeadlineExceeded/serve: a queue-expired request sheds with the
+    machine-readable PARTIAL 503, never an exit."""
+    import threading
+    import time
+
+    from open_simulator_tpu.runtime.budget import Budget
+    from open_simulator_tpu.serve.coalescer import Coalescer, PendingRequest
+
+    session, _ = _serve_session()
+    coal = Coalescer(session, max_batch=4, queue_depth=8)
+    coal.hold = threading.Event()
+    coal.start()
+    doomed = PendingRequest(
+        request=_serve_request("doomed", 1), budget=Budget(0.01)
+    )
+    assert coal.submit(doomed)
+    time.sleep(0.05)
+    coal.hold.set()
+    assert doomed.done.wait(timeout=CELL_TIMEOUT_S)
+    assert doomed.reply.status == 503
+    body = json.loads(doomed.reply.body)
+    assert body["partial"] is True and body["reason"] == "deadline"
+    coal.close()
+
+
+# --------------------------------------------------------------- io cells
+
+
+@pytest.mark.parametrize(
+    "error,spec",
+    [(e, sp) for e, _s, sp in IO_CELLS],
+    ids=[sp.split("=")[0] for _e, _s, sp in IO_CELLS],
+)
+def test_io_cell_exhaustion_is_typed_and_breaker_counted(error, spec):
+    from open_simulator_tpu.runtime import ExternalIOError
+    from open_simulator_tpu.runtime.retry import breaker_for, retry_io
+    from open_simulator_tpu.scheduler.extender import ExtenderError
+
+    label = spec.split("=")[0][len("io."):]
+    INJECT.configure(spec)
+    r0 = COUNTERS.get("retry_attempts_total")
+    with pytest.raises(ExternalIOError) as ei:
+        retry_io(
+            lambda: "never",
+            label=label,
+            endpoint=f"matrix://{label}",
+            attempts=3,
+            # the extender call site retries its own typed error the
+            # same way (scheduler/extender.py passes it in `catch`)
+            catch=(OSError, ExtenderError),
+            sleep=lambda s: None,
+        )
+    assert ei.value.endpoint == f"matrix://{label}"
+    assert COUNTERS.get("retry_attempts_total") - r0 == 3
+    assert COUNTERS.get(f"retry_attempts_ep:matrix://{label}") >= 3
+    assert breaker_for(f"matrix://{label}").failures == 1
+
+
+def test_io_http_client_errors_pass_through_raw():
+    """HTTP < 500 is an ANSWER, not an outage: it must reach the
+    caller raw (the kubeclient's 410 anchored-relist depends on it)."""
+    import urllib.error
+
+    from open_simulator_tpu.runtime.retry import retry_io
+
+    INJECT.configure("io.matrix-410=http:410@1")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        retry_io(
+            lambda: "never",
+            label="matrix-410",
+            endpoint="matrix://410",
+            retryable=lambda e: not (
+                isinstance(e, urllib.error.HTTPError) and e.code < 500
+            ),
+            sleep=lambda s: None,
+        )
+    assert ei.value.code == 410
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _build_serve_cluster():
+    from open_simulator_tpu.models.decode import ResourceTypes
+
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        {
+            "kind": "Node",
+            "metadata": {
+                "name": f"mx-n-{i}",
+                "labels": {"kubernetes.io/hostname": f"mx-n-{i}"},
+            },
+            "status": {
+                "allocatable": {
+                    "cpu": "8", "memory": "32Gi", "pods": "110"
+                }
+            },
+        }
+        for i in range(3)
+    ]
+    return cluster
+
+
+def _serve_request(name, replicas):
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.serve.session import WhatIfRequest
+
+    res = ResourceTypes()
+    res.deployments = [
+        {
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "mx",
+                         "labels": {"app": name}},
+            "spec": {
+                "replicas": replicas,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "img",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "500m", "memory": "1Gi"
+                                    }
+                                },
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ]
+    return WhatIfRequest(apps=[AppResource(name, res)])
+
+
+def _serve_serial_body(cluster, req):
+    import copy
+
+    from open_simulator_tpu.models.workloads import reset_name_counter
+    from open_simulator_tpu.scheduler.core import AppResource, simulate
+    from open_simulator_tpu.serve.session import result_payload
+
+    reset_name_counter()
+    result = simulate(
+        copy.deepcopy(cluster),
+        [AppResource(a.name, copy.deepcopy(a.resource)) for a in req.apps],
+        engine="tpu",
+    )
+    return result_payload(result)
+
+
+def _node(name):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name,
+                     "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"}
+        },
+    }
+
+
+def _deploy(name, replicas):
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "mx",
+                     "labels": {"app": name}},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    }
+
+
+def _write_cli_config(tmp_path, tag="m", n_nodes=2, replicas=6):
+    root = tmp_path / f"cfg-{tag}"
+    root.mkdir(exist_ok=True)
+    cluster_dir = root / "cluster"
+    cluster_dir.mkdir(exist_ok=True)
+    for i in range(n_nodes):
+        (cluster_dir / f"n{i}.yaml").write_text(
+            _yaml.safe_dump(_node(f"base-{i}"))
+        )
+    app_dir = root / "app"
+    app_dir.mkdir(exist_ok=True)
+    (app_dir / "deploy.yaml").write_text(
+        _yaml.safe_dump(_deploy("web", replicas))
+    )
+    newnode_dir = root / "newnode"
+    newnode_dir.mkdir(exist_ok=True)
+    (newnode_dir / "node.yaml").write_text(_yaml.safe_dump(_node("template")))
+    cfg = root / "simon-config.yaml"
+    cfg.write_text(
+        _yaml.safe_dump(
+            {
+                "apiVersion": "simon/v1alpha1",
+                "kind": "Config",
+                "metadata": {"name": f"mx-{tag}"},
+                "spec": {
+                    "cluster": {"customConfig": str(cluster_dir)},
+                    "appList": [{"name": "web", "path": str(app_dir)}],
+                    "newNode": str(newnode_dir),
+                },
+            }
+        )
+    )
+    return str(cfg)

@@ -1,0 +1,331 @@
+"""The chaos injection layer itself (runtime/inject.py): spec grammar,
+deterministic scheduling, fault shapes, crash points, and — load-
+bearing — total inertness when disarmed (docs/ROBUSTNESS.md)."""
+
+import os
+import urllib.error
+
+import pytest
+
+from open_simulator_tpu.models.validation import InputError
+from open_simulator_tpu.runtime import (
+    ConformanceError,
+    DeadlineExceeded,
+    DeviceOOM,
+    ExternalIOError,
+    Interrupted,
+)
+from open_simulator_tpu.runtime.guard import classify_device_error
+from open_simulator_tpu.runtime.inject import (
+    INJECT,
+    InjectedCrash,
+    Rule,
+    parse_spec,
+)
+from open_simulator_tpu.utils.trace import COUNTERS
+
+
+# ---------------------------------------------------------------- grammar
+
+
+def test_parse_spec_full_grammar():
+    rules = parse_spec(
+        "jit.scenario_scan=oom@2;"
+        "io.kube*=reset@1x3;"
+        "journal.fsync.apply=crash:0.25;"
+        "ledger.predict_fit=lie:high;"
+        "serve.tick=error%5;"
+        "shadow.poll=http:410~0.5;"
+        "timeline.tick=slow:0.01x*"
+    )
+    assert len(rules) == 7
+    oom = rules[0]
+    assert (oom.pattern, oom.fault, oom.at, oom.count) == (
+        "jit.scenario_scan", "oom", 2, 1,
+    )
+    reset = rules[1]
+    assert (reset.at, reset.count) == (1, 3)
+    crash = rules[2]
+    assert (crash.fault, crash.param) == ("crash", "0.25")
+    lie = rules[3]
+    assert (lie.fault, lie.param) == ("lie", "high")
+    assert rules[4].every == 5
+    assert rules[5].prob == 0.5
+    forever = rules[6]
+    assert (forever.fault, forever.param, forever.count) == (
+        "slow", "0.01", -1,
+    )
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nonsense",               # no '='
+        "site=",                  # empty fault
+        "site=unknownfault",      # not in the table
+        "site=oom@0",             # start hit < 1
+        "site=oom@notanumber",    # unparsable start hit
+        "site=oom~2.0",           # probability out of (0, 1]
+        "site=oom%0",             # period < 1
+        "=oom",                   # empty site
+        "site=raise:NotAClass",   # unknown taxonomy name
+        "site=lie:sideways",      # lie param not low/high
+        "site=crash:1.5",         # crash fraction out of (0, 1)
+        "site=slow:fast",         # unparsable sleep seconds
+        "site=http:teapot",       # unparsable status code
+    ],
+)
+def test_parse_spec_bad_clause_is_input_error(bad):
+    # every param typo fails at PARSE time (exit 2 before any work) —
+    # never mid-run on the Nth hit of a dispatcher thread
+    with pytest.raises(InputError):
+        parse_spec(bad)
+
+
+def test_parse_spec_x_inside_param_is_not_a_count():
+    # 'x' appears inside raise:Name params; only a trailing integer (or
+    # '*') is a repeat-count modifier
+    (rule,) = parse_spec("site=raise:DeviceOOM")
+    assert rule.fault == "raise" and rule.param == "DeviceOOM"
+    assert rule.count == 1
+
+
+# ---------------------------------------------------------------- schedule
+
+
+def test_fire_window_at_n_for_count():
+    INJECT.configure("s=oom@2x2")
+    INJECT.fire("s")  # hit 1: below the window
+    for _ in range(2):  # hits 2, 3: inside
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            INJECT.fire("s")
+    INJECT.fire("s")  # hit 4: past the window
+    assert INJECT.hits("s") == 4
+
+
+def test_fire_every_nth():
+    INJECT.configure("s=error%3")
+    outcomes = []
+    for _ in range(9):
+        try:
+            INJECT.fire("s")
+            outcomes.append(False)
+        except RuntimeError:
+            outcomes.append(True)
+    assert outcomes == [False, False, True] * 3
+
+
+def test_fire_probability_is_deterministic_given_seed():
+    def firing_pattern(seed):
+        INJECT.configure("s=error x*~0.5".replace(" ", ""), seed=seed)
+        pat = []
+        for _ in range(32):
+            try:
+                INJECT.fire("s")
+                pat.append(0)
+            except RuntimeError:
+                pat.append(1)
+        INJECT.clear()
+        return pat
+
+    a, b = firing_pattern(7), firing_pattern(7)
+    assert a == b, "same seed must replay byte-identically"
+    assert firing_pattern(8) != a, "a different seed must differ"
+    assert 4 <= sum(a) <= 28, "prob 0.5 over 32 hits should fire sometimes"
+
+
+def test_glob_site_patterns():
+    INJECT.configure("io.kube*=timeout@1")
+    with pytest.raises(TimeoutError):
+        INJECT.fire("io.kube LIST /api/v1/pods")
+    INJECT.fire("io.extender score")  # different prefix: untouched
+
+
+def test_per_site_hit_counters_are_independent():
+    INJECT.configure("*=oom@2")
+    INJECT.fire("a")  # a: hit 1
+    INJECT.fire("b")  # b: hit 1
+    with pytest.raises(RuntimeError):
+        INJECT.fire("a")  # a: hit 2 fires
+    with pytest.raises(RuntimeError):
+        INJECT.fire("b")  # b: hit 2 fires
+
+
+# ---------------------------------------------------------------- shapes
+
+
+@pytest.mark.parametrize(
+    "fault,exc,classified",
+    [
+        ("oom", RuntimeError, DeviceOOM),
+        ("compile", RuntimeError, None),  # classified below
+        ("backend", RuntimeError, None),
+        ("reset", ConnectionResetError, None),
+        ("timeout", TimeoutError, None),
+        ("deadline", DeadlineExceeded, None),
+        ("interrupt", Interrupted, None),
+        ("exio", ExternalIOError, None),
+        ("conformance", ConformanceError, None),
+        ("error", RuntimeError, None),
+    ],
+)
+def test_fault_shapes(fault, exc, classified):
+    INJECT.configure(f"s={fault}@1")
+    with pytest.raises(exc) as ei:
+        INJECT.fire("s")
+    if fault == "oom":
+        assert classify_device_error(ei.value) is DeviceOOM
+    elif fault == "compile":
+        from open_simulator_tpu.runtime import CompileFailure
+
+        assert classify_device_error(ei.value) is CompileFailure
+    elif fault == "backend":
+        from open_simulator_tpu.runtime import BackendUnavailable
+
+        assert classify_device_error(ei.value) is BackendUnavailable
+    elif fault == "error":
+        # the UNclassified control: the guard must not degrade around it
+        assert classify_device_error(ei.value) is None
+
+
+def test_http_fault_is_a_real_http_error_with_code():
+    INJECT.configure("s=http:410@1")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        INJECT.fire("s")
+    assert ei.value.code == 410
+
+
+def test_raise_fault_reaches_every_taxonomy_class():
+    for name in (
+        "GuardError", "DeviceOOM", "CompileFailure", "BackendUnavailable",
+        "ExternalIOError", "ConformanceError", "ExecutionHalted",
+        "DeadlineExceeded", "Interrupted", "SampleRngOverflow",
+        "ExtenderError",
+    ):
+        INJECT.configure(f"s=raise:{name}@1")
+        with pytest.raises(BaseException) as ei:
+            INJECT.fire("s")
+        assert type(ei.value).__name__ == name
+        INJECT.clear()
+
+
+def test_exio_fault_carries_site_as_endpoint():
+    INJECT.configure("io.kube LIST=exio@1")
+    with pytest.raises(ExternalIOError) as ei:
+        INJECT.fire("io.kube LIST")
+    assert ei.value.endpoint == "io.kube LIST"
+
+
+def test_fire_context_joins_message():
+    INJECT.configure("s=error@1")
+    with pytest.raises(RuntimeError, match=r"window=3"):
+        INJECT.fire("s", window=3)
+
+
+# ---------------------------------------------------------------- crash
+
+
+def test_crash_write_leaves_durable_torn_prefix(tmp_path):
+    p = tmp_path / "t.jsonl"
+    record = '{"kind":"probe","count":4}\n'
+    INJECT.configure("journal.fsync.t=crash:0.5@1")
+    with open(p, "w") as f:
+        with pytest.raises(InjectedCrash):
+            INJECT.crash_write("journal.fsync.t", f, record)
+    torn = p.read_text()
+    assert 0 < len(torn) < len(record), "prefix, never empty or whole"
+    assert record.startswith(torn)
+
+
+def test_crash_is_baseexception():
+    # recovery paths catch Exception; a simulated process death must
+    # sail through them exactly like a real kill -9 would
+    assert issubclass(InjectedCrash, BaseException)
+    assert not issubclass(InjectedCrash, Exception)
+
+
+def test_lie_faults_only_surface_via_value():
+    INJECT.configure("ledger.predict_fit=lie:high x*".replace(" ", ""))
+    # fire() must never raise for a value-kind fault
+    INJECT.fire("ledger.predict_fit")
+    assert INJECT.value("ledger.predict_fit") == "high"
+    assert INJECT.value("other.site") is None
+
+
+# ---------------------------------------------------------------- inertness
+
+
+def test_disarmed_injector_is_inert_and_counts_nothing():
+    from open_simulator_tpu.runtime import inject as mod
+
+    assert not INJECT.armed
+    before = COUNTERS.get("inject_fired_total")
+    mod.fire("jit.scenario_scan")
+    mod.crash_write("journal.fsync.apply", None, "data")  # f unused: no-op
+    assert mod.value("ledger.predict_fit") is None
+    assert COUNTERS.get("inject_fired_total") == before
+    assert INJECT.hits("jit.scenario_scan") == 0, (
+        "a disarmed injector must not even count hits"
+    )
+
+
+def test_fired_counter_increments_per_fire():
+    c0 = COUNTERS.get("inject_fired_total")
+    INJECT.configure("s=error@1x2")
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            INJECT.fire("s")
+    INJECT.fire("s")
+    assert COUNTERS.get("inject_fired_total") - c0 == 2
+
+
+def test_configure_from_env_seed(monkeypatch):
+    monkeypatch.setenv("SIMON_INJECT_SEED", "notanint")
+    with pytest.raises(InputError):
+        INJECT.configure("s=error~0.5")
+    monkeypatch.setenv("SIMON_INJECT_SEED", "11")
+    INJECT.configure("s=error~0.5")
+    assert INJECT._seed == 11
+
+
+def test_env_armed_subprocess_inert_when_unset(tmp_path):
+    """SIMON_INJECT in the environment arms a fresh process at import;
+    an unset env leaves it disarmed — the production posture."""
+    import subprocess
+    import sys
+
+    code = (
+        "from open_simulator_tpu.runtime.inject import INJECT;"
+        "print('armed' if INJECT.armed else 'inert')"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "SIMON_INJECT"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, timeout=120,
+        capture_output=True, text=True,
+    )
+    assert out.stdout.strip() == "inert"
+    env["SIMON_INJECT"] = "jit.*=oom@1"
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, timeout=120,
+        capture_output=True, text=True,
+    )
+    assert out.stdout.strip() == "armed"
+
+
+def test_cli_inject_flag_bad_spec_exit_2(tmp_path, capsys):
+    from open_simulator_tpu.cli import main
+
+    rc = main(["apply", "-f", "nonexistent.yaml", "--inject", "bogus"])
+    assert rc == 2
+    assert "--inject" in capsys.readouterr().err
+
+
+def test_rule_triggers_window_math():
+    r = Rule(pattern="s", fault="oom", at=3, count=2)
+    hits = [h for h in range(1, 8) if r.triggers(h, "s", 0)]
+    assert hits == [3, 4]
+    r_forever = Rule(pattern="s", fault="oom", at=2, count=-1)
+    assert [h for h in range(1, 6) if r_forever.triggers(h, "s", 0)] == [
+        2, 3, 4, 5,
+    ]

@@ -397,9 +397,13 @@ def test_run_chunked_serial_floor_and_non_oom_propagates(monkeypatch):
     )
     assert out == [0, -1, -2]
     assert "sweep-serial-fallback" in GLOBAL.notes
-    # without a serial floor the OOM propagates once chunks reach 1
+    # without a serial floor the OOM propagates once chunks reach 1 —
+    # TYPED (DeviceOOM, never the raw XLA RuntimeError), so exit codes
+    # stay within the taxonomy (docs/ROBUSTNESS.md)
+    from open_simulator_tpu.runtime import DeviceOOM
+
     monkeypatch.setattr(guard_mod, "_OOM_INJECT", _counting_injector(0, []))
-    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+    with pytest.raises(DeviceOOM, match="RESOURCE_EXHAUSTED"):
         run_chunked(lambda lo, hi: list(range(lo, hi)), 2, label="sweep")
     # a non-OOM error is never swallowed
 

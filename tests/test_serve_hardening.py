@@ -1,0 +1,592 @@
+"""Resident-service hardening for `simon serve` (docs/SERVING.md):
+cost-predictive admission control (429 + Retry-After, serial routing,
+per-tenant accounting), the warm-session LRU with ledger-pressure
+eviction, the dispatcher watchdog, breaker half-open recovery,
+readiness-aware /healthz, and the resilience /metrics exposition —
+plus a short in-process chaos soak (the 30s CI soak's little sibling).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.models.workloads import reset_name_counter
+from open_simulator_tpu.obs.histo import HISTOS
+from open_simulator_tpu.runtime.budget import Budget
+from open_simulator_tpu.runtime.inject import INJECT, InjectedCrash
+from open_simulator_tpu.runtime.retry import (
+    breaker_for,
+    enable_breaker_recovery,
+    retry_io,
+)
+from open_simulator_tpu.scheduler.core import AppResource, simulate
+from open_simulator_tpu.serve.admission import (
+    AdmissionController,
+    estimate_request_pods,
+    sanitize_tenant,
+)
+from open_simulator_tpu.serve.coalescer import Coalescer, PendingRequest
+from open_simulator_tpu.serve.server import ServeDaemon
+from open_simulator_tpu.serve.session import (
+    Session,
+    WhatIfRequest,
+    result_payload,
+)
+from open_simulator_tpu.serve.sessions import SessionCache, open_snapshot
+from open_simulator_tpu.utils.trace import COUNTERS
+
+
+def make_node(name):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"}
+        },
+    }
+
+
+def deployment(name, replicas):
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "hard", "labels": {"app": name}},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    }
+
+
+def build_cluster() -> ResourceTypes:
+    cluster = ResourceTypes()
+    cluster.nodes = [make_node(f"hard-n-{i}") for i in range(3)]
+    return cluster
+
+
+def request_of(name, replicas) -> WhatIfRequest:
+    res = ResourceTypes()
+    res.deployments = [deployment(name, replicas)]
+    return WhatIfRequest(apps=[AppResource(name, res)])
+
+
+def serial_body(cluster, req: WhatIfRequest) -> bytes:
+    reset_name_counter()
+    result = simulate(
+        copy.deepcopy(cluster),
+        [AppResource(a.name, copy.deepcopy(a.resource)) for a in req.apps],
+        engine="tpu",
+    )
+    return result_payload(result)
+
+
+# ------------------------------------------------------------- admission
+
+
+def test_sanitize_tenant_bounds_and_charset():
+    assert sanitize_tenant(None) == "default"
+    assert sanitize_tenant("") == "default"
+    assert sanitize_tenant("team-a.prod_1") == "team-a.prod_1"
+    assert sanitize_tenant('evil"} inject{') == "evil___inject_"
+    assert len(sanitize_tenant("x" * 500)) == 64
+
+
+def test_sanitize_tenant_caps_cardinality():
+    # a client cycling unique headers must not mint unbounded metric
+    # keys in the resident daemon: tenant N+1.. share one bucket
+    from open_simulator_tpu.serve.admission import (
+        MAX_TENANTS,
+        OVERFLOW_TENANT,
+        reset_tenant_registry,
+    )
+
+    reset_tenant_registry()
+    try:
+        for i in range(MAX_TENANTS):
+            assert sanitize_tenant(f"t{i}") == f"t{i}"
+        assert sanitize_tenant("one-too-many") == OVERFLOW_TENANT
+        assert sanitize_tenant("another") == OVERFLOW_TENANT
+        # known tenants keep their own series
+        assert sanitize_tenant("t0") == "t0"
+    finally:
+        reset_tenant_registry()
+
+
+def test_estimate_request_pods_reads_declared_replicas():
+    req = request_of("w", 7)
+    assert estimate_request_pods(req) == 7
+    res = ResourceTypes()
+    res.deployments = [deployment("a", 3)]
+    res.pods = [{"kind": "Pod", "metadata": {"name": "p"}}]
+    assert (
+        estimate_request_pods(WhatIfRequest(apps=[AppResource("a", res)]))
+        == 4
+    )
+
+
+def test_admission_default_is_admit():
+    ctl = AdmissionController(max_batch=8)
+    v = ctl.decide(est_pods=100, queue_depth=50)
+    assert v.action == "admit" and v.admitted
+
+
+def test_admission_oversize_routes_serial():
+    ctl = AdmissionController(max_batch=8, max_request_pods=10)
+    v = ctl.decide(est_pods=11, queue_depth=0)
+    assert v.action == "serial" and v.admitted
+    assert "max-request-pods" in v.reason
+
+
+def test_admission_predicted_latency_sheds_with_retry_after():
+    ctl = AdmissionController(max_batch=4, tick_budget_s=0.5)
+    # seed the observed coalescer tick p95 well past the budget
+    for _ in range(32):
+        HISTOS.observe("serve/evaluate", 2.0)
+    s0 = COUNTERS.get("serve_admission_shed_total")
+    v = ctl.decide(est_pods=1, queue_depth=8)
+    assert v.action == "shed" and not v.admitted
+    # 8 queued / batch 4 -> 3 ticks ahead (incl. ours) at ~2s p95
+    assert v.retry_after_s >= 2
+    assert "predicted wait" in v.reason
+    assert COUNTERS.get("serve_admission_shed_total") - s0 == 1
+
+
+def test_admission_predicted_hbm_routes_serial(monkeypatch):
+    from open_simulator_tpu.obs.costs import COSTS
+
+    ctl = AdmissionController(max_batch=4)
+    monkeypatch.setattr(
+        COSTS, "estimate_bytes", lambda site, lead: 1 << 40
+    )
+    # ledger.predict_fit lies "nothing fits": the predictive path sheds
+    # to the serial rung before any doomed dispatch
+    INJECT.configure("ledger.predict_fit=lie:highx*")
+    v = ctl.decide(est_pods=1, queue_depth=0)
+    INJECT.clear()
+    assert v.action == "serial"
+    assert "memory ledger" in v.reason
+
+
+def test_coalescer_serial_route_answers_byte_identical():
+    cluster = build_cluster()
+    session = Session(cluster)
+    coal = Coalescer(session, max_batch=4, queue_depth=8)
+    coal.start()
+    try:
+        p = PendingRequest(
+            request=request_of("routed", 3),
+            budget=Budget(None),
+            route="serial",
+            route_reason="admission test",
+        )
+        assert coal.submit(p)
+        assert p.done.wait(timeout=300)
+        assert p.reply.status == 200
+        assert p.reply.meta["engine"] == "serial"
+        assert p.reply.body == serial_body(cluster, p.request)
+    finally:
+        coal.close()
+
+
+# ------------------------------------------------------------- watchdog
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_watchdog_restarts_dead_dispatcher_and_fails_inflight_typed(
+    monkeypatch,
+):
+    cluster = build_cluster()
+    session = Session(cluster)
+    coal = Coalescer(session, max_batch=4, queue_depth=8)
+
+    real = session.evaluate_batch
+    died = threading.Event()
+
+    def die_once(reqs):
+        if not died.is_set():
+            died.set()
+            raise InjectedCrash("simulated dispatcher death mid-batch")
+        return real(reqs)
+
+    monkeypatch.setattr(session, "evaluate_batch", die_once)
+    coal.start()
+    try:
+        r0 = COUNTERS.get("serve_watchdog_restarts_total")
+        doomed = PendingRequest(
+            request=request_of("doomed", 2), budget=Budget(None)
+        )
+        assert coal.submit(doomed)
+        # the dispatcher dies mid-batch; the watchdog must (a) answer
+        # the in-flight request typed, (b) restart the dispatcher
+        assert doomed.done.wait(timeout=300), (
+            "died dispatcher wedged its in-flight request"
+        )
+        assert doomed.reply.status == 500
+        body = json.loads(doomed.reply.body)
+        assert "dispatcher thread died" in body["error"]
+        assert coal.restarts >= 1
+        assert COUNTERS.get("serve_watchdog_restarts_total") > r0
+        # (c) the restarted dispatcher serves: clean request answers 200
+        ok = PendingRequest(
+            request=request_of("after", 2), budget=Budget(None)
+        )
+        assert coal.submit(ok)
+        assert ok.done.wait(timeout=300)
+        assert ok.reply.status == 200
+        assert ok.reply.body == serial_body(cluster, ok.request)
+    finally:
+        coal.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_injected_tick_crash_restarts_without_casualties():
+    """A crash at the serve.tick seam (before the batch is picked)
+    kills the thread with an empty in-flight set: restart, no 500s."""
+    cluster = build_cluster()
+    session = Session(cluster)
+    coal = Coalescer(session, max_batch=4, queue_depth=8)
+    INJECT.configure("serve.tick=crash@1")
+    coal.start()
+    try:
+        p = PendingRequest(request=request_of("x", 2), budget=Budget(None))
+        assert coal.submit(p)
+        assert p.done.wait(timeout=300)
+        INJECT.clear()
+        assert p.reply.status == 200
+        assert coal.restarts >= 1
+    finally:
+        INJECT.clear()
+        coal.close()
+
+
+# ------------------------------------------------------------- sessions
+
+
+def _fake_session(fp):
+    return types.SimpleNamespace(fingerprint=fp)
+
+
+def test_session_cache_lru_eviction_and_pin(tmp_path):
+    snap = open_snapshot(str(tmp_path / "snap.jsonl"))
+    cache = SessionCache(capacity=2, snapshot=snap)
+    cache.add(_fake_session("primary"), pinned=True)
+    cache.add(_fake_session("a"))
+    evicted = cache.add(_fake_session("b"))
+    assert evicted == ["a"], "LRU secondary evicts; pinned survives"
+    assert set(cache.fingerprints()) == {"primary", "b"}
+    # recency refresh: touching b then adding c evicts nothing else
+    assert cache.get("b") is not None
+    cache.add(_fake_session("c"))
+    assert "primary" in cache.fingerprints()
+    # the pinned primary is never evictable even under direct pressure
+    cache.evict_lru("test")  # takes an unpinned one
+    cache.evict_lru("test")
+    assert cache.fingerprints() == ["primary"]
+    assert cache.evict_lru("test") is None
+    cache.drain()
+    # the snapshot resumes cleanly after the churn (record-level
+    # content is asserted in test_session_snapshot_records_lifecycle)
+    resumed = open_snapshot(str(tmp_path / "snap.jsonl"))
+    assert resumed.dropped == 0 and resumed.replayed > 0
+    resumed.close()
+
+
+def test_session_cache_ledger_pressure_evicts_lru(monkeypatch):
+    import open_simulator_tpu.obs.ledger as ledger_mod
+
+    cache = SessionCache(capacity=4)
+    cache.add(_fake_session("primary"), pinned=True)
+    cache.add(_fake_session("old"))
+    cache.add(_fake_session("new"))
+    # no budget known -> no eviction
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", lambda: (0, 0, "none")
+    )
+    assert cache.check_pressure() is None
+    # live bytes past the pressure fraction -> LRU secondary goes
+    monkeypatch.setattr(
+        ledger_mod, "device_memory_stats", lambda: (950, 1000, "env")
+    )
+    e0 = COUNTERS.get("serve_session_evictions_ledger_pressure_total")
+    assert cache.check_pressure() == "old"
+    assert COUNTERS.get("serve_session_evictions_ledger_pressure_total") == e0 + 1
+    assert set(cache.fingerprints()) == {"primary", "new"}
+
+
+def test_session_snapshot_records_lifecycle(tmp_path):
+    path = str(tmp_path / "lifecycle.jsonl")
+    snap = open_snapshot(path)
+    cache = SessionCache(capacity=2, snapshot=snap)
+    cache.add(_fake_session("one"), pinned=True)
+    cache.add(_fake_session("two"))  # fits
+    cache.add(_fake_session("three"))  # over capacity: evicts two
+    cache.drain()
+    records = [
+        json.loads(line)
+        for line in open(path).read().splitlines()[1:]
+        if line
+    ]
+    events = [(r["event"], r["fingerprint"]) for r in records]
+    assert ("admit", "one") in events and ("admit", "three") in events
+    assert ("evict", "two") in events
+    drained = {fp for ev, fp in events if ev == "drain"}
+    assert drained == {"one", "three"}
+
+
+# ------------------------------------------------------------- breakers
+
+
+def test_breaker_half_open_recovery_and_reopen():
+    enable_breaker_recovery(0.05)
+    try:
+        b = breaker_for("flappy://api")
+        for _ in range(5):
+            b.record_failure()
+        assert b.is_open and not b.allow_call()
+        time.sleep(0.06)
+        # cooldown elapsed: exactly one probe goes through half-open;
+        # the window re-arms, so a concurrent caller fails fast
+        # instead of storming the still-dead endpoint alongside it
+        assert b.allow_call() and b.half_open
+        assert not b.allow_call(), "second caller must not also probe"
+        b.record_failure()  # probe failed: re-opened, fresh window
+        assert b.is_open and not b.allow_call()
+        time.sleep(0.06)
+        assert b.allow_call()
+        b.record_success()  # probe succeeded: circuit re-closes
+        assert not b.is_open and b.failures == 0
+        assert b.allow_call()
+    finally:
+        enable_breaker_recovery(None)
+
+
+def test_breaker_without_cooldown_stays_open():
+    b = breaker_for("oneshot://api")
+    for _ in range(5):
+        b.record_failure()
+    assert b.is_open and not b.allow_call()
+    time.sleep(0.05)
+    assert not b.allow_call(), "one-shot posture: open stays open"
+
+
+def test_retry_io_half_open_probe_reaches_endpoint():
+    enable_breaker_recovery(0.05)
+    try:
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) <= 5:
+                raise ConnectionResetError("down")
+            return "up"
+
+        for _ in range(5):
+            with pytest.raises(Exception):
+                retry_io(
+                    flaky, label="ho", endpoint="ho://x", attempts=1,
+                    sleep=lambda s: None,
+                )
+        # breaker is open: fail-fast, no endpoint call
+        n = len(calls)
+        with pytest.raises(Exception, match="circuit breaker open"):
+            retry_io(
+                flaky, label="ho", endpoint="ho://x", attempts=1,
+                sleep=lambda s: None,
+            )
+        assert len(calls) == n
+        time.sleep(0.06)
+        # half-open probe goes through and re-closes the circuit
+        out = retry_io(
+            flaky, label="ho", endpoint="ho://x", attempts=1,
+            sleep=lambda s: None,
+        )
+        assert out == "up" and not breaker_for("ho://x").is_open
+    finally:
+        enable_breaker_recovery(None)
+
+
+# ------------------------------------------------------------- daemon HTTP
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    cluster = build_cluster()
+    session = Session(cluster)
+    d = ServeDaemon(
+        session, port=0, max_batch=4, queue_depth=8, drain_timeout_s=10.0,
+        max_request_pods=50,
+    )
+    d.start()
+    yield d, cluster
+    d.shutdown()
+
+
+def _post(base, payload, timeout=300, headers=()):
+    req = urllib.request.Request(
+        base + "/v1/simulate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **dict(headers)},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _app_payload(name, replicas, **extra):
+    return {
+        "apps": [{"name": name, "yaml": json.dumps(deployment(name, replicas))}],
+        **extra,
+    }
+
+
+def test_healthz_reports_ok_then_degraded(daemon):
+    d, _ = daemon
+    base = f"http://{d.host}:{d.port}"
+    h = json.load(urllib.request.urlopen(base + "/healthz", timeout=30))
+    assert h["ok"] and h["status"] == "ok" and h["reasons"] == []
+    assert h["sessions"]["sessions"] >= 1
+    # open a breaker: liveness stays true, readiness degrades
+    b = breaker_for("degraded://api")
+    for _ in range(5):
+        b.record_failure()
+    h = json.load(urllib.request.urlopen(base + "/healthz", timeout=30))
+    assert h["ok"] is True and h["status"] == "degraded"
+    assert any("degraded://api" in r for r in h["reasons"])
+
+
+def test_http_tenant_accounting_and_metrics(daemon):
+    d, cluster = daemon
+    base = f"http://{d.host}:{d.port}"
+    resp = _post(
+        base, _app_payload("tenanted", 2),
+        headers=[("X-Simon-Tenant", "team-a")],
+    )
+    assert resp.status == 200
+    resp2 = _post(base, _app_payload("enveloped", 2, tenant="team-b"))
+    assert resp2.status == 200
+    metrics = urllib.request.urlopen(base + "/metrics", timeout=30).read().decode()
+    assert 'simon_serve_tenant_requests_total{tenant="team-a"}' in metrics
+    assert 'simon_serve_tenant_requests_total{tenant="team-b"}' in metrics
+    assert "simon_breaker_state" in metrics
+    assert "simon_retry_attempts_total" in metrics
+    assert "simon_serve_sessions" in metrics
+    assert "simon_serve_watchdog_restarts_total" in metrics
+    assert "simon_serve_admission_total" in metrics
+    assert "simon_inject_fired_total" in metrics
+
+
+def test_http_admission_shed_is_429_with_retry_after(daemon):
+    d, _ = daemon
+    base = f"http://{d.host}:{d.port}"
+    # arm a tiny tick budget on the live daemon and seed the p95
+    old = d.admission.tick_budget_s
+    d.admission.tick_budget_s = 0.001
+    for _ in range(32):
+        HISTOS.observe("serve/evaluate", 1.0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, _app_payload("shed-me", 2))
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert body["partial"] is True and body["reason"] == "admission"
+    finally:
+        d.admission.tick_budget_s = old
+
+
+def test_http_oversize_request_routes_serial(daemon):
+    d, cluster = daemon
+    base = f"http://{d.host}:{d.port}"
+    # 51 replicas > --max-request-pods 50: serial route, same answer
+    wire_req = request_of("big", 51)
+    resp = _post(base, _app_payload("big", 51))
+    assert resp.status == 200
+    assert resp.headers["X-Simon-Engine"] == "serial"
+    assert resp.read() == serial_body(cluster, wire_req)
+
+
+# ------------------------------------------------------------- mini soak
+
+
+def test_serve_mini_soak_with_injected_faults(daemon):
+    """The CI soak's in-process sibling: ~3s of concurrent load while
+    every 3rd scenario-scan dispatch OOMs and every 7th loses the
+    backend. Every request must answer 200 byte-identical to a
+    standalone simulate(); the daemon must stay up throughout."""
+    import urllib.error
+
+    d, cluster = daemon
+    base = f"http://{d.host}:{d.port}"
+    INJECT.configure("jit.scenario_scan=oom%3;jit.scenario_scan=backend%7")
+    results = []  # (status, name, replicas, body)
+    errors = []
+    lock = threading.Lock()
+
+    def client(i):
+        name, replicas = f"soak-{i % 4}", 2 + (i % 3)
+        try:
+            resp = _post(base, _app_payload(name, replicas))
+            body = resp.read()
+            with lock:
+                results.append((resp.status, name, replicas, body))
+        except Exception as e:  # noqa: BLE001 - collected and asserted below
+            with lock:
+                errors.append(repr(e))
+
+    f0 = COUNTERS.get("inject_fired_total")
+    try:
+        deadline = time.monotonic() + 3.0
+        i = 0
+        while time.monotonic() < deadline:
+            threads = [
+                threading.Thread(target=client, args=(i + k,))
+                for k in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            i += 4
+    finally:
+        INJECT.clear()
+    assert not errors, f"soak requests failed: {errors[:3]}"
+    assert results, "no soak requests completed"
+    assert all(status == 200 for status, _n, _r, _b in results)
+    # byte-identical to standalone simulate() — computed after the
+    # load stops (serial_body resets the process-global name counter)
+    expected = {
+        (name, replicas): serial_body(cluster, request_of(name, replicas))
+        for (_s, name, replicas, _b) in results
+    }
+    for _status, name, replicas, body in results:
+        assert body == expected[(name, replicas)], (
+            f"degraded answer drifted for {name} x{replicas}"
+        )
+    assert COUNTERS.get("inject_fired_total") > f0, "the chaos never fired"
+    # the daemon is still alive and ready
+    h = json.load(
+        urllib.request.urlopen(base + "/healthz", timeout=30)
+    )
+    assert h["ok"] is True

@@ -1334,3 +1334,96 @@ def test_full_repo_lints_clean():
 
     findings = lint_repo()
     assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------------- RT002
+
+
+def _rt002_tree(tmp_path, registry: str):
+    """Fixture taxonomy tree: a bare-named GuardError root with two
+    subtypes, plus (optionally) the coverage registry module."""
+    (tmp_path / "errors.py").write_text(
+        "class GuardError(Exception):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "class DeviceOOM(GuardError):\n"
+        "    pass\n"
+        "\n"
+        "\n"
+        "class NewFangledError(GuardError):\n"
+        "    pass\n"
+    )
+    if registry:
+        (tmp_path / "test_matrix.py").write_text(registry)
+    return _lint_tree(tmp_path)
+
+
+def test_rt002_clean_when_registry_covers_taxonomy(tmp_path):
+    findings = _rt002_tree(
+        tmp_path,
+        "INJECTION_COVERAGE = {\n"
+        '    "GuardError": ["GuardError/serve"],\n'
+        '    "DeviceOOM": ["DeviceOOM/apply"],\n'
+        '    "NewFangledError": ["NewFangledError/apply"],\n'
+        "}\n",
+    )
+    assert [f for f in findings if f[1] == "RT002"] == []
+
+
+def test_rt002_flags_uncovered_subtype_at_its_classdef(tmp_path):
+    findings = _rt002_tree(
+        tmp_path,
+        "INJECTION_COVERAGE = {\n"
+        '    "GuardError": ["GuardError/serve"],\n'
+        '    "DeviceOOM": ["DeviceOOM/apply"],\n'
+        "}\n",
+    )
+    rt = [f for f in findings if f[1] == "RT002"]
+    # anchored at `class NewFangledError` (errors.py line 9)
+    assert ("errors.py", "RT002", 9) in rt
+
+
+def test_rt002_flags_stale_registry_entry(tmp_path):
+    findings = _rt002_tree(
+        tmp_path,
+        "INJECTION_COVERAGE = {\n"
+        '    "GuardError": ["GuardError/serve"],\n'
+        '    "DeviceOOM": ["DeviceOOM/apply"],\n'
+        '    "NewFangledError": ["NewFangledError/apply"],\n'
+        '    "GhostError": ["GhostError/apply"],\n'
+        "}\n",
+    )
+    rt = [f for f in findings if f[1] == "RT002"]
+    assert any(
+        rel == "test_matrix.py" and line == 5 for rel, _r, line in rt
+    ), rt
+
+
+def test_rt002_flags_missing_registry_entirely(tmp_path):
+    findings = _rt002_tree(tmp_path, "")
+    rt = [f for f in findings if f[1] == "RT002"]
+    assert rt, "a taxonomy with no coverage registry must be flagged"
+
+
+def test_rt002_empty_cell_list_counts_as_uncovered(tmp_path):
+    findings = _rt002_tree(
+        tmp_path,
+        "INJECTION_COVERAGE = {\n"
+        '    "GuardError": ["GuardError/serve"],\n'
+        '    "DeviceOOM": [],\n'
+        '    "NewFangledError": ["NewFangledError/apply"],\n'
+        "}\n",
+    )
+    rt = [f for f in findings if f[1] == "RT002"]
+    assert ("errors.py", "RT002", 5) in rt
+
+
+def test_rt002_real_tree_taxonomy_is_fully_registered():
+    """The live contract: every GuardError subtype in the package has
+    a registered chaos-matrix cell (the closure the matrix's own
+    test_registry_is_closed_over_cells pins from the other side)."""
+    from tools.simonlint.runner import lint_repo
+
+    rt = [f for f in lint_repo() if f.rule == "RT002"]
+    assert rt == [], "\n".join(f.render() for f in rt)

@@ -1,0 +1,329 @@
+"""Torn-tail recovery under injected mid-fsync crash points for all
+four crash-safe JSONL writers (docs/ROBUSTNESS.md): the apply/chaos
+planning journal, the serve session snapshot, the shadow decision log,
+and the timeline trace. Each must (a) leave a durable torn prefix when
+the process dies mid-append, (b) resume by replaying every COMPLETE
+record and truncating the tear, re-executing zero completed work, and
+(c) refuse loudly on interior corruption (damage before the tail means
+the file was not grown append-only)."""
+
+import json
+
+import pytest
+import yaml as _yaml
+
+from open_simulator_tpu.runtime import (
+    InjectedCrash,
+    Journal,
+    JournalMismatch,
+    config_fingerprint,
+)
+from open_simulator_tpu.runtime.inject import INJECT
+
+FP = config_fingerprint({"suite": "torn-tail"})
+
+
+def _corrupt_interior(path):
+    """Scramble a middle line (not header, not tail)."""
+    lines = open(path, "rb").read().split(b"\n")
+    assert len(lines) >= 4, "need at least header + 2 records"
+    lines[2] = b'{"interior": dama'  # unparsable mid-file record
+    with open(path, "wb") as f:
+        f.write(b"\n".join(lines))
+
+
+# ------------------------------------------------- apply/chaos journal
+
+
+def test_journal_crash_mid_append_then_resume(tmp_path):
+    p = str(tmp_path / "plan.jsonl")
+    j = Journal.create(p, FP)
+    j.append({"kind": "probe", "count": 0, "ok": True})
+    j.append({"kind": "probe", "count": 1, "ok": True})
+    INJECT.configure("journal.fsync.apply=crash:0.4@1")
+    with pytest.raises(InjectedCrash, match="mid-append"):
+        j.append({"kind": "probe", "count": 2, "ok": True})
+    INJECT.clear()
+    # the file ends in a durable torn prefix of record 3
+    raw = open(p).read()
+    assert raw.count("\n") == 3  # header + 2 complete records
+    assert not raw.endswith("\n")
+    # resume: completed records replay, the tear is truncated
+    r = Journal.resume(p, FP)
+    assert r.replayed == 2 and r.dropped == 1
+    assert {rec["count"] for rec in r.probes.values()} == {0, 1}
+    # appending continues on a clean line boundary
+    r.append({"kind": "probe", "count": 2, "ok": True})
+    r.close()
+    r2 = Journal.resume(p, FP)
+    assert r2.replayed == 3 and r2.dropped == 0
+    r2.close()
+
+
+def test_journal_interior_corruption_refused(tmp_path):
+    p = str(tmp_path / "plan.jsonl")
+    j = Journal.create(p, FP)
+    j.append({"kind": "probe", "count": 0})
+    j.append({"kind": "probe", "count": 1})
+    j.close()
+    _corrupt_interior(p)
+    with pytest.raises(JournalMismatch, match="corrupt journal record"):
+        Journal.resume(p, FP)
+
+
+def test_cli_apply_journal_crash_then_resume_zero_probes(
+    tmp_path, capsys, monkeypatch
+):
+    """End-to-end: an apply run killed by an injected crash at the
+    SECOND journal append leaves a torn journal; --resume completes the
+    plan, re-executes ZERO journaled probes, and gives the same answer
+    an uncrashed run gives."""
+    from open_simulator_tpu.cli import main
+    from open_simulator_tpu.models.workloads import reset_name_counter
+    from open_simulator_tpu.parallel.sweep import CapacitySweep
+
+    cfg = _write_cli_config(tmp_path)
+    journal = str(tmp_path / "crash.jsonl")
+    # header is hit 1; the crash lands on the SECOND probe append
+    INJECT.configure("journal.fsync.apply=crash:0.5@3")
+    with pytest.raises(InjectedCrash):
+        main(
+            ["apply", "-f", cfg, "--tolerate-node-failures", "1",
+             "--journal", journal, "--format", "json"]
+        )
+    INJECT.clear()
+    capsys.readouterr()
+    # the torn tail has no trailing newline: every complete record is a
+    # "\n"-terminated segment, the final segment is the tear
+    segments = open(journal).read().split("\n")
+    completed = [json.loads(line) for line in segments[1:-1] if line]
+    journaled_probes = [r for r in completed if r.get("kind") == "probe"]
+    assert journaled_probes, "at least one probe completed before the crash"
+
+    probes_after_resume = []
+    orig_dev = CapacitySweep._probe_device
+
+    def counting(self, count):
+        probes_after_resume.append(count)
+        return orig_dev(self, count)
+
+    monkeypatch.setattr(CapacitySweep, "_probe_device", counting)
+    reset_name_counter()
+    rc = main(
+        ["apply", "-f", cfg, "--tolerate-node-failures", "1",
+         "--resume", journal, "--format", "json"]
+    )
+    resumed = json.loads(capsys.readouterr().out)
+    assert rc == 0 and resumed["success"]
+    # no journaled probe re-executed on the device
+    done = {r["count"] for r in journaled_probes}
+    assert not (done & set(probes_after_resume)), (
+        f"journaled probes {sorted(done)} re-executed: "
+        f"{probes_after_resume}"
+    )
+
+    # control: the same plan straight through, no crash
+    reset_name_counter()
+    rc2 = main(
+        ["apply", "-f", cfg, "--tolerate-node-failures", "1",
+         "--format", "json"]
+    )
+    control = json.loads(capsys.readouterr().out)
+    assert rc2 == 0 and control == resumed
+
+
+# ------------------------------------------------- serve session snapshot
+
+
+def test_serve_snapshot_crash_and_resume(tmp_path):
+    from open_simulator_tpu.serve.sessions import open_snapshot
+
+    p = str(tmp_path / "sessions.jsonl")
+    snap = open_snapshot(p)
+    snap.append({"kind": "session", "event": "admit", "fingerprint": "aaa"})
+    INJECT.configure("journal.fsync.serve=crash:0.6@1")
+    with pytest.raises(InjectedCrash):
+        snap.append(
+            {"kind": "session", "event": "admit", "fingerprint": "bbb"}
+        )
+    INJECT.clear()
+    resumed = open_snapshot(p)  # open == resume when the file exists
+    assert resumed.replayed == 1 and resumed.dropped == 1
+    resumed.append(
+        {"kind": "session", "event": "evict", "fingerprint": "aaa"}
+    )
+    resumed.close()
+    final = open_snapshot(p)
+    assert final.replayed == 2 and final.dropped == 0
+    final.close()
+
+
+def test_serve_snapshot_interior_corruption_refused(tmp_path):
+    from open_simulator_tpu.serve.sessions import open_snapshot
+
+    p = str(tmp_path / "sessions.jsonl")
+    snap = open_snapshot(p)
+    snap.append({"kind": "session", "event": "admit", "fingerprint": "aaa"})
+    snap.append({"kind": "session", "event": "admit", "fingerprint": "bbb"})
+    snap.close()
+    _corrupt_interior(p)
+    with pytest.raises(JournalMismatch):
+        open_snapshot(p)
+
+
+# ------------------------------------------------- shadow decision log
+
+
+def _step(seq):
+    from open_simulator_tpu.shadow.log import Step
+
+    return Step(
+        seq=seq,
+        kind="decision",
+        pod={"metadata": {"name": f"p{seq}", "namespace": "d"}},
+        node=f"n{seq}",
+    )
+
+
+def test_shadow_log_crash_tolerated_on_read(tmp_path):
+    from open_simulator_tpu.shadow.log import (
+        DecisionLogWriter,
+        read_decision_log,
+    )
+
+    p = str(tmp_path / "decisions.jsonl")
+    w = DecisionLogWriter(p, "cluster-fp")
+    w.append(_step(0))
+    w.append(_step(1))
+    INJECT.configure("journal.fsync.shadow=crash:0.5@1")
+    with pytest.raises(InjectedCrash):
+        w.append(_step(2))
+    INJECT.clear()
+    steps, meta = read_decision_log(p, fingerprint="cluster-fp")
+    assert [s.seq for s in steps] == [0, 1]
+    assert meta["dropped"] == 1
+
+
+def test_shadow_log_interior_corruption_refused(tmp_path):
+    from open_simulator_tpu.shadow.log import (
+        DecisionLogWriter,
+        read_decision_log,
+    )
+
+    p = str(tmp_path / "decisions.jsonl")
+    w = DecisionLogWriter(p, "cluster-fp")
+    w.append(_step(0))
+    w.append(_step(1))
+    w.close()
+    _corrupt_interior(p)
+    with pytest.raises(JournalMismatch):
+        read_decision_log(p, fingerprint="cluster-fp")
+
+
+# ------------------------------------------------- timeline trace
+
+
+def _event(seq, t):
+    from open_simulator_tpu.timeline.events import POD_DEPARTURE, Event
+
+    return Event(time=t, kind=POD_DEPARTURE, seq=seq, pod_ref=f"d/p{seq}")
+
+
+def test_timeline_trace_crash_tolerated_on_read(tmp_path):
+    from open_simulator_tpu.timeline.events import TraceWriter, read_trace
+
+    p = str(tmp_path / "trace.jsonl")
+    fp = config_fingerprint({"trace": "torn"})
+    w = TraceWriter(p, fp)
+    w.append(_event(1, 0.5))
+    w.append(_event(2, 1.0))
+    INJECT.configure("journal.fsync.timeline=crash:0.5@1")
+    with pytest.raises(InjectedCrash):
+        w.append(_event(3, 1.5))
+    INJECT.clear()
+    events, meta = read_trace(p, fingerprint=fp)
+    assert [e.seq for e in events] == [1, 2]
+    assert meta["dropped"] == 1
+
+
+def test_timeline_trace_interior_corruption_refused(tmp_path):
+    from open_simulator_tpu.timeline.events import TraceWriter, read_trace
+
+    p = str(tmp_path / "trace.jsonl")
+    fp = config_fingerprint({"trace": "torn2"})
+    w = TraceWriter(p, fp)
+    w.append(_event(1, 0.5))
+    w.append(_event(2, 1.0))
+    w.close()
+    _corrupt_interior(p)
+    with pytest.raises(JournalMismatch):
+        read_trace(p, fingerprint=fp)
+
+
+# ------------------------------------------------- helpers
+
+
+def _node(name):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"}
+        },
+    }
+
+
+def _deploy(name, replicas):
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "torn", "labels": {"app": name}},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    }
+
+
+def _write_cli_config(tmp_path, n_nodes=2, replicas=6):
+    root = tmp_path / "cfg"
+    root.mkdir()
+    cluster_dir = root / "cluster"
+    cluster_dir.mkdir()
+    for i in range(n_nodes):
+        (cluster_dir / f"n{i}.yaml").write_text(
+            _yaml.safe_dump(_node(f"base-{i}"))
+        )
+    app_dir = root / "app"
+    app_dir.mkdir()
+    (app_dir / "deploy.yaml").write_text(_yaml.safe_dump(_deploy("web", replicas)))
+    newnode_dir = root / "newnode"
+    newnode_dir.mkdir()
+    (newnode_dir / "node.yaml").write_text(_yaml.safe_dump(_node("template")))
+    cfg = root / "simon-config.yaml"
+    cfg.write_text(
+        _yaml.safe_dump(
+            {
+                "apiVersion": "simon/v1alpha1",
+                "kind": "Config",
+                "metadata": {"name": "torn"},
+                "spec": {
+                    "cluster": {"customConfig": str(cluster_dir)},
+                    "appList": [{"name": "web", "path": str(app_dir)}],
+                    "newNode": str(newnode_dir),
+                },
+            }
+        )
+    )
+    return str(cfg)

@@ -120,6 +120,10 @@ EXC001_VALIDATION_FILES: Set[str] = {
     "open_simulator_tpu/scheduler/schedconfig.py",
     # snapshot document validation (version/shape checks on load)
     "open_simulator_tpu/scheduler/snapshot.py",
+    # --inject spec grammar: modifier parsing raises ValueError and
+    # parse_spec's own `except ValueError` cascade wraps every one
+    # into a clause-scoped InputError (the quantity.py pattern)
+    "open_simulator_tpu/runtime/inject.py",
 }
 
 # Individual validation-boundary functions allowed to raise stdlib
@@ -132,6 +136,7 @@ EXC001_ALLOW: Set[Key] = {
     # constructor argument validation (the Python idiom; callers that
     # pass literals deserve the loud TypeError/ValueError)
     ("open_simulator_tpu/serve/coalescer.py", "__init__"),
+    ("open_simulator_tpu/serve/sessions.py", "__init__"),
     ("open_simulator_tpu/runtime/budget.py", "__init__"),
     ("open_simulator_tpu/runtime/guard.py", "run_laddered"),
     ("open_simulator_tpu/resilience/chaos.py", "__init__"),

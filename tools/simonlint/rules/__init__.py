@@ -5,6 +5,7 @@ from . import concurrency  # noqa: F401
 from . import deadline  # noqa: F401
 from . import exceptions  # noqa: F401
 from . import hygiene  # noqa: F401
+from . import injection  # noqa: F401
 from . import jax_compile  # noqa: F401
 from . import jax_dtype  # noqa: F401
 from . import jax_trace  # noqa: F401

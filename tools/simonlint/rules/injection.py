@@ -1,0 +1,162 @@
+"""RT002 — every GuardError subtype must have registered injection-test
+coverage.
+
+The chaos matrix (tests/test_chaos_matrix.py, docs/ROBUSTNESS.md) is
+only a guarantee while it is EXHAUSTIVE: a new taxonomy error that
+ships without an injection cell is an untested degradation path — the
+exact gap the matrix exists to close. This rule makes the coverage a
+land-time invariant instead of a review-time hope.
+
+Mechanics: the project's class hierarchy (effects.Effects.class_bases,
+the EXC001 machinery) yields every class transitively rooted in a
+bare-named **GuardError**. The coverage document is a module-level
+``INJECTION_COVERAGE = {...}`` dict literal in the test tree whose
+keys are taxonomy class names — the chaos matrix derives its
+parametrized cells from the same dict and pins the ids to the live
+cell tables (``test_registry_is_closed_over_cells``), so the static
+check reads an honest document. Findings:
+
+- a GuardError subtype missing from the registry (anchored at its
+  ``class`` statement — the line the author is editing when they add
+  the error);
+- a registry key naming no live taxonomy class (a stale entry,
+  anchored at the registry);
+- no registry found at all while taxonomy classes exist.
+
+Out-of-repo fixture trees (the lint test suite) exercise the rule
+directly: any tree defining a bare-named GuardError root plays.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import Finding, Rule, register
+from ..effects import get_effects
+from ..project import ProjectIndex
+
+#: the registry variable the chaos matrix publishes
+REGISTRY_NAME = "INJECTION_COVERAGE"
+
+#: the taxonomy root (bare-name matching, like EXC001)
+ROOT = "GuardError"
+
+
+def _find_registry(
+    project: ProjectIndex,
+) -> Optional[Tuple[object, ast.Assign, Dict[str, int]]]:
+    """Locate the module-level ``INJECTION_COVERAGE = {...}`` dict:
+    (source file, assignment node, {key: line}). Last one wins if
+    several exist (they should not)."""
+    found = None
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in sf.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if REGISTRY_NAME not in targets:
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            keys: Dict[str, int] = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys[k.value] = k.lineno
+            found = (sf, node, keys)
+    return found
+
+
+@register
+class InjectionCoverage(Rule):
+    id = "RT002"
+    title = "GuardError subtype without registered injection-test coverage"
+    rationale = (
+        "a taxonomy error that ships without a chaos-matrix injection "
+        "cell is an untested degradation path; register it in "
+        "tests/test_chaos_matrix.py INJECTION_COVERAGE with a live cell"
+    )
+    scope = "project"
+
+    def check_project(self, project: ProjectIndex) -> Iterable[Finding]:
+        effects = get_effects(project)
+        taxonomy = effects.taxonomy_classes({ROOT})
+        if not taxonomy:
+            return []  # no taxonomy in this tree: nothing to enforce
+        # dotted -> leaf names, keeping the defining file/line so the
+        # finding lands on the class statement
+        leaf_sites: Dict[str, Tuple[object, int]] = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            mod = sf.module or sf.rel
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.ClassDef)
+                    and f"{mod}.{node.name}" in taxonomy
+                ):
+                    leaf_sites[node.name] = (sf, node.lineno)
+        findings: List[Finding] = []
+        registry = _find_registry(project)
+        if registry is None:
+            sf, lineno = next(iter(leaf_sites.values()))
+            findings.append(
+                Finding(
+                    sf.path, sf.rel, lineno, self.id,
+                    f"taxonomy classes exist but no module-level "
+                    f"{REGISTRY_NAME} dict was found in the tree — the "
+                    "chaos matrix cannot certify injection coverage "
+                    "(tests/test_chaos_matrix.py)",
+                )
+            )
+            return findings
+        reg_sf, node, keys = registry
+        covered = {k for k, ids in _key_ids(node).items() if ids}
+        for leaf, (sf, lineno) in sorted(leaf_sites.items()):
+            if leaf not in covered:
+                findings.append(
+                    Finding(
+                        sf.path, sf.rel, lineno, self.id,
+                        f"taxonomy class '{leaf}' has no registered "
+                        f"injection test — add a chaos-matrix cell and "
+                        f"list its id under {REGISTRY_NAME}['{leaf}'] "
+                        "(tests/test_chaos_matrix.py, "
+                        "docs/ROBUSTNESS.md failure-mode matrix)",
+                    )
+                )
+        for key, lineno in sorted(keys.items()):
+            if key not in leaf_sites:
+                findings.append(
+                    Finding(
+                        reg_sf.path, reg_sf.rel, lineno, self.id,
+                        f"{REGISTRY_NAME} entry '{key}' names no class "
+                        "in the GuardError taxonomy — stale registry "
+                        "entries hide real gaps; remove or rename it",
+                    )
+                )
+        return findings
+
+
+def _key_ids(assign: ast.Assign) -> Dict[str, list]:
+    """{key: [cell ids]} from the registry dict literal (non-literal
+    values count as covered — the runtime closure test owns them)."""
+    out: Dict[str, list] = {}
+    value = assign.value
+    if not isinstance(value, ast.Dict):
+        return out
+    for k, v in zip(value.keys, value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue
+        if isinstance(v, (ast.List, ast.Tuple)):
+            out[k.value] = [
+                e.value
+                for e in v.elts
+                if isinstance(e, ast.Constant)
+            ]
+        else:
+            out[k.value] = ["<computed>"]
+    return out

@@ -36,6 +36,11 @@ Scenarios (SIMON_BENCH env):
   kernel's storage scope cap (>4 VGs), so the batch rides the XLA
   fallback and its rate is a recorded number instead of an invisible
   regression surface.
+- `twin-delta`: the live digital twin's substrate — cluster deltas/s
+  applied to a warm 10k-node mirror through the incremental
+  applicator (twin/deltas.py), with warm what-if queries answered
+  against the drifting live state (p50/p95 recorded, zero warm
+  recompiles asserted).
 - `fuzz`: on-device Pallas-vs-XLA placement conformance over a
   mixed-feature scenario (terms+ports+scalars+pins+storage, plus a
   forced STREAMED-terms pass); `all` runs it first and aborts on any
@@ -572,6 +577,135 @@ def run_shadow_replay(n_nodes=200, n_pods=400) -> dict:
             prof["jax_dispatches_total"] / (decisions * spread["runs"]), 3
         ),
         "spread": spread,
+    }
+
+
+def run_twin_delta(n_nodes=10_000, n_deltas=2000, query_every=100) -> dict:
+    """SIMON_BENCH=twin-delta: the live digital twin's substrate under
+    churn (docs/TWIN.md). A warm 10k-node mirror absorbs a
+    deterministic stream of pod bind/evict deltas through the
+    incremental applicator (twin/deltas.py — place/evict on
+    copy-on-write NodeStates, never a reload), with a warm what-if
+    query answered against LIVE state every `query_every` deltas (one
+    masked-scan dispatch + scratch replay). Measures deltas/s applied
+    and the query p50/p95 while the cluster drifts underneath; zero
+    recompiles asserted across the measured churn — the query
+    re-dispatches ONE compiled shape the whole time (the tentpole's
+    warm-delta contract, measured at bench scale)."""
+    import numpy as _np
+
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.obs import profile as obs_profile
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.twin import queries as twin_queries
+    from open_simulator_tpu.twin.deltas import (
+        POD_BIND,
+        POD_EVICT,
+        ClusterDelta,
+    )
+    from open_simulator_tpu.twin.mirror import ClusterMirror, FeedSource
+
+    nodes = [
+        _make_node(f"twin-n-{i:05d}", 32, 128, {"zone": f"z{i % 8}"})
+        for i in range(n_nodes)
+    ]
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    mirror = ClusterMirror(cluster, FeedSource([], batch=1), engine="tpu")
+    mirror.bootstrap()
+
+    def churn_pod(i):
+        return {
+            "kind": "Pod",
+            "metadata": {"name": f"tw-{i:06d}", "namespace": "bench"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "img-twin",
+                        "resources": {
+                            "requests": {"cpu": "500m", "memory": "1Gi"}
+                        },
+                    }
+                ]
+            },
+        }
+
+    # deterministic churn: two binds then an evict of the older one —
+    # the mirror's committed population grows while never leaking
+    stream = []
+    for i in range(n_deltas):
+        if i % 3 == 2:
+            j = i - 2
+            stream.append(
+                ClusterDelta(
+                    kind=POD_EVICT,
+                    namespace="bench",
+                    name=f"tw-{j:06d}",
+                    node_name=f"twin-n-{j % n_nodes:05d}",
+                )
+            )
+        else:
+            stream.append(
+                ClusterDelta(
+                    kind=POD_BIND,
+                    pod=churn_pod(i),
+                    node_name=f"twin-n-{i % n_nodes:05d}",
+                )
+            )
+
+    def query_app():
+        res = ResourceTypes()
+        res.pods = [
+            {
+                "kind": "Pod",
+                "metadata": {"name": "twin-query", "namespace": "bench"},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img-twin",
+                            "resources": {
+                                "requests": {"cpu": "2", "memory": "4Gi"}
+                            },
+                        }
+                    ]
+                },
+            }
+        ]
+        return [AppResource("twin-query", res)]
+
+    out = twin_queries.whatif(mirror, query_app())  # cold: compiles the shape
+    assert out["success"]
+    app = mirror.applicator
+    obs0 = obs_profile.snapshot()
+    q_times = []
+    t0 = time.perf_counter()
+    for i, d in enumerate(stream):
+        app.apply(d)
+        if i % query_every == query_every - 1:
+            tq = time.perf_counter()
+            ans = twin_queries.whatif(mirror, query_app())
+            q_times.append(time.perf_counter() - tq)
+            assert ans["success"]
+    elapsed = time.perf_counter() - t0
+    prof = obs_profile.delta(obs0)
+    assert prof["jax_recompiles_total"] == 0, (
+        f"warm deltas recompiled {prof['jax_recompiles_total']}x"
+    )
+    assert app.reloads == 0 and app.skips == 0
+    q_arr = _np.asarray(q_times)
+    return {
+        "nodes": n_nodes,
+        "deltas": n_deltas,
+        "deltas_per_sec": round(n_deltas / (elapsed - float(q_arr.sum())), 1),
+        "elapsed_s": round(elapsed, 3),
+        "queries": len(q_times),
+        "query_p50_ms": round(float(_np.percentile(q_arr, 50)) * 1000, 1),
+        "query_p95_ms": round(float(_np.percentile(q_arr, 95)) * 1000, 1),
+        "query_dispatches": prof["jax_dispatches_total"],
+        "warm_recompiles": prof["jax_recompiles_total"],
+        "committed_pods": len([p for ns in mirror.oracle.nodes for p in ns.pods]),
     }
 
 
@@ -1702,6 +1836,23 @@ def main():
             "agreement_rate": sh["agreement_rate"],
             "dispatches_per_step": sh["dispatches_per_step"],
         }
+    elif scenario == "twin-delta":
+        td = run_twin_delta()
+        out = {
+            "metric": f"twin cluster-deltas/s applied to a warm "
+            f"{td['nodes']}-node mirror ({td['deltas']} bind/evict deltas, "
+            f"{td['committed_pods']} pods committed at close; "
+            f"{td['queries']} live what-if queries interleaved, "
+            f"p50 {td['query_p50_ms']}ms p95 {td['query_p95_ms']}ms, "
+            f"zero warm recompiles)",
+            "value": td["deltas_per_sec"],
+            "unit": "deltas/s",
+            "vs_baseline": None,
+            "deltas_per_sec": td["deltas_per_sec"],
+            "query_p50_ms": td["query_p50_ms"],
+            "query_p95_ms": td["query_p95_ms"],
+            "warm_recompiles": td["warm_recompiles"],
+        }
     elif scenario == "timeline":
         tl = run_timeline()
         out = {
@@ -1793,6 +1944,7 @@ def main():
         sq = isolated(run_serve_qps)
         sh = isolated(run_shadow_replay)
         tl = isolated(run_timeline)
+        td = isolated(run_twin_delta)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -1834,7 +1986,10 @@ def main():
             f"timeline {tl['steps_per_sec']:.0f} steps/s over "
             f"{tl['arrivals']} arrivals x {tl['policies']} policies "
             f"({tl['windows']} windows, {tl['dispatches_per_policy']} "
-            f"dispatches/policy, zero warm recompiles); "
+            f"dispatches/policy, zero warm recompiles), "
+            f"twin-delta {td['deltas_per_sec']:.0f} deltas/s onto a warm "
+            f"{td['nodes']}-node mirror (live what-if p95 "
+            f"{td['query_p95_ms']}ms, zero warm recompiles); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"

@@ -1143,6 +1143,128 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+@_with_obs("twin")
+def cmd_twin(args) -> int:
+    """Live digital-twin daemon (twin/; docs/TWIN.md): continuously
+    mirror a cluster — a live apiserver tail (--tail) or a recorded
+    decision-log feed (--feed) — on the cluster-delta substrate, audit
+    every real scheduler decision against the warm mirror, and answer
+    what-if / drain-safety / N+K / capacity-forecast queries over HTTP
+    against LIVE state. Exit 0 after a clean SIGTERM/SIGINT drain, 2
+    on input errors."""
+    import json
+
+    from .apply.applier import Applier, SimonConfig
+    from .models.validation import InputError
+    from .runtime import ExternalIOError
+    from .shadow.log import cluster_fingerprint, read_decision_log
+    from .twin.mirror import ClusterMirror, FeedSource, LiveSource
+    from .twin.server import TwinDaemon
+
+    _force_platform()
+    client = None
+    try:
+        modes = sum(bool(m) for m in (args.feed, args.tail))
+        if modes != 1:
+            raise InputError(
+                "pick exactly one source: --feed LOG (tail a recorded "
+                "decision log) or --tail (poll the config's live cluster)"
+            )
+        if args.poll_interval <= 0:
+            raise InputError("--poll-interval must be > 0 seconds")
+        if args.drain_timeout < 0:
+            raise InputError("--drain-timeout must be >= 0 seconds")
+        if args.tick_budget is not None and args.tick_budget <= 0:
+            raise InputError("--tick-budget must be > 0 seconds")
+        if args.max_request_pods is not None and args.max_request_pods < 1:
+            raise InputError("--max-request-pods must be >= 1")
+        if args.max_catchup < 1:
+            raise InputError(
+                "--max-catchup must be >= 1 (0 would never apply the "
+                "backlog and the mirror would stop advancing)"
+            )
+        # resident service: breakers recover (the serve posture)
+        from .runtime.retry import BREAKER_COOLDOWN_ENV, enable_breaker_recovery
+
+        if args.breaker_cooldown and args.breaker_cooldown > 0:
+            if not os.environ.get(BREAKER_COOLDOWN_ENV):
+                enable_breaker_recovery(args.breaker_cooldown)
+        config = SimonConfig.from_file(args.simon_config)
+        applier = Applier(config)
+        if args.feed:
+            cluster = applier.load_cluster()
+            fp = cluster_fingerprint(cluster)
+            steps, _meta = read_decision_log(
+                args.feed,
+                fingerprint=None if args.allow_fingerprint_mismatch else fp,
+            )
+            source = FeedSource(steps, batch=args.feed_batch)
+        else:  # --tail
+            if not config.kube_config:
+                raise InputError(
+                    "--tail needs a kubeConfig cluster in the simon config "
+                    "(customConfig clusters have no scheduler to mirror)"
+                )
+            from .models.decode import ResourceTypes
+            from .models.kubeclient import KubeClient
+            from .shadow.ingest import ClusterTailer
+
+            client = KubeClient(config.kube_config)
+            tailer = ClusterTailer(client)
+            nodes, boot_steps = tailer.bootstrap()
+            cluster = ResourceTypes()
+            cluster.nodes = nodes
+            source = LiveSource(tailer, boot_steps=boot_steps)
+        mirror = ClusterMirror(
+            cluster, source, engine=args.engine, max_catchup=args.max_catchup
+        )
+        mirror.bootstrap()
+        daemon = TwinDaemon(
+            mirror,
+            host=args.host,
+            port=args.port,
+            poll_interval_s=args.poll_interval,
+            max_polls=args.max_polls,
+            tick_budget_s=args.tick_budget,
+            max_request_pods=args.max_request_pods,
+            drain_timeout_s=args.drain_timeout,
+        )
+    except (OSError, ValueError, ExternalIOError, InputError) as e:
+        if client is not None:
+            client.close()
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    daemon.start()
+    # machine-parsable readiness line (tests and the CI smoke read the
+    # bound port from it — --port 0 binds an ephemeral one)
+    print(
+        f"simon twin listening on http://{daemon.host}:{daemon.port} "
+        f"(mirroring {len(mirror.oracle.nodes)} node(s), "
+        f"source {'feed' if args.feed else 'tail'})",
+        flush=True,
+    )
+    try:
+        code = daemon.run_until_signaled()
+    finally:
+        if client is not None:
+            client.close()
+    # one JSON summary line on stderr at drain: the audit the mirror
+    # accumulated (agreement, divergences, lag) survives the process
+    print(
+        "simon twin mirror: " + json.dumps(mirror.stats(), sort_keys=True),
+        file=sys.stderr,
+    )
+    from .obs.spans import observatory_block
+
+    observatory = observatory_block()
+    if observatory:
+        print(
+            "simon twin observatory: " + json.dumps(observatory),
+            file=sys.stderr,
+        )
+    return code
+
+
 def cmd_doctor(args) -> int:
     """Perf-regression doctor (obs/doctor.py): diff a candidate bench
     record against a baseline — headline value, device dispatches,
@@ -1850,6 +1972,120 @@ def build_parser() -> argparse.ArgumentParser:
         "trace-file input here, unlike the other commands)",
     )
     p_timeline.set_defaults(func=cmd_timeline)
+
+    p_twin = sub.add_parser(
+        "twin",
+        help="live digital-twin daemon: mirror a cluster, answer "
+        "what-if/drain/N+K/forecast against live state",
+        description="Continuously mirror a cluster on the cluster-delta "
+        "substrate (a live apiserver tail or a recorded decision-log "
+        "feed), audit every real scheduler decision against the warm "
+        "mirror (agreement-rate and mirror-lag stream to /metrics as "
+        "alertable gauges), and serve on-demand queries over HTTP: "
+        "POST /v1/whatif (would these apps fit right now), /v1/drain "
+        "(can I cordon these nodes/this rack), /v1/nplusk (does the "
+        "live placement survive K node failures), /v1/forecast "
+        "(timeline windows stepped forward from the current mirrored "
+        "state). docs/TWIN.md.",
+    )
+    p_twin.add_argument(
+        "-f", "--simon-config", required=True, help="simon config file path"
+    )
+    p_twin.add_argument(
+        "--tail",
+        action="store_true",
+        help="poll the config's live cluster (kubeConfig required)",
+    )
+    p_twin.add_argument(
+        "--feed",
+        default="",
+        metavar="LOG",
+        help="tail a recorded decision log instead of a live cluster "
+        "(the self-conformance and CI-smoke source; simon tailing its "
+        "own recorded feed must agree with itself 100%%)",
+    )
+    p_twin.add_argument(
+        "--feed-batch",
+        type=int,
+        default=64,
+        metavar="N",
+        help="feed steps replayed per poll round",
+    )
+    p_twin.add_argument(
+        "--allow-fingerprint-mismatch",
+        action="store_true",
+        help="replay a --feed log recorded against different inputs "
+        "(divergences become meaningful; default refuses loudly)",
+    )
+    p_twin.add_argument(
+        "--engine",
+        choices=["tpu", "oracle"],
+        default="tpu",
+        help="mirror probe/query engine: tpu = warm masked scans, "
+        "oracle = the serial host walk",
+    )
+    p_twin.add_argument("--host", default="127.0.0.1", help="bind address")
+    p_twin.add_argument(
+        "--port", type=int, default=8081, help="bind port (0 = ephemeral)"
+    )
+    p_twin.add_argument(
+        "--poll-interval",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="tail poll cadence",
+    )
+    p_twin.add_argument(
+        "--max-polls",
+        type=int,
+        default=None,
+        metavar="N",
+        help="stop tailing after N polls (the mirror stays queryable "
+        "at its final state; default: tail until signaled)",
+    )
+    p_twin.add_argument(
+        "--max-catchup",
+        type=int,
+        default=256,
+        metavar="N",
+        help="max backlog steps applied per poll round (a recovered "
+        "flap's giant diff converges across rounds instead of blocking "
+        "queries)",
+    )
+    p_twin.add_argument(
+        "--tick-budget",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="admission sheds a query 429 (with Retry-After) when the "
+        "p95 query time times the queue ahead exceeds this",
+    )
+    p_twin.add_argument(
+        "--max-request-pods",
+        type=int,
+        default=None,
+        metavar="N",
+        help="admission bound on estimated pods per what-if request",
+    )
+    p_twin.add_argument(
+        "--drain-timeout",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="max wait for the tail thread and in-flight queries at "
+        "shutdown",
+    )
+    p_twin.add_argument(
+        "--breaker-cooldown",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="circuit-breaker half-open recovery cooldown for the "
+        "apiserver endpoints (SIMON_BREAKER_COOLDOWN wins when set; "
+        "0 disables recovery)",
+    )
+    _add_obs_flags(p_twin)
+    p_twin.set_defaults(func=cmd_twin)
 
     p_doctor = sub.add_parser(
         "doctor",

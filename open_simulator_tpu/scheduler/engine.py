@@ -122,11 +122,20 @@ class TpuEngine:
                 sample=sample,
             )
 
-    def scan_active(self, active: np.ndarray) -> np.ndarray:
+    def scan_active(
+        self, active: np.ndarray, valid: Optional[np.ndarray] = None
+    ) -> np.ndarray:
         """One masked scan over the begin_batch encoding against the
         oracle's CURRENT state. Returns placements for the full batch:
         node index, -1 (active but unschedulable), or -2 (inactive —
-        `ops.scan.INACTIVE`, positions masked off by `active`)."""
+        `ops.scan.INACTIVE`, positions masked off by `active`).
+
+        `valid` gates candidate nodes (default: all) — the twin's
+        drain-safety and N+K queries evaluate "where do these pods go
+        WITHOUT nodes X" as one warm dispatch this way (the scenario
+        node mask of the chaos substrate, ops.scan.run_scan_masked
+        node_valid). Same shapes, so a masked query re-dispatches the
+        compiled scan without recompiling."""
         import jax.numpy as jnp
 
         from ..ops import pallas_scan
@@ -139,6 +148,11 @@ class TpuEngine:
         sample = bool(getattr(self._features, "sample", False))
         with phase("engine/encode"):
             cluster = self.cluster_static()
+            node_valid = (
+                np.ones(cluster.n, bool)
+                if valid is None
+                else np.asarray(valid, bool)
+            )
             dyn = encode_dynamic(oracle, cluster)
             plan = (
                 pallas_scan.build_plan(
@@ -182,7 +196,7 @@ class TpuEngine:
                     plan,
                     batch.class_of_pod,
                     np.asarray(active, bool),
-                    np.ones(cluster.n, bool),
+                    node_valid,
                     pinned=batch.pinned_node,
                 )
             return np.asarray(out)
@@ -192,7 +206,7 @@ class TpuEngine:
                 init,
                 jnp.asarray(batch.class_of_pod),
                 jnp.asarray(batch.pinned_node),
-                jnp.ones(cluster.n, bool),
+                jnp.asarray(node_valid),
                 jnp.asarray(np.asarray(active, bool)),
                 features=self._features,
             )

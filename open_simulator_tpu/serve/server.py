@@ -240,6 +240,10 @@ def render_metrics(coalescer: Coalescer) -> bytes:
         ("shadow_warm_recompiles_total", "Jit-cache misses on an already-seen replay shape."),
         ("shadow_reloads_total", "Replay state reloads forced by node removal."),
         ("shadow_delta_skips_total", "Cluster-delta ops skipped (stale live-tail races)."),
+        ("shadow_ingest_event_decisions_total", "Tail decisions sourced from scheduler Event objects."),
+        ("shadow_ingest_diff_decisions_total", "Tail decisions inferred from pod diffs alone."),
+        ("shadow_ingest_event_mismatch_total", "Scheduled events whose node contradicted the pod spec."),
+        ("shadow_ingest_events_unsupported_total", "Events endpoints that failed the one-time probe."),
     ):
         metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
     metric(
@@ -342,6 +346,13 @@ def _resilience_lines(snap: dict) -> List[str]:
                 lines.append(
                     f'{name}{{tenant="{_escape_label(tenant)}"}} {counts[key]}'
                 )
+    # -- warm-session cluster deltas (/v1/cluster-delta, twin substrate)
+    for key, help_text in (
+        ("serve_deltas_applied_total", "Cluster deltas applied to the warm session."),
+        ("serve_delta_skips_total", "Deltas skipped (no matching roster pod / known node)."),
+        ("serve_delta_reloads_total", "Deltas that rebuilt the session (node drains; daemonset node churn)."),
+    ):
+        metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
     # -- session cache (serve/sessions.py)
     metric(
         "simon_serve_sessions", "gauge",
@@ -534,6 +545,7 @@ class ServeDaemon:
                                 "degraded": bool(reasons),
                                 "reasons": reasons,
                                 "cluster": daemon.session.fingerprint,
+                                "deltaSeq": daemon.session.delta_seq,
                                 "queueDepth": daemon.coalescer.depth,
                                 "sessions": daemon.sessions.stats(),
                                 "draining": daemon._shutdown.is_set(),
@@ -550,6 +562,9 @@ class ServeDaemon:
                     self._send(404, json.dumps({"error": "not found"}).encode())
 
             def do_POST(self):
+                if self.path == "/v1/cluster-delta":
+                    self._do_cluster_delta()
+                    return
                 if self.path != "/v1/simulate":
                     self._send(404, json.dumps({"error": "not found"}).encode())
                     return
@@ -563,6 +578,105 @@ class ServeDaemon:
                         daemon._inflight -= 1
                         if daemon._inflight == 0:
                             daemon._inflight_zero.set()
+
+            def _do_cluster_delta(self):
+                """POST /v1/cluster-delta: apply a ClusterDelta stream
+                (twin/deltas.py vocabulary) to the warm primary
+                session — ROADMAP item 2's watch-style delta update.
+                Body: one delta record or ``{"deltas": [...]}``. Every
+                record FULLY validates before any applies — shape,
+                pod validity, and node-reference consistency walked
+                against the session's node set — so a typo'd stream
+                mutates nothing (400); each applied delta journals to
+                the session snapshot (--snapshot), so a restarted
+                daemon can see what its warm state had absorbed."""
+                import copy as _copy
+
+                from ..models import workloads as _wl
+                from ..models.validation import InputError
+                from ..twin import deltas as _dl
+                from ..twin.deltas import ClusterDelta
+
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length)
+                try:
+                    doc = json.loads(raw.decode("utf-8"))
+                    if isinstance(doc, dict) and "deltas" in doc:
+                        recs = doc["deltas"]
+                    elif isinstance(doc, dict):
+                        recs = [doc]
+                    else:
+                        raise InputError(
+                            'body must be a delta object or {"deltas": [...]}'
+                        )
+                    if not isinstance(recs, list) or not recs:
+                        raise InputError('"deltas" must be a non-empty list')
+                    deltas = [ClusterDelta.from_record(r) for r in recs]
+                    # node-reference consistency over the stream
+                    # (joins add, drains need presence) and pod
+                    # validity — the apply loop re-runs the same
+                    # validation, so this pre-pass makes the 400 path
+                    # mutation-free without forking semantics
+                    names = {
+                        (n.get("metadata") or {}).get("name")
+                        for n in daemon.session.cluster.nodes
+                    }
+                    for d in deltas:
+                        if d.kind == _dl.NODE_JOIN:
+                            names.add(d.node_name)
+                        elif d.kind == _dl.NODE_DRAIN:
+                            if d.node_name not in names:
+                                raise InputError(
+                                    "node_drain delta names unknown "
+                                    f"node {d.node_name!r}"
+                                )
+                            names.discard(d.node_name)
+                        elif d.kind in (_dl.POD_BIND, _dl.POD_ARRIVE):
+                            _wl.pod_from_pod(_copy.deepcopy(d.pod))
+                except (UnicodeDecodeError, ValueError, InputError) as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                if daemon._shutdown.is_set():
+                    from .coalescer import partial_body
+
+                    self._send(
+                        503, partial_body("drain", "daemon is draining")
+                    )
+                    return
+                counts = {"applied": 0, "skipped": 0, "reloads": 0}
+                try:
+                    for d, rec in zip(deltas, recs):
+                        out = daemon.session.apply_delta(d)
+                        daemon.sessions.record_delta(
+                            daemon.session.fingerprint, rec
+                        )
+                        if out == "skipped":
+                            counts["skipped"] += 1
+                        else:
+                            counts["applied"] += 1
+                            if out == "reloaded":
+                                counts["reloads"] += 1
+                except InputError as e:
+                    # mid-stream application error (e.g. a drain naming
+                    # an unknown node): report what landed — the
+                    # journal holds the applied prefix
+                    self._send(
+                        409,
+                        json.dumps(
+                            {
+                                "error": str(e),
+                                **counts,
+                                "deltaSeq": daemon.session.delta_seq,
+                            }
+                        ).encode(),
+                    )
+                    return
+                self._send(
+                    200,
+                    json.dumps(
+                        {**counts, "deltaSeq": daemon.session.delta_seq}
+                    ).encode(),
+                )
 
             def _do_simulate(self):
                 length = int(self.headers.get("Content-Length") or 0)

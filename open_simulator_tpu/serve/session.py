@@ -140,6 +140,8 @@ class Session:
     """One warm cluster + the machinery to answer request batches."""
 
     def __init__(self, cluster: ResourceTypes):
+        import threading
+
         from ..scheduler.engine import TpuEngine
         from ..scheduler.preemption import build_priority_resolver, pod_uses_priority
         from ..utils.trace import phase
@@ -148,10 +150,27 @@ class Session:
         self.fingerprint = config_fingerprint(
             {k: getattr(cluster, k) for k in sorted(vars(cluster))}
         )
+        # delta application (apply_delta) vs the dispatcher's ticks:
+        # one reentrant lock serializes roster/oracle mutation against
+        # batch evaluation (the dispatcher is single-threaded, but
+        # /v1/cluster-delta arrives on handler threads). A _reload()
+        # re-runs this constructor while HOLDING the lock — it must
+        # never be rebound mid-rebuild, or a concurrent thread would
+        # acquire a fresh unheld lock and see a half-built session
+        if getattr(self, "_delta_lock", None) is None:
+            self._delta_lock = threading.RLock()
+        self.delta_seq = 0
+        self.delta_reloads = 0
         with phase("serve/session-build"):
             wl.reset_name_counter()
             pods: List[dict] = []
             pods.extend(wl.pods_excluding_daemon_sets(cluster))
+            # bare cluster pods expand 1:1 and FIRST; delta arrivals
+            # insert at the end of that section so warm roster order
+            # equals the cold expansion order of the materialized
+            # cluster (cluster.pods + deltas, then workloads, then
+            # daemonsets)
+            self._bare_end = len(cluster.pods)
             for ds in cluster.daemon_sets:
                 pods.extend(wl.pods_from_daemon_set(ds, cluster.nodes))
             self.cluster_pods = pods
@@ -242,7 +261,10 @@ class Session:
         from ..obs.spans import RECORDER
 
         with RECORDER.span("serve/tick", requests=len(reqs)):
-            return self._evaluate_batch(reqs)
+            # deltas (/v1/cluster-delta, handler threads) never land
+            # mid-tick: a batch evaluates against one consistent state
+            with self._delta_lock:
+                return self._evaluate_batch(reqs)
 
     def _evaluate_batch(self, reqs: List[WhatIfRequest]) -> List[WhatIfReply]:
         from ..models.validation import InputError
@@ -421,7 +443,7 @@ class Session:
         cluster must stay pristine — simulate binds pods in place)."""
         from ..utils.trace import phase
 
-        with phase("serve/serial"):
+        with phase("serve/serial"), self._delta_lock:
             wl.reset_name_counter()
             cluster = copy.deepcopy(self.cluster)
             apps = [
@@ -433,3 +455,127 @@ class Session:
             body=result_payload(result),
             meta={"engine": "serial", "serialReason": reason},
         )
+
+    # -- cluster deltas (the shared substrate, twin/deltas.py) --------------
+
+    def apply_delta(self, delta) -> str:
+        """Apply one ``ClusterDelta`` to this WARM session — ROADMAP
+        item 2's watch-style delta update, on the twin substrate's
+        vocabulary. Roster application: arrived/bound pods enter the
+        session's pod roster at the bare-pod boundary (so they ride
+        every subsequent tick exactly where a cold reload of the
+        mutated cluster would expand them), evict/delete remove by
+        key, a node join is one incremental ``add_node``. Node drains
+        — and any node delta on a daemonset-bearing cluster, whose
+        per-node pods consume the generated-name counter — REBUILD the
+        session (counted, ``serve_delta_reloads_total``). The
+        conformance contract (tests/test_twin.py, CI-gated): after any
+        delta stream, this session answers byte-identically to a fresh
+        Session over its mutated ``self.cluster``."""
+        from ..twin.deltas import RELOADED, SKIPPED
+
+        with self._delta_lock:
+            out = self._apply_delta(delta)
+            self.delta_seq += 1
+            COUNTERS.inc(f"serve_delta_{delta.kind}_total")
+            if out == SKIPPED:
+                COUNTERS.inc("serve_delta_skips_total")
+            else:
+                COUNTERS.inc("serve_deltas_applied_total")
+                if out == RELOADED:
+                    COUNTERS.inc("serve_delta_reloads_total")
+        return out
+
+    def _apply_delta(self, delta) -> str:
+        from ..twin import deltas as dl
+
+        kind = delta.kind
+        if kind in (dl.POD_ARRIVE, dl.POD_BIND):
+            raw = copy.deepcopy(delta.pod)
+            if kind == dl.POD_BIND:
+                raw.setdefault("spec", {})["nodeName"] = delta.node_name
+            # re-arrival of a live key replaces the stale entry (its
+            # roster slot moves to the section end — the order a cold
+            # reload of the mutated cluster.pods list would expand)
+            self._remove_roster_pod(delta.pod_key)
+            valid = wl.pod_from_pod(copy.deepcopy(raw))
+            self.cluster.pods.append(raw)
+            self.cluster_pods.insert(self._bare_end, valid)
+            self._bare_end += 1
+            if not self.force_serial_reason and self._pod_uses_priority(
+                valid, self._resolver
+            ):
+                self.force_serial_reason = "cluster pods carry priority"
+            return dl.APPLIED
+        if kind in (dl.POD_EVICT, dl.POD_DELETE):
+            return (
+                dl.APPLIED
+                if self._remove_roster_pod(delta.pod_key)
+                else dl.SKIPPED
+            )
+        if kind == dl.NODE_JOIN:
+            if any(
+                (n.get("metadata") or {}).get("name") == delta.node_name
+                for n in self.cluster.nodes
+            ):
+                return dl.SKIPPED  # re-join of a known node
+            self.cluster.nodes.append(delta.node)
+            if self.cluster.daemon_sets:
+                return self._reload()
+            self.oracle.add_node(delta.node)
+            return dl.APPLIED
+        # node_drain: node identity is baked into every encoding
+        from ..models.validation import InputError
+
+        if not any(
+            (n.get("metadata") or {}).get("name") == delta.node_name
+            for n in self.cluster.nodes
+        ):
+            raise InputError(
+                f"node_drain delta names unknown node {delta.node_name!r}"
+            )
+        self.cluster.nodes = [
+            n
+            for n in self.cluster.nodes
+            if (n.get("metadata") or {}).get("name") != delta.node_name
+        ]
+        return self._reload()
+
+    def _remove_roster_pod(self, key) -> bool:
+        """Drop a bare-section roster pod (and its cluster.pods source
+        entry) by (namespace, name). Workload-expanded replicas are out
+        of scope: their source object is the workload, which a delta
+        stream cannot partially shrink — counted skip instead."""
+        for i in range(self._bare_end):
+            meta = self.cluster_pods[i].get("metadata") or {}
+            if (meta.get("namespace") or "default", meta.get("name", "")) == key:
+                self.cluster_pods.pop(i)
+                self._bare_end -= 1
+                for j, p in enumerate(self.cluster.pods):
+                    pm = p.get("metadata") or {}
+                    if (
+                        pm.get("namespace") or "default",
+                        pm.get("name", ""),
+                    ) == key:
+                        self.cluster.pods.pop(j)
+                        break
+                return True
+        return False
+
+    def _reload(self) -> str:
+        """Counted session rebuild over the mutated cluster: the
+        constructor body re-runs (fresh oracle/engine/expansion) with
+        the caller still holding the delta lock (the constructor
+        preserves an existing lock, so no thread can observe the
+        half-built state); the session identity (fingerprint) and
+        delta bookkeeping survive. The cross-run identity caches keep
+        unchanged node templates and pristine encodings warm
+        underneath."""
+        from ..twin.deltas import RELOADED
+
+        fp = self.fingerprint
+        seq, reloads = self.delta_seq, self.delta_reloads
+        self.__init__(self.cluster)
+        self.fingerprint = fp
+        self.delta_seq, self.delta_reloads = seq, reloads + 1
+        return RELOADED

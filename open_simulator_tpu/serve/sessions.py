@@ -89,6 +89,13 @@ class SessionCache:
             {"kind": "session", "event": event, "fingerprint": fingerprint, **extra}
         )
 
+    def record_delta(self, fingerprint: str, delta_record: dict):
+        """Journal one applied cluster delta (POST /v1/cluster-delta):
+        the snapshot then carries not just WHICH clusters were warm at
+        a crash but what delta stream their warm state had absorbed —
+        fsync'd per append like every session event."""
+        self._record("delta", fingerprint, delta=delta_record)
+
     # -- membership ----------------------------------------------------------
 
     def add(self, session, pinned: bool = False) -> List[str]:

@@ -85,6 +85,8 @@ class ShadowReplayer:
     ):
         if engine not in ("tpu", "oracle"):
             raise InputError(f"unknown shadow engine {engine!r}")
+        from ..twin.deltas import MirrorApplicator
+
         self.cluster = cluster
         self.engine_kind = engine
         self.explain_divergences = explain_divergences
@@ -93,77 +95,35 @@ class ShadowReplayer:
         )
         self._obs_before = obs_profile.snapshot()
         self._shapes: set = set()
-        self._build_oracle(cluster.nodes)
+        # the replayer's mirrored state lives on the shared
+        # cluster-delta substrate (twin/deltas.py): the applicator owns
+        # the warm Oracle/TpuEngine and every delta op routes through
+        # it, so shadow replay, the twin mirror, and the conformance
+        # gate can never fork their application semantics
+        self._app = MirrorApplicator(cluster, engine=engine)
 
-    def _build_oracle(self, nodes: List[dict]):
-        self.oracle = Oracle(
-            nodes,
-            pdbs=self.cluster.pod_disruption_budgets,
-            priority_classes=self.cluster.priority_classes,
-        )
-        self._engine = None
-        if self.engine_kind == "tpu":
-            from ..scheduler.engine import TpuEngine
+    @property
+    def oracle(self) -> Oracle:
+        return self._app.oracle
 
-            self._engine = TpuEngine(self.oracle)
+    @property
+    def _engine(self):
+        return self._app.engine
 
     # -- cluster deltas -----------------------------------------------------
 
     def _apply_delta(self, op: dict):
-        kind = op.get("op")
-        oracle = self.oracle
-        if kind == "place_pod":
-            pod = _own_pod(op.get("pod") or {})
-            name = (pod.get("spec") or {}).get("nodeName")
-            if name not in oracle.node_index:
-                # dangling pre-bound pod: tracked by the reference in
-                # the apiserver only, never by the scheduler — skip
-                return
-            oracle.place_existing_pod(pod)
-        elif kind == "evict_pod":
-            idx = oracle.node_index.get(op.get("node", ""))
-            key = (op.get("namespace") or "default", op.get("name", ""))
-            if idx is None:
-                # a live tail can observe a deletion racing a node it
-                # never mirrored; skip (counted) rather than killing an
-                # hours-long audit on one informer race
-                COUNTERS.inc("shadow_delta_skips_total")
-                return
-            ns = oracle.nodes[idx]
-            for p in ns.pods:
-                meta = p.get("metadata") or {}
-                if (
-                    meta.get("namespace") or "default",
-                    meta.get("name", ""),
-                ) == key:
-                    oracle.evict_pod(ns, p)
-                    break
-            else:
-                COUNTERS.inc("shadow_delta_skips_total")
-        elif kind == "add_node":
-            oracle.add_node(op.get("node") or {})
-        elif kind == "remove_node":
-            self._remove_node(op.get("name", ""))
-        else:
-            raise InputError(f"unknown delta op {kind!r}")
+        from ..twin.deltas import RELOADED, SKIPPED, from_shadow_op
 
-    def _remove_node(self, name: str):
-        """Node identity is baked into every index and encoding, so a
-        removal is a state reload: rebuild the oracle from the
-        surviving nodes and re-place their committed pods (the pods on
-        the removed node died with it). Counted — the report makes the
-        cost visible instead of hiding it."""
-        oracle = self.oracle
-        if name not in oracle.node_index:
-            raise InputError(f"remove_node delta names unknown node {name!r}")
-        survivors = [ns for ns in oracle.nodes if ns.name != name]
-        nodes = [ns.node for ns in survivors]
-        committed = [p for ns in survivors for p in ns.pods]
-        self._build_oracle(nodes)
-        for p in committed:
-            self.oracle.place_existing_pod(p)
-        self.report.reloads += 1
-        COUNTERS.inc("shadow_reloads_total")
+        out = self._app.apply(from_shadow_op(op))
+        if out == SKIPPED:
+            # a live tail can observe a deletion racing a node it never
+            # mirrored (or a dangling pre-bound pod); counted, never
+            # fatal to an hours-long audit
+            COUNTERS.inc("shadow_delta_skips_total")
+        elif out == RELOADED:
+            self.report.reloads += 1
+            COUNTERS.inc("shadow_reloads_total")
 
     # -- the probe ----------------------------------------------------------
 
@@ -384,13 +344,13 @@ class ShadowReplayer:
                 simon_node=simon_node or "",
             )
         # commit REALITY, not simon's counterfactual: later steps are
-        # judged against the cluster as it actually evolved
+        # judged against the cluster as it actually evolved (a failed
+        # real decision leaves the pod pending on the substrate — the
+        # population the twin forecast requeues)
         if real_node is not None:
-            idx = self.oracle.node_index[real_node]
-            if self._engine is not None:
-                self._engine.commit_host(pod, idx)
-            else:
-                self.oracle._reserve_and_bind(pod, self.oracle.nodes[idx])
+            self._app.commit_decision(pod, self.oracle.node_index[real_node])
+        else:
+            self._app.note_pending(pod)
         self.report.add(outcome)
         COUNTERS.inc("shadow_decisions_total")
         if cls == CLASS_AGREE:

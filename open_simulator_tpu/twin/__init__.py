@@ -1,0 +1,24 @@
+"""`simon twin` — the live digital twin (ROADMAP item 4).
+
+One resident process that continuously mirrors a real cluster and
+answers anything against LIVE state: the shadow tailer's ingest
+(shadow/ingest.py), the serve daemon's warm sessions, and the
+timeline's forward stepping, fused on one substrate — the typed
+``ClusterDelta`` vocabulary and its incremental applicator
+(twin/deltas.py). See docs/TWIN.md.
+"""
+
+from .deltas import (  # noqa: F401
+    APPLIED,
+    DELTA_KINDS,
+    RELOADED,
+    SKIPPED,
+    ClusterDelta,
+    MirrorApplicator,
+    cold_reload,
+    deltas_to_events,
+    from_shadow_op,
+    materialize,
+    state_dict,
+    steps_to_deltas,
+)

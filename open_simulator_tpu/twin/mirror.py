@@ -1,0 +1,335 @@
+"""The resident per-cluster mirror: one warm replayer continuously fed
+by a live cluster (or a recorded feed), always current, always
+queryable.
+
+A ``ClusterMirror`` fuses the three previously separate CLIs:
+
+- **ingest** — steps come from a ``StepSource``: ``LiveSource`` wraps
+  the shadow tailer's poll-diff loop (shadow/ingest.py, now
+  event/binding-aware), ``FeedSource`` replays a recorded decision
+  log at a configurable batch per poll (the self-conformance and CI
+  path: simon tails its own recorded feed and must agree with itself
+  100%).
+- **apply** — every step routes through the shadow replayer, whose
+  state lives on the cluster-delta substrate (twin/deltas.py): pod
+  deltas are incremental commits on copy-on-write NodeStates, the
+  probe replays the real scheduler's decision against the warm mirror
+  and classifies the divergence, and reality commits — exactly PR 7's
+  audit loop, now resident.
+- **observe** — agreement-rate, mirror-lag (age of the oldest
+  unapplied observed step), backlog depth, flap and apply-error
+  counts stream to the process counter registry as alertable gauges
+  (``/metrics``, twin/server.py).
+
+Concurrency: the tail loop and the query engines (twin/queries.py)
+share ``self._lock`` — queries see a consistent mirror, the tail
+never applies mid-query. Polls are bounded by ``max_catchup`` steps
+per round (a recovered flap's giant diff converges across rounds
+instead of blocking queries for its full length).
+
+Failure posture (docs/ROBUSTNESS.md): a failed poll is a counted flap
+with deterministic backoff (the tail survives apiserver restarts); a
+step the substrate cannot apply (torn feed, corrupt record, injected
+``twin.apply_delta`` fault) is counted, skipped, and surfaces as a
+``degraded`` reason in ``/healthz`` — the mirror keeps serving with
+the staleness visible rather than dying mid-shift.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..models.validation import InputError
+from ..runtime.errors import GuardError
+from ..utils.trace import COUNTERS
+from .deltas import MirrorApplicator  # noqa: F401  (re-export for callers)
+
+#: backlog depth past which /healthz reports the mirror degraded
+BACKLOG_DEGRADED = 4096
+
+
+class LiveSource:
+    """Step source over a live cluster: the shadow tailer's
+    poll-diff-normalize loop (one paged LIST per poll, retry/breaker
+    hardened underneath). When the caller already bootstrapped the
+    tailer (the CLI needs the node LIST to build the mirror's cluster
+    first), the recorded ``boot_steps`` replay from here instead of a
+    second LIST."""
+
+    def __init__(self, tailer, boot_steps: Optional[list] = None):
+        self.tailer = tailer
+        self._boot_steps = boot_steps
+        self.exhausted = False  # a live cluster never runs out
+
+    def bootstrap(self) -> Tuple[List[dict], list]:
+        if self._boot_steps is not None:
+            steps, self._boot_steps = self._boot_steps, None
+            return [], steps
+        return self.tailer.bootstrap()
+
+    def poll(self) -> list:
+        return self.tailer.poll()
+
+
+class FeedSource:
+    """Step source over a recorded decision log: each poll yields the
+    next ``batch`` steps until the feed is exhausted. This is the
+    mirror's self-conformance harness — tailing a feed simon itself
+    recorded must replay at agreement 1.0 — and the CI smoke's
+    synthetic live cluster."""
+
+    def __init__(self, steps: list, batch: int = 64):
+        if batch < 1:
+            raise InputError(f"feed batch must be >= 1, got {batch}")
+        self._steps = collections.deque(steps)
+        self.batch = batch
+        self.total = len(steps)
+
+    @property
+    def exhausted(self) -> bool:
+        return not self._steps
+
+    def bootstrap(self) -> Tuple[List[dict], list]:
+        return [], []  # the cluster comes from the config
+
+    def poll(self) -> list:
+        out = []
+        while self._steps and len(out) < self.batch:
+            out.append(self._steps.popleft())
+        return out
+
+
+class ClusterMirror:
+    """One mirrored cluster plus its tail-loop state. All mirrored
+    state is guarded by ``lock`` — the tail thread applies under it,
+    query engines read under it."""
+
+    def __init__(
+        self,
+        cluster,
+        source,
+        engine: str = "tpu",
+        max_catchup: int = 256,
+    ):
+        from ..shadow.replay import ShadowReplayer
+
+        if max_catchup < 1:
+            raise InputError(
+                f"--max-catchup must be >= 1, got {max_catchup} (0 would "
+                "never apply the backlog and the mirror would stop advancing)"
+            )
+        self.source = source
+        self.max_catchup = int(max_catchup)
+        self._lock = threading.RLock()
+        self.replayer = ShadowReplayer(
+            cluster, engine=engine, explain_divergences=False
+        )
+        # (observed_monotonic, step) — steps wait here between the
+        # poll that observed them and the bounded catch-up that
+        # applies them; the oldest entry's age IS the mirror lag
+        self._backlog: "collections.deque" = collections.deque()
+        self.polls = 0
+        self.flaps = 0
+        self.apply_errors = 0
+        self.started_at = time.monotonic()
+
+    # -- locking (query engines hold the mirror across one evaluation) --
+
+    @property
+    def lock(self) -> threading.RLock:
+        return self._lock
+
+    # `replayer` is bound once in __init__ and never rebound; only its
+    # INTERIOR state needs the lock, so handing out the reference
+    # itself is race-free
+    @property
+    def applicator(self) -> MirrorApplicator:  # simonlint: disable=CONC001 - immutable reference; interior mutation happens under the lock in _apply_step/stats
+        return self.replayer._app
+
+    @property
+    def oracle(self):  # simonlint: disable=CONC001 - immutable reference (see applicator)
+        return self.replayer.oracle
+
+    @property
+    def engine(self):  # simonlint: disable=CONC001 - immutable reference (see applicator)
+        return self.replayer._engine
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def bootstrap(self):
+        """First contact: LiveSource LISTs the cluster and the mirror
+        applies the bootstrap placement deltas; FeedSource mirrors are
+        born from the config's cluster and bootstrap is a no-op."""
+        nodes, steps = self.source.bootstrap()
+        with self._lock:
+            for st in steps:
+                self._apply_step(st)
+        self._export()
+        return nodes
+
+    def poll_once(self, budget=None) -> int:
+        """One tail round: poll the source (a failure is a counted
+        flap, never fatal), enqueue observed steps, apply at most
+        ``max_catchup`` of the backlog under the lock. Returns the
+        number of steps applied; raises nothing but ExecutionHalted
+        (budget) and unclassified faults (which must stay loud)."""
+        from ..runtime import inject as _inject
+        from ..runtime.errors import ExternalIOError
+
+        with self._lock:
+            poll_no = self.polls
+        try:
+            # chaos seam: a `twin.poll` fault lands like a real
+            # apiserver flap (reset/timeout/http:NNN/exio). The
+            # network LIST runs OUTSIDE the mirror lock — a slow or
+            # wedged apiserver must never block queries
+            _inject.fire("twin.poll", poll=poll_no)
+            steps = self.source.poll()
+        except (ExternalIOError, OSError):
+            with self._lock:
+                self.flaps += 1
+                self.polls += 1
+            COUNTERS.inc("twin_tail_flaps_total")
+            self._export()
+            return -1  # the caller backs off
+        now = time.monotonic()
+        applied = 0
+        with self._lock:
+            self._backlog.extend((now, st) for st in steps)
+            while self._backlog and applied < self.max_catchup:
+                if budget is not None:
+                    budget.check(f"twin tail (poll {poll_no}, catch-up)")
+                _obs, st = self._backlog.popleft()
+                self._apply_step(st)
+                applied += 1
+            if self._backlog:
+                COUNTERS.inc(
+                    "twin_tail_deferred_steps_total", len(self._backlog)
+                )
+            self.polls += 1
+        self._export()
+        return applied
+
+    def drain_backlog(self, budget=None) -> int:
+        """Apply every deferred step (shutdown / end-of-feed path)."""
+        applied = 0
+        with self._lock:
+            while self._backlog:
+                if budget is not None:
+                    budget.check("twin tail (final catch-up)")
+                _obs, st = self._backlog.popleft()
+                self._apply_step(st)
+                applied += 1
+        self._export()
+        return applied
+
+    def _apply_step(self, st):  # simonlint: disable=CONC001 - callers hold self._lock (poll_once/drain_backlog/bootstrap)
+        try:
+            self.replayer.step(st)
+        except (GuardError, InputError) as e:
+            # a step the substrate cannot apply (torn feed, injected
+            # fault, corrupt record): counted and skipped — the mirror
+            # keeps serving, /healthz carries the degradation
+            self.apply_errors += 1
+            COUNTERS.inc("twin_apply_errors_total")
+            from ..utils.trace import GLOBAL
+
+            GLOBAL.append_note(
+                "twin-apply-error", f"step {getattr(st, 'seq', '?')}: {str(e)[:120]}"
+            )
+
+    # -- observability ------------------------------------------------------
+
+    def _lag_locked(self) -> float:  # simonlint: disable=CONC001 - caller holds self._lock (the _locked suffix contract)
+        if not self._backlog:
+            return 0.0
+        return max(0.0, time.monotonic() - self._backlog[0][0])
+
+    def mirror_lag_s(self) -> float:
+        """Age of the oldest observed-but-unapplied step (0.0 when the
+        mirror is current) — the alertable staleness signal."""
+        with self._lock:
+            return self._lag_locked()
+
+    def agreement_rate(self) -> float:
+        with self._lock:
+            return self.replayer.report.agreement_rate
+
+    def _export(self):
+        with self._lock:
+            rep = self.replayer.report
+            agreement = rep.agreement_rate
+            backlog = float(len(self._backlog))
+            polls = float(self.polls)
+            lag = self._lag_locked()
+        COUNTERS.gauge("twin_agreement_rate", agreement)
+        COUNTERS.gauge("twin_mirror_lag_seconds", round(lag, 6))
+        COUNTERS.gauge("twin_backlog", backlog)
+        COUNTERS.gauge("twin_polls", polls)
+
+    def degraded_reasons(self) -> List[str]:
+        reasons = []
+        with self._lock:
+            apply_errors = self.apply_errors
+            backlog = len(self._backlog)
+            lag = self._lag_locked()
+        if apply_errors:
+            reasons.append(
+                f"{apply_errors} delta step(s) could not be applied "
+                "(mirror may be stale; see twin_apply_errors_total)"
+            )
+        if backlog > BACKLOG_DEGRADED:
+            reasons.append(
+                f"tail backlog {backlog} steps deep "
+                f"(> {BACKLOG_DEGRADED}); mirror lag {lag:.1f}s"
+            )
+        return reasons
+
+    def stats(self) -> dict:
+        exhausted = bool(getattr(self.source, "exhausted", False))
+        with self._lock:
+            rep = self.replayer.report
+            app = self.replayer._app
+            return {
+                "polls": self.polls,
+                "flaps": self.flaps,
+                "backlog": len(self._backlog),
+                "mirrorLagSeconds": round(self._lag_locked(), 6),
+                "steps": rep.steps,
+                "decisions": rep.decisions,
+                "agreementRate": rep.agreement_rate,
+                "divergences": rep.divergence_count,
+                "warmRecompiles": rep.warm_recompiles,
+                "reloads": rep.reloads,
+                "deltasApplied": app.applied,
+                "deltaSkips": app.skips,
+                "applyErrors": self.apply_errors,
+                "pendingPods": len(app.pending),
+                "nodes": len(app.oracle.nodes),
+                "feedExhausted": exhausted,
+            }
+
+    # -- state snapshot (the timeline bridge) -------------------------------
+
+    def snapshot_cluster(self):  # simonlint: disable=CONC001 - caller holds self.lock (queries.forecast takes it across the snapshot)
+        """The mirrored state as a loadable cluster: current nodes plus
+        every committed pod in its bound form — what a capacity
+        forecast steps forward from (twin/queries.py) and what
+        ``simon apply`` would load if the mirror were written to disk.
+        Caller holds the lock."""
+        import copy
+
+        from ..models.decode import ResourceTypes
+
+        cluster = ResourceTypes()
+        cluster.nodes = [copy.deepcopy(ns.node) for ns in self.oracle.nodes]
+        cluster.pods = [
+            copy.deepcopy(p) for ns in self.oracle.nodes for p in ns.pods
+        ]
+        base = self.replayer.cluster
+        cluster.pod_disruption_budgets = list(base.pod_disruption_budgets)
+        cluster.priority_classes = list(base.priority_classes)
+        return cluster

@@ -13,6 +13,7 @@ the static rule checks an honest document.
 
 import json
 import signal
+import time
 
 import pytest
 import yaml as _yaml
@@ -97,6 +98,14 @@ IO_CELLS = [
     ("ExtenderError", "io", "io.matrix-extender=raise:ExtenderError@1x*"),
 ]
 
+# the twin's two seams (twin/mirror.py): a poll fault is a counted
+# flap with deterministic backoff and bounded catch-up; an apply fault
+# is a counted skip that degrades /healthz — neither kills the daemon
+TWIN_CELLS = [
+    ("ExternalIOError", "twin", "twin.poll=exio%2"),
+    ("ConformanceError", "twin", "twin.apply_delta=conformance@1"),
+]
+
 #: taxonomy class name -> matrix cell ids proving its injection
 #: coverage. simonlint RT002 statically requires every GuardError
 #: subtype to appear here; test_registry_is_closed_over_cells keeps
@@ -115,8 +124,13 @@ INJECTION_COVERAGE = {
         "BackendUnavailable/apply", "BackendUnavailable/timeline",
         "BackendUnavailable/serve",
     ],
-    "ExternalIOError": ["ExternalIOError/io", "ExternalIOError/io"],
-    "ConformanceError": ["ConformanceError/apply", "ConformanceError/serve"],
+    "ExternalIOError": [
+        "ExternalIOError/io", "ExternalIOError/io", "ExternalIOError/twin",
+    ],
+    "ConformanceError": [
+        "ConformanceError/apply", "ConformanceError/serve",
+        "ConformanceError/twin",
+    ],
     "ExecutionHalted": ["ExecutionHalted/apply", "ExecutionHalted/timeline"],
     "DeadlineExceeded": [
         "DeadlineExceeded/apply", "DeadlineExceeded/chaos",
@@ -138,6 +152,7 @@ def test_registry_is_closed_over_cells():
     live = {f"{e}/{s}" for e, s, *_ in CLI_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in SERVE_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in IO_CELLS}
+    live |= {f"{e}/{s}" for e, s, *_ in TWIN_CELLS}
     registered = {cid for ids in INJECTION_COVERAGE.values() for cid in ids}
     assert registered == live, (
         f"registry drift: only-registered={sorted(registered - live)} "
@@ -344,6 +359,108 @@ def test_serve_deadline_cell_sheds_503_partial():
     body = json.loads(doomed.reply.body)
     assert body["partial"] is True and body["reason"] == "deadline"
     coal.close()
+
+
+# --------------------------------------------------------------- twin cells
+
+
+def _twin_mirror(engine="oracle"):
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.shadow.record import record_simulation
+    from open_simulator_tpu.testing import make_fake_node
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.twin.mirror import ClusterMirror, FeedSource
+
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_fake_node(f"tw-{i}", cpu="8", memory="16Gi") for i in range(2)
+    ]
+    res = ResourceTypes()
+    res.pods = [
+        {
+            "kind": "Pod",
+            "metadata": {"name": f"tw-p-{i}", "namespace": "m"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "img",
+                        "resources": {
+                            "requests": {"cpu": "250m", "memory": "256Mi"}
+                        },
+                    }
+                ]
+            },
+        }
+        for i in range(6)
+    ]
+    steps = record_simulation(cluster, [AppResource("m", res)])
+    mirror = ClusterMirror(
+        cluster, FeedSource(steps, batch=2), engine=engine, max_catchup=4
+    )
+    mirror.bootstrap()
+    return mirror, len([s for s in steps if s.kind == "decision"])
+
+
+def test_twin_cell_poll_flap_bounded_catchup_no_hang():
+    """ExternalIOError/twin: an injected poll fault every other round
+    is a counted flap; the feed still drains fully across bounded
+    catch-up rounds and the daemon drains to exit 0 — no hang, no
+    lost steps."""
+    from open_simulator_tpu.twin.server import TwinDaemon
+
+    mirror, decisions = _twin_mirror()
+    flaps0 = COUNTERS.get("twin_tail_flaps_total")
+    INJECT.configure(TWIN_CELLS[0][2])
+    try:
+        daemon = TwinDaemon(mirror, port=0, poll_interval_s=0.01)
+        daemon.start()
+        deadline = time.monotonic() + CELL_TIMEOUT_S
+        while time.monotonic() < deadline:
+            stats = mirror.stats()
+            if stats["feedExhausted"] and stats["backlog"] == 0:
+                break
+            time.sleep(0.02)
+        assert daemon.shutdown() == 0
+    finally:
+        INJECT.clear()
+    stats = mirror.stats()
+    assert stats["decisions"] == decisions, "flaps lost steps"
+    assert COUNTERS.get("twin_tail_flaps_total") > flaps0
+    assert stats["agreementRate"] == 1.0
+
+
+def test_twin_cell_apply_fault_degrades_and_daemon_survives():
+    """ConformanceError/twin: an injected substrate fault is counted,
+    the step skips, /healthz reports degraded — and the daemon keeps
+    mirroring and answering."""
+    import urllib.request
+
+    from open_simulator_tpu.twin.server import TwinDaemon
+
+    mirror, decisions = _twin_mirror()
+    INJECT.configure(TWIN_CELLS[1][2])
+    try:
+        daemon = TwinDaemon(mirror, port=0, poll_interval_s=0.01)
+        daemon.start()
+        deadline = time.monotonic() + CELL_TIMEOUT_S
+        while time.monotonic() < deadline:
+            stats = mirror.stats()
+            if stats["feedExhausted"] and stats["backlog"] == 0:
+                break
+            time.sleep(0.02)
+        with urllib.request.urlopen(
+            f"http://{daemon.host}:{daemon.port}/healthz", timeout=10
+        ) as resp:
+            health = json.loads(resp.read())
+        assert daemon.shutdown() == 0
+    finally:
+        INJECT.clear()
+    assert mirror.apply_errors >= 1
+    assert health["status"] == "degraded"
+    assert any("could not be applied" in r for r in health["reasons"])
+    # exactly one step was lost to the single-shot fault
+    assert mirror.stats()["steps"] >= decisions - 1
 
 
 # --------------------------------------------------------------- io cells

@@ -393,15 +393,22 @@ def test_serve_metrics_export_shadow_counters():
 
 
 class _FakePods:
-    """Minimal KubeClient stand-in: list() serves mutable fixtures."""
+    """Minimal KubeClient stand-in: list() serves mutable fixtures.
+    The events endpoint raises by default (the un-exposed apiserver
+    case — pure diff inference); tests set ``events`` to arm it."""
 
     def __init__(self):
         self.nodes = [make_fake_node("live-0", cpu="8", memory="16Gi")]
         self.pods = []
+        self.events = None  # None = endpoint unsupported
 
     def list(self, path):
         if path.endswith("/nodes"):
             return copy.deepcopy(self.nodes)
+        if path.endswith("/events"):
+            if self.events is None:
+                raise OSError("the server could not find the requested resource")
+            return copy.deepcopy(self.events)
         return copy.deepcopy(self.pods)
 
     def list_with_rv(self, path):
@@ -484,6 +491,179 @@ def test_tailer_defers_binding_until_node_is_listed():
     assert kinds == ["delta", "decision"]  # add_node first, then the bind
     assert steps[0].deltas[0]["op"] == "add_node"
     assert steps[1].node == "live-new"
+
+
+def _scheduled_event(namespace, name, node):
+    return {
+        "kind": "Event",
+        "involvedObject": {"kind": "Pod", "namespace": namespace, "name": name},
+        "reason": "Scheduled",
+        "message": f"Successfully assigned {namespace}/{name} to {node}",
+    }
+
+
+def _failed_event(namespace, name, message):
+    return {
+        "kind": "Event",
+        "involvedObject": {"kind": "Pod", "namespace": namespace, "name": name},
+        "reason": "FailedScheduling",
+        "message": message,
+    }
+
+
+def test_tailer_event_sourced_decisions_counted():
+    """An observed binding corroborated by a Scheduled event counts as
+    event-sourced; one without counts as diff-inferred — the PR-7
+    ingestion tail, closed and measured."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+    from open_simulator_tpu.utils.trace import COUNTERS
+
+    client = _FakePods()
+    client.events = []
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    ev0 = COUNTERS.get("shadow_ingest_event_decisions_total")
+    diff0 = COUNTERS.get("shadow_ingest_diff_decisions_total")
+    # round 1: binding WITH its Scheduled event
+    with_ev = _pod("with-event", node_name="live-0")
+    with_ev["status"] = {"phase": "Running"}
+    client.pods = [with_ev]
+    client.events = [_scheduled_event("d", "with-event", "live-0")]
+    steps = tailer.poll()
+    assert [s.node for s in steps if s.kind == "decision"] == ["live-0"]
+    assert COUNTERS.get("shadow_ingest_event_decisions_total") == ev0 + 1
+    # round 2: binding with NO event -> diff inference
+    no_ev = _pod("no-event", node_name="live-0")
+    no_ev["status"] = {"phase": "Running"}
+    client.pods = [with_ev, no_ev]
+    client.events = []
+    tailer.poll()
+    assert COUNTERS.get("shadow_ingest_diff_decisions_total") == diff0 + 1
+    assert COUNTERS.get("shadow_ingest_event_decisions_total") == ev0 + 1
+
+
+def test_tailer_event_failure_message_wins():
+    """A FailedScheduling event's message (the scheduler's full
+    reason) replaces the pod condition's when both exist."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+
+    client = _FakePods()
+    client.events = []
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    stuck = _pod("stuck")
+    stuck["status"] = {
+        "phase": "Pending",
+        "conditions": [
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "Unschedulable",
+                "message": "condition text",
+            }
+        ],
+    }
+    client.pods = [stuck]
+    client.events = [
+        _failed_event(
+            "d", "stuck",
+            "0/1 nodes are available: 1 Insufficient cpu. "
+            "preemption: not eligible",
+        )
+    ]
+    steps = tailer.poll()
+    (decision,) = [s for s in steps if s.kind == "decision"]
+    assert "preemption: not eligible" in decision.reason
+
+
+def test_tailer_events_endpoint_probed_once_then_diff_fallback():
+    """An apiserver without /events fails the probe ONCE; the tail
+    stays pure diff inference and never re-probes."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+    from open_simulator_tpu.utils.trace import COUNTERS
+
+    client = _FakePods()  # events = None -> endpoint raises
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    unsup0 = COUNTERS.get("shadow_ingest_events_unsupported_total")
+    diff0 = COUNTERS.get("shadow_ingest_diff_decisions_total")
+    bound = _pod("plain", node_name="live-0")
+    bound["status"] = {"phase": "Running"}
+    client.pods = [bound]
+    tailer.poll()
+    tailer.poll()
+    assert tailer._events_supported is False
+    assert COUNTERS.get("shadow_ingest_events_unsupported_total") == unsup0 + 1
+    assert COUNTERS.get("shadow_ingest_diff_decisions_total") == diff0 + 1
+
+
+def test_tailer_transient_event_flap_does_not_latch_unsupported():
+    """Only a 404/403-shaped failure latches the events endpoint off;
+    a transient flap on the first poll re-probes next round."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+
+    class _Flaky(_FakePods):
+        def __init__(self):
+            super().__init__()
+            self.fail_events_once = True
+
+        def list(self, path):
+            if path.endswith("/events") and self.fail_events_once:
+                self.fail_events_once = False
+                raise OSError("connection reset by peer")
+            return super().list(path)
+
+    client = _Flaky()
+    client.events = []
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    tailer.poll()  # flap round: no latch
+    assert tailer._events_supported is None
+    tailer.poll()  # recovery round: the probe succeeds
+    assert tailer._events_supported is True
+
+
+def test_tailer_emits_evict_for_vanished_pending_pod():
+    """A tracked unbound pod that disappears emits a node-less evict —
+    the twin mirror's pending queue (the forecast requeue set) must
+    not hold deleted pods forever."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+
+    client = _FakePods()
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    stuck = _pod("ghost")
+    stuck["status"] = {"phase": "Pending"}
+    client.pods = [stuck]
+    tailer.poll()
+    client.pods = []  # deleted while still unbound
+    steps = tailer.poll()
+    (delta,) = [s for s in steps if s.kind == "delta"]
+    assert delta.deltas == [
+        {"op": "evict_pod", "namespace": "d", "name": "ghost"}
+    ]
+
+
+def test_tailer_event_node_mismatch_trusts_spec():
+    """A Scheduled event naming a different node than spec.nodeName is
+    drift: the spec wins, the mismatch is counted, the decision is
+    diff-sourced."""
+    from open_simulator_tpu.shadow.ingest import ClusterTailer
+    from open_simulator_tpu.utils.trace import COUNTERS
+
+    client = _FakePods()
+    client.events = []
+    tailer = ClusterTailer(client)
+    tailer.bootstrap()
+    mm0 = COUNTERS.get("shadow_ingest_event_mismatch_total")
+    bound = _pod("drifty", node_name="live-0")
+    bound["status"] = {"phase": "Running"}
+    client.pods = [bound]
+    client.events = [_scheduled_event("d", "drifty", "some-other-node")]
+    steps = tailer.poll()
+    (decision,) = [s for s in steps if s.kind == "decision"]
+    assert decision.node == "live-0"  # the spec, not the event
+    assert COUNTERS.get("shadow_ingest_event_mismatch_total") == mm0 + 1
 
 
 def test_tailer_reemits_failure_for_recreated_pod():

@@ -1,0 +1,706 @@
+"""The live digital twin (open_simulator_tpu/twin/).
+
+Covers the tentpole contracts:
+
+- delta-applicator conformance: every delta kind, and seeded random
+  interleavings of all six, yield a warm state dict-equal to a cold
+  full reload of the resulting cluster;
+- warm deltas cost zero jit-cache misses: a repeat-shape query after a
+  pod-delta stream re-dispatches the compiled scan without a single
+  recompile (obs counter asserted, not assumed);
+- mirror self-conformance: simon tailing its own recorded feed agrees
+  with itself 100%, with zero warm recompiles;
+- the query surface: what-if / drain / N+K / forecast against live
+  state, with the tpu scan path conformant to the serial oracle walk;
+- serve re-platform: POST /v1/cluster-delta applies the same
+  vocabulary to a warm session, byte-identical to a cold session over
+  the mutated cluster, journaled to the session snapshot;
+- robustness: tail flaps are counted and bounded catch-up converges;
+  injected apply faults degrade (counted, /healthz reason), never
+  kill the daemon.
+"""
+
+import copy
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource
+from open_simulator_tpu.serve.session import Session, WhatIfRequest
+from open_simulator_tpu.shadow.record import record_simulation
+from open_simulator_tpu.testing import make_fake_node, with_node_labels
+from open_simulator_tpu.twin.deltas import (
+    NODE_DRAIN,
+    NODE_JOIN,
+    POD_ARRIVE,
+    POD_BIND,
+    POD_DELETE,
+    POD_EVICT,
+    RELOADED,
+    SKIPPED,
+    ClusterDelta,
+    MirrorApplicator,
+    cold_reload,
+    deltas_to_events,
+    state_dict,
+    steps_to_deltas,
+)
+from open_simulator_tpu.twin.mirror import ClusterMirror, FeedSource
+from open_simulator_tpu.twin import queries
+
+
+def _pod(name, cpu="500m", mem="512Mi", namespace="d", node=None, port=None,
+         scalar=None):
+    pod = {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "img",
+                    "resources": {"requests": {"cpu": cpu, "memory": mem}},
+                }
+            ]
+        },
+    }
+    if node:
+        pod["spec"]["nodeName"] = node
+    if port:
+        pod["spec"]["containers"][0]["ports"] = [
+            {"hostPort": port, "protocol": "TCP"}
+        ]
+    if scalar:
+        pod["spec"]["containers"][0]["resources"]["requests"][scalar[0]] = str(
+            scalar[1]
+        )
+    return pod
+
+
+def _cluster(n=3, cpu="8", memory="16Gi"):
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_fake_node(
+            f"n-{i}", cpu, memory, with_node_labels({"rack": f"r{i % 2}"})
+        )
+        for i in range(n)
+    ]
+    return cluster
+
+
+def _app(name, pods):
+    res = ResourceTypes()
+    res.pods = list(pods)
+    return AppResource(name, res)
+
+
+# ----------------------------------------------------- delta vocabulary
+
+
+def test_delta_record_round_trip_every_kind():
+    node = make_fake_node("n-9", cpu="2", memory="4Gi")
+    deltas = [
+        ClusterDelta(kind=POD_BIND, pod=_pod("a"), node_name="n-0"),
+        ClusterDelta(kind=POD_ARRIVE, pod=_pod("b")),
+        ClusterDelta(kind=POD_EVICT, namespace="d", name="a", node_name="n-0"),
+        ClusterDelta(kind=POD_DELETE, namespace="d", name="b"),
+        ClusterDelta(kind=NODE_JOIN, node=node),
+        ClusterDelta(kind=NODE_DRAIN, node_name="n-9"),
+    ]
+    for d in deltas:
+        rec = json.loads(json.dumps(d.as_record()))
+        assert ClusterDelta.from_record(rec).as_record() == d.as_record()
+
+
+def test_delta_validation_refuses_malformed():
+    from open_simulator_tpu.models.validation import InputError
+
+    with pytest.raises(InputError):
+        ClusterDelta(kind="pod_teleport")
+    with pytest.raises(InputError):
+        ClusterDelta(kind=POD_BIND, pod=_pod("a"))  # no node
+    with pytest.raises(InputError):
+        ClusterDelta(kind=POD_ARRIVE, pod=_pod("a", node="n-0"))  # bound
+    with pytest.raises(InputError):
+        ClusterDelta(kind=NODE_JOIN, node={"metadata": {}})  # nameless
+
+
+def test_steps_to_deltas_and_timeline_events():
+    cluster = _cluster(2)
+    res = ResourceTypes()
+    res.pods = [_pod(f"p-{i}") for i in range(4)]
+    steps = record_simulation(cluster, [_app("a", res.pods)])
+    deltas = steps_to_deltas(steps)
+    # every decision surfaces as a state delta (bind or pending)
+    decisions = [s for s in steps if s.kind == "decision"]
+    assert len(deltas) >= len(decisions)
+    events = deltas_to_events(deltas, t0=0.0, spacing=1.0)
+    assert len(events) == len(deltas)
+    kinds = {ev.kind for ev in events}
+    assert "PodArrival" in kinds
+
+
+# ------------------------------------------------ applicator conformance
+
+
+def test_every_delta_kind_conforms_to_cold_reload():
+    cluster = _cluster(3)
+    deltas = [
+        ClusterDelta(kind=POD_BIND, pod=_pod("a", port=8080), node_name="n-0"),
+        ClusterDelta(
+            kind=POD_BIND,
+            pod=_pod("s", scalar=("example.com/widget", 2)),
+            node_name="n-1",
+        ),
+        ClusterDelta(kind=POD_ARRIVE, pod=_pod("b", cpu="7")),
+        ClusterDelta(kind=NODE_JOIN, node=make_fake_node("n-3", cpu="4", memory="8Gi")),
+        ClusterDelta(kind=POD_BIND, pod=_pod("c"), node_name="n-3"),
+        ClusterDelta(kind=POD_EVICT, namespace="d", name="a", node_name="n-0"),
+        ClusterDelta(kind=POD_DELETE, namespace="d", name="b"),
+        ClusterDelta(kind=NODE_DRAIN, node_name="n-2"),
+    ]
+    warm = MirrorApplicator(cluster, engine="oracle")
+    outcomes = [warm.apply(d) for d in deltas]
+    assert outcomes.count(RELOADED) == 1  # only the drain reloads
+    cold = cold_reload(cluster, deltas, engine="oracle")
+    assert state_dict(warm) == state_dict(cold)
+    assert warm.reloads == 1
+
+
+def test_skip_semantics_match_cold_reload():
+    """Live-tail races — a bind to a never-seen node, an evict of a
+    pod already gone — skip (counted) on BOTH sides, so conformance
+    survives dirty feeds."""
+    cluster = _cluster(2)
+    deltas = [
+        ClusterDelta(kind=POD_BIND, pod=_pod("ghost"), node_name="never-seen"),
+        ClusterDelta(kind=POD_EVICT, namespace="d", name="not-there"),
+        ClusterDelta(kind=POD_DELETE, namespace="d", name="not-pending"),
+        ClusterDelta(kind=POD_BIND, pod=_pod("real"), node_name="n-1"),
+    ]
+    warm = MirrorApplicator(cluster, engine="oracle")
+    outcomes = [warm.apply(d) for d in deltas]
+    assert outcomes == [SKIPPED, SKIPPED, SKIPPED, "applied"]
+    assert warm.skips == 3
+    cold = cold_reload(cluster, deltas, engine="oracle")
+    assert state_dict(warm) == state_dict(cold)
+
+
+def test_evict_with_stale_node_name_still_finds_the_pod():
+    """A live tail can name a STALE node on an evict (the pod rebound
+    within one poll window): the warm side must evict the pod wherever
+    it actually sits — the cold reload drops it unconditionally, and
+    conformance must hold."""
+    cluster = _cluster(3)
+    deltas = [
+        ClusterDelta(kind=POD_BIND, pod=_pod("mv"), node_name="n-0"),
+        # stale node reference: the pod is on n-0, the evict says n-2
+        ClusterDelta(kind=POD_EVICT, namespace="d", name="mv", node_name="n-2"),
+    ]
+    warm = MirrorApplicator(cluster, engine="oracle")
+    outcomes = [warm.apply(d) for d in deltas]
+    assert outcomes == ["applied", "applied"]  # found via the fallback walk
+    sd = state_dict(warm)
+    assert all(not e["pods"] for e in sd["nodes"].values())
+    assert sd == state_dict(cold_reload(cluster, deltas, engine="oracle"))
+
+
+def test_evict_removes_pending_pod():
+    """A failed-then-deleted pod must leave the pending queue (the
+    forecast requeues it otherwise) — an evict without a node targets
+    pending state, conformant to the cold reload."""
+    cluster = _cluster(2)
+    deltas = [
+        ClusterDelta(kind=POD_ARRIVE, pod=_pod("stuck")),
+        ClusterDelta(kind=POD_EVICT, namespace="d", name="stuck"),
+    ]
+    warm = MirrorApplicator(cluster, engine="oracle")
+    assert [warm.apply(d) for d in deltas] == ["applied", "applied"]
+    assert warm.pending == {}
+    assert state_dict(warm) == state_dict(
+        cold_reload(cluster, deltas, engine="oracle")
+    )
+
+
+def test_rebind_of_live_key_evicts_stale_binding():
+    cluster = _cluster(2)
+    warm = MirrorApplicator(cluster, engine="oracle")
+    warm.apply(ClusterDelta(kind=POD_BIND, pod=_pod("mv"), node_name="n-0"))
+    warm.apply(ClusterDelta(kind=POD_BIND, pod=_pod("mv"), node_name="n-1"))
+    sd = state_dict(warm)
+    assert sd["nodes"]["n-0"]["pods"] == []
+    assert sd["nodes"]["n-1"]["pods"] == ["d/mv"]
+    cold = cold_reload(
+        cluster,
+        [
+            ClusterDelta(kind=POD_BIND, pod=_pod("mv"), node_name="n-0"),
+            ClusterDelta(kind=POD_BIND, pod=_pod("mv"), node_name="n-1"),
+        ],
+        engine="oracle",
+    )
+    assert sd == state_dict(cold)
+
+
+def test_random_interleavings_conform(seed=7, rounds=3, steps=60):
+    """Seeded random streams over all six kinds: warm application is
+    dict-equal to a cold reload at the end of every stream."""
+    for r in range(rounds):
+        rng = random.Random(seed + r)
+        cluster = _cluster(3)
+        warm = MirrorApplicator(cluster, engine="oracle")
+        deltas = []
+        node_pool = [f"x-{r}-{j}" for j in range(3)]
+        live_nodes = ["n-0", "n-1", "n-2"]
+        pod_i = 0
+        for _s in range(steps):
+            kind = rng.choice(
+                [POD_BIND, POD_BIND, POD_ARRIVE, POD_EVICT, POD_DELETE,
+                 NODE_JOIN, NODE_DRAIN]
+            )
+            if kind == POD_BIND:
+                pod_i += 1
+                d = ClusterDelta(
+                    kind=POD_BIND,
+                    pod=_pod(f"p-{r}-{pod_i}", cpu=rng.choice(["250m", "1", "2"])),
+                    node_name=rng.choice(live_nodes + ["nowhere"]),
+                )
+            elif kind == POD_ARRIVE:
+                pod_i += 1
+                d = ClusterDelta(kind=POD_ARRIVE, pod=_pod(f"p-{r}-{pod_i}"))
+            elif kind == POD_EVICT:
+                d = ClusterDelta(
+                    kind=POD_EVICT, namespace="d",
+                    name=f"p-{r}-{rng.randint(1, max(pod_i, 1))}",
+                )
+            elif kind == POD_DELETE:
+                d = ClusterDelta(
+                    kind=POD_DELETE, namespace="d",
+                    name=f"p-{r}-{rng.randint(1, max(pod_i, 1))}",
+                )
+            elif kind == NODE_JOIN and node_pool:
+                name = node_pool.pop()
+                live_nodes.append(name)
+                d = ClusterDelta(
+                    kind=NODE_JOIN,
+                    node=make_fake_node(name, cpu="4", memory="8Gi"),
+                )
+            elif kind == NODE_DRAIN and len(live_nodes) > 1:
+                name = rng.choice(live_nodes)
+                live_nodes.remove(name)
+                d = ClusterDelta(kind=NODE_DRAIN, node_name=name)
+            else:
+                continue
+            deltas.append(d)
+            warm.apply(d)
+        cold = cold_reload(cluster, deltas, engine="oracle")
+        assert state_dict(warm) == state_dict(cold), f"stream seed {seed + r}"
+
+
+def test_warm_deltas_zero_recompiles_on_repeat_query_shape():
+    """The tentpole's warm contract: after a pod-delta stream, a query
+    of an already-seen shape re-dispatches the compiled scan with ZERO
+    jit-cache misses — measured on the obs recompile counter."""
+    from open_simulator_tpu.obs import profile as obs_profile
+
+    cluster = _cluster(3)
+    mirror = ClusterMirror(cluster, FeedSource([], batch=8), engine="tpu")
+    app = _app("q", [_pod("q-0", cpu="1")])
+    queries.whatif(mirror, [app])  # cold: compiles the query shape
+    before = obs_profile.snapshot()
+    for i in range(6):
+        mirror.applicator.apply(
+            ClusterDelta(
+                kind=POD_BIND, pod=_pod(f"live-{i}"), node_name=f"n-{i % 3}"
+            )
+        )
+        out = queries.whatif(mirror, [_app("q", [_pod("q-0", cpu="1")])])
+        assert out["success"]
+    prof = obs_profile.delta(before)
+    assert prof["jax_recompiles_total"] == 0, (
+        f"warm deltas recompiled {prof['jax_recompiles_total']}x"
+    )
+    assert prof["jax_dispatches_total"] >= 6  # the queries DID dispatch
+
+
+# ------------------------------------------------ mirror self-conformance
+
+
+def _recorded_feed(n_pods=10):
+    cluster = _cluster(3)
+    res = ResourceTypes()
+    res.pods = [_pod(f"p-{i}") for i in range(n_pods)]
+    steps = record_simulation(cluster, [_app("app", res.pods)])
+    return cluster, steps
+
+
+@pytest.mark.parametrize("engine", ["oracle", "tpu"])
+def test_mirror_tails_own_feed_at_full_agreement(engine):
+    cluster, steps = _recorded_feed()
+    mirror = ClusterMirror(cluster, FeedSource(steps, batch=4), engine=engine)
+    mirror.bootstrap()
+    polls = 0
+    while not mirror.source.exhausted:
+        assert mirror.poll_once() >= 0
+        polls += 1
+        assert polls < 100
+    mirror.drain_backlog()
+    stats = mirror.stats()
+    assert stats["agreementRate"] == 1.0
+    assert stats["divergences"] == 0
+    assert stats["warmRecompiles"] == 0
+    assert stats["feedExhausted"]
+    assert stats["mirrorLagSeconds"] == 0.0
+
+
+def test_mirror_bounded_catchup_converges():
+    """A giant feed batch converges across rounds under max_catchup,
+    never in one stop-the-world gulp."""
+    cluster, steps = _recorded_feed(n_pods=12)
+    mirror = ClusterMirror(
+        cluster, FeedSource(steps, batch=len(steps)), engine="oracle",
+        max_catchup=3,
+    )
+    mirror.bootstrap()
+    applied = mirror.poll_once()
+    assert applied == 3  # bounded
+    assert mirror.stats()["backlog"] > 0
+    assert mirror.mirror_lag_s() >= 0.0
+    rounds = 1
+    while mirror.stats()["backlog"] or not mirror.source.exhausted:
+        mirror.poll_once()
+        rounds += 1
+        assert rounds < 100
+    assert mirror.stats()["agreementRate"] == 1.0
+
+
+def test_mirror_flap_counts_and_survives():
+    class FlakySource:
+        exhausted = False
+
+        def __init__(self):
+            self.calls = 0
+
+        def bootstrap(self):
+            return [], []
+
+        def poll(self):
+            self.calls += 1
+            if self.calls % 2:
+                raise OSError("apiserver hiccup")
+            return []
+
+    mirror = ClusterMirror(_cluster(2), FlakySource(), engine="oracle")
+    mirror.bootstrap()
+    assert mirror.poll_once() == -1  # flap
+    assert mirror.poll_once() == 0
+    assert mirror.flaps == 1
+    assert mirror.stats()["polls"] == 2
+
+
+def test_injected_apply_fault_degrades_not_dies():
+    """`twin.apply_delta` chaos seam: a classified fault is counted,
+    the step is skipped, the mirror reports degraded — and keeps
+    applying subsequent steps."""
+    from open_simulator_tpu.runtime.inject import INJECT
+
+    cluster, steps = _recorded_feed(n_pods=6)
+    mirror = ClusterMirror(cluster, FeedSource(steps, batch=64), engine="oracle")
+    mirror.bootstrap()
+    INJECT.configure("twin.apply_delta=raise:ConformanceError@1")
+    try:
+        while not mirror.source.exhausted:
+            mirror.poll_once()
+        mirror.drain_backlog()
+    finally:
+        INJECT.clear()
+    assert mirror.apply_errors >= 1
+    reasons = mirror.degraded_reasons()
+    assert any("could not be applied" in r for r in reasons)
+    # the rest of the feed still landed
+    assert mirror.stats()["decisions"] >= 1
+
+
+# ------------------------------------------------------------- queries
+
+
+def _fed_mirror(engine="tpu", n_pods=10):
+    cluster, steps = _recorded_feed(n_pods=n_pods)
+    mirror = ClusterMirror(cluster, FeedSource(steps, batch=64), engine=engine)
+    mirror.bootstrap()
+    while not mirror.source.exhausted:
+        mirror.poll_once()
+    mirror.drain_backlog()
+    return mirror
+
+
+def test_whatif_scan_conforms_to_serial_walk():
+    """The tpu query path and the serial oracle walk answer the same
+    question identically (placements and failure reasons)."""
+    tpu = _fed_mirror(engine="tpu")
+    ser = _fed_mirror(engine="oracle")
+    apps = [_app("q", [_pod("q-0", cpu="2"), _pod("q-big", cpu="64")])]
+    a = queries.whatif(tpu, apps)
+    b = queries.whatif(ser, apps)
+    for key in ("success", "placed", "failedCount", "placements",
+                "unscheduledPods"):
+        assert a[key] == b[key], key
+    assert a["unscheduledPods"] and "Insufficient cpu" in a["unscheduledPods"][0]["reason"]
+
+
+def test_drain_by_name_and_selector():
+    mirror = _fed_mirror(engine="tpu")
+    by_name = queries.drain(mirror, nodes=["n-0"])
+    assert by_name["drainedNodes"] == ["n-0"]
+    assert by_name["displaced"] >= 0
+    # rack selector: rack r0 holds n-0 and n-2 (labels stamped in _cluster)
+    by_rack = queries.drain(mirror, selector={"rack": "r0"})
+    assert by_rack["drainedNodes"] == ["n-0", "n-2"]
+    # the mirror itself is untouched by queries
+    assert mirror.stats()["agreementRate"] == 1.0
+
+
+def test_drain_refuses_whole_cluster_and_unknown_nodes():
+    from open_simulator_tpu.models.validation import InputError
+
+    mirror = _fed_mirror(engine="oracle")
+    with pytest.raises(InputError):
+        queries.drain(mirror, nodes=["n-0", "n-1", "n-2"])
+    with pytest.raises(InputError):
+        queries.drain(mirror, nodes=["nope"])
+    with pytest.raises(InputError):
+        queries.drain(mirror, nodes=[])
+
+
+def test_nplusk_exhaustive_singles():
+    mirror = _fed_mirror(engine="tpu")
+    out = queries.nplusk(mirror, k=1, trials=8)
+    assert out["mode"] == "exhaustive"
+    assert out["scenarios"] == 3
+    assert out["survived"] + sum(
+        1 for o in out["outages"] if not o["safe"]
+    ) == out["scenarios"]
+    if not out["survivable"]:
+        assert out["worst"] is not None
+
+
+def test_forecast_steps_forward_from_live_state():
+    mirror = _fed_mirror(engine="oracle", n_pods=8)
+    pending_now = mirror.stats()["pendingPods"]
+    out = queries.forecast(
+        mirror, horizon_s=60.0, arrival_rate=0.25, policy="static:0",
+        engine="oracle",
+    )
+    assert out["pendingSeeded"] == pending_now
+    assert out["arrivals"] == 15
+    assert out["policies"] and out["policies"][0]["final"] is not None
+
+
+def test_forecast_zero_rate_without_pending_is_trivial():
+    cluster = _cluster(2)
+    mirror = ClusterMirror(cluster, FeedSource([], batch=4), engine="oracle")
+    mirror.bootstrap()
+    out = queries.forecast(mirror, horizon_s=10.0, arrival_rate=0.0)
+    assert out["policies"] == []
+    assert "note" in out
+
+
+# ---------------------------------------------- serve /v1/cluster-delta
+
+
+def _serve_cluster():
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_fake_node(f"sv-{i}", cpu="4", memory="8Gi") for i in range(3)
+    ]
+    cluster.pods = [_pod("base-0", node="sv-0")]
+    return cluster
+
+
+def _whatif_req():
+    return WhatIfRequest(apps=[_app("q", [_pod("q-0", cpu="2")])])
+
+
+def test_session_delta_stream_byte_identical_to_cold_session():
+    warm = Session(_serve_cluster())
+    deltas = [
+        ClusterDelta(kind=POD_BIND, pod=_pod("live-1"), node_name="sv-1"),
+        ClusterDelta(kind=POD_ARRIVE, pod=_pod("pend-1", cpu="3")),
+        ClusterDelta(kind=NODE_JOIN, node=make_fake_node("sv-3", cpu="2", memory="4Gi")),
+        ClusterDelta(kind=POD_EVICT, namespace="d", name="base-0"),
+        ClusterDelta(kind=NODE_DRAIN, node_name="sv-2"),
+    ]
+    for d in deltas:
+        warm.apply_delta(d)
+    assert warm.delta_seq == len(deltas)
+    assert warm.delta_reloads == 1  # the drain
+    cold = Session(copy.deepcopy(warm.cluster))
+    wb = warm.evaluate_batch([_whatif_req()])[0]
+    cb = cold.evaluate_batch([_whatif_req()])[0]
+    assert wb.status == cb.status == 200
+    assert wb.body == cb.body
+
+
+def test_session_delta_with_daemonsets_reloads_on_node_churn():
+    """Daemonset per-node pods consume the generated-name counter, so
+    node churn on a daemonset-bearing cluster must rebuild — and the
+    rebuilt session still answers byte-identically to cold."""
+    from open_simulator_tpu.testing import make_fake_daemon_set
+
+    cluster = _serve_cluster()
+    cluster.daemon_sets = [make_fake_daemon_set("ds", "d")]
+    warm = Session(cluster)
+    out = warm.apply_delta(
+        ClusterDelta(kind=NODE_JOIN, node=make_fake_node("sv-9", cpu="2", memory="4Gi"))
+    )
+    assert out == RELOADED
+    cold = Session(copy.deepcopy(warm.cluster))
+    assert (
+        warm.evaluate_batch([_whatif_req()])[0].body
+        == cold.evaluate_batch([_whatif_req()])[0].body
+    )
+
+
+def test_serve_cluster_delta_endpoint(tmp_path):
+    """HTTP: push deltas, see them in answers, the snapshot journal,
+    /healthz deltaSeq, and /metrics counters; malformed streams apply
+    nothing."""
+    from open_simulator_tpu.serve.server import ServeDaemon
+
+    session = Session(_serve_cluster())
+    snapshot = tmp_path / "snap.jsonl"
+    daemon = ServeDaemon(
+        session, port=0, max_batch=4, queue_depth=16,
+        snapshot_path=str(snapshot),
+    )
+    daemon.start()
+    base = f"http://{daemon.host}:{daemon.port}"
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return urllib.request.urlopen(req, timeout=30)
+
+        recs = [
+            {"kind": "pod_bind", "pod": _pod("live-1", cpu="3"), "node": "sv-1"},
+            {"kind": "pod_arrive", "pod": _pod("pend-1")},
+        ]
+        with post("/v1/cluster-delta", {"deltas": recs}) as resp:
+            body = json.loads(resp.read())
+        assert body["applied"] == 2 and body["deltaSeq"] == 2
+        # malformed stream: validated before anything applies
+        bad = [{"kind": "pod_bind", "pod": _pod("x")}]  # no node
+        try:
+            post("/v1/cluster-delta", {"deltas": bad})
+            raised = None
+        except urllib.error.HTTPError as e:
+            raised = e.code
+        assert raised == 400
+        # a typo'd node_drain LATER in an otherwise-valid stream is
+        # caught by the pre-validation walk: 400, nothing applied
+        typo = [
+            {"kind": "pod_bind", "pod": _pod("never"), "node": "sv-2"},
+            {"kind": "node_drain", "name": "sv-typo"},
+        ]
+        try:
+            post("/v1/cluster-delta", {"deltas": typo})
+            raised = None
+        except urllib.error.HTTPError as e:
+            raised = e.code
+        assert raised == 400
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            h = json.loads(resp.read())
+        assert h["deltaSeq"] == 2  # the bad streams applied nothing
+        # the warm session answers against the delta'd state,
+        # byte-identical to a cold session over the same cluster
+        with post(
+            "/v1/simulate",
+            {"apps": [{"name": "q", "yaml": json.dumps(_pod("q-0", cpu="2"))}]},
+        ) as resp:
+            warm_body = resp.read()
+        cold = Session(copy.deepcopy(session.cluster))
+        cold_body = cold.evaluate_batch(
+            [WhatIfRequest(apps=[_app("q", [_pod("q-0", cpu="2")])])]
+        )[0].body
+        assert warm_body == cold_body
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            metrics = resp.read().decode()
+        # counters are process-wide: assert the family exists and has
+        # absorbed at least this test's two deltas
+        applied_line = next(
+            l for l in metrics.splitlines()
+            if l.startswith("simon_serve_deltas_applied_total")
+        )
+        assert int(applied_line.split()[-1]) >= 2
+    finally:
+        daemon.shutdown()
+    # snapshot-journal compatibility: the applied deltas are journaled
+    lines = [
+        json.loads(l)
+        for l in snapshot.read_text().splitlines()
+        if l.strip()
+    ]
+    delta_recs = [
+        r for r in lines if r.get("kind") == "session" and r.get("event") == "delta"
+    ]
+    assert len(delta_recs) == 2
+    assert delta_recs[0]["delta"]["kind"] == "pod_bind"
+
+
+# --------------------------------------------------------- twin daemon
+
+
+def test_twin_daemon_http_surface():
+    from open_simulator_tpu.twin.server import TwinDaemon
+
+    cluster, steps = _recorded_feed(n_pods=8)
+    mirror = ClusterMirror(cluster, FeedSource(steps, batch=64), engine="tpu")
+    mirror.bootstrap()
+    daemon = TwinDaemon(mirror, port=0, poll_interval_s=0.02)
+    daemon.start()
+    base = f"http://{daemon.host}:{daemon.port}"
+    try:
+        # wait until the tail drained the feed
+        for _ in range(200):
+            with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+                h = json.loads(r.read())
+            if h["mirror"]["feedExhausted"] and h["mirror"]["backlog"] == 0:
+                break
+        assert h["mirror"]["agreementRate"] == 1.0
+
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read())
+
+        w = post(
+            "/v1/whatif",
+            {"apps": [{"name": "q", "yaml": json.dumps(_pod("q-0"))}]},
+        )
+        assert w["kind"] == "whatif" and w["success"]
+        d = post("/v1/drain", {"nodes": ["n-1"]})
+        assert d["kind"] == "drain" and "safe" in d
+        nk = post("/v1/nplusk", {"k": 1, "trials": 4})
+        assert nk["scenarios"] == 3
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            m = resp.read().decode()
+        assert "simon_twin_agreement_rate 1.0" in m
+        assert "simon_shadow_warm_recompiles_total 0" in m
+        assert "simon_twin_whatif_total 1" in m
+        # input errors answer 400, not 500
+        try:
+            post("/v1/drain", {"nodes": []})
+            code = None
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400
+    finally:
+        assert daemon.shutdown() == 0

@@ -201,6 +201,7 @@ def cmd_apply(args) -> int:
 
     _force_platform()
     try:
+        _configure_mesh(args)
         if args.interactive and args.deadline is not None:
             raise InputError(
                 "--deadline is not available in interactive mode (the "
@@ -342,6 +343,7 @@ def cmd_chaos(args) -> int:
 
     _force_platform()
     try:
+        _configure_mesh(args)
         config = SimonConfig.from_file(args.simon_config)
         applier = Applier(config, use_greed=args.use_greed)
         cluster = applier.load_cluster()
@@ -996,6 +998,7 @@ def cmd_timeline(args) -> int:
 
     _force_platform()
     try:
+        _configure_mesh(args)
         sources = sum(
             1 for m in (args.synthetic, args.trace, args.from_decision_log)
             if m
@@ -1453,6 +1456,34 @@ def _arm_injection(args) -> None:
         raise _inject.IMPORT_SPEC_ERROR  # simonlint: disable=EXC001
 
 
+def _add_mesh_flag(p: argparse.ArgumentParser):
+    p.add_argument(
+        "--mesh",
+        default=None,
+        metavar="auto|off|N",
+        help="shard batched scans over a device mesh: auto = every "
+        "local device, N = the first N devices, off = single-device "
+        "(the default; the SIMON_MESH env var changes it). The layout "
+        "planner picks node-axis vs scenario-axis sharding per "
+        "dispatch from the cost/memory observatory "
+        "(docs/PERFORMANCE.md); faults on the mesh degrade down the "
+        "single-device guard ladder",
+    )
+
+
+def _configure_mesh(args) -> None:
+    """Wire --mesh into the process-wide mesh (parallel/mesh.py). The
+    flag wins; without it the SIMON_MESH env default stands. Resolves
+    devices eagerly so a bad device count is a clean exit-2 InputError
+    here, not a traceback deep inside a sweep."""
+    from .parallel import mesh as mesh_mod
+
+    spec = getattr(args, "mesh", None)
+    if spec is not None:
+        mesh_mod.configure(spec)
+    mesh_mod.current_mesh()
+
+
 def _add_guard_flags(p: argparse.ArgumentParser):
     """Execution-guard flags shared by the long-running commands
     (docs/ROBUSTNESS.md): wall-clock budget + resumable journal."""
@@ -1534,6 +1565,7 @@ def build_parser() -> argparse.ArgumentParser:
         default=32,
         help="sampled K-failure scenarios per escalation (K >= 2)",
     )
+    _add_mesh_flag(p_apply)
     _add_guard_flags(p_apply)
     _add_obs_flags(p_apply)
     p_apply.add_argument(
@@ -1628,6 +1660,7 @@ def build_parser() -> argparse.ArgumentParser:
         "on the named nodes (default all)",
     )
     p_chaos.add_argument("--use-greed", action="store_true", help=argparse.SUPPRESS)
+    _add_mesh_flag(p_chaos)
     _add_guard_flags(p_chaos)
     _add_obs_flags(p_chaos)
     p_chaos.add_argument(
@@ -1959,6 +1992,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="window engine: tpu = batched masked scan rows, oracle = "
         "the serial host walk (the conformance reference)",
     )
+    _add_mesh_flag(p_timeline)
     _add_guard_flags(p_timeline)
     _add_obs_flags(p_timeline)
     p_timeline.add_argument(

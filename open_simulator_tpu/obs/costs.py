@@ -180,7 +180,7 @@ class CostRegistry:
             self._records.clear()
 
     def estimate_bytes(
-        self, site: str, lead_dim: Optional[int] = None
+        self, site: str, lead_dim: Optional[int] = None, shards: int = 1
     ) -> Optional[int]:
         """Predicted fresh device bytes for one dispatch of ``site``
         at ``lead_dim`` rows (None = the largest known shape),
@@ -193,10 +193,21 @@ class CostRegistry:
         construction) and keeps the argument bytes whole, an upper
         bound for the splitting direction; growing past it scales
         everything linearly. None when the site has never compiled —
-        the caller falls back to the reactive ladder."""
+        the caller falls back to the reactive ladder.
+
+        ``shards`` > 1 asks for the PER-DEVICE bytes of a mesh-sharded
+        dispatch (parallel/mesh.py): the batched axis splits across
+        devices, so the workspace scales by the per-shard row count
+        (ceil(lead_dim / shards)) while the argument bytes stay whole
+        — the static/init pytrees replicate onto every device and
+        dominate the inputs. Without this a sharded dispatch would be
+        predicted at full-replica size and spuriously chunk-split or
+        rung-skip."""
         recs = [r for r in self.records_for(site).values()]
         if not recs:
             return None
+        if shards > 1 and lead_dim is not None:
+            lead_dim = -(-int(lead_dim) // int(shards))
         if lead_dim is not None:
             exact = [r for r in recs if r.lead_dim == lead_dim]
             if exact:
@@ -210,14 +221,19 @@ class CostRegistry:
             )
         return int(best.dispatch_bytes * (lead_dim / best.lead_dim))
 
-    def chunk_estimator(self, site: str) -> Callable[[int, int], Optional[int]]:
+    def chunk_estimator(
+        self, site: str, shards: int = 1
+    ) -> Callable[[int, int], Optional[int]]:
         """An ``estimate(lo, hi)`` callable for guard.run_chunked:
         predicted fresh device bytes (arguments + workspace) of
         dispatching rows [lo, hi) at this site (None until the site's
-        first compile)."""
+        first compile). ``shards`` makes the estimate per-device for a
+        mesh-sharded dispatch (see estimate_bytes) — pair it with
+        ``run_chunked(shards=...)`` so the ledger verdict compares
+        per-device bytes against the per-device budget slice."""
 
         def estimate(lo: int, hi: int) -> Optional[int]:
-            return self.estimate_bytes(site, hi - lo)
+            return self.estimate_bytes(site, hi - lo, shards=shards)
 
         return estimate
 

@@ -37,7 +37,7 @@ from ..models.validation import InputError
 
 # units whose headline value is better when LARGER; everything else
 # (s, mismatches, bytes) regresses upward
-_RATE_UNITS = {"pods/s", "req/s", "steps/s", "qps"}
+_RATE_UNITS = {"pods/s", "req/s", "steps/s", "qps", "rows/s", "deltas/s"}
 
 
 @dataclass

@@ -50,20 +50,29 @@ DEFAULT_HEADROOM = 0.92
 # source are rate-limited, while span boundaries always sample
 LIVE_POLL_MIN_INTERVAL_S = 0.05
 
+# per-device row refresh cadence for UNFORCED polls (forced polls —
+# span boundaries — always refresh): the rows feed /metrics gauges and
+# sharded-fit verdicts, neither of which needs per-dispatch freshness
+PER_DEVICE_MIN_INTERVAL_S = 1.0
 
-def device_memory_stats():
-    """(bytes_in_use, bytes_limit, source) for the process's devices.
-    ``bytes_limit``/``bytes_in_use`` sum across local devices when the
-    backend reports allocator stats; otherwise in-use falls back to
-    live-buffer accounting and the limit to SIMON_DEVICE_MEM_BUDGET."""
+
+def device_memory_stats_per_device():
+    """Per-device memory accounting: a list of {device, in_use, limit}
+    covering EVERY local device — the mesh makes "device 0's memory"
+    the wrong question, a sharded dispatch lives or dies on the
+    tightest shard. ``memory_stats()`` backends report allocator truth
+    per device; the live-buffer fallback (CPU) attributes each live
+    array's bytes to every device holding a shard of it (committed
+    sharded arrays enumerate their device set) and splits the
+    SIMON_DEVICE_MEM_BUDGET budget evenly. Returns ([], source) when
+    no backend is importable."""
     try:
         import jax
 
         devices = jax.local_devices()
     except Exception:  # noqa: BLE001 - no backend at all: the ledger reports unknown rather than failing the caller
-        return 0, None, "unavailable"
-    in_use = 0
-    limit = 0
+        return [], "unavailable"
+    rows = []
     saw_stats = False
     for d in devices:
         try:
@@ -72,19 +81,63 @@ def device_memory_stats():
             stats = None
         if stats:
             saw_stats = True
-            in_use += int(stats.get("bytes_in_use", 0) or 0)
-            limit += int(stats.get("bytes_limit", 0) or 0)
+            rows.append(
+                {
+                    "device": f"{d.platform}:{d.id}",
+                    "in_use": int(stats.get("bytes_in_use", 0) or 0),
+                    "limit": int(stats.get("bytes_limit", 0) or 0) or None,
+                }
+            )
     if saw_stats:
-        return in_use, (limit or None), "memory_stats"
+        return rows, "memory_stats"
     import jax
 
-    in_use = sum(int(a.nbytes) for a in jax.live_arrays())
+    per_dev = {f"{d.platform}:{d.id}": 0 for d in devices}
+    for a in jax.live_arrays():
+        try:
+            holders = a.devices()
+        except Exception:  # noqa: BLE001 - deleted/donated buffer mid-enumeration: skip it
+            continue
+        n_holders = max(len(holders), 1)
+        for d in holders:
+            key = f"{d.platform}:{d.id}"
+            if key in per_dev:
+                per_dev[key] += int(a.nbytes) // n_holders
+    env = os.environ.get("SIMON_DEVICE_MEM_BUDGET")
+    try:
+        budget = int(env) if env else None
+    except ValueError:
+        budget = None
+    per_limit = budget // max(len(devices), 1) if budget else None
+    return (
+        [
+            {"device": k, "in_use": v, "limit": per_limit}
+            for k, v in per_dev.items()
+        ],
+        "live_arrays",
+    )
+
+
+def device_memory_stats():
+    """(bytes_in_use, bytes_limit, source) for the process's devices.
+    ``bytes_limit``/``bytes_in_use`` sum across local devices when the
+    backend reports allocator stats; otherwise in-use falls back to
+    live-buffer accounting and the limit to SIMON_DEVICE_MEM_BUDGET."""
+    rows, source = device_memory_stats_per_device()
+    if source == "unavailable":
+        return 0, None, source
+    in_use = sum(r["in_use"] for r in rows)
+    if source == "memory_stats":
+        limit = sum(r["limit"] or 0 for r in rows)
+        return in_use, (limit or None), source
+    # live-buffer fallback: per-device rows split shared arrays, so the
+    # process total is their sum; the limit is the whole env budget
     env = os.environ.get("SIMON_DEVICE_MEM_BUDGET")
     try:
         limit = int(env) if env else None
     except ValueError:
         limit = None
-    return in_use, limit, "live_arrays"
+    return in_use, limit, source
 
 
 class MemoryLedger:
@@ -103,6 +156,11 @@ class MemoryLedger:
         self.watermarks: Dict[str, int] = {}
         self._last_poll = 0.0
         self._last_in_use = 0
+        # last per-device rows ({device, in_use, limit}) — every mesh
+        # device, not just device 0; exported as labeled
+        # simon_device_mem_*{device=...} gauges on /metrics
+        self._per_device: list = []
+        self._last_rows_poll = 0.0
 
     # -- sampling -----------------------------------------------------------
 
@@ -121,10 +179,22 @@ class MemoryLedger:
                 < LIVE_POLL_MIN_INTERVAL_S
             ):
                 return self._last_in_use
+            last_rows_poll = self._last_rows_poll
+        # totals through device_memory_stats (the module's test seam);
+        # per-device rows refresh on forced polls and at a bounded
+        # cadence otherwise — a second full device sweep per hot-path
+        # poll would double the cost the rate limiter exists to bound
         in_use, limit, source = device_memory_stats()
+        now = time.monotonic()
+        rows = None
+        if force or now - last_rows_poll >= PER_DEVICE_MIN_INTERVAL_S:
+            rows, _row_source = device_memory_stats_per_device()
         with self._lock:
             self._last_poll = time.monotonic()
             self._last_in_use = in_use
+            if rows is not None:
+                self._per_device = rows
+                self._last_rows_poll = now
             self.samples += 1
             self.source = source
             if in_use > self.peak_bytes:
@@ -171,11 +241,21 @@ class MemoryLedger:
         *,
         headroom: float = DEFAULT_HEADROOM,
         label: str = "",
+        shards: int = 1,
     ) -> Optional[bool]:
         """Would a dispatch allocating ``estimate_bytes`` of fresh
         workspace fit right now? None when no budget is known (the
         caller must stay reactive); every real verdict is counted so
         predicted-vs-actual accuracy is a number, not a hope.
+
+        ``shards`` > 1 means the dispatch is mesh-sharded and
+        ``estimate_bytes`` is PER-DEVICE (the shard-aware chunk
+        estimator, obs/costs.py): the verdict then compares it against
+        the TIGHTEST device's real headroom from the per-device rows
+        (a sharded dispatch lives or dies on its tightest shard) —
+        never against the summed budget divided by the shard count,
+        which would overstate per-device room whenever the mesh uses
+        fewer devices than the host has.
 
         ``ledger.predict_fit`` is an injection point: a ``lie:low``
         clause answers True (everything fits — the predictive path is
@@ -196,10 +276,15 @@ class MemoryLedger:
             if not fits and label:
                 COUNTERS.inc(f"ledger_predict_unfit_{label}")
             return fits
-        in_use, limit, _src = device_memory_stats()
-        if not limit:
-            return None
-        fits = in_use + int(estimate_bytes) <= limit * headroom
+        if shards > 1:
+            fits = self._fits_per_device(int(estimate_bytes), headroom)
+            if fits is None:
+                return None
+        else:
+            in_use, limit, _src = device_memory_stats()
+            if not limit:
+                return None
+            fits = in_use + int(estimate_bytes) <= limit * headroom
         COUNTERS.inc("ledger_predictions_total")
         COUNTERS.inc(
             "ledger_predict_fit_total" if fits else "ledger_predict_unfit_total"
@@ -207,6 +292,35 @@ class MemoryLedger:
         if not fits and label:
             COUNTERS.inc(f"ledger_predict_unfit_{label}")
         return fits
+
+    def _fits_per_device(
+        self, per_device_bytes: int, headroom: float
+    ) -> Optional[bool]:
+        """Would ``per_device_bytes`` fit on the TIGHTEST device? None
+        when no device reports a limit (no budget known)."""
+        rows, _src = device_memory_stats_per_device()
+        limited = [r for r in rows if r.get("limit")]
+        if not limited:
+            return None
+        free = min(
+            r["limit"] * headroom - r["in_use"] for r in limited
+        )
+        return per_device_bytes <= free
+
+    def would_fit(
+        self,
+        estimate_bytes: int,
+        *,
+        headroom: float = DEFAULT_HEADROOM,
+    ) -> Optional[bool]:
+        """predict_fit's verdict WITHOUT the prediction counters — for
+        planning probes (parallel/mesh.py plan_layout) that correspond
+        to no dispatch, so predicted-vs-actual accounting stays about
+        dispatches that actually ran."""
+        in_use, limit, _src = device_memory_stats()
+        if not limit:
+            return None
+        return in_use + int(estimate_bytes) <= limit * headroom
 
     def rung_predictor(
         self, estimators: Dict[str, Callable[[], Optional[int]]]
@@ -228,6 +342,13 @@ class MemoryLedger:
 
     # -- reporting ----------------------------------------------------------
 
+    def device_summary(self) -> list:
+        """Last per-device rows ({device, in_use, limit}) — the
+        labeled ``simon_device_mem_*{device=...}`` /metrics series and
+        the ``per_device`` ledger block."""
+        with self._lock:
+            return [dict(r) for r in self._per_device]
+
     def reset(self) -> None:
         with self._lock:
             self.peak_bytes = 0
@@ -237,6 +358,8 @@ class MemoryLedger:
             self.watermarks.clear()
             self._last_poll = 0.0
             self._last_in_use = 0
+            self._per_device = []
+            self._last_rows_poll = 0.0
 
     def summary(self, top: int = 8) -> dict:
         """The ``ledger`` block for bench obs lines, trace artifacts,
@@ -250,6 +373,7 @@ class MemoryLedger:
                 "samples": self.samples,
                 "source": self.source,
                 "watermarks": {k: v for k, v in marks},
+                "per_device": [dict(r) for r in self._per_device],
             }
         out["predictions"] = {
             "total": COUNTERS.get("ledger_predictions_total"),

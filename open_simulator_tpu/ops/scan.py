@@ -251,11 +251,60 @@ class ScanState(NamedTuple):
     rng_overflow: jnp.ndarray = None  # [] bool
 
 
-def _default_normalize(raw, feasible, reverse: bool):
+class _LocalCtx:
+    """Node-axis reduction context for the single-device scan: every
+    cross-node combine is the identity (the local reduction already saw
+    the whole axis), node gathers are plain indexing, and the select is
+    a plain first-max argmax. The mesh-sharded scan (parallel/mesh.py)
+    substitutes a context whose combines are `lax.pmax`/`psum`/... over
+    the mesh axis and whose gathers broadcast the owning shard's value,
+    so ONE step implementation serves both layouts — the sharded scan
+    can never drift semantically from the single-device one."""
+
+    axis = None
+
+    def combine_max(self, x):
+        return x
+
+    def combine_min(self, x):
+        return x
+
+    def combine_sum(self, x):
+        return x
+
+    def combine_any(self, x):
+        return x
+
+    def gather_vec(self, vec, idx):
+        """vec[idx] where idx is a GLOBAL node index (vec is the full
+        node axis here; a local shard under the sharded ctx)."""
+        return vec[idx]
+
+    def gather_cols(self, arr, idx):
+        """arr[..., idx] at a global node index (values >= -1)."""
+        return arr[..., idx]
+
+    def first_max_index(self, masked):
+        """GLOBAL index of the first maximum along the node axis."""
+        return jnp.argmax(masked)
+
+    def commit_onehot(self, placement, commit, n_local):
+        """One-hot of a GLOBAL placement over the LOCAL node slice,
+        zero everywhere when commit is False (out-of-shard indices
+        one-hot to all-zeros by jax.nn.one_hot's out-of-range rule)."""
+        return jax.nn.one_hot(
+            jnp.maximum(placement, 0), n_local, dtype=jnp.int64
+        ) * commit.astype(jnp.int64)
+
+
+LOCAL_CTX = _LocalCtx()
+
+
+def _default_normalize(raw, feasible, reverse: bool, ctx=LOCAL_CTX):
     """DefaultNormalizeScore (plugins/helper/normalize_score.go:26-53)
     over the feasible set."""
     masked = jnp.where(feasible, raw, 0)
-    max_count = jnp.max(masked)
+    max_count = ctx.combine_max(jnp.max(masked))
     base = jnp.where(max_count > 0, MAX_SCORE * raw // jnp.maximum(max_count, 1), 0)
     if reverse:
         out = jnp.where(max_count > 0, MAX_SCORE - base, MAX_SCORE)
@@ -264,12 +313,12 @@ def _default_normalize(raw, feasible, reverse: bool):
     return out
 
 
-def _minmax_normalize(raw, feasible):
+def _minmax_normalize(raw, feasible, ctx=LOCAL_CTX):
     """Simon/GpuShare/OpenLocal NormalizeScore (plugin/simon.go:75-100)
     over the feasible set; all-equal collapses to MinNodeScore=0."""
     big = jnp.iinfo(jnp.int64).max
-    hi = jnp.max(jnp.where(feasible, raw, -big))
-    lo = jnp.min(jnp.where(feasible, raw, big))
+    hi = ctx.combine_max(jnp.max(jnp.where(feasible, raw, -big)))
+    lo = ctx.combine_min(jnp.min(jnp.where(feasible, raw, big)))
     rng = hi - lo
     return jnp.where(rng > 0, (raw - lo) * MAX_SCORE // jnp.maximum(rng, 1), 0)
 
@@ -489,7 +538,8 @@ def _sample_select(masked, feasible, consume, rng_hist, n: int):
     return best, new_hist, overflow, t_used
 
 
-def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, features):
+def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, features,
+                ctx=LOCAL_CTX):
     """InterPodAffinity filter + raw score and PodTopologySpread hard
     filter + soft score for pod class u over all nodes.
 
@@ -577,8 +627,8 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
         hvals = static.topo_val[hrow]  # [Hm, N]
         cand_nodes = static.h_cand_nodes[h] & node_valid[None, :]  # [Hm, N]
         counts_h = state.tgt[hrow]  # [Hm, N] node-space
-        minc = jnp.min(jnp.where(cand_nodes, counts_h, big), axis=1)
-        minc = jnp.where(jnp.any(cand_nodes, axis=1), minc, 0)
+        minc = ctx.combine_min(jnp.min(jnp.where(cand_nodes, counts_h, big), axis=1))
+        minc = jnp.where(ctx.combine_any(jnp.any(cand_nodes, axis=1)), minc, 0)
         pair_in = cand_nodes & (hvals >= 0)
         cnt_eff = jnp.where(pair_in, counts_h, 0)
         selfm = static.h_self[h, u]
@@ -611,9 +661,11 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
         # value v" into an elementwise AND + reduce (Vs = small vocab);
         # hostname rows count eligible nodes directly (value == node)
         onehot = static.s_val_onehot[s]  # [Sm, Vs, N]
-        present = jnp.any(onehot & eligible[None, None, :], axis=2)  # [Sm, Vs]
+        present = ctx.combine_any(
+            jnp.any(onehot & eligible[None, None, :], axis=2)
+        )  # [Sm, Vs]
         sz_nonhost = jnp.sum(present, axis=1)
-        sz = jnp.where(is_host, jnp.sum(eligible), sz_nonhost)
+        sz = jnp.where(is_host, ctx.combine_sum(jnp.sum(eligible)), sz_nonhost)
         weight = jnp.log(sz.astype(jnp.float64) + 2.0)
         # node-space counts: each node already reads its own value
         cnt_soft = state.soft_counts[s]
@@ -629,9 +681,9 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
         )
         raw = score_f.astype(jnp.int64)
         valid = feasible_final & has_keys
-        any_valid = jnp.any(valid)
-        mx = jnp.max(jnp.where(valid, raw, -big))
-        mn = jnp.min(jnp.where(valid, raw, big))
+        any_valid = ctx.combine_any(jnp.any(valid))
+        mx = ctx.combine_max(jnp.max(jnp.where(valid, raw, -big)))
+        mn = ctx.combine_min(jnp.min(jnp.where(valid, raw, big)))
         normalized = jnp.where(
             mx == 0, MAX_SCORE, MAX_SCORE * (mx + mn - raw) // jnp.maximum(mx, 1)
         )
@@ -642,7 +694,8 @@ def _terms_eval(static: "ScanStatic", state: "ScanState", u, node_valid, feature
     return ipa_ok, spread_ok, ipa_raw, soft_score
 
 
-def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit, features):
+def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit,
+                  features, ctx=LOCAL_CTX):
     """Rank-1 count updates after a commit (AddPod semantics of the
     PreFilterExtensions / next cycle's PreScore recomputation).
 
@@ -665,7 +718,9 @@ def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit
     soft_counts = state.soft_counts
 
     if features.terms:
-        val_at = static.topo_val[:, node]  # [T] placed node's values
+        # placed node's values: a cross-shard broadcast gather under
+        # the mesh ctx (the committed node lives on exactly one shard)
+        val_at = ctx.gather_cols(static.topo_val, node)  # [T]
         eq = (static.topo_val == val_at[:, None]) & (val_at >= 0)[:, None]
         eqi = eq.astype(jnp.int64)
         # target counts feed IPA filters/score, hard-spread skew checks,
@@ -678,7 +733,7 @@ def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit
         own_panti = own_panti + (static.carry_anti_pref_w[:, u] * inc)[:, None] * eqi
 
         # group counts: all A rows
-        g_val = static.g_topo_val[:, node]  # [A]
+        g_val = ctx.gather_cols(static.g_topo_val, node)  # [A]
         g_ok = g_val >= 0
         g_eq = (static.g_topo_val == g_val[:, None]) & g_ok[:, None]
         g_match = jnp.take(static.match_all[:, u], static.group_of_row)  # [A]
@@ -689,8 +744,8 @@ def _terms_commit(static: "ScanStatic", state: "ScanState", u, placement, commit
     if features.soft_spread:
         # soft spread counts: all Cs rows, restricted to qualifying
         # PLACED nodes (s_q gates who counts, not who reads)
-        s_val = static.s_topo_val[:, node]  # [Cs]
-        s_ok = (s_val >= 0) & static.s_q[:, node]
+        s_val = ctx.gather_cols(static.s_topo_val, node)  # [Cs]
+        s_ok = (s_val >= 0) & ctx.gather_cols(static.s_q, node)
         s_eq = (static.s_topo_val == s_val[:, None]) & s_ok[:, None]
         s_match = jnp.take(static.term_match[:, u], static.s_row)  # [Cs]
         s_inc = (s_match & s_ok).astype(jnp.int64) * inc
@@ -827,7 +882,14 @@ def _run_scan_compiled_impl(
     pinned_node,
     node_valid,
     pod_active,
+    ctx=LOCAL_CTX,
 ):
+    # `ctx` (static at trace time) abstracts the node axis: LOCAL_CTX
+    # is the whole-axis identity; the mesh-sharded scan passes a
+    # collective-aware ctx and LOCAL node slices, so each step scores
+    # its shard locally and combines max/min/sum/select across devices
+    # (parallel/mesh.py). Sample mode stays LOCAL-only — the Go-RNG
+    # prefix arithmetic is a serial scan over the full node axis.
     n = static.alloc_mcpu.shape[0]
 
     def step(state: ScanState, inp):
@@ -870,7 +932,7 @@ def _run_scan_compiled_impl(
             feasible = feasible & local_ok
         # InterPodAffinity + PodTopologySpread
         ipa_ok, spread_ok, ipa_raw, soft_score = _terms_eval(
-            static, state, u, node_valid, features
+            static, state, u, node_valid, features, ctx=ctx
         )
 
         feasible = feasible & ipa_ok & spread_ok
@@ -901,14 +963,18 @@ def _run_scan_compiled_impl(
             )
             total = total + balanced * w.balanced
         if w.nodeaff:
-            nodeaff = _default_normalize(static.nodeaff_raw[u], feasible, reverse=False)
+            nodeaff = _default_normalize(
+                static.nodeaff_raw[u], feasible, reverse=False, ctx=ctx
+            )
             total = total + nodeaff * w.nodeaff
         if w.tainttol:
-            tainttol = _default_normalize(static.taint_intol[u], feasible, reverse=True)
+            tainttol = _default_normalize(
+                static.taint_intol[u], feasible, reverse=True, ctx=ctx
+            )
             total = total + tainttol * w.tainttol
         if w.simon or w.gpushare:
             # Simon and Open-Gpu-Share share one formula (simon.go:44-67)
-            simon = _minmax_normalize(static.simon_raw[u], feasible)
+            simon = _minmax_normalize(static.simon_raw[u], feasible, ctx=ctx)
             total = total + simon * (w.simon + w.gpushare)
         if w.spread:
             # PodTopologySpread soft score (all MaxNodeScore when the pod
@@ -922,8 +988,12 @@ def _run_scan_compiled_impl(
         if features.ipa and w.ipa:
             # InterPodAffinity NormalizeScore (scoring.go:246-270): bounds
             # include 0, float divide, int64 truncation
-            ipa_mx = jnp.maximum(jnp.max(jnp.where(feasible, ipa_raw, 0)), 0)
-            ipa_mn = jnp.minimum(jnp.min(jnp.where(feasible, ipa_raw, 0)), 0)
+            ipa_mx = jnp.maximum(
+                ctx.combine_max(jnp.max(jnp.where(feasible, ipa_raw, 0))), 0
+            )
+            ipa_mn = jnp.minimum(
+                ctx.combine_min(jnp.min(jnp.where(feasible, ipa_raw, 0))), 0
+            )
             ipa_diff = (ipa_mx - ipa_mn).astype(jnp.float64)
             ipa = jnp.where(
                 ipa_diff > 0,
@@ -935,7 +1005,7 @@ def _run_scan_compiled_impl(
             total = total + ipa * w.ipa
         if features.storage and w.openlocal:
             # Open-Local plugin
-            total = total + _minmax_normalize(local_raw, feasible) * w.openlocal
+            total = total + _minmax_normalize(local_raw, feasible, ctx=ctx) * w.openlocal
         if features.custom:
             # out-of-tree custom plugins (static K, unrolled)
             for k_i in range(static.custom_raw.shape[0]):
@@ -948,17 +1018,25 @@ def _run_scan_compiled_impl(
                     if mode_k == 0:
                         score_k = raw_k
                     elif mode_k == 1:
-                        score_k = _default_normalize(raw_k, feasible, reverse=False)
+                        score_k = _default_normalize(
+                            raw_k, feasible, reverse=False, ctx=ctx
+                        )
                     elif mode_k == 2:
-                        score_k = _default_normalize(raw_k, feasible, reverse=True)
+                        score_k = _default_normalize(
+                            raw_k, feasible, reverse=True, ctx=ctx
+                        )
                     else:
-                        score_k = _minmax_normalize(raw_k, feasible)
+                        score_k = _minmax_normalize(raw_k, feasible, ctx=ctx)
                     total = total + score_k * weight_k
                     continue
                 mode = static.custom_mode[k_i]
-                norm_default = _default_normalize(raw_k, feasible, reverse=False)
-                norm_reverse = _default_normalize(raw_k, feasible, reverse=True)
-                norm_minmax = _minmax_normalize(raw_k, feasible)
+                norm_default = _default_normalize(
+                    raw_k, feasible, reverse=False, ctx=ctx
+                )
+                norm_reverse = _default_normalize(
+                    raw_k, feasible, reverse=True, ctx=ctx
+                )
+                norm_minmax = _minmax_normalize(raw_k, feasible, ctx=ctx)
                 score_k = jnp.where(
                     mode == 0,
                     raw_k,
@@ -973,7 +1051,7 @@ def _run_scan_compiled_impl(
         # ---- select: first max over feasible; pinned overrides ----
         neg = jnp.iinfo(jnp.int64).min
         masked = jnp.where(feasible, total, neg)
-        found = jnp.any(feasible)
+        found = ctx.combine_any(jnp.any(feasible))
         if features.sample:
             # reservoir sampling over ties with the Go math/rand
             # stream in the carry; pinned/inactive/unschedulable pods
@@ -987,7 +1065,7 @@ def _run_scan_compiled_impl(
             )
             new_rng_overflow = state.rng_overflow | step_ovf
         else:
-            best = jnp.argmax(masked)
+            best = ctx.first_max_index(masked)
             new_rng_hist = state.rng_hist
             new_rng_overflow = state.rng_overflow
         placement = jnp.where(found, best, -1)
@@ -995,7 +1073,7 @@ def _run_scan_compiled_impl(
             placement = jnp.where(pin >= 0, pin, placement)
             # a pod pinned to a masked-out node does not exist in this
             # scenario; never commit resources outside node_valid
-            pin_ok = node_valid[jnp.maximum(pin, 0)]
+            pin_ok = ctx.gather_vec(node_valid, jnp.maximum(pin, 0))
             placement = jnp.where((pin >= 0) & ~pin_ok, INACTIVE, placement)
         placement = jnp.where(active, placement, INACTIVE)
 
@@ -1004,11 +1082,8 @@ def _run_scan_compiled_impl(
         (
             tgt, own_anti, own_paff, own_panti,
             group_counts, group_total, soft_counts,
-        ) = _terms_commit(static, state, u, placement, commit, features)
-        onehot = (
-            jax.nn.one_hot(jnp.maximum(placement, 0), n, dtype=jnp.int64)
-            * commit.astype(jnp.int64)
-        )
+        ) = _terms_commit(static, state, u, placement, commit, features, ctx=ctx)
+        onehot = ctx.commit_onehot(placement, commit, n)
         new_state = ScanState(
             used_mcpu=state.used_mcpu + onehot * static.req_mcpu[u],
             used_mem=state.used_mem + onehot * static.req_mem[u],

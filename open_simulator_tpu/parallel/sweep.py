@@ -260,6 +260,16 @@ class CapacitySweep:
                 self._ds_target[p_i] = name_to_idx[target]
         self._probe_jit = None
         self._many_jit = None
+        # process-wide mesh (parallel/mesh.py configure/current_mesh,
+        # the --mesh flag): the layout planner decides PER REQUEST
+        # whether to shard the scenario axis (probe_many /
+        # probe_scenarios) or the node axis (single probes on big
+        # clusters) across it; None = the single-device ladder
+        from . import mesh as mesh_mod
+
+        self.mesh = mesh_mod.current_mesh()
+        self._node_plan = None  # padded node-sharded state, built lazily
+        self._mesh_retired = False  # a mesh rung fault retires the mesh
         # optional resumable journal (runtime/journal.py): probe()
         # serves journaled counts without touching the device and
         # appends every fresh result (attach_journal)
@@ -356,17 +366,38 @@ class CapacitySweep:
     def _probe_device(self, count: int) -> ProbeResult:
         from ..obs.costs import COSTS
         from ..obs.ledger import LEDGER
+        from . import mesh as mesh_mod
 
         valid = self.node_valid(count)
         steps = []
         if self._pallas_plan is not None:
             steps.append(("pallas", lambda: self._probe_pallas(count, valid)))
+        # node-axis mesh rung: ONE scenario over a cluster the planner
+        # says is too big (or predicted not to fit) on one device —
+        # each device scores its node shard, the winner reduces
+        # globally (parallel/mesh.py). A classified fault retires the
+        # rung for this sweep and the ladder continues unsharded.
+        if self._pallas_plan is None and not self._mesh_retired:
+            # site "sweep_probe": the single-device probe jit whose
+            # compiled records say whether one device can hold it
+            layout = mesh_mod.plan_layout(
+                "sweep_probe", mesh=self.mesh, n_scenarios=1,
+                n_nodes=self.n,
+                sample=bool(getattr(self.features, "sample", False)),
+            )
+            if layout.axis == "node":
+                steps.append(
+                    ("mesh-scan", lambda: self._probe_mesh(count, valid))
+                )
         steps.append(("xla-scan", lambda: self._probe_xla(count, valid)))
         steps.append(("serial-oracle", lambda: self._probe_serial(count, valid)))
 
         def on_downgrade(rung, _e):
             if rung == "pallas":
                 self._pallas_plan = None  # retire the dead rung
+            if rung == "mesh-scan":
+                self._mesh_retired = True
+                self._node_plan = None
 
         # predictive rung gate: once a rung's shape has compiled, the
         # memory ledger can veto re-dispatching it into a device that
@@ -378,6 +409,28 @@ class CapacitySweep:
         return run_laddered(
             steps, label="sweep-probe", on_downgrade=on_downgrade,
             predictor=predictor,
+        )
+
+    def _probe_mesh(self, count: int, valid) -> ProbeResult:
+        """One capacity probe through the node-axis-sharded scan: the
+        padded shard state is built once per sweep (NodeShardPlan), so
+        repeated probes pay only the masks' transfer."""
+        from ..utils.trace import phase
+        from . import mesh as mesh_mod
+
+        if self._node_plan is None:
+            self._node_plan = mesh_mod.NodeShardPlan(
+                self.mesh, self.static, self.init,
+                self.batch.class_of_pod, self.batch.pinned_node,
+                self.features,
+            )
+        with phase("sweep/probe"):
+            pl, unsched, cpu, mem, vg = self._node_plan.run(
+                valid, self.pod_active(valid)
+            )
+        return ProbeResult(
+            count=count, unscheduled=unsched, cpu_util=cpu,
+            mem_util=mem, vg_util=vg, placements=pl,
         )
 
     def _probe_pallas(self, count: int, valid) -> ProbeResult:
@@ -525,31 +578,43 @@ class CapacitySweep:
                 lead_argnum=0,
             )
 
-        def evaluate(lo, hi):
-            valid_j = jnp.asarray(node_valid[lo:hi])
-            active_j = jnp.asarray(pod_active[lo:hi])
-            if mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
+        # layout planner: an explicit mesh argument wins (the historic
+        # sweep_node_counts contract); otherwise the process-wide mesh
+        # shards the scenario axis when the planner picks it
+        from . import mesh as mesh_mod
 
-                axis = mesh.axis_names[0]
-                n_dev = mesh.devices.size
-                pad = (-(hi - lo)) % n_dev
-                if pad:
-                    valid_j = jnp.concatenate(
-                        [valid_j, jnp.repeat(valid_j[-1:], pad, 0)]
+        if mesh is None:
+            layout = mesh_mod.plan_layout(
+                "sweep_many", mesh=self.mesh, n_scenarios=sc,
+                n_nodes=self.n,
+                sample=bool(getattr(self.features, "sample", False)),
+            )
+            if layout.axis == "scenario":
+                mesh = self.mesh
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
+
+        def evaluate(lo, hi):
+            nonlocal mesh
+            if mesh is not None:
+                try:
+                    (valid_s, active_s), _rows = mesh_mod.shard_scenario_rows(
+                        mesh, [node_valid[lo:hi], pod_active[lo:hi]]
                     )
-                    active_j = jnp.concatenate(
-                        [active_j, jnp.repeat(active_j[-1:], pad, 0)]
-                    )
-                sharding = NamedSharding(mesh, P(axis))
-                valid_j = jax.device_put(valid_j, sharding)
-                active_j = jax.device_put(active_j, sharding)
-                out = self._many_jit(valid_j, active_j)
-                arrays = [np.asarray(o)[: hi - lo] for o in out]
-            else:
-                out = self._many_jit(valid_j, active_j)
-                arrays = [np.asarray(o) for o in out]
-            return list(zip(*arrays))
+                    out = self._many_jit(valid_s, active_s)
+                    arrays = [np.asarray(o)[: hi - lo] for o in out]
+                    return list(zip(*arrays))
+                except (RuntimeError, MemoryError, OSError) as e:
+                    from ..runtime.guard import try_downgrade
+
+                    if not try_downgrade(
+                        e, label="sweep", frm="mesh-scenario", to="xla-scan"
+                    ):
+                        raise
+                    mesh = None
+            out = self._many_jit(
+                jnp.asarray(node_valid[lo:hi]), jnp.asarray(pod_active[lo:hi])
+            )
+            return list(zip(*(np.asarray(o) for o in out)))
 
         def serial_fallback(i):
             placements, _ = self.serial_scenario(node_valid[i], pod_active[i])
@@ -557,9 +622,18 @@ class CapacitySweep:
 
         from ..obs.costs import COSTS
 
+        # estimator + shard count re-read per chunk (mid-run mesh
+        # downgrade flips later chunks to full-size prediction)
+        est_plain = COSTS.chunk_estimator("sweep_many")
+        est_shard = COSTS.chunk_estimator("sweep_many", shards=n_dev)
+
+        def estimate(lo, hi):
+            return (est_shard if mesh is not None else est_plain)(lo, hi)
+
         rows = run_chunked(
             evaluate, sc, label="sweep", serial_fallback=serial_fallback,
-            budget=budget, estimate=COSTS.chunk_estimator("sweep_many"),
+            budget=budget, estimate=estimate,
+            shards=lambda: n_dev if mesh is not None else 1,
         )
         placements, unsched, cpu_util, mem_util, vg_util = (
             np.stack([np.asarray(r[k]) for r in rows]) for k in range(5)
@@ -696,8 +770,16 @@ class CapacitySweep:
         Runs on the XLA masked scan (the Pallas plan is compiled for
         the batch's original pin feature set); chaos batches are
         scenario-bound, not pod-throughput-bound, so this is the
-        latency-appropriate path."""
+        latency-appropriate path. With a process-wide mesh the layout
+        planner shards the scenario axis across it (rows are
+        independent; the only communication is the result gather) via
+        a per-site ``mesh_<site>`` jit family, so sharded dispatch and
+        injection seams (``jit.mesh_*``) stay separately attributable;
+        a classified device fault on the sharded path degrades to the
+        unsharded ladder, trace-noted."""
         import jax.numpy as jnp
+
+        from . import mesh as mesh_mod
 
         node_valid = np.asarray(node_valid)
         pod_active = np.asarray(pod_active)
@@ -705,8 +787,37 @@ class CapacitySweep:
         sc = node_valid.shape[0]
         site_jit = _scenario_rows_jit(site)
         cls = jnp.asarray(self.batch.class_of_pod)
+        layout = mesh_mod.plan_layout(
+            f"{site}_sweep", mesh=self.mesh, n_scenarios=sc, n_nodes=self.n,
+            sample=bool(getattr(self.features, "sample", False)),
+        )
+        mesh = self.mesh if layout.axis == "scenario" else None
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
+        mesh_jit = _scenario_rows_jit(f"mesh_{site}") if mesh is not None else None
 
         def evaluate(lo, hi):
+            nonlocal mesh
+            if mesh is not None:
+                try:
+                    (valid_s, active_s, pin_s), _rows = (
+                        mesh_mod.shard_scenario_rows(
+                            mesh,
+                            [node_valid[lo:hi], pod_active[lo:hi], pinned[lo:hi]],
+                        )
+                    )
+                    out = mesh_jit(
+                        self.static, self.init, cls,
+                        valid_s, active_s, pin_s, self.features,
+                    )
+                    return list(zip(*(np.asarray(o)[: hi - lo] for o in out)))
+                except (RuntimeError, MemoryError, OSError) as e:
+                    from ..runtime.guard import try_downgrade
+
+                    if not try_downgrade(
+                        e, label=site, frm="mesh-scenario", to="xla-scan"
+                    ):
+                        raise
+                    mesh = None
             out = site_jit(
                 self.static,
                 self.init,
@@ -726,9 +837,19 @@ class CapacitySweep:
 
         from ..obs.costs import COSTS
 
+        # estimator + shard count re-read per chunk: a mid-run mesh
+        # downgrade inside evaluate() must flip later chunks back to
+        # full-size single-device prediction arithmetic
+        est_plain = COSTS.chunk_estimator(f"{site}_sweep")
+        est_shard = COSTS.chunk_estimator(f"{site}_sweep", shards=n_dev)
+
+        def estimate(lo, hi):
+            return (est_shard if mesh is not None else est_plain)(lo, hi)
+
         rows = run_chunked(
             evaluate, sc, label=site, serial_fallback=serial_fallback,
-            budget=budget, estimate=COSTS.chunk_estimator(f"{site}_sweep"),
+            budget=budget, estimate=estimate,
+            shards=lambda: n_dev if mesh is not None else 1,
         )
         placements = np.stack([np.asarray(r[0]) for r in rows])
         unsched = np.array([int(r[1]) for r in rows], dtype=np.int64)

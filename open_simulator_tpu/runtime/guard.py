@@ -192,6 +192,7 @@ def run_chunked(
     trace=None,
     budget=None,
     estimate=None,
+    shards=1,
 ):
     """Evaluate items [0, n_items) in device batches with bounded
     halving-retry on device OOM (a 10k-scenario vmap that exhausts
@@ -219,6 +220,17 @@ def run_chunked(
     (``ledger_predict_hit_total`` / ``ledger_predict_miss_total``) so
     CI can gate on the ledger staying honest; estimate=None (or an
     unknown budget) leaves the reactive behavior exactly as before.
+
+    ``shards`` is the device count of a mesh-sharded dispatch
+    (parallel/mesh.py) — an int, or a CALLABLE re-read per chunk so a
+    mid-run mesh downgrade inside ``evaluate`` (classified fault ->
+    unsharded) flips the later chunks' predictions back to full-size
+    arithmetic. The estimate is then PER-DEVICE bytes (the shard-aware
+    chunk estimator divides the batched-axis workspace by the shard
+    count) and the ledger's fit verdict compares it against the
+    TIGHTEST device's headroom — without this, a sharded dispatch
+    would be predicted at full-replica size and spuriously
+    chunk-split.
 
     ``budget.check`` runs between chunks (the executor's safe
     boundary); on expiry/interrupt the raised ``ExecutionHalted``
@@ -262,7 +274,10 @@ def run_chunked(
             if est is not None:
                 from ..obs.ledger import LEDGER
 
-                predicted_fit = LEDGER.predict_fit(int(est), label=label)
+                cur_shards = shards() if callable(shards) else shards
+                predicted_fit = LEDGER.predict_fit(
+                    int(est), label=label, shards=cur_shards
+                )
                 if predicted_fit is False and hi - lo > 1:
                     COUNTERS.inc("guard_oom_predicted_total")
                     mid = (lo + hi) // 2

@@ -79,6 +79,12 @@ class TpuEngine:
         # cumsum) of the last dispatched scan — rewind_sample_rng uses
         # it when a priority-scan escape discards the scanned tail
         self._last_rng = None
+        # device mesh override (None = the process-wide configured
+        # mesh, parallel/mesh.py current_mesh): the layout planner
+        # routes single big-cluster scans through the node-sharded
+        # path and scenario batches across the scenario axis
+        self.mesh = None
+        self._mesh_retired = False
 
     def cluster_static(self) -> ClusterStatic:
         # keyed on (node count, alloc epoch): GPU-share Reserve mutates
@@ -180,13 +186,35 @@ class TpuEngine:
                             np.array(hist0, dtype=np.uint64)
                         )
                     )
+        # node-axis mesh route: ONE scan over a cluster the layout
+        # planner says belongs on the mesh (too big / predicted unfit
+        # for one device) — the twin's 100k-node drain/what-if queries
+        # ride this (parallel/mesh.py). Classified faults degrade to
+        # the single-device path below, trace-noted.
+        mesh_route = None
+        if plan is None and not sample and not self._mesh_retired:
+            from ..parallel import mesh as mesh_mod
+
+            m = self.mesh if self.mesh is not None else mesh_mod.current_mesh()
+            if m is not None:
+                # site "scan": the single-device masked scan whose
+                # compiled records say whether ONE device can hold it
+                layout = mesh_mod.plan_layout(
+                    "scan", mesh=m, n_scenarios=1, n_nodes=cluster.n,
+                    sample=sample,
+                )
+                if layout.axis == "node":
+                    mesh_route = m
         # never a silent fallback: name why the fused kernel was out of
         # scope or unavailable (pallas_scan.fallback_reason)
         GLOBAL.note(
             "batch-kernel",
             pallas_scan.kernel_label(plan)
             if plan is not None
-            else f"xla-scan ({pallas_scan.fallback_reason()})",
+            else (
+                "mesh-scan" if mesh_route is not None
+                else f"xla-scan ({pallas_scan.fallback_reason()})"
+            ),
         )
         if plan is not None:
             # fused single-kernel fast path; bit-identical placements
@@ -200,6 +228,30 @@ class TpuEngine:
                     pinned=batch.pinned_node,
                 )
             return np.asarray(out)
+        if mesh_route is not None:
+            from ..parallel import mesh as mesh_mod
+
+            try:
+                with profiled("engine/scan"):
+                    out, *_stats = mesh_mod.run_node_sharded(
+                        mesh_route,
+                        self._scan_static,
+                        init,
+                        batch.class_of_pod,
+                        batch.pinned_node,
+                        node_valid,
+                        np.asarray(active, bool),
+                        self._features,
+                    )
+                return np.asarray(out)
+            except (RuntimeError, MemoryError, OSError) as e:
+                from ..runtime.guard import try_downgrade
+
+                if not try_downgrade(
+                    e, label="engine-scan", frm="mesh-scan", to="xla-scan"
+                ):
+                    raise
+                self._mesh_retired = True
         with profiled("engine/scan"):
             placements, final_state = scan_ops.run_scan_masked(
                 self._scan_static,
@@ -267,17 +319,61 @@ class TpuEngine:
                 self._scan_static_cluster = cluster
             init = to_scan_state(dyn, batch)
         actives_arr = np.asarray(actives, bool)
-        with profiled("engine/scan"):
-            out = _scenario_scan_jit()(
-                self._scan_static,
-                init,
-                jnp.asarray(batch.class_of_pod),
-                jnp.asarray(batch.pinned_node),
-                jnp.ones(cluster.n, bool),
-                jnp.asarray(actives_arr),
-                self._features,
+        # scenario-axis sharding: coalesced request rows are
+        # independent, so a configured mesh splits them across devices
+        # ("computation follows sharding" — the jit compiles an SPMD
+        # partition per observed input sharding); a classified device
+        # fault degrades to the unsharded dispatch, trace-noted
+        from ..parallel import mesh as mesh_mod
+
+        m = self.mesh if self.mesh is not None else mesh_mod.current_mesh()
+        mesh_route = None
+        if m is not None and not self._mesh_retired:
+            layout = mesh_mod.plan_layout(
+                "scenario_scan", mesh=m,
+                n_scenarios=int(actives_arr.shape[0]), n_nodes=cluster.n,
             )
-        out = np.asarray(out)
+            if layout.axis == "scenario":
+                mesh_route = m
+        out = None
+        if mesh_route is not None:
+            try:
+                (actives_s,), rows = mesh_mod.shard_scenario_rows(
+                    mesh_route, [actives_arr]
+                )
+                with profiled("engine/scan"):
+                    out = _scenario_scan_jit()(
+                        self._scan_static,
+                        init,
+                        jnp.asarray(batch.class_of_pod),
+                        jnp.asarray(batch.pinned_node),
+                        jnp.ones(cluster.n, bool),
+                        actives_s,
+                        self._features,
+                    )
+                out = np.asarray(out)[:rows]
+            except (RuntimeError, MemoryError, OSError) as e:
+                from ..runtime.guard import try_downgrade
+
+                if not try_downgrade(
+                    e, label="scenario-scan", frm="mesh-scenario",
+                    to="xla-scan",
+                ):
+                    raise
+                self._mesh_retired = True
+                out = None
+        if out is None:
+            with profiled("engine/scan"):
+                out = _scenario_scan_jit()(
+                    self._scan_static,
+                    init,
+                    jnp.asarray(batch.class_of_pod),
+                    jnp.asarray(batch.pinned_node),
+                    jnp.ones(cluster.n, bool),
+                    jnp.asarray(actives_arr),
+                    self._features,
+                )
+            out = np.asarray(out)
         from ..obs import profile
 
         profile.record_h2d(actives_arr.nbytes)

@@ -440,6 +440,34 @@ def _observatory_lines(snap: dict) -> List[str]:
         "Peak device bytes observed by the ledger this process.",
         gauges.get("device_mem_peak_bytes", 0),
     )
+    # per-device rows: every mesh device, labeled — a sharded dispatch
+    # lives or dies on the TIGHTEST shard, not the device-0 number
+    from ..obs.ledger import LEDGER
+
+    per_device = LEDGER.device_summary()
+    if per_device:
+        lines.append(
+            "# HELP simon_device_mem_device_bytes_in_use Device bytes in "
+            "use at the last ledger poll, per device."
+        )
+        lines.append("# TYPE simon_device_mem_device_bytes_in_use gauge")
+        for row in per_device:
+            lines.append(
+                f'simon_device_mem_device_bytes_in_use{{device="{row["device"]}"}} '
+                f"{row['in_use']}"
+            )
+        if any(row.get("limit") for row in per_device):
+            lines.append(
+                "# HELP simon_device_mem_device_bytes_limit Per-device "
+                "allocator budget (or the even SIMON_DEVICE_MEM_BUDGET slice)."
+            )
+            lines.append("# TYPE simon_device_mem_device_bytes_limit gauge")
+            for row in per_device:
+                if row.get("limit"):
+                    lines.append(
+                        f'simon_device_mem_device_bytes_limit{{device="{row["device"]}"}} '
+                        f"{row['limit']}"
+                    )
     for key, help_text in (
         ("ledger_predictions_total", "predict_fit verdicts issued."),
         ("ledger_predict_fit_total", "Dispatches predicted to fit."),
@@ -449,6 +477,9 @@ def _observatory_lines(snap: dict) -> List[str]:
         ("guard_oom_predicted_total", "Chunks split/degraded predictively, zero doomed dispatches."),
         ("guard_oom_reactive_total", "Device OOMs caught reactively (the halving fallback)."),
         ("guard_rung_predicted_skips_total", "Ladder rungs skipped on a ledger verdict."),
+        ("mesh_layout_scenario_total", "Dispatches the layout planner sharded on the scenario axis."),
+        ("mesh_layout_node_total", "Dispatches the layout planner sharded on the node axis."),
+        ("mesh_layout_none_total", "Dispatches the planner kept on the single-device ladder."),
     ):
         metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
     # -- latency histograms (obs/histo.py)

@@ -106,6 +106,15 @@ TWIN_CELLS = [
     ("ConformanceError", "twin", "twin.apply_delta=conformance@1"),
 ]
 
+# mesh-sharded dispatch seams (parallel/mesh.py): a classified fault
+# on a sharded dispatch (jit.mesh_*) degrades down the existing guard
+# ladder to the single-device path with IDENTICAL results — driven
+# in-process below
+MESH_CELLS = [
+    ("DeviceOOM", "mesh", "jit.mesh_*=oom@1"),
+    ("CompileFailure", "mesh", "jit.mesh_*=compile@1"),
+]
+
 #: taxonomy class name -> matrix cell ids proving its injection
 #: coverage. simonlint RT002 statically requires every GuardError
 #: subtype to appear here; test_registry_is_closed_over_cells keeps
@@ -114,11 +123,12 @@ INJECTION_COVERAGE = {
     "GuardError": ["GuardError/serve"],
     "DeviceOOM": [
         "DeviceOOM/apply", "DeviceOOM/chaos", "DeviceOOM/timeline",
-        "DeviceOOM/serve",
+        "DeviceOOM/serve", "DeviceOOM/mesh",
     ],
     "CompileFailure": [
         "CompileFailure/apply", "CompileFailure/chaos",
         "CompileFailure/timeline", "CompileFailure/serve",
+        "CompileFailure/mesh",
     ],
     "BackendUnavailable": [
         "BackendUnavailable/apply", "BackendUnavailable/timeline",
@@ -153,6 +163,7 @@ def test_registry_is_closed_over_cells():
     live |= {f"{e}/{s}" for e, s, *_ in SERVE_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in IO_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in TWIN_CELLS}
+    live |= {f"{e}/{s}" for e, s, *_ in MESH_CELLS}
     registered = {cid for ids in INJECTION_COVERAGE.values() for cid in ids}
     assert registered == live, (
         f"registry drift: only-registered={sorted(registered - live)} "
@@ -660,3 +671,61 @@ def _write_cli_config(tmp_path, tag="m", n_nodes=2, replicas=6):
         )
     )
     return str(cfg)
+
+
+# --------------------------------------------------------------- mesh cells
+
+
+def _mesh_sweep():
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.parallel import mesh as mesh_mod
+    from open_simulator_tpu.parallel.sweep import CapacitySweep
+    from open_simulator_tpu.scheduler.core import AppResource
+
+    cluster = ResourceTypes()
+    cluster.nodes = [_node(f"base-{i}") for i in range(6)]
+    res = ResourceTypes()
+    res.deployments = [_deploy("web", 16)]
+    sweep = CapacitySweep(
+        cluster, [AppResource("m", res)], _node("template"), max_count=4
+    )
+    sweep.mesh = mesh_mod.mesh_from_spec("auto")
+    assert sweep.mesh is not None, "conftest forces an 8-device CPU mesh"
+    return sweep
+
+
+@pytest.mark.parametrize(
+    "error,_subsystem,spec",
+    MESH_CELLS,
+    ids=[f"{e}-mesh" for e, _s, _sp in MESH_CELLS],
+)
+def test_mesh_cell_fault_degrades_to_single_device(error, _subsystem, spec):
+    """DeviceOOM|CompileFailure/mesh: a classified fault on a
+    mesh-sharded dispatch (jit.mesh_* seam) degrades down the existing
+    guard ladder to the single-device path — the run completes with
+    placements IDENTICAL to the unsharded answer, the downgrade is
+    trace-noted, and the injection counter proves the fault fired."""
+    import numpy as np
+
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    sweep = _mesh_sweep()
+    sc = 4
+    valids = np.stack([sweep.node_valid(c) for c in range(sc)])
+    actives = np.stack([sweep.pod_active(v) for v in valids])
+    pins = np.tile(np.asarray(sweep.batch.pinned_node), (sc, 1))
+    fired0 = COUNTERS.get("inject_fired_total")
+    INJECT.configure(spec)
+    try:
+        sharded = sweep.probe_scenarios(valids, actives, pins, site="chaos")
+    finally:
+        INJECT.clear()
+    assert COUNTERS.get("inject_fired_total") > fired0, "fault never fired"
+    sweep.mesh = None
+    plain = sweep.probe_scenarios(valids, actives, pins, site="chaos")
+    for got, want in zip(sharded, plain):
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+    notes = GLOBAL.as_dict().get("notes") or {}
+    assert any("mesh-scenario -> xla-scan" in str(v) for v in notes.values()), (
+        "downgrade not trace-noted", notes,
+    )

@@ -24,6 +24,7 @@ import argparse
 import logging
 import os
 import sys
+import time
 
 from . import __version__
 
@@ -624,6 +625,10 @@ def cmd_serve(args) -> int:
             raise InputError("--max-request-pods must be >= 1")
         if args.max_sessions < 1:
             raise InputError("--max-sessions must be >= 1")
+        # declarative SLOs + telemetry cadence: a bad --slo-config or
+        # --obs-cadence raises InputError here (the daemon constructor
+        # validates the cadence) -> exit 2 before listening
+        slo_engine = _build_slo_engine(args)
         # resident service: circuit breakers get a recovery cooldown so
         # an apiserver/extender flap degrades, not dooms, the daemon.
         # SIMON_BREAKER_COOLDOWN wins when set (0 restores the one-shot
@@ -648,10 +653,18 @@ def cmd_serve(args) -> int:
             max_request_pods=args.max_request_pods,
             max_sessions=args.max_sessions,
             snapshot_path=args.snapshot or None,
+            slo_engine=slo_engine,
+            obs_cadence_s=args.obs_cadence,
         )
     except (OSError, ValueError, ExternalIOError, InputError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    # continuous flight recorder: the resident daemon records into a
+    # bounded ring (overwrite-oldest, dropped counted) so /debug/dump
+    # always has a recent span window — --trace-out still owns export
+    from .obs.telemetry import arm_flight_recorder
+
+    arm_flight_recorder()
     if not args.no_warm:
         # one tiny request through the whole path before we listen:
         # cluster static encode + scenario-scan jit are warm, so the
@@ -1186,6 +1199,7 @@ def cmd_twin(args) -> int:
                 "--max-catchup must be >= 1 (0 would never apply the "
                 "backlog and the mirror would stop advancing)"
             )
+        slo_engine = _build_slo_engine(args)
         # resident service: breakers recover (the serve posture)
         from .runtime.retry import BREAKER_COOLDOWN_ENV, enable_breaker_recovery
 
@@ -1231,12 +1245,17 @@ def cmd_twin(args) -> int:
             tick_budget_s=args.tick_budget,
             max_request_pods=args.max_request_pods,
             drain_timeout_s=args.drain_timeout,
+            slo_engine=slo_engine,
+            obs_cadence_s=args.obs_cadence,
         )
     except (OSError, ValueError, ExternalIOError, InputError) as e:
         if client is not None:
             client.close()
         print(f"error: {e}", file=sys.stderr)
         return 2
+    from .obs.telemetry import arm_flight_recorder
+
+    arm_flight_recorder()
     daemon.start()
     # machine-parsable readiness line (tests and the CI smoke read the
     # bound port from it — --port 0 binds an ephemeral one)
@@ -1304,6 +1323,124 @@ def cmd_doctor(args) -> int:
             print(f"simon doctor: cannot write --out: {e}", file=sys.stderr)
             return 2
     return 0 if report.ok else 1
+
+
+def _fetch_json(url: str, timeout: float):
+    """GET a daemon endpoint, decode JSON. Raises ExternalIOError with
+    the endpoint on any transport/decode failure (exit 1/2 mapping is
+    the caller's)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from .runtime import ExternalIOError
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (OSError, urllib.error.URLError, ValueError) as e:
+        raise ExternalIOError(f"cannot read {url}: {e}", endpoint=url) from e
+
+
+def cmd_top(args) -> int:
+    """Live terminal dashboard against a RUNNING serve/twin daemon
+    (obs/telemetry.py): polls /v1/obs/snapshot + /v1/obs/series and
+    renders health, SLO burn rates, and sparklined history — the
+    `kubectl top`-shaped view of a resident simon daemon. --once
+    prints a single frame (CI smoke); --format json dumps the raw
+    snapshot. Exit 0 on a clean stop (Ctrl-C included), 1 when the
+    daemon is unreachable, 2 on input errors."""
+    import json as _json
+
+    from .obs import telemetry as _tm
+    from .runtime import ExternalIOError
+
+    url = (args.url or f"http://{args.host}:{args.port}").rstrip("/")
+    if args.interval <= 0:
+        print("error: --interval must be > 0 seconds", file=sys.stderr)
+        return 2
+    names = list(args.series or ())
+
+    def fetch():
+        from urllib.parse import quote
+
+        snapshot = _fetch_json(f"{url}/v1/obs/snapshot", args.timeout)
+        want = names or [
+            n
+            for n in _tm.TOP_DEFAULT_SERIES
+            if n in (snapshot.get("latest") or {})
+        ]
+        qs = "&".join(f"name={quote(n, safe='')}" for n in want)
+        series = (
+            _fetch_json(
+                f"{url}/v1/obs/series?{qs}&sinceSeconds={args.window:g}",
+                args.timeout,
+            )
+            if want
+            else {"series": {}}
+        )
+        return snapshot, series
+
+    try:
+        snapshot, series = fetch()
+    except ExternalIOError as e:
+        print(f"simon top: {e}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(_json.dumps({"snapshot": snapshot, "series": series}, indent=2))
+        return 0
+    if args.once:
+        print(_tm.render_top_frame(snapshot, series, url))
+        return 0
+    try:
+        while True:
+            # ANSI home+clear per frame: a live dashboard, not a scroll
+            print("\x1b[2J\x1b[H" + _tm.render_top_frame(snapshot, series, url), flush=True)
+            time.sleep(args.interval)
+            try:
+                snapshot, series = fetch()
+            except ExternalIOError as e:
+                print(f"simon top: {e}", file=sys.stderr)
+                return 1
+    except KeyboardInterrupt:
+        return 0
+
+
+def _build_slo_engine(args):
+    """--slo-config as an SLOEngine (None when unset) — shared by the
+    serve and twin daemons. Raises InputError on a bad config; the
+    callers' guarded setup blocks turn that into exit 2 before
+    listening."""
+    if not getattr(args, "slo_config", ""):
+        return None
+    from .obs.slo import SLOEngine, load_slo_config
+
+    return SLOEngine(load_slo_config(args.slo_config))
+
+
+def _add_telemetry_flags(p: argparse.ArgumentParser):
+    """Resident-telemetry flags shared by the serve and twin daemons
+    (docs/OBSERVABILITY.md production-telemetry section)."""
+    p.add_argument(
+        "--slo-config",
+        default="",
+        metavar="PATH",
+        help="declarative SLO objectives (JSON or YAML; kinds: "
+        "availability, latency, gauge_min, counter_budget) evaluated "
+        "over the resident series store with multi-window burn-rate "
+        "alerts — alert states export as simon_slo_* metrics and "
+        "/healthz reasons",
+    )
+    p.add_argument(
+        "--obs-cadence",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="telemetry sampling cadence: every counter/gauge, "
+        "histogram percentile, and ledger level lands in the ring "
+        "store (queryable at /v1/obs/series, rendered by `simon top`) "
+        "once per cadence",
+    )
 
 
 def cmd_version(_args) -> int:
@@ -1748,6 +1885,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_inject_flag(p_serve)
     _add_obs_flags(p_serve)
+    _add_telemetry_flags(p_serve)
     p_serve.set_defaults(func=cmd_serve)
 
     p_shadow = sub.add_parser(
@@ -2119,7 +2257,53 @@ def build_parser() -> argparse.ArgumentParser:
         "0 disables recovery)",
     )
     _add_obs_flags(p_twin)
+    _add_telemetry_flags(p_twin)
     p_twin.set_defaults(func=cmd_twin)
+
+    p_top = sub.add_parser(
+        "top",
+        help="live terminal dashboard against a running serve/twin daemon",
+        description="Poll a RUNNING daemon's /v1/obs/snapshot and "
+        "/v1/obs/series endpoints and render a live dashboard: health "
+        "and degradation reasons, SLO burn rates and alert states, and "
+        "sparklined history of the key operational signals (QPS, queue "
+        "depth, latency percentiles, agreement rate, device memory). "
+        "The daemon side is the resident telemetry store "
+        "(docs/OBSERVABILITY.md); `simon top` is a pure reader — it "
+        "never perturbs the daemon beyond two GETs per refresh.",
+    )
+    p_top.add_argument(
+        "--url", default="",
+        help="daemon base URL (wins over --host/--port)",
+    )
+    p_top.add_argument("--host", default="127.0.0.1", help="daemon host")
+    p_top.add_argument("--port", type=int, default=8080, help="daemon port")
+    p_top.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECONDS",
+        help="refresh interval",
+    )
+    p_top.add_argument(
+        "--window", type=float, default=300.0, metavar="SECONDS",
+        help="history window rendered in the sparklines",
+    )
+    p_top.add_argument(
+        "--series", action="append", metavar="NAME",
+        help="render this series instead of the curated defaults "
+        "(repeatable; names as listed by GET /v1/obs/series)",
+    )
+    p_top.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (no screen clearing; CI smoke)",
+    )
+    p_top.add_argument(
+        "--timeout", type=float, default=5.0, metavar="SECONDS",
+        help="per-request HTTP timeout",
+    )
+    p_top.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="json dumps the raw snapshot+series instead of rendering",
+    )
+    p_top.set_defaults(func=cmd_top)
 
     p_doctor = sub.add_parser(
         "doctor",

@@ -190,6 +190,34 @@ class HistogramRegistry:
 
 HISTOS = HistogramRegistry()
 
+
+def percentile_from_counts(counts: List[int], q: float) -> float:
+    """Percentile (seconds) from a raw 64-bucket count vector — the
+    telemetry sampler's INTERVAL percentiles are computed from bucket
+    DELTAS between two samples of a cumulative histogram, so a
+    latency regression shows up at full strength in the next sample
+    instead of being diluted into the process-lifetime distribution.
+    Same landing-bucket interpolation as Histogram.percentile (without
+    the observed min/max clamp — deltas carry no min/max); the
+    overflow bucket answers its lower bound. 0.0 when empty."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = max(1, min(total, int(math.ceil(q / 100.0 * total))))
+    cum = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if cum + c >= rank:
+            lo = 0.0 if i == 0 else _UPPER[i - 1]
+            hi = _UPPER[i]
+            if math.isinf(hi):
+                return _UPPER[i - 1]
+            frac = (rank - cum - 0.5) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return _UPPER[-2]
+
 # the subset of bucket boundaries exported as Prometheus `le` labels
 # (cumulative, so any subset stays correct); every 4th + +Inf keeps
 # the exposition ~17 lines per site instead of 65

@@ -60,6 +60,40 @@ def set_boundary_hook(fn) -> None:
     _BOUNDARY_HOOK = fn
 
 
+# request-correlation provider (obs/telemetry.py): answers the calling
+# context's request ID (or None). A settable slot keeps this module
+# stdlib-only; when installed, every recorded span is stamped with a
+# `request_id` attr automatically, so a request's whole subtree —
+# admission, queue wait, coalesced dispatch, reply — is greppable by
+# one ID in any exported trace.
+_RID_PROVIDER = None
+
+# dropped-span counter hook (utils/trace.py installs one that feeds
+# COUNTERS "spans_dropped_total" and the trace notes): truncation must
+# be observable wherever the spans end up
+_DROP_HOOK = None
+
+
+def set_request_id_provider(fn) -> None:
+    global _RID_PROVIDER
+    _RID_PROVIDER = fn
+
+
+def set_drop_hook(fn) -> None:
+    global _DROP_HOOK
+    _DROP_HOOK = fn
+
+
+def _count_drop(n: int = 1) -> None:
+    hook = _DROP_HOOK
+    if hook is None:
+        return
+    try:
+        hook(n)
+    except Exception:  # noqa: BLE001,S110 - drop accounting must never fail the traced work
+        pass
+
+
 @dataclass
 class SpanRecord:
     """One closed span. Times are seconds relative to the recorder's
@@ -140,16 +174,33 @@ class JsonlSink:
 class Recorder:
     """Process-wide span store. enable()/disable() bracket a recording
     session; spans closing while disabled are dropped silently (a
-    thread may still be inside a span when the CLI disables at exit)."""
+    thread may still be inside a span when the CLI disables at exit).
 
-    # hard cap so a pathological run cannot grow the recorder without
-    # bound; overflow increments `dropped` instead of failing the run
+    Two overflow postures past ``max_spans``:
+
+    - cap mode (``ring=False``, the one-shot CLI default): newest
+      spans drop, the recorded prefix stays intact — a bounded trace
+      of how the run STARTED;
+    - ring mode (``ring=True``, the resident daemons): the OLDEST span
+      is overwritten — a continuous flight recorder whose window is
+      always the most recent activity, which is what a live
+      ``/debug/dump`` needs.
+
+    Either way every lost span increments ``dropped`` and fires the
+    drop hook (COUNTERS ``spans_dropped_total`` + a trace note), so a
+    truncated trace is detectable, never silent."""
+
+    # default bound so a pathological run cannot grow the recorder
+    # without limit; daemons arm smaller rings (obs/telemetry.py)
     MAX_SPANS = 250_000
 
     def __init__(self):
         self.enabled = False
+        self.ring = False
+        self.max_spans = self.MAX_SPANS
         self._lock = threading.Lock()
         self._spans: List[SpanRecord] = []
+        self._ring_pos = 0
         self._next_id = 1
         self.dropped = 0
         self._epoch = 0.0
@@ -158,6 +209,7 @@ class Recorder:
     def enable(self, sink: Optional[JsonlSink] = None):
         with self._lock:
             self._spans = []
+            self._ring_pos = 0
             self._next_id = 1
             self.dropped = 0
             self._epoch = time.perf_counter()
@@ -174,12 +226,43 @@ class Recorder:
     def reset(self):
         with self._lock:
             self._spans = []
+            self._ring_pos = 0
             self._next_id = 1
             self.dropped = 0
 
-    def snapshot(self) -> List[SpanRecord]:
+    @property
+    def count(self) -> int:
+        """Resident span count, O(1) — /metrics and snapshot polls
+        must not copy a 100k-span ring just to report its size."""
         with self._lock:
+            return len(self._spans)
+
+    def snapshot(self) -> List[SpanRecord]:
+        """Recorded spans, oldest first (ring rotation unwound)."""
+        with self._lock:
+            if self.ring and len(self._spans) == self.max_spans:
+                pos = self._ring_pos
+                return self._spans[pos:] + self._spans[:pos]
             return list(self._spans)
+
+    # audited: every caller invokes this WITH self._lock held (span's
+    # close path and record_span both take it around the call); the
+    # helper exists so the cap-vs-ring posture lives in one place
+    def _store(self, rec: SpanRecord) -> Optional[JsonlSink]:  # simonlint: disable=CONC001
+        """Append one closed span — caller MUST hold self._lock (cap
+        vs ring posture); returns the sink to emit to (outside the
+        lock), or None. Caller fires the drop hook when `dropped`
+        advanced."""
+        if len(self._spans) < self.max_spans:
+            self._spans.append(rec)
+        elif self.ring:
+            self._spans[self._ring_pos] = rec
+            self._ring_pos = (self._ring_pos + 1) % self.max_spans
+            self.dropped += 1
+        else:
+            self.dropped += 1
+            return None
+        return self._sink
 
     @contextmanager
     def span(self, name: str, **attrs):
@@ -200,6 +283,14 @@ class Recorder:
             # epoch or the span's duration is garbage
             epoch = self._epoch
         parent = _parent.get()
+        rid_fn = _RID_PROVIDER
+        if rid_fn is not None and "request_id" not in attrs:
+            try:
+                rid = rid_fn()
+            except Exception:  # noqa: BLE001 - correlation must never fail the traced work
+                rid = None
+            if rid is not None:
+                attrs["request_id"] = rid
         token = _parent.set(sid)
         hook = _BOUNDARY_HOOK if parent is None else None
         hook_token = None
@@ -235,13 +326,13 @@ class Recorder:
                 # (contextlib reads the generator's clean exit as
                 # "exception suppressed")
                 if self.enabled:
-                    if len(self._spans) < self.MAX_SPANS:
-                        self._spans.append(rec)
-                    else:
-                        self.dropped += 1
-                    sink = self._sink
+                    before = self.dropped
+                    sink = self._store(rec)
+                    dropped = self.dropped - before
                 else:
-                    sink = None
+                    sink, dropped = None, 0
+            if dropped:
+                _count_drop(dropped)
             # sink I/O (write+flush+fsync) happens OUTSIDE the recorder
             # lock: concurrent threads closing spans must not queue
             # behind each other's disk syncs. The sink's own lock keeps
@@ -249,6 +340,57 @@ class Recorder:
             # emit a no-op (the span stays in the in-memory snapshot)
             if sink is not None:
                 sink.emit(rec)
+
+    def record_span(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        parent_id: Optional[int] = None,
+        tid: Optional[int] = None,
+        **attrs,
+    ) -> Optional[int]:
+        """Append one span with EXPLICIT perf_counter timestamps —
+        how the coalescer synthesizes per-request subtrees (queue_wait
+        / evaluate) from timings it already measured, instead of
+        wrapping work that happened for a whole batch at once. Returns
+        the span id (None when disabled) so children can attach."""
+        # unlocked fast-path read, same contract as span(): a stale
+        # read at the enable/disable boundary loses at most one span,
+        # and the store path re-checks under the lock
+        if not self.enabled:  # simonlint: disable=CONC001
+            return None
+        rid_fn = _RID_PROVIDER
+        if rid_fn is not None and "request_id" not in attrs:
+            try:
+                rid = rid_fn()
+            except Exception:  # noqa: BLE001 - correlation must never fail the recording
+                rid = None
+            if rid is not None:
+                attrs["request_id"] = rid
+        with self._lock:
+            if not self.enabled:
+                return None
+            sid = self._next_id
+            self._next_id += 1
+            epoch = self._epoch
+            rec = SpanRecord(
+                span_id=sid,
+                parent_id=parent_id,
+                name=name,
+                t0=t0 - epoch,
+                t1=t1 - epoch,
+                tid=tid if tid is not None else threading.get_ident(),
+                attrs=attrs,
+            )
+            before = self.dropped
+            sink = self._store(rec)
+            dropped = self.dropped - before
+        if dropped:
+            _count_drop(dropped)
+        if sink is not None:
+            sink.emit(rec)
+        return sid
 
 RECORDER = Recorder()
 
@@ -318,6 +460,14 @@ def export_chrome_trace(path: str, spans: Optional[List[SpanRecord]] = None):
     observatory = observatory_block()
     if observatory:
         doc["simonObservatory"] = observatory
+    if RECORDER.dropped:
+        # truncation is part of the artifact: validate_trace flags it,
+        # and a reader knows the forest is a window, not the whole run
+        doc["simonSpansDropped"] = {
+            "dropped": RECORDER.dropped,
+            "mode": "ring" if RECORDER.ring else "cap",
+            "maxSpans": RECORDER.max_spans,
+        }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f)
 
@@ -346,6 +496,15 @@ def observatory_block() -> dict:
     histos = HISTOS.summary(with_buckets=True)
     if histos:
         out["histograms"] = histos
+    # per-device ledger rows at top level (PR-13 mesh accounting): a
+    # mesh-scan bench artifact must record device IMBALANCE, and the
+    # tightest device is invisible inside process-total ledger sums —
+    # validate_trace.py gates the rows' shape (--require-per-device)
+    per_device = LEDGER.device_summary()
+    if per_device:
+        out["per_device"] = per_device
+    if RECORDER.dropped:
+        out["spans_dropped"] = RECORDER.dropped
     return out
 
 
@@ -403,3 +562,24 @@ def top_spans(spans: List[SpanRecord], k: int = 5) -> List[dict]:
         {"name": name, "exclusive_ms": round(sec * 1e3, 3)}
         for name, sec in ranked
     ]
+
+
+# cached hot-span table for /metrics: with the daemons' always-armed
+# ring, an uncached read would copy the (up to 100k-span) ring and walk
+# it on EVERY scrape — stalling concurrent span closes behind the
+# recorder lock for the copy's duration. The cache is a benign-race
+# dict: worst case two scrapes both recompute one window.
+_TOP_CACHE = {"t": -1e18, "top": []}
+TOP_SPANS_CACHE_S = 30.0
+
+
+def top_spans_cached(k: int = 5, max_age_s: float = TOP_SPANS_CACHE_S) -> List[dict]:
+    """`top_spans` over the live recorder, recomputed at most once per
+    ``max_age_s`` — the /metrics exposition's bounded-cost accessor."""
+    now = time.monotonic()
+    if now - _TOP_CACHE["t"] < max_age_s:
+        return _TOP_CACHE["top"]
+    top = top_spans(RECORDER.snapshot(), k)
+    _TOP_CACHE["top"] = top
+    _TOP_CACHE["t"] = now
+    return top

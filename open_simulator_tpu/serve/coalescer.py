@@ -57,13 +57,18 @@ log = logging.getLogger(__name__)
 WATCHDOG_INTERVAL_S = 0.25
 
 
-def partial_body(reason: str, message: str) -> bytes:
+def partial_body(
+    reason: str, message: str, request_id: Optional[str] = None
+) -> bytes:
     """Machine-readable shed body — the HTTP analogue of the CLI's
     PARTIAL report (cli._emit_partial): same `partial`/`reason` keys,
-    so one client-side parser reads both surfaces."""
-    return json.dumps(
-        {"partial": True, "reason": reason, "message": message}
-    ).encode()
+    so one client-side parser reads both surfaces. ``request_id``
+    (when the request got far enough to have one) rides along so a
+    caller-supplied correlation ID survives the shed path verbatim."""
+    doc = {"partial": True, "reason": reason, "message": message}
+    if request_id:
+        doc["requestId"] = request_id
+    return json.dumps(doc).encode()
 
 
 @dataclass
@@ -80,7 +85,14 @@ class PendingRequest:
     route: str = "batch"
     tenant: str = "default"
     route_reason: str = ""
+    # correlation ID (X-Simon-Request-Id or minted — obs/telemetry.py):
+    # echoed in reply headers/shed bodies, stamped on the request's
+    # span subtree, distinct per member of a coalesced batch
+    request_id: str = ""
     enqueued_at: float = field(default_factory=time.monotonic)
+    # perf_counter twin of enqueued_at: synthesized per-request spans
+    # (queue_wait/evaluate) must live in the recorder's clock domain
+    enqueued_perf: float = field(default_factory=time.perf_counter)
     done: threading.Event = field(default_factory=threading.Event)
     reply: Optional[WhatIfReply] = None
 
@@ -254,6 +266,7 @@ class Coalescer:
             if req.budget.expired() or req.budget.interrupted:
                 COUNTERS.inc("serve_shed_total")
                 COUNTERS.inc("serve_shed_deadline_total")
+                self._record_request_spans(req, evaluated=False)
                 self._finish_counted(
                     req,
                     WhatIfReply(
@@ -262,6 +275,7 @@ class Coalescer:
                             "deadline",
                             f"deadline of {req.budget.deadline_s:g}s expired "
                             f"after {req.budget.elapsed():.2f}s in the queue",
+                            request_id=req.request_id,
                         ),
                         meta={"engine": "shed-deadline"},
                     ),
@@ -308,38 +322,56 @@ class Coalescer:
     def _evaluate_tick(self, batch: List[PendingRequest]):
         """Answer one tick's worth of picked requests: admission-
         routed serial requests individually through the host oracle,
-        everything else in ONE coalesced device dispatch."""
+        everything else in ONE coalesced device dispatch. Under the
+        flight recorder, the tick is one ``serve/batch`` span LINKING
+        every member's request ID, and each member gets its own
+        synthesized span subtree (queue_wait / evaluate) stamped with
+        its ID — N requests, N traceable subtrees, zero extra device
+        work."""
+        from ..obs.spans import RECORDER
+
         t0 = time.monotonic()
+        t0_perf = time.perf_counter()
         COUNTERS.observe("serve_batch_fill", len(batch))
         COUNTERS.inc("serve_batches_total")
         for p in batch:
             HISTOS.observe("serve/queue_wait", t0 - p.enqueued_at)
         scan = [p for p in batch if p.route != "serial"]
         serial = [p for p in batch if p.route == "serial"]
-        replies: List[WhatIfReply] = []
-        if scan:
-            try:
-                replies = self.session.evaluate_batch(
-                    [p.request for p in scan]
-                )
-            except Exception as e:  # noqa: BLE001 - the daemon must outlive any one batch
-                # a failed batch answers its waiters (500) and the
-                # dispatcher keeps serving; an unhandled raise here
-                # would strand every queued request forever
-                COUNTERS.inc("serve_batch_errors_total")
-                replies = [self._error_reply(e) for _ in scan]
-        serial_replies: List[WhatIfReply] = []
-        for p in serial:
-            try:
-                serial_replies.append(
-                    self.session.evaluate_serial(
-                        p.request, reason=p.route_reason or "admission"
+        with RECORDER.span(
+            "serve/batch",
+            requests=len(batch),
+            request_ids=[p.request_id for p in batch if p.request_id],
+        ) as batch_span:
+            replies: List[WhatIfReply] = []
+            if scan:
+                try:
+                    replies = self.session.evaluate_batch(
+                        [p.request for p in scan]
                     )
-                )
-            except Exception as e:  # noqa: BLE001 - ditto: one bad serial request must not strand the rest
-                COUNTERS.inc("serve_batch_errors_total")
-                serial_replies.append(self._error_reply(e))
+                except Exception as e:  # noqa: BLE001 - the daemon must outlive any one batch
+                    # a failed batch answers its waiters (500) and the
+                    # dispatcher keeps serving; an unhandled raise here
+                    # would strand every queued request forever
+                    COUNTERS.inc("serve_batch_errors_total")
+                    replies = [
+                        self._error_reply(e, p.request_id) for p in scan
+                    ]
+            serial_replies: List[WhatIfReply] = []
+            for p in serial:
+                try:
+                    serial_replies.append(
+                        self.session.evaluate_serial(
+                            p.request, reason=p.route_reason or "admission"
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001 - ditto: one bad serial request must not strand the rest
+                    COUNTERS.inc("serve_batch_errors_total")
+                    serial_replies.append(
+                        self._error_reply(e, p.request_id)
+                    )
         tick_s = time.monotonic() - t0
+        t1_perf = time.perf_counter()
         COUNTERS.observe("serve_tick_seconds", tick_s)
         HISTOS.observe("serve/evaluate", tick_s)
         for pending, reply in list(zip(scan, replies)) + list(
@@ -347,21 +379,80 @@ class Coalescer:
         ):
             reply.meta.setdefault("batchSize", len(batch))
             reply.meta["queueSeconds"] = round(t0 - pending.enqueued_at, 6)
+            self._record_request_spans(
+                pending,
+                evaluated=True,
+                t0_perf=t0_perf,
+                t1_perf=t1_perf,
+                batch_span=batch_span,
+                engine=str(reply.meta.get("engine", "")),
+            )
             self._finish_counted(pending, reply)
 
     @staticmethod
-    def _error_reply(e: Exception) -> WhatIfReply:
+    def _record_request_spans(
+        pending: PendingRequest,
+        evaluated: bool,
+        t0_perf: Optional[float] = None,
+        t1_perf: Optional[float] = None,
+        batch_span=None,
+        engine: str = "",
+    ):
+        """Synthesize one request's span subtree from timings the
+        dispatcher already measured: a ``serve/request`` root spanning
+        enqueue -> answer, with ``queue_wait`` and (when the request
+        was evaluated rather than shed) ``evaluate`` children — each
+        stamped with the request's own ID, the batch span linked on
+        the root. Host-side bookkeeping only: correlation costs zero
+        jit-cache misses by construction (CI-gated)."""
+        from ..obs.spans import RECORDER
+
+        if not RECORDER.enabled:
+            return
+        now_perf = time.perf_counter()
+        attrs = {"request_id": pending.request_id or None}
+        if batch_span is not None:
+            attrs["batch_span"] = batch_span
+        if engine:
+            attrs["engine"] = engine
+        if not evaluated:
+            attrs["shed"] = True
+        root = RECORDER.record_span(
+            "serve/request", pending.enqueued_perf, now_perf, **attrs
+        )
+        if root is None:
+            return
+        wait_end = t0_perf if evaluated and t0_perf is not None else now_perf
+        RECORDER.record_span(
+            "serve/request/queue_wait",
+            pending.enqueued_perf,
+            wait_end,
+            parent_id=root,
+            request_id=pending.request_id or None,
+        )
+        if evaluated and t0_perf is not None and t1_perf is not None:
+            RECORDER.record_span(
+                "serve/request/evaluate",
+                t0_perf,
+                t1_perf,
+                parent_id=root,
+                request_id=pending.request_id or None,
+            )
+
+    @staticmethod
+    def _error_reply(e: Exception, request_id: str = "") -> WhatIfReply:
         """Typed 500 body: the taxonomy class name rides along so a
         client (and the chaos matrix) can route on the failure kind
         without parsing message text."""
+        doc = {
+            "error": f"evaluation failed: {e}",
+            "errorType": type(e).__name__,
+        }
+        if request_id:
+            doc["requestId"] = request_id
         return WhatIfReply(
             status=500,
-            body=json.dumps(
-                {
-                    "error": f"evaluation failed: {e}",
-                    "errorType": type(e).__name__,
-                }
-            ).encode(),
+            body=json.dumps(doc).encode(),
             meta={"engine": "error"},
         )
 
@@ -395,6 +486,7 @@ class Coalescer:
                             "drain",
                             "daemon shutting down before this request "
                             "could be evaluated",
+                            request_id=req.request_id,
                         ),
                         meta={"engine": "shed-drain"},
                     ),

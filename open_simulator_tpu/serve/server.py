@@ -29,6 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from ..models.decode import ResourceTypes, decode_yaml_content
+from ..obs import telemetry
 from ..runtime.budget import Budget
 from ..runtime.errors import EXIT_OK, EXIT_PARTIAL_DEADLINE
 from ..scheduler.core import AppResource
@@ -134,7 +135,7 @@ def _decode_app_yaml(text: str, i: int) -> ResourceTypes:
         raise ValueError(f"apps[{i}]: invalid YAML: {e}") from e
 
 
-def render_metrics(coalescer: Coalescer) -> bytes:
+def render_metrics(coalescer: Coalescer, slo_engine=None) -> bytes:
     """Prometheus text exposition of the process-wide counters
     (utils/trace.COUNTERS)."""
     snap = COUNTERS.snapshot()
@@ -253,8 +254,51 @@ def render_metrics(coalescer: Coalescer) -> bytes:
     )
     lines.extend(_resilience_lines(snap))
     lines.extend(_observatory_lines(snap))
+    lines.extend(_telemetry_lines(snap, slo_engine))
     lines.append("")
     return "\n".join(lines).encode()
+
+
+def _telemetry_lines(snap: dict, slo_engine=None) -> List[str]:
+    """Production-telemetry exposition shared by serve and twin
+    (docs/OBSERVABILITY.md): span-recorder truncation, series-store
+    occupancy, and the ``simon_slo_*`` burn-rate block when an SLO
+    config is loaded."""
+    from ..obs.spans import RECORDER
+
+    counts = snap["counts"]
+    lines: List[str] = []
+
+    def metric(name, kind, help_text, value):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+
+    metric(
+        "simon_spans_dropped_total", "counter",
+        "Spans lost to the recorder cap (cap mode) or overwritten "
+        "oldest-first (ring mode) — nonzero means exported traces are "
+        "a window, not the whole run.",
+        counts.get("spans_dropped_total", 0),
+    )
+    metric(
+        "simon_obs_series", "gauge",
+        "Signals resident in the telemetry ring store.",
+        telemetry.SERIES.stats()["series"],
+    )
+    metric(
+        "simon_obs_spans_resident", "gauge",
+        "Spans currently held by the flight recorder.",
+        RECORDER.count if RECORDER.enabled else 0,
+    )
+    metric(
+        "simon_telemetry_sample_errors_total", "counter",
+        "Telemetry sampling passes that failed (loop survives them).",
+        counts.get("telemetry_sample_errors_total", 0),
+    )
+    if slo_engine is not None:
+        lines.extend(slo_engine.prometheus_lines())
+    return lines
 
 
 def _escape_label(value: str) -> str:
@@ -484,9 +528,11 @@ def _observatory_lines(snap: dict) -> List[str]:
         metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
     # -- latency histograms (obs/histo.py)
     lines.extend(histo.prometheus_lines())
-    # -- hot spans by exclusive time (span recorder armed only)
+    # -- hot spans by exclusive time (span recorder armed only);
+    # cached: the always-armed daemon ring must not be copied and
+    # walked per scrape (spans.top_spans_cached, 30s refresh)
     if spans.RECORDER.enabled:
-        top = spans.top_spans(spans.RECORDER.snapshot(), 5)
+        top = spans.top_spans_cached(5)
         if top:
             lines.append(
                 "# HELP simon_span_exclusive_seconds Top spans by exclusive "
@@ -517,10 +563,19 @@ class ServeDaemon:
         max_request_pods: Optional[int] = None,
         max_sessions: int = 8,
         snapshot_path: Optional[str] = None,
+        slo_engine=None,
+        obs_cadence_s: float = 1.0,
     ):
         self.session = session
         self.default_deadline_s = default_deadline_s
         self.drain_timeout_s = drain_timeout_s
+        self.slo_engine = slo_engine
+        # the resident telemetry loop: counters/gauges/percentiles/
+        # ledger into the series rings on a cadence, SLO evaluation
+        # riding each sample (obs/telemetry.py)
+        self.telemetry = telemetry.TelemetryRuntime(
+            cadence_s=obs_cadence_s, slo_engine=slo_engine
+        )
         self.admission = AdmissionController(
             max_batch=max_batch,
             tick_budget_s=tick_budget_s,
@@ -579,6 +634,11 @@ class ServeDaemon:
                                 "deltaSeq": daemon.session.delta_seq,
                                 "queueDepth": daemon.coalescer.depth,
                                 "sessions": daemon.sessions.stats(),
+                                "sloAlerting": (
+                                    daemon.slo_engine.alerting()
+                                    if daemon.slo_engine is not None
+                                    else []
+                                ),
                                 "draining": daemon._shutdown.is_set(),
                             }
                         ).encode(),
@@ -586,8 +646,30 @@ class ServeDaemon:
                 elif self.path == "/metrics":
                     self._send(
                         200,
-                        render_metrics(daemon.coalescer),
+                        render_metrics(daemon.coalescer, daemon.slo_engine),
                         content_type="text/plain; version=0.0.4",
+                    )
+                elif self.path.startswith("/v1/obs/series"):
+                    status, doc = telemetry.series_endpoint(self.path)
+                    self._send(
+                        status,
+                        json.dumps(doc, sort_keys=True).encode(),
+                    )
+                elif self.path == "/v1/obs/snapshot":
+                    self._send(
+                        200,
+                        json.dumps(
+                            telemetry.snapshot_doc(
+                                daemon.slo_engine,
+                                runtime=daemon.telemetry,
+                                extra={
+                                    "daemon": "serve",
+                                    "health": daemon.readiness()[0],
+                                    "queueDepth": daemon.coalescer.depth,
+                                },
+                            ),
+                            sort_keys=True,
+                        ).encode(),
                     )
                 else:
                     self._send(404, json.dumps({"error": "not found"}).encode())
@@ -595,6 +677,18 @@ class ServeDaemon:
             def do_POST(self):
                 if self.path == "/v1/cluster-delta":
                     self._do_cluster_delta()
+                    return
+                if self.path == "/debug/dump":
+                    length = int(self.headers.get("Content-Length") or 0)
+                    status, doc = telemetry.handle_debug_dump(
+                        self.rfile.read(length),
+                        slo_engine=daemon.slo_engine,
+                        runtime=daemon.telemetry,
+                        label="serve",
+                    )
+                    self._send(
+                        status, json.dumps(doc, sort_keys=True).encode()
+                    )
                     return
                 if self.path != "/v1/simulate":
                     self._send(404, json.dumps({"error": "not found"}).encode())
@@ -628,6 +722,10 @@ class ServeDaemon:
                 from ..twin import deltas as _dl
                 from ..twin.deltas import ClusterDelta
 
+                rid = telemetry.ensure_request_id(
+                    self.headers.get(telemetry.REQUEST_ID_HEADER)
+                )
+                rid_header = (telemetry.REQUEST_ID_HEADER, rid)
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length)
                 try:
@@ -665,13 +763,23 @@ class ServeDaemon:
                         elif d.kind in (_dl.POD_BIND, _dl.POD_ARRIVE):
                             _wl.pod_from_pod(_copy.deepcopy(d.pod))
                 except (UnicodeDecodeError, ValueError, InputError) as e:
-                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    self._send(
+                        400,
+                        json.dumps(
+                            {"error": str(e), "requestId": rid}
+                        ).encode(),
+                        headers=(rid_header,),
+                    )
                     return
                 if daemon._shutdown.is_set():
                     from .coalescer import partial_body
 
                     self._send(
-                        503, partial_body("drain", "daemon is draining")
+                        503,
+                        partial_body(
+                            "drain", "daemon is draining", request_id=rid
+                        ),
+                        headers=(rid_header,),
                     )
                     return
                 counts = {"applied": 0, "skipped": 0, "reloads": 0}
@@ -679,7 +787,7 @@ class ServeDaemon:
                     for d, rec in zip(deltas, recs):
                         out = daemon.session.apply_delta(d)
                         daemon.sessions.record_delta(
-                            daemon.session.fingerprint, rec
+                            daemon.session.fingerprint, rec, request_id=rid
                         )
                         if out == "skipped":
                             counts["skipped"] += 1
@@ -698,8 +806,10 @@ class ServeDaemon:
                                 "error": str(e),
                                 **counts,
                                 "deltaSeq": daemon.session.delta_seq,
+                                "requestId": rid,
                             }
                         ).encode(),
+                        headers=(rid_header,),
                     )
                     return
                 self._send(
@@ -707,9 +817,30 @@ class ServeDaemon:
                     json.dumps(
                         {**counts, "deltaSeq": daemon.session.delta_seq}
                     ).encode(),
+                    headers=(rid_header,),
                 )
 
             def _do_simulate(self):
+                # request correlation end-to-end (obs/telemetry.py):
+                # the caller's X-Simon-Request-Id (else a minted one)
+                # is bound for the whole handler scope — every span
+                # recorded while THIS request is parsed/admitted/
+                # answered carries it — echoed on every response
+                # (200/400/429/503/500) and carried in every shed/
+                # PARTIAL body. The 200 body itself stays byte-
+                # identical to standalone simulate() (the coalescing
+                # conformance contract): correlation lives in headers
+                # and error/shed bodies only.
+                rid = telemetry.ensure_request_id(
+                    self.headers.get(telemetry.REQUEST_ID_HEADER)
+                )
+                with telemetry.request_scope(rid):
+                    self._do_simulate_correlated(rid)
+
+            def _do_simulate_correlated(self, rid: str):
+                from ..obs.spans import RECORDER
+
+                rid_header = (telemetry.REQUEST_ID_HEADER, rid)
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length)
                 try:
@@ -717,7 +848,13 @@ class ServeDaemon:
                         raw, self.headers.get("Content-Type", "")
                     )
                 except ValueError as e:
-                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    self._send(
+                        400,
+                        json.dumps(
+                            {"error": str(e), "requestId": rid}
+                        ).encode(),
+                        headers=(rid_header,),
+                    )
                     return
                 if deadline is None:
                     deadline = daemon.default_deadline_s
@@ -733,19 +870,23 @@ class ServeDaemon:
                 # cost-predictive admission BEFORE the queue: 429 when
                 # the predicted wait busts the tick budget, serial
                 # routing when the predicted HBM would not fit
-                verdict = daemon.admission.decide(
-                    est_pods=estimate_request_pods(req),
-                    queue_depth=daemon.coalescer.depth,
-                )
+                with RECORDER.span("serve/request/admission"):
+                    verdict = daemon.admission.decide(
+                        est_pods=estimate_request_pods(req),
+                        queue_depth=daemon.coalescer.depth,
+                    )
                 if verdict.action == "shed":
                     # serve_admission_shed_total counted by decide()
                     COUNTERS.inc("serve_shed_total")
                     COUNTERS.inc(f"serve_tenant_shed:{tenant}")
                     self._send(
                         429,
-                        partial_body("admission", verdict.reason),
+                        partial_body(
+                            "admission", verdict.reason, request_id=rid
+                        ),
                         headers=(
                             ("Retry-After", str(verdict.retry_after_s)),
+                            rid_header,
                         ),
                     )
                     return
@@ -755,6 +896,7 @@ class ServeDaemon:
                     route="serial" if verdict.action == "serial" else "batch",
                     tenant=tenant,
                     route_reason=verdict.reason,
+                    request_id=rid,
                 )
                 if not daemon.coalescer.submit(pending):
                     draining = daemon._shutdown.is_set()
@@ -766,9 +908,11 @@ class ServeDaemon:
                             "daemon is draining for shutdown"
                             if draining
                             else f"queue full at depth {daemon.coalescer.queue_depth}",
+                            request_id=rid,
                         ),
                         headers=(
                             ("Retry-After", str(daemon.coalescer.retry_after_s())),
+                            rid_header,
                         ),
                     )
                     return
@@ -776,19 +920,27 @@ class ServeDaemon:
                 if not pending.done.wait(timeout=wait):
                     self._send(
                         500,
-                        json.dumps({"error": "dispatcher unresponsive"}).encode(),
+                        json.dumps(
+                            {
+                                "error": "dispatcher unresponsive",
+                                "requestId": rid,
+                            }
+                        ).encode(),
+                        headers=(rid_header,),
                     )
                     return
                 reply = pending.reply
                 headers = [
                     ("X-Simon-Engine", str(reply.meta.get("engine", ""))),
                     ("X-Simon-Batch-Size", str(reply.meta.get("batchSize", ""))),
+                    rid_header,
                 ]
                 if want_trace:
                     headers.append(
                         ("X-Simon-Trace", json.dumps(reply.meta, sort_keys=True))
                     )
-                self._send(reply.status, reply.body, headers=headers)
+                with RECORDER.span("serve/request/reply"):
+                    self._send(reply.status, reply.body, headers=headers)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
@@ -800,6 +952,7 @@ class ServeDaemon:
         )
 
     def start(self):
+        self.telemetry.start()
         self.coalescer.start()
         self._server_thread.start()
         log.info("simon serve listening on %s:%d", self.host, self.port)
@@ -828,6 +981,8 @@ class ServeDaemon:
             reasons.append(
                 f"device memory over budget ({in_use} > {limit} bytes)"
             )
+        if self.slo_engine is not None:
+            reasons.extend(self.slo_engine.reasons())
         return ("degraded" if reasons else "ok"), reasons
 
     def begin_shutdown(self):
@@ -846,6 +1001,7 @@ class ServeDaemon:
         # wedged client socket must not hold the exit hostage)
         self._inflight_zero.wait(timeout=min(self.drain_timeout_s, 10.0))
         self.sessions.drain()  # journal surviving warm sessions
+        self.telemetry.stop()  # one final sample so dumps see the end
         self.httpd.shutdown()
         self.httpd.server_close()
         if not drained:

@@ -89,12 +89,21 @@ class SessionCache:
             {"kind": "session", "event": event, "fingerprint": fingerprint, **extra}
         )
 
-    def record_delta(self, fingerprint: str, delta_record: dict):
+    def record_delta(
+        self, fingerprint: str, delta_record: dict, request_id: str = ""
+    ):
         """Journal one applied cluster delta (POST /v1/cluster-delta):
         the snapshot then carries not just WHICH clusters were warm at
         a crash but what delta stream their warm state had absorbed —
-        fsync'd per append like every session event."""
-        self._record("delta", fingerprint, delta=delta_record)
+        fsync'd per append like every session event. ``request_id``
+        correlates the journal line with the HTTP request that carried
+        the delta (the X-Simon-Request-Id contract)."""
+        if request_id:
+            self._record(
+                "delta", fingerprint, delta=delta_record, requestId=request_id
+            )
+        else:
+            self._record("delta", fingerprint, delta=delta_record)
 
     # -- membership ----------------------------------------------------------
 

@@ -39,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..models.validation import InputError
+from ..obs import telemetry
 from ..runtime.errors import GuardError
 from ..serve.admission import AdmissionController, estimate_request_pods
 from ..utils.trace import COUNTERS
@@ -69,8 +70,11 @@ def render_twin_metrics(daemon: "TwinDaemon") -> bytes:
     divergence counters the mirror's replayer feeds, then the shared
     resilience + observatory blocks (serve/server.py helpers — one
     exposition dialect across both daemons)."""
-    from ..obs import histo
-    from ..serve.server import _observatory_lines, _resilience_lines
+    from ..serve.server import (
+        _observatory_lines,
+        _resilience_lines,
+        _telemetry_lines,
+    )
 
     snap = COUNTERS.snapshot()
     counts, gauges = snap["counts"], snap["gauges"]
@@ -141,9 +145,13 @@ def render_twin_metrics(daemon: "TwinDaemon") -> bytes:
         ("shadow_ingest_diff_decisions_total", "Tail decisions inferred from pod diffs alone."),
     ):
         metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
+    # NOTE: _observatory_lines already includes the histogram
+    # exposition; appending histo.prometheus_lines() again here used to
+    # emit every latency family twice — duplicate samples a Prometheus
+    # scraper rejects (caught by the exposition conformance test)
     lines.extend(_resilience_lines(snap))
     lines.extend(_observatory_lines(snap))
-    lines.extend(histo.prometheus_lines())
+    lines.extend(_telemetry_lines(snap, daemon.slo_engine))
     lines.append("")
     return "\n".join(lines).encode()
 
@@ -186,6 +194,8 @@ class TwinDaemon:
         max_request_pods: Optional[int] = None,
         drain_timeout_s: float = 30.0,
         budget=None,
+        slo_engine=None,
+        obs_cadence_s: float = 1.0,
     ):
         if poll_interval_s <= 0:
             raise InputError(
@@ -196,6 +206,10 @@ class TwinDaemon:
         self.max_polls = max_polls
         self.drain_timeout_s = drain_timeout_s
         self.budget = budget
+        self.slo_engine = slo_engine
+        self.telemetry = telemetry.TelemetryRuntime(
+            cadence_s=obs_cadence_s, slo_engine=slo_engine
+        )
         self.admission = TwinAdmission(
             max_batch=1,
             tick_budget_s=tick_budget_s,
@@ -233,6 +247,11 @@ class TwinDaemon:
                         "status": status,
                         "degraded": bool(reasons),
                         "reasons": reasons,
+                        "sloAlerting": (
+                            daemon.slo_engine.alerting()
+                            if daemon.slo_engine is not None
+                            else []
+                        ),
                         "mirror": daemon.mirror.stats(),
                     }))
                 elif self.path == "/metrics":
@@ -241,10 +260,37 @@ class TwinDaemon:
                         render_twin_metrics(daemon),
                         content_type="text/plain; version=0.0.4",
                     )
+                elif self.path.startswith("/v1/obs/series"):
+                    status, doc = telemetry.series_endpoint(self.path)
+                    self._send(status, canonical_body(doc))
+                elif self.path == "/v1/obs/snapshot":
+                    self._send(
+                        200,
+                        canonical_body(
+                            telemetry.snapshot_doc(
+                                daemon.slo_engine,
+                                runtime=daemon.telemetry,
+                                extra={
+                                    "daemon": "twin",
+                                    "health": daemon.readiness()[0],
+                                },
+                            )
+                        ),
+                    )
                 else:
                     self._send(404, json.dumps({"error": "not found"}).encode())
 
             def do_POST(self):
+                if self.path == "/debug/dump":
+                    length = int(self.headers.get("Content-Length") or 0)
+                    status, doc = telemetry.handle_debug_dump(
+                        self.rfile.read(length),
+                        slo_engine=daemon.slo_engine,
+                        runtime=daemon.telemetry,
+                        label="twin",
+                    )
+                    self._send(status, canonical_body(doc))
+                    return
                 route = {
                     "/v1/whatif": daemon._q_whatif,
                     "/v1/drain": daemon._q_drain,
@@ -266,10 +312,24 @@ class TwinDaemon:
                             daemon._inflight_zero.set()
 
             def _route(self, route):
+                # the serve request-ID contract verbatim: accepted or
+                # minted, bound for the query's whole scope (mirror
+                # probes and scan spans all stamp it), echoed on every
+                # response
+                rid = telemetry.ensure_request_id(
+                    self.headers.get(telemetry.REQUEST_ID_HEADER)
+                )
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length)
-                status, payload, headers = daemon.answer(
-                    route, raw, self.headers.get("Content-Type", "")
+                with telemetry.request_scope(rid):
+                    status, payload, headers = daemon.answer(
+                        route,
+                        raw,
+                        self.headers.get("Content-Type", ""),
+                        rid=rid,
+                    )
+                headers = tuple(headers) + (
+                    (telemetry.REQUEST_ID_HEADER, rid),
                 )
                 self._send(status, payload, headers=headers)
 
@@ -285,15 +345,24 @@ class TwinDaemon:
 
     # -- query dispatch -----------------------------------------------------
 
-    def answer(self, route, raw: bytes, content_type: str):
+    def answer(self, route, raw: bytes, content_type: str, rid: str = ""):
         """One admission-gated query evaluation. Returns
-        (status, body bytes, headers)."""
+        (status, body bytes, headers). ``rid`` is the request's
+        correlation ID — carried in every error/shed body (the 200
+        body stays a pure function of the query, echoed in the
+        response header by the handler instead)."""
         from ..obs.histo import HISTOS
+        from ..obs.spans import RECORDER
+
+        def err_body(doc: dict) -> bytes:
+            if rid:
+                doc = {**doc, "requestId": rid}
+            return canonical_body(doc)
 
         try:
             est_pods, call = route(raw, content_type)
         except (InputError, ValueError) as e:
-            return 400, canonical_body({"error": str(e)}), ()
+            return 400, err_body({"error": str(e)}), ()
         with self._inflight_lock:
             waiting = self._inflight - 1  # queries ahead of this one
         verdict = self.admission.decide(
@@ -303,21 +372,22 @@ class TwinDaemon:
             COUNTERS.inc("twin_queries_shed_total")
             return (
                 429,
-                canonical_body({"error": verdict.reason, "shed": True}),
+                err_body({"error": verdict.reason, "shed": True}),
                 (("Retry-After", str(verdict.retry_after_s)),),
             )
         t0 = time.perf_counter()
         try:
-            out = call()
+            with RECORDER.span("twin/request"):
+                out = call()
         except (InputError, ValueError) as e:
-            return 400, canonical_body({"error": str(e)}), ()
+            return 400, err_body({"error": str(e)}), ()
         except GuardError as e:
             # classified degradation (device OOM mid-query, injected
             # fault): a typed 500, the daemon stays up
             COUNTERS.inc("twin_query_errors_total")
             return (
                 500,
-                canonical_body({"error": str(e), "type": type(e).__name__}),
+                err_body({"error": str(e), "type": type(e).__name__}),
                 (),
             )
         HISTOS.observe(QUERY_HISTO, time.perf_counter() - t0)
@@ -419,6 +489,7 @@ class TwinDaemon:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self):
+        self.telemetry.start()
         self._server_thread.start()
         self._tail_thread.start()
         log.info("simon twin listening on %s:%d", self.host, self.port)
@@ -430,6 +501,8 @@ class TwinDaemon:
         for endpoint, st in sorted(breaker_states().items()):
             if st["open"]:
                 reasons.append(f"circuit breaker open: {endpoint}")
+        if self.slo_engine is not None:
+            reasons.extend(self.slo_engine.reasons())
         return ("degraded" if reasons else "ok"), reasons
 
     def begin_shutdown(self):
@@ -439,6 +512,7 @@ class TwinDaemon:
         self.begin_shutdown()
         self._tail_done.wait(timeout=self.drain_timeout_s)
         self._inflight_zero.wait(timeout=min(self.drain_timeout_s, 10.0))
+        self.telemetry.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         return 0

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 # hierarchical span, so the flat phase timers become leaf spans of the
 # trace tree for free — call sites unchanged
 from ..obs.spans import RECORDER as _SPANS
+from ..obs.spans import set_drop_hook as _set_span_drop_hook
 
 _lock = threading.Lock()
 
@@ -287,6 +288,17 @@ class Counters:
 # process-wide operational counters (simon serve /metrics); distinct
 # from GLOBAL (phase wall-clock) — counters survive GLOBAL.reset()
 COUNTERS = Counters()
+
+
+def _count_dropped_spans(n: int = 1) -> None:
+    """Span-recorder overflow hook: a truncated trace must be
+    detectable from /metrics (simon_spans_dropped_total) and from the
+    run's trace notes, not just from eyeballing span counts."""
+    COUNTERS.inc("spans_dropped_total", n)
+    GLOBAL.note("spans_dropped", str(COUNTERS.get("spans_dropped_total")))
+
+
+_set_span_drop_hook(_count_dropped_spans)
 
 
 @contextmanager

@@ -1,0 +1,354 @@
+"""Request-ID correlation under load (the X-Simon-Request-Id contract).
+
+A request that joins a coalesced dispatch must NOT lose its identity:
+
+- a held burst of N requests answered by shared batched dispatches
+  yields N DISTINCT request IDs, each with its own span subtree
+  (queue_wait + evaluate phases) stamped with that ID, the batch spans
+  linking their member IDs — at ZERO new jit-cache misses (correlation
+  is host bookkeeping, never a recompile);
+- a shed (deadline / overload / admission 429) carries the
+  CALLER-SUPPLIED ID verbatim in its machine-readable body;
+- the HTTP surface echoes the ID on every response status, minting one
+  when the caller sent none.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.obs import spans as spans_mod
+from open_simulator_tpu.obs import telemetry as tm
+from open_simulator_tpu.runtime.budget import Budget
+from open_simulator_tpu.scheduler.core import AppResource
+from open_simulator_tpu.serve.coalescer import Coalescer, PendingRequest
+from open_simulator_tpu.serve.server import ServeDaemon
+from open_simulator_tpu.serve.session import Session, WhatIfRequest
+from open_simulator_tpu.testing import make_fake_node
+from open_simulator_tpu.utils.trace import COUNTERS
+
+
+@pytest.fixture(autouse=True)
+def _pristine_recorder():
+    rec = spans_mod.RECORDER
+    yield
+    rec.disable()
+    rec.ring = False
+    rec.max_spans = rec.MAX_SPANS
+    rec.reset()
+    tm.SERIES.reset()
+
+
+def _cluster():
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node(f"rid-n-{i}", "16", "64Gi") for i in range(3)]
+    return cluster
+
+
+def _request(name, replicas=2):
+    res = ResourceTypes()
+    res.deployments = [
+        {
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "rid"},
+            "spec": {
+                "replicas": replicas,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "x",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "128Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ]
+    return WhatIfRequest(apps=[AppResource(name, res)])
+
+
+def test_coalesced_burst_yields_distinct_traceable_ids():
+    """The acceptance gate: N coalesced requests -> N distinct IDs,
+    each ID's span subtree holds queue_wait/evaluate, batch spans link
+    member IDs, and the whole correlation pass costs zero new
+    jit-cache misses on the second identical burst."""
+    N = 8
+    rec = spans_mod.RECORDER
+    rec.enable()
+    session = Session(_cluster())
+    coal = Coalescer(session, max_batch=4, queue_depth=32)
+    coal.hold = threading.Event()
+    coal.start()
+
+    def burst(tag):
+        pendings = [
+            PendingRequest(
+                request=_request(f"{tag}-{i}", 2 + (i % 2)),
+                budget=Budget(None),
+                request_id=tm.ensure_request_id(
+                    f"caller-{tag}-{i}" if i % 2 == 0 else None
+                ),
+            )
+            for i in range(N)
+        ]
+        for p in pendings:
+            assert coal.submit(p)
+        coal.hold.set()
+        for p in pendings:
+            assert p.done.wait(timeout=120)
+        coal.hold = threading.Event()
+        return pendings
+
+    pendings = burst("b1")
+    assert all(p.reply.status == 200 for p in pendings)
+    rids = [p.request_id for p in pendings]
+    assert len(set(rids)) == N  # distinct, caller-supplied AND minted
+    assert rids[0] == "caller-b1-0"  # caller IDs verbatim
+    assert rids[1].startswith("req-")  # minted where absent
+
+    spans = rec.snapshot()
+    by_id = {s.span_id: s for s in spans}
+    roots = [s for s in spans if s.name == "serve/request"]
+    assert {s.attrs.get("request_id") for s in roots} == set(rids)
+    for root in roots:
+        phases = {
+            s.name for s in spans if s.parent_id == root.span_id
+        }
+        assert phases == {
+            "serve/request/queue_wait",
+            "serve/request/evaluate",
+        }
+        for s in spans:
+            if s.parent_id == root.span_id:
+                assert s.attrs.get("request_id") == root.attrs["request_id"]
+        # the batch span links this member's ID
+        batch = by_id[root.attrs["batch_span"]]
+        assert batch.name == "serve/batch"
+        assert root.attrs["request_id"] in batch.attrs["request_ids"]
+
+    # second identical-shape burst: correlation must not cost compiles
+    r0 = COUNTERS.get("jax_recompiles_total")
+    pendings2 = burst("b1")  # same app names/shapes as the first burst
+    assert all(p.reply.status == 200 for p in pendings2)
+    assert COUNTERS.get("jax_recompiles_total") == r0
+    coal.close()
+    coal.drain(timeout=30)
+
+
+def test_deadline_shed_carries_caller_id_verbatim():
+    session = Session(_cluster())
+    coal = Coalescer(session, max_batch=4, queue_depth=8)
+    coal.hold = threading.Event()
+    rec = spans_mod.RECORDER
+    rec.enable()
+    coal.start()
+    doomed = PendingRequest(
+        request=_request("doomed"),
+        budget=Budget(0.01),
+        request_id="caller-doomed-42",
+    )
+    assert coal.submit(doomed)
+    time.sleep(0.05)
+    coal.hold.set()
+    assert doomed.done.wait(timeout=120)
+    assert doomed.reply.status == 503
+    body = json.loads(doomed.reply.body)
+    assert body["partial"] and body["reason"] == "deadline"
+    assert body["requestId"] == "caller-doomed-42"
+    # the shed request still got its (shed-marked) span subtree
+    spans = rec.snapshot()
+    root = next(
+        s
+        for s in spans
+        if s.name == "serve/request"
+        and s.attrs.get("request_id") == "caller-doomed-42"
+    )
+    assert root.attrs.get("shed") is True
+    assert any(
+        s.name == "serve/request/queue_wait" and s.parent_id == root.span_id
+        for s in spans
+    )
+    coal.close()
+    coal.drain(timeout=30)
+
+
+def _post(base, body, rid=None, path="/v1/simulate"):
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Simon-Request-Id"] = rid
+    req = urllib.request.Request(
+        base + path, data=body, headers=headers, method="POST"
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=120)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_http_surface_echoes_ids_on_every_status():
+    daemon = ServeDaemon(Session(_cluster()), port=0, max_batch=4)
+    daemon.start()
+    base = f"http://{daemon.host}:{daemon.port}"
+    try:
+        body = json.dumps(
+            {
+                "apps": [
+                    {
+                        "name": "http-rid",
+                        "yaml": json.dumps(
+                            _request("http-rid").apps[0].resource.deployments[0]
+                        ),
+                    }
+                ]
+            }
+        ).encode()
+        # 200 with caller ID echoed; body untouched (conformance)
+        status, headers, payload = _post(base, body, rid="caller-http-1")
+        assert status == 200
+        assert headers["X-Simon-Request-Id"] == "caller-http-1"
+        assert b"requestId" not in payload
+        # 200 with minted ID when the caller sent none
+        status, headers, _ = _post(base, body)
+        assert status == 200
+        assert headers["X-Simon-Request-Id"].startswith("req-")
+        # adversarial header values are sanitized, not trusted
+        status, headers, _ = _post(base, body, rid='we"ird\tid')
+        assert status == 200
+        assert headers["X-Simon-Request-Id"] == "we_ird_id"
+        # 400 carries the ID in header AND body
+        status, headers, payload = _post(base, b"{}", rid="caller-bad")
+        assert status == 400
+        assert headers["X-Simon-Request-Id"] == "caller-bad"
+        assert json.loads(payload)["requestId"] == "caller-bad"
+        # obs endpoints answer from the live store
+        with urllib.request.urlopen(
+            base + "/v1/obs/snapshot", timeout=60
+        ) as r:
+            snap = json.loads(r.read())
+        assert snap["daemon"] == "serve"
+        with urllib.request.urlopen(
+            base + "/v1/obs/series?name=counter/serve_requests_total",
+            timeout=60,
+        ) as r:
+            series = json.loads(r.read())
+        assert series["series"]["counter/serve_requests_total"]
+    finally:
+        daemon.begin_shutdown()
+        daemon.shutdown()
+
+
+def test_overload_shed_carries_caller_id():
+    daemon = ServeDaemon(
+        Session(_cluster()), port=0, max_batch=1, queue_depth=1
+    )
+    daemon.coalescer.hold = threading.Event()  # queue can only fill
+    daemon.start()
+    base = f"http://{daemon.host}:{daemon.port}"
+    body = json.dumps(
+        {
+            "apps": [
+                {
+                    "name": "ovl",
+                    "yaml": json.dumps(
+                        _request("ovl").apps[0].resource.deployments[0]
+                    ),
+                }
+            ]
+        }
+    ).encode()
+    results = []
+
+    def client(i):
+        results.append(_post(base, body, rid=f"caller-ovl-{i}"))
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(6)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        # wait until at least one 503 landed (the queue holds 1)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            if any(s == 503 for s, _h, _b in results):
+                break
+            time.sleep(0.02)
+        shed = [r for r in results if r[0] == 503]
+        assert shed, "overload never shed"
+        for status, headers, payload in shed:
+            doc = json.loads(payload)
+            assert doc["partial"] and doc["reason"] == "overload"
+            assert doc["requestId"].startswith("caller-ovl-")
+            assert headers["X-Simon-Request-Id"] == doc["requestId"]
+    finally:
+        daemon.coalescer.hold.set()
+        for t in threads:
+            t.join(timeout=120)
+        daemon.begin_shutdown()
+        daemon.shutdown()
+
+
+def test_twin_error_bodies_carry_request_id():
+    from open_simulator_tpu.shadow.record import record_simulation
+    from open_simulator_tpu.twin.mirror import ClusterMirror, FeedSource
+    from open_simulator_tpu.twin.server import TwinDaemon
+
+    cluster = _cluster()
+    res = ResourceTypes()
+    res.pods = [
+        {
+            "kind": "Pod",
+            "metadata": {"name": "tw-rid", "namespace": "rid"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "x",
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"}
+                        },
+                    }
+                ]
+            },
+        }
+    ]
+    steps = record_simulation(cluster, [AppResource("tw", res)])
+    mirror = ClusterMirror(cluster, FeedSource(steps, batch=8), engine="oracle")
+    mirror.bootstrap()
+    daemon = TwinDaemon(mirror, port=0, poll_interval_s=0.05)
+    daemon.start()
+    base = f"http://{daemon.host}:{daemon.port}"
+    try:
+        # a malformed drain body answers 400 with the ID in both places
+        status, headers, payload = _post(
+            base, b'{"nodes": "not-a-list"}', rid="caller-twin-1",
+            path="/v1/drain",
+        )
+        assert status == 400
+        assert headers["X-Simon-Request-Id"] == "caller-twin-1"
+        assert json.loads(payload)["requestId"] == "caller-twin-1"
+        # a good query echoes the ID in the header only (pure body)
+        status, headers, payload = _post(
+            base, b'{"nodes": ["rid-n-0"]}', rid="caller-twin-2",
+            path="/v1/drain",
+        )
+        assert status == 200
+        assert headers["X-Simon-Request-Id"] == "caller-twin-2"
+        assert b"requestId" not in payload
+    finally:
+        daemon.begin_shutdown()
+        daemon.shutdown()

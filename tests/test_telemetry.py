@@ -1,0 +1,825 @@
+"""Production telemetry (obs/telemetry.py + obs/slo.py).
+
+The load-bearing contracts:
+
+- SERIES STORE: fixed rings append O(1) and never grow; downsampling
+  to coarser resolutions is seeded-DETERMINISTIC (same samples + same
+  seed -> byte-identical coarse rings); windowed deltas anchor at the
+  window edge so cumulative counters never lose their oldest
+  increment.
+- SLO ENGINE: declarative objectives validate loudly; the multi-window
+  burn alert fires only when BOTH windows burn, clears as soon as the
+  fast window recovers, and the transitions are counted.
+- FLIGHT RECORDER RING: past the cap the daemons' ring overwrites
+  OLDEST-first (the one-shot CLI cap drops newest), every lost span
+  counts in simon_spans_dropped_total and leaves a trace note, and
+  exported artifacts carry the truncation marker validate_trace flags.
+- PROMETHEUS EXPOSITION: serve and twin /metrics conform — every
+  family declared once with HELP/TYPE, no duplicate samples, label
+  values escaped, histogram buckets cumulative/monotone — so new
+  simon_slo_*/series gauges can't land malformed.
+- DEBUG DUMP: a live daemon's spans+series+SLO dump is a bench-record
+  shape `simon doctor` can load and diff.
+"""
+
+import json
+import re
+import time
+
+import pytest
+
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.models.validation import InputError
+from open_simulator_tpu.obs import slo as slo_mod
+from open_simulator_tpu.obs import spans as spans_mod
+from open_simulator_tpu.obs import telemetry as tm
+from open_simulator_tpu.scheduler.core import AppResource
+from open_simulator_tpu.serve.coalescer import Coalescer
+from open_simulator_tpu.serve.session import Session, WhatIfRequest
+from open_simulator_tpu.testing import make_fake_node
+from open_simulator_tpu.utils.trace import COUNTERS, GLOBAL
+
+
+@pytest.fixture(autouse=True)
+def _pristine_recorder():
+    """The recorder and series store are process-global; every test
+    here leaves them exactly as found (disabled, cap mode)."""
+    rec = spans_mod.RECORDER
+    yield
+    rec.disable()
+    rec.ring = False
+    rec.max_spans = rec.MAX_SPANS
+    rec.reset()
+    tm.SERIES.reset()
+
+
+# ------------------------------------------------------------ series store
+
+
+def _filled_store(n=100, seed=0, cap=32):
+    s = tm.SeriesStore(capacity=cap, seed=seed)
+    for i in range(n):
+        s.record("counter/x", 1000.0 + i, float(i))
+    return s
+
+
+def test_series_ring_is_bounded_and_chronological():
+    s = _filled_store(n=100, cap=16)
+    raw = s.query("counter/x")
+    assert len(raw) == 16  # capacity, not sample count
+    times = [p[0] for p in raw]
+    assert times == sorted(times)
+    assert raw[-1][1] == 99.0  # newest survives, oldest overwritten
+
+
+def test_series_downsampling_is_seeded_deterministic():
+    a = _filled_store(n=200, seed=7)
+    b = _filled_store(n=200, seed=7)
+    assert a.query("counter/x", resolution=8) == b.query(
+        "counter/x", resolution=8
+    )
+    assert a.query("counter/x", resolution=64) == b.query(
+        "counter/x", resolution=64
+    )
+    # coarse points carry the bucket envelope, not just the pick
+    for t, v, vmin, vmax in a.query("counter/x", resolution=8):
+        assert vmin <= v <= vmax
+
+
+def test_series_delta_anchors_at_window_edge():
+    s = _filled_store(n=100)
+    # 10s window at t=1099: samples 90..99 plus the anchor at 89
+    assert s.delta("counter/x", 10.0, now=1099.0) == pytest.approx(11.0)
+    # a window past ALL retention answers from the deepest ring: the
+    # x8 ring reaches further back than the 32-slot raw ring, so the
+    # delta covers MORE history than the raw tail alone could
+    raw = s.query("counter/x")
+    assert s.delta("counter/x", 10_000.0, now=1099.0) > (
+        raw[-1][1] - raw[0][1]
+    )
+    assert s.delta("counter/missing", 10.0, now=1099.0) is None
+
+
+def test_series_long_windows_read_coarser_rings():
+    """A window longer than the raw ring's retention must fall back to
+    the ×8/×64 rings instead of silently evaluating only the raw
+    tail — the slow burn window of an SLO covers its full span."""
+    s = tm.SeriesStore(capacity=32)
+    for i in range(1000):  # raw ring holds the last 32 samples only
+        s.record("counter/x", 1000.0 + i, float(i))
+    now = 1999.0
+    # raw retention is ~32s; a 500s window must see the x8/x64 history
+    assert s.delta("counter/x", 500.0, now=now) == pytest.approx(
+        500.0, abs=tm.AGG * tm.AGG
+    )
+    # and a window even the coarse rings can't cover answers what the
+    # deepest ring retains (x64 reaches back ~960 samples) rather
+    # than nothing — the representative picks cost at most one bucket
+    # of slack at each end
+    assert s.delta("counter/x", 10_000.0, now=now) > 800.0
+    # a fresh series (too few samples to have folded) still answers
+    # from the raw ring for any window size
+    s2 = tm.SeriesStore(capacity=32)
+    for i in range(5):
+        s2.record("gauge/y", 1000.0 + i, 1.0)
+    assert len(s2.window("gauge/y", 5000.0, now=1004.0)) == 5
+
+
+def test_frac_beyond_excludes_pre_window_anchor():
+    """The delta anchor (newest pre-window sample) must NOT count
+    toward a window's bad-sample ratio: a stale out-of-window reading
+    cannot hold an alert up after the window itself recovered."""
+    s = tm.SeriesStore(capacity=32)
+    s.record("gauge/a", 1000.0, 0.0)  # old, below min
+    for i in range(1, 4):
+        s.record("gauge/a", 1010.0 + i, 1.0)  # fresh, healthy
+    frac = s.frac_beyond("gauge/a", 0.5, 5.0, now=1014.0, below=True)
+    assert frac == 0.0  # the stale 0.0 at t=1000 is outside the window
+    # while delta still anchors at the edge
+    s.record("counter/c", 1000.0, 10.0)
+    s.record("counter/c", 1012.0, 15.0)
+    assert s.delta("counter/c", 5.0, now=1014.0) == 5.0
+
+
+def test_sampler_records_interval_percentiles_not_lifetime():
+    """histo/<site>/pXX_ms series are INTERVAL percentiles (bucket
+    deltas between samples): a latency regression on a long-running
+    daemon moves the next sample at full strength instead of being
+    diluted into the process-lifetime distribution, and an idle
+    interval records no sample at all."""
+    from open_simulator_tpu.obs.histo import HISTOS
+
+    site = "telemetry/interval"
+    s = tm.SeriesStore(capacity=64)
+    rt = tm.TelemetryRuntime(cadence_s=1.0, series=s, clock=lambda: 0.0)
+    for _ in range(1000):
+        HISTOS.observe(site, 0.010)  # a long healthy history
+    rt.sample_once(now=2000.0)
+    assert s.last(f"histo/{site}/p95_ms")[1] == pytest.approx(10.0, rel=0.5)
+    # regression: 10 slow observations — 1% of lifetime, 100% of the
+    # interval — the sampled p95 must jump to ~500ms, not stay ~10ms
+    for _ in range(10):
+        HISTOS.observe(site, 0.500)
+    rt.sample_once(now=2001.0)
+    assert s.last(f"histo/{site}/p95_ms")[1] > 300.0
+    # idle interval: no new observations -> no new sample
+    before = len(s.query(f"histo/{site}/p95_ms"))
+    rt.sample_once(now=2002.0)
+    assert len(s.query(f"histo/{site}/p95_ms")) == before
+    # recovery shows immediately too
+    for _ in range(10):
+        HISTOS.observe(site, 0.010)
+    rt.sample_once(now=2003.0)
+    assert s.last(f"histo/{site}/p95_ms")[1] == pytest.approx(10.0, rel=0.5)
+
+
+def test_series_counter_reset_clamps_to_zero():
+    s = tm.SeriesStore(capacity=8)
+    s.record("counter/x", 1000.0, 50.0)
+    s.record("counter/x", 1001.0, 3.0)  # process restarted
+    assert s.delta("counter/x", 10.0, now=1001.0) == 0.0
+
+
+def test_series_cardinality_bound():
+    s = tm.SeriesStore(capacity=4)
+    for i in range(tm.MAX_SERIES + 5):
+        s.record(f"gauge/g{i}", 1000.0, 1.0)
+    stats = s.stats()
+    assert stats["series"] == tm.MAX_SERIES
+    assert stats["overflowed"] == 5
+
+
+def test_series_query_rejects_unknown_resolution():
+    s = _filled_store()
+    with pytest.raises(InputError):
+        s.query("counter/x", resolution=7)
+
+
+def test_sampler_lands_counters_gauges_and_histos():
+    from open_simulator_tpu.obs.histo import HISTOS
+
+    s = tm.SeriesStore(capacity=16)
+    COUNTERS.inc("telemetry_test_total", 3)
+    COUNTERS.gauge("telemetry_test_gauge", 1.5)
+    HISTOS.observe("telemetry/test", 0.01)
+    clock = [2000.0]
+    rt = tm.TelemetryRuntime(
+        cadence_s=1.0, series=s, clock=lambda: clock[0]
+    )
+    rt.sample_once()
+    assert s.last("counter/telemetry_test_total")[1] == 3.0
+    assert s.last("gauge/telemetry_test_gauge")[1] == 1.5
+    assert s.last("histo/telemetry/test/p95_ms")[1] > 0
+    assert s.last("recorder/spans_dropped") is not None
+    with pytest.raises(InputError):
+        tm.TelemetryRuntime(cadence_s=0.0)
+
+
+def test_request_id_sanitize_and_mint():
+    assert tm.sanitize_request_id(None) is None
+    assert tm.sanitize_request_id("") is None
+    assert tm.sanitize_request_id("ok-id_1:2.3") == "ok-id_1:2.3"
+    assert tm.sanitize_request_id('we"ird\nid') == "we_ird_id"
+    assert len(tm.sanitize_request_id("x" * 500)) == tm.MAX_REQUEST_ID_LEN
+    a, b = tm.new_request_id(), tm.new_request_id()
+    assert a != b and a.startswith("req-")
+    assert tm.ensure_request_id("caller-7") == "caller-7"
+    with tm.request_scope("rid-1"):
+        assert tm.current_request_id() == "rid-1"
+    assert tm.current_request_id() is None
+
+
+# --------------------------------------------------------------- slo engine
+
+
+def _avail_objective(**kw):
+    rec = {
+        "name": "availability",
+        "kind": "availability",
+        "target": 0.9,
+        "total": "req_total",
+        "bad": "bad_total",
+        "fastWindowSeconds": 10,
+        "slowWindowSeconds": 30,
+    }
+    rec.update(kw)
+    return rec
+
+
+def test_slo_parse_validates_loudly():
+    with pytest.raises(InputError):
+        slo_mod.parse_objectives([])
+    with pytest.raises(InputError):
+        slo_mod.parse_objectives([{"name": "x", "kind": "nope"}])
+    with pytest.raises(InputError):  # label-unsafe name
+        slo_mod.parse_objectives([_avail_objective(name='we"ird')])
+    with pytest.raises(InputError):  # availability needs target < 1
+        slo_mod.parse_objectives([_avail_objective(target=1.0)])
+    with pytest.raises(InputError):  # slow window < fast window
+        slo_mod.parse_objectives(
+            [_avail_objective(slowWindowSeconds=5)]
+        )
+    with pytest.raises(InputError):  # duplicate names
+        slo_mod.parse_objectives(
+            [_avail_objective(), _avail_objective()]
+        )
+    with pytest.raises(InputError):  # latency needs thresholdMs
+        slo_mod.parse_objectives(
+            [{"name": "l", "kind": "latency", "site": "serve/request"}]
+        )
+    objs = slo_mod.parse_objectives({"slos": [_avail_objective()]})
+    assert objs[0].series_name() == "counter/bad_total"
+    assert objs[0].error_budget() == pytest.approx(0.1)
+
+
+def _engine_with_traffic(series, objectives, clock):
+    return slo_mod.SLOEngine(
+        slo_mod.parse_objectives(objectives), series=series, clock=clock
+    )
+
+
+def test_slo_multiwindow_fire_and_clear():
+    s = tm.SeriesStore(capacity=128)
+    now = [1000.0]
+    eng = _engine_with_traffic(s, [_avail_objective()], lambda: now[0])
+    # healthy: 40s of traffic, zero bad
+    for i in range(40):
+        s.record("counter/req_total", 1000.0 + i, i * 2.0)
+        s.record("counter/bad_total", 1000.0 + i, 0.0)
+    now[0] = 1040.0
+    (st,) = eng.evaluate()
+    assert not st.alerting and st.burn_fast == 0.0
+    # fault storm: every other request bad for 20s
+    for i in range(40, 60):
+        s.record("counter/req_total", 1000.0 + i, i * 2.0)
+        s.record("counter/bad_total", 1000.0 + i, (i - 40) * 1.0)
+    now[0] = 1060.0
+    (st,) = eng.evaluate()
+    assert st.alerting and st.burn_fast > 1.0 and st.burn_slow > 1.0
+    assert st.fired_total == 1
+    assert eng.alerting() == ["availability"]
+    assert any("slo burning: availability" in r for r in eng.reasons())
+    # recovery: the fast window drains first and clears the alert even
+    # while the slow window still remembers the storm
+    for i in range(60, 80):
+        s.record("counter/req_total", 1000.0 + i, i * 2.0)
+        s.record("counter/bad_total", 1000.0 + i, 19.0)
+    now[0] = 1080.0
+    (st,) = eng.evaluate()
+    assert not st.alerting and st.cleared_total == 1
+    assert st.burn_slow > 1.0  # slow still burning: fast clearing wins
+    assert eng.reasons() == []
+
+
+def test_slo_needs_both_windows_to_fire():
+    """A short blip burns the fast window but not the slow one: no
+    page — exactly the flap resistance multi-window buys."""
+    s = tm.SeriesStore(capacity=128)
+    now = [1000.0]
+    eng = _engine_with_traffic(s, [_avail_objective()], lambda: now[0])
+    for i in range(60):
+        s.record("counter/req_total", 1000.0 + i, i * 10.0)
+        # bad only in the last 5 seconds
+        s.record(
+            "counter/bad_total", 1000.0 + i, 5.0 * max(i - 55, 0)
+        )
+    now[0] = 1060.0
+    (st,) = eng.evaluate()
+    assert st.burn_fast > 1.0
+    assert st.burn_slow < 1.0
+    assert not st.alerting
+
+
+def test_slo_counter_budget_and_gauge_min():
+    s = tm.SeriesStore(capacity=128)
+    now = [1000.0]
+    eng = _engine_with_traffic(
+        s,
+        [
+            {
+                "name": "recompiles",
+                "kind": "counter_budget",
+                "counter": "recompiles_total",
+                "maxPerWindow": 0,
+                "fastWindowSeconds": 10,
+                "slowWindowSeconds": 30,
+            },
+            {
+                "name": "agreement",
+                "kind": "gauge_min",
+                "gauge": "agreement_rate",
+                "min": 0.99,
+                "budget": 0.2,
+                "fastWindowSeconds": 10,
+                "slowWindowSeconds": 30,
+            },
+        ],
+        lambda: now[0],
+    )
+    for i in range(40):
+        s.record("counter/recompiles_total", 1000.0 + i, 2.0)  # flat
+        s.record("gauge/agreement_rate", 1000.0 + i, 1.0)
+    now[0] = 1040.0
+    assert [st.alerting for st in eng.evaluate()] == [False, False]
+    for i in range(40, 60):
+        s.record("counter/recompiles_total", 1000.0 + i, 2.0 + (i - 40))
+        s.record("gauge/agreement_rate", 1000.0 + i, 0.5)
+    now[0] = 1060.0
+    states = eng.evaluate()
+    assert [st.alerting for st in states] == [True, True]
+    # zero-budget burn saturates instead of dividing by zero
+    assert states[0].burn_fast == slo_mod.BURN_SATURATED
+
+
+def test_slo_latency_objective_over_percentile_series():
+    s = tm.SeriesStore(capacity=128)
+    now = [1000.0]
+    eng = _engine_with_traffic(
+        s,
+        [
+            {
+                "name": "p95",
+                "kind": "latency",
+                "site": "serve/request",
+                "percentile": 95,
+                "thresholdMs": 100.0,
+                "budget": 0.2,
+                "fastWindowSeconds": 10,
+                "slowWindowSeconds": 30,
+            }
+        ],
+        lambda: now[0],
+    )
+    for i in range(60):
+        ms = 50.0 if i < 30 else 500.0  # latency regression halfway in
+        s.record("histo/serve/request/p95_ms", 1000.0 + i, ms)
+    now[0] = 1060.0
+    (st,) = eng.evaluate()
+    assert st.alerting and st.burn_fast == pytest.approx(5.0)
+
+
+# ------------------------------------------------------ flight-recorder ring
+
+
+def test_ring_overwrites_oldest_and_counts_drops():
+    rec = spans_mod.RECORDER
+    rec.ring = True
+    rec.max_spans = 8
+    rec.enable()
+    c0 = COUNTERS.get("spans_dropped_total")
+    for i in range(20):
+        with rec.span(f"s{i}"):
+            pass
+    snap = rec.snapshot()
+    assert [s.name for s in snap] == [f"s{i}" for i in range(12, 20)]
+    assert rec.dropped == 12
+    assert COUNTERS.get("spans_dropped_total") - c0 == 12
+    assert GLOBAL.as_dict()["notes"]["spans_dropped"]
+
+
+def test_cap_mode_drops_newest_and_counts():
+    rec = spans_mod.RECORDER
+    rec.ring = False
+    rec.max_spans = 4
+    rec.enable()
+    c0 = COUNTERS.get("spans_dropped_total")
+    for i in range(7):
+        with rec.span(f"c{i}"):
+            pass
+    snap = rec.snapshot()
+    assert [s.name for s in snap] == ["c0", "c1", "c2", "c3"]
+    assert rec.dropped == 3
+    assert COUNTERS.get("spans_dropped_total") - c0 == 3
+
+
+def test_truncated_trace_export_is_flagged(tmp_path):
+    from tools.validate_trace import validate
+
+    rec = spans_mod.RECORDER
+    rec.ring = True
+    rec.max_spans = 6
+    rec.enable()
+    with rec.span("root"):
+        with rec.span("mid"):
+            for i in range(10):
+                with rec.span(f"leaf{i}"):
+                    pass
+    out = tmp_path / "trace.json"
+    spans_mod.export_chrome_trace(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["simonSpansDropped"]["dropped"] == rec.dropped
+    assert doc["simonSpansDropped"]["mode"] == "ring"
+    summary = validate(str(out), min_depth=1)
+    assert "WARNING" in summary and "dropped" in summary
+    with pytest.raises(ValueError, match="forbidden"):
+        validate(str(out), min_depth=1, forbid_dropped=True)
+
+
+def test_record_span_synthesizes_subtrees_with_explicit_times():
+    rec = spans_mod.RECORDER
+    rec.enable()
+    t1 = time.perf_counter()
+    t0 = t1 - 0.5
+    with tm.request_scope("rid-9"):
+        root = rec.record_span("serve/request", t0, t1)
+    child = rec.record_span(
+        "serve/request/queue_wait", t0, t0 + 0.2, parent_id=root,
+        request_id="rid-9",
+    )
+    by_id = {s.span_id: s for s in rec.snapshot()}
+    assert by_id[root].attrs["request_id"] == "rid-9"  # contextvar stamp
+    assert by_id[child].parent_id == root
+    assert by_id[root].duration == pytest.approx(0.5, abs=0.01)
+
+
+# ------------------------------------------------- per-device observatory
+
+
+def test_observatory_block_carries_per_device_rows():
+    from open_simulator_tpu.obs.ledger import LEDGER
+
+    LEDGER.poll(force=True)
+    block = spans_mod.observatory_block()
+    rows = block.get("per_device")
+    assert rows, "observatory block must carry per-device ledger rows"
+    assert all(r["device"] and r["in_use"] >= 0 for r in rows)
+
+
+def test_validate_trace_gates_per_device(tmp_path):
+    from tools.validate_trace import validate_observatory
+
+    good = {"per_device": [{"device": "cpu:0", "in_use": 10, "limit": 100}]}
+    assert "1 device row(s)" in validate_observatory(good)
+    with pytest.raises(ValueError, match="per_device"):
+        validate_observatory({"per_device": [{"device": "", "in_use": 1}]})
+    with pytest.raises(ValueError, match="in_use"):
+        validate_observatory({"per_device": [{"device": "cpu:0"}]})
+    with pytest.raises(ValueError, match="mesh device accounting"):
+        validate_observatory({"costs": {}}, require_per_device=True)
+    # the nested (ledger.per_device) shape of checked-in BENCH records
+    nested = {
+        "ledger": {
+            "peak_bytes": 5,
+            "samples": 1,
+            "watermarks": {},
+            "per_device": [{"device": "cpu:0", "in_use": 1, "limit": None}],
+        }
+    }
+    assert "1 device row(s)" in validate_observatory(
+        nested, require_per_device=True
+    )
+
+
+# --------------------------------------------- prometheus exposition gates
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\["\\n])*)"')
+
+
+def check_exposition(text: str):
+    """Prometheus text-format conformance: parseable samples, unique
+    family declarations with HELP/TYPE before first sample, no
+    duplicate (name, labels) pairs, escaped label values, cumulative
+    monotone histogram buckets with +Inf == _count."""
+    helps, types = {}, {}
+    seen_samples = set()
+    family_started = set()
+    buckets = {}  # (family, labels-minus-le) -> [(le, cum)]
+    counts = {}  # (family, labels) -> value for _count samples
+    infs = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helps, f"line {ln}: duplicate HELP {name}"
+            assert name not in family_started, (
+                f"line {ln}: HELP {name} after its samples"
+            )
+            helps[name] = True
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            name, kind = parts[2], parts[3]
+            assert name not in types, f"line {ln}: duplicate TYPE {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary")
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"line {ln}: bad comment {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {ln}: unparseable sample {line!r}"
+        name, _brace, labels_raw, value = m.groups()
+        float(value)  # must parse
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                family = base
+        assert family in types, f"line {ln}: {name} has no TYPE"
+        assert family in helps, f"line {ln}: {name} has no HELP"
+        family_started.add(family)
+        labels = {}
+        if labels_raw:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(labels_raw):
+                labels[lm.group(1)] = lm.group(2)
+                consumed = lm.end()
+                if consumed < len(labels_raw):
+                    assert labels_raw[consumed] == ",", (
+                        f"line {ln}: bad label separator in {line!r}"
+                    )
+                    consumed += 1
+            assert consumed >= len(labels_raw.rstrip(",")), (
+                f"line {ln}: unescaped/unparseable labels {labels_raw!r}"
+            )
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen_samples, f"line {ln}: duplicate sample {key}"
+        seen_samples.add(key)
+        if types.get(family) == "histogram" and name.endswith("_bucket"):
+            le = labels.get("le")
+            assert le is not None, f"line {ln}: bucket without le"
+            rest = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            if le == "+Inf":
+                infs[(family, rest)] = float(value)
+            else:
+                buckets.setdefault((family, rest), []).append(
+                    (float(le), float(value))
+                )
+        if name.endswith("_count") and types.get(family) == "histogram":
+            counts[(family, tuple(sorted(labels.items())))] = float(value)
+    for key, rows in buckets.items():
+        les = [le for le, _c in rows]
+        cums = [c for _le, c in rows]
+        assert les == sorted(les), f"{key}: le not increasing"
+        assert cums == sorted(cums), f"{key}: buckets not cumulative"
+        inf = infs.get(key)
+        assert inf is not None, f"{key}: no +Inf bucket"
+        assert not cums or cums[-1] <= inf, f"{key}: bucket > +Inf"
+        cnt = counts.get(key)
+        assert cnt is not None and cnt == inf, (
+            f"{key}: +Inf {inf} != _count {cnt}"
+        )
+    return len(seen_samples)
+
+
+def _serve_cluster():
+    cluster = ResourceTypes()
+    cluster.nodes = [make_fake_node(f"tel-n-{i}", "8", "32Gi") for i in range(2)]
+    return cluster
+
+
+def _request(name, replicas=2):
+    res = ResourceTypes()
+    res.deployments = [
+        {
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "tel"},
+            "spec": {
+                "replicas": replicas,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "x",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "128Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ]
+    return WhatIfRequest(apps=[AppResource(name, res)])
+
+
+def test_serve_metrics_exposition_conforms():
+    from open_simulator_tpu.obs.slo import SLOEngine, parse_objectives
+    from open_simulator_tpu.serve.server import render_metrics
+
+    session = Session(_serve_cluster())
+    coal = Coalescer(session, max_batch=4, queue_depth=8)
+    session.evaluate_batch([_request("expo-a"), _request("expo-b", 3)])
+    # adversarial label values must come out escaped
+    COUNTERS.inc('retry_attempts_ep:we"ird\\label\nname')
+    COUNTERS.inc("serve_tenant_requests:tenant-a")
+    engine = SLOEngine(
+        parse_objectives(
+            [
+                _avail_objective(
+                    total="serve_requests_total", bad="serve_shed_total"
+                )
+            ]
+        )
+    )
+    engine.evaluate()
+    text = render_metrics(coal, engine).decode()
+    n = check_exposition(text)
+    assert n > 50
+    assert "simon_slo_alert{slo=\"availability\"}" in text
+    assert "simon_spans_dropped_total" in text
+    assert "simon_latency_seconds_bucket" in text
+
+
+def test_twin_metrics_exposition_conforms():
+    from open_simulator_tpu.shadow.record import record_simulation
+    from open_simulator_tpu.twin.mirror import ClusterMirror, FeedSource
+    from open_simulator_tpu.twin.server import TwinDaemon, render_twin_metrics
+
+    cluster = _serve_cluster()
+    res = ResourceTypes()
+    res.pods = [
+        {
+            "kind": "Pod",
+            "metadata": {"name": f"tp-{i}", "namespace": "tel"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "x",
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"}
+                        },
+                    }
+                ]
+            },
+        }
+        for i in range(3)
+    ]
+    steps = record_simulation(cluster, [AppResource("tw", res)])
+    mirror = ClusterMirror(cluster, FeedSource(steps, batch=8), engine="oracle")
+    mirror.bootstrap()
+    while not mirror.source.exhausted:
+        mirror.poll_once()
+    daemon = TwinDaemon(mirror, port=0, poll_interval_s=0.5)
+    try:
+        text = render_twin_metrics(daemon).decode()
+    finally:
+        daemon.httpd.server_close()
+    n = check_exposition(text)
+    assert n > 50
+    assert "simon_twin_agreement_rate" in text
+
+
+# ----------------------------------------------------------- debug dump
+
+
+def test_debug_dump_is_doctor_diffable(tmp_path, monkeypatch):
+    from open_simulator_tpu.obs.doctor import diff_records, load_bench_record
+
+    rec = spans_mod.RECORDER
+    rec.ring = True
+    rec.enable()
+    session = Session(_serve_cluster())
+    session.evaluate_batch([_request("dump-a")])
+    rt = tm.TelemetryRuntime(cadence_s=1.0)
+    rt.sample_once()
+    monkeypatch.chdir(tmp_path)  # server-side writes confine to cwd
+    status, doc = tm.handle_debug_dump(
+        json.dumps({"path": "dump.json"}).encode(),
+        runtime=rt,
+        label="serve",
+    )
+    assert status == 200 and doc["written"]
+    loaded = load_bench_record(str(tmp_path / "dump.json"))
+    assert loaded["metric"] == "serve-debug-dump"
+    report = diff_records(loaded, loaded)
+    assert report.ok
+    # inline dump (no path) answers the full document
+    status, inline = tm.handle_debug_dump(b"", runtime=rt, label="serve")
+    assert status == 200
+    assert inline["spans"]["events"]
+    assert inline["series"]
+    assert tm.handle_debug_dump(b"not json", runtime=rt)[0] == 400
+
+
+def test_debug_dump_path_is_confined(tmp_path, monkeypatch):
+    """/debug/dump is reachable by anything that can reach the port:
+    the path parameter must not be an arbitrary-file-write primitive —
+    absolute paths, `..` escapes, and overwrites all answer 400 with
+    the filesystem untouched."""
+    monkeypatch.chdir(tmp_path)
+    rt = tm.TelemetryRuntime(cadence_s=1.0)
+    rt.sample_once()
+
+    def dump(path):
+        return tm.handle_debug_dump(
+            json.dumps({"path": path}).encode(), runtime=rt
+        )
+
+    outside = tmp_path.parent / "escaped.json"
+    status, doc = dump(str(outside))
+    assert status == 400 and "relative" in doc["error"]
+    status, doc = dump("../escaped.json")
+    assert status == 400 and "escapes" in doc["error"]
+    assert not outside.exists()
+    (tmp_path / "existing.json").write_text("precious")
+    status, doc = dump("existing.json")
+    assert status == 400 and "exists" in doc["error"]
+    assert (tmp_path / "existing.json").read_text() == "precious"
+    status, doc = dump("sub/dir.json")  # missing parent dir: clean 400
+    assert status == 400
+    status, doc = dump("fresh.json")
+    assert status == 200 and (tmp_path / "fresh.json").exists()
+
+
+def test_series_endpoint_query_shapes():
+    tm.SERIES.record("counter/endpoint_test", time.time(), 5.0)
+    status, doc = tm.series_endpoint("/v1/obs/series")
+    assert status == 200 and "counter/endpoint_test" in doc["names"]
+    status, doc = tm.series_endpoint(
+        "/v1/obs/series?name=counter/endpoint_test&sinceSeconds=60"
+    )
+    assert status == 200
+    assert doc["series"]["counter/endpoint_test"]
+    status, doc = tm.series_endpoint("/v1/obs/series?resolution=13&name=x")
+    assert status == 400 and "resolution" in doc["error"]
+    status, doc = tm.series_endpoint("/v1/obs/series?sinceSeconds=abc&name=x")
+    assert status == 400
+
+
+def test_top_frame_renders_slo_and_sparklines():
+    assert tm.sparkline([]) == ""
+    assert tm.sparkline([1.0, 1.0]) == "▁▁"
+    line = tm.sparkline(list(range(10)))
+    assert line[0] == "▁" and line[-1] == "█"
+    snapshot = {
+        "daemon": "serve",
+        "health": "degraded",
+        "uptimeSeconds": 12.0,
+        "recorder": {"spans": 5, "dropped": 2},
+        "seriesStats": {"series": 3},
+        "slo": {
+            "alerting": ["availability"],
+            "states": [
+                {
+                    "objective": {"name": "availability"},
+                    "burnFast": 3.2,
+                    "burnSlow": 1.5,
+                }
+            ],
+        },
+    }
+    series_doc = {
+        "series": {
+            "counter/serve_requests_total": [
+                [1.0, 0.0, 0.0, 0.0],
+                [2.0, 5.0, 5.0, 5.0],
+                [3.0, 9.0, 9.0, 9.0],
+            ],
+            "gauge/serve_queue_depth": [[1.0, 2.0, 2.0, 2.0]],
+        }
+    }
+    frame = tm.render_top_frame(snapshot, series_doc, "http://x:1")
+    assert "BURNING" in frame and "availability" in frame
+    assert "serve_requests_total Δ" in frame
+    assert "dropped 2" in frame

@@ -140,13 +140,59 @@ def _validate_histograms(histos) -> int:
     return len(histos)
 
 
+def _validate_per_device(rows) -> int:
+    """The PR-13 per-device ledger rows ({device, in_use, limit}) —
+    the device-imbalance record a mesh-scan artifact must carry: every
+    row named, in_use non-negative, limit absent or positive."""
+    if not isinstance(rows, list):
+        raise ValueError("per_device block is not a list")
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            raise ValueError(f"per_device[{i}] is not an object")
+        dev = row.get("device")
+        if not isinstance(dev, str) or not dev:
+            raise ValueError(f"per_device[{i}].device missing or empty")
+        in_use = row.get("in_use")
+        if not isinstance(in_use, (int, float)) or in_use < 0:
+            raise ValueError(
+                f"per_device[{i}].in_use missing or negative: {in_use!r}"
+            )
+        limit = row.get("limit")
+        if limit is not None and (
+            not isinstance(limit, (int, float)) or limit <= 0
+        ):
+            raise ValueError(
+                f"per_device[{i}].limit must be absent/null or > 0: "
+                f"{limit!r}"
+            )
+    return len(rows)
+
+
+def _dropped_of(block) -> int:
+    """Dropped-span count carried by an observatory block (or a
+    trace's simonSpansDropped object)."""
+    if isinstance(block, dict):
+        v = block.get("spans_dropped", block.get("dropped", 0))
+        if isinstance(v, (int, float)) and v > 0:
+            return int(v)
+    return 0
+
+
 def validate_observatory(
-    block, *, require: bool = False, require_peak: bool = False
+    block,
+    *,
+    require: bool = False,
+    require_peak: bool = False,
+    require_per_device: bool = False,
+    forbid_dropped: bool = False,
 ) -> str:
-    """Validate a costs/ledger/histograms observatory block (a trace's
-    ``simonObservatory`` or a bench record's ``obs``). Returns a short
-    summary fragment; raises ValueError on structural damage or — with
-    ``require``/``require_peak`` — on absence."""
+    """Validate a costs/ledger/histograms/per_device observatory block
+    (a trace's ``simonObservatory`` or a bench record's ``obs``).
+    Returns a short summary fragment; raises ValueError on structural
+    damage or — with ``require``/``require_peak``/
+    ``require_per_device`` — on absence. A dropped-span count is
+    FLAGGED in the summary (the trace is a window, not the whole run)
+    and fails only under ``forbid_dropped``."""
     block = block or {}
     parts = []
     if "costs" in block:
@@ -162,6 +208,23 @@ def validate_observatory(
         parts.append(
             f"{_validate_histograms(block['histograms'])} histogram(s)"
         )
+    per_device = block.get("per_device")
+    if per_device is None and isinstance(block.get("ledger"), dict):
+        per_device = block["ledger"].get("per_device")
+    if per_device is not None:
+        parts.append(f"{_validate_per_device(per_device)} device row(s)")
+    elif require_per_device:
+        raise ValueError(
+            "no per_device ledger rows (mesh device accounting required)"
+        )
+    dropped = _dropped_of(block)
+    if dropped:
+        if forbid_dropped:
+            raise ValueError(
+                f"{dropped} span(s) dropped — truncated trace forbidden "
+                "(--forbid-dropped)"
+            )
+        parts.append(f"WARNING: {dropped} span(s) dropped (truncated)")
     if require and not parts:
         raise ValueError(
             "no observatory blocks (costs/ledger/histograms) found"
@@ -186,6 +249,8 @@ def validate(
     min_depth: int = 3,
     require_observatory: bool = False,
     require_peak: bool = False,
+    require_per_device: bool = False,
+    forbid_dropped: bool = False,
 ) -> str:
     """Returns the summary line; raises ValueError on any failure."""
     with open(path, encoding="utf-8") as f:
@@ -202,6 +267,8 @@ def validate(
                 bench.get("obs"),
                 require=require_observatory,
                 require_peak=require_peak,
+                require_per_device=require_per_device,
+                forbid_dropped=forbid_dropped,
             )
             return f"{path}: OK — bench record, {summary}"
     if doc is None:
@@ -242,10 +309,21 @@ def validate(
         doc.get("simonObservatory"),
         require=require_observatory,
         require_peak=require_peak,
+        require_per_device=require_per_device,
+        forbid_dropped=forbid_dropped,
     )
+    dropped = _dropped_of(doc.get("simonSpansDropped"))
+    drop_note = ""
+    if dropped:
+        if forbid_dropped:
+            raise ValueError(
+                f"{dropped} span(s) dropped — truncated trace forbidden "
+                "(--forbid-dropped)"
+            )
+        drop_note = f"; WARNING: {dropped} span(s) dropped (truncated)"
     return (
         f"{path}: OK — {len(recs)} spans, nesting depth {depth}, "
-        f"{len({r.tid for r in recs})} thread(s); {obs_summary}"
+        f"{len({r.tid for r in recs})} thread(s); {obs_summary}{drop_note}"
     )
 
 
@@ -267,6 +345,18 @@ def main() -> int:
         help="fail unless the memory ledger recorded a NONZERO peak "
         "watermark (CI smoke: proof the ledger sampled real memory)",
     )
+    ap.add_argument(
+        "--require-per-device",
+        action="store_true",
+        help="fail unless per-device ledger rows are present (mesh "
+        "bench artifacts must record device imbalance)",
+    )
+    ap.add_argument(
+        "--forbid-dropped",
+        action="store_true",
+        help="fail when the artifact records dropped spans (by default "
+        "truncation is flagged in the summary, not fatal)",
+    )
     args = ap.parse_args()
     try:
         print(
@@ -275,6 +365,8 @@ def main() -> int:
                 args.min_depth,
                 require_observatory=args.require_observatory,
                 require_peak=args.require_peak,
+                require_per_device=args.require_per_device,
+                forbid_dropped=args.forbid_dropped,
             )
         )
     except (OSError, ValueError, KeyError) as e:

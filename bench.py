@@ -709,6 +709,261 @@ def run_twin_delta(n_nodes=10_000, n_deltas=2000, query_every=100) -> dict:
     }
 
 
+def run_delta_resim(n_nodes=10_000, n_pods=20_000, delta_pods=16) -> dict:
+    """SIMON_BENCH=delta-resim: delta re-simulation on the committed
+    placement journal (docs/PERFORMANCE.md, ROADMAP item 3). A serve
+    session commits an N-pod roster ONCE (the committed scan), then a
+    K-pod delta stream (evicts near the journal tail + fresh arrivals)
+    re-simulates only the affected suffix per delta — prefix placements
+    replay host-side from the journal (PR-3 bulk scatter-add, no
+    device work, no re-encode) and one suffix-sized scan re-decides the
+    rest. Gated inline: the resimulated committed state is
+    dict-identical to a from-scratch full re-scan, the suffix-pods
+    counter stays ≪ the roster (the acceptance bound), and a warm
+    what-if against the drifted state repeats at zero recompiles.
+    Reports deltas/s and the measured speedup vs paying the full
+    re-scan per delta."""
+    import numpy as _np
+
+    from open_simulator_tpu.incremental.resim import CommittedScan
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.obs import profile as obs_profile
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.serve.session import Session, WhatIfRequest
+    from open_simulator_tpu.twin.deltas import (
+        POD_ARRIVE,
+        POD_EVICT,
+        ClusterDelta,
+    )
+    from open_simulator_tpu.utils.trace import COUNTERS
+
+    def bare_pod(name):
+        return {
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "bench"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "img-resim",
+                        "resources": {
+                            "requests": {"cpu": "500m", "memory": "1Gi"}
+                        },
+                    }
+                ],
+                "schedulerName": "default-scheduler",
+            },
+        }
+
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        _make_node(f"resim-n-{i:05d}", 64, 256, {"zone": f"z{i % 8}"})
+        for i in range(n_nodes)
+    ]
+    cluster.pods = [bare_pod(f"resim-p-{i:05d}") for i in range(n_pods)]
+    session = Session(cluster)
+    committed = session._committed_scan()
+    assert committed is not None, "delta-resim needs the incremental path"
+    # full re-scan baseline: what every delta would cost without the
+    # journal (also the conformance anchor's construction path)
+    t0 = time.perf_counter()
+    CommittedScan(cluster.nodes, session.cluster_pods)
+    t_full = time.perf_counter() - t0
+
+    suffix0 = COUNTERS.get("incremental_suffix_pods_total")
+    prefix0 = COUNTERS.get("incremental_prefix_reused_pods_total")
+    deltas = []
+    for i in range(delta_pods // 2):
+        deltas.append(
+            ClusterDelta(
+                kind=POD_EVICT, namespace="bench",
+                name=f"resim-p-{n_pods - 2 - 3 * i:05d}",
+            )
+        )
+        deltas.append(
+            ClusterDelta(kind=POD_ARRIVE, pod=bare_pod(f"resim-new-{i:03d}"))
+        )
+    t0 = time.perf_counter()
+    for delta in deltas:
+        out = session.apply_delta(delta)
+        assert out == "applied", f"delta not applied: {out}"
+    t_deltas = time.perf_counter() - t0
+    suffix_pods = COUNTERS.get("incremental_suffix_pods_total") - suffix0
+    prefix_pods = COUNTERS.get("incremental_prefix_reused_pods_total") - prefix0
+    total_rows = len(deltas) * len(session.cluster_pods)
+    # acceptance gate: the journal re-dispatched a sliver of the rows
+    # a per-delta full re-scan would have paid
+    assert suffix_pods * 20 < total_rows, (
+        f"suffix not incremental: {suffix_pods} of {total_rows} rows"
+    )
+    # conformance gate: resimulated committed state == full re-scan
+    fresh = CommittedScan(cluster.nodes, session.cluster_pods)
+    assert session._committed_scan().state_digest() == fresh.state_digest(), (
+        "delta re-simulation diverged from the full re-scan"
+    )
+    # warm what-if against the drifted state: second query of the same
+    # shape must be pure cache (the millisecond warm path)
+    app = ResourceTypes()
+    app.pods = [bare_pod("resim-query-pod")]
+    req = WhatIfRequest(apps=[AppResource("resim-query", app)])
+    session.evaluate_batch([req])  # shape compile
+    prof0 = obs_profile.snapshot()
+    q_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        replies = session.evaluate_batch([req])
+        q_times.append(time.perf_counter() - t0)
+        assert replies[0].status == 200
+    prof = obs_profile.delta(prof0)
+    assert prof["jax_recompiles_total"] == 0, (
+        f"warm what-if recompiled: {prof['jax_recompiles_total']}"
+    )
+    per_delta = t_deltas / len(deltas)
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "deltas": len(deltas),
+        "deltas_per_sec": round(len(deltas) / t_deltas, 2),
+        "per_delta_ms": round(per_delta * 1000, 1),
+        "full_rescan_s": round(t_full, 3),
+        "speedup_x": round(t_full / per_delta, 2),
+        "suffix_pods": suffix_pods,
+        "prefix_reused_pods": prefix_pods,
+        "suffix_fraction": round(
+            suffix_pods / max(1, suffix_pods + prefix_pods), 6
+        ),
+        "whatif_p50_ms": round(
+            float(_np.percentile(_np.asarray(q_times), 50)) * 1000, 1
+        ),
+        "warm_recompiles": prof["jax_recompiles_total"],
+    }
+
+
+def run_cold_start(config="example/simon-config.yaml") -> dict:
+    """SIMON_BENCH=cold-start: time-to-first-200 for a fresh `simon
+    serve` process, cold vs warm artifact store (incremental/store.py).
+    Two daemon subprocesses run against the SAME --aot-store directory:
+    the first compiles and persists every shape it touches, the second
+    loads them — gated inline at zero new XLA compiles before its
+    first answer (the zero-compile cold start, CI-mirrored). Value is
+    the warm-store time-to-first-200."""
+    import shutil
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    store = tempfile.mkdtemp(prefix="simon-aot-bench-")
+    body = json.dumps(
+        {
+            "apps": [
+                {
+                    "name": "cold",
+                    "yaml": json.dumps(
+                        {
+                            "kind": "Pod",
+                            "metadata": {
+                                "name": "cold-1", "namespace": "bench"
+                            },
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "image": "img-cold",
+                                        "resources": {
+                                            "requests": {
+                                                "cpu": "100m",
+                                                "memory": "128Mi",
+                                            }
+                                        },
+                                    }
+                                ]
+                            },
+                        }
+                    ),
+                }
+            ]
+        }
+    ).encode()
+
+    def one_process() -> dict:
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "open_simulator_tpu.cli", "serve",
+                "-f", config, "--port", "0", "--aot-store", store,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        base = None
+        try:
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    raise RuntimeError("serve exited before listening")
+                if "listening on http://" in line:
+                    base = line.split("listening on ")[1].split()[0]
+                    break
+            assert base, "serve never reported its port"
+            req = urllib.request.Request(
+                base + "/v1/simulate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                assert resp.status == 200
+                answer = resp.read()
+            t_first = time.perf_counter() - t0
+            with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+                metrics = resp.read().decode()
+            counts = {}
+            for key in (
+                "simon_jax_recompiles_total",
+                "simon_aot_store_hit_total",
+                "simon_aot_store_save_total",
+            ):
+                for ln in metrics.splitlines():
+                    if ln.startswith(key + " "):
+                        counts[key] = int(float(ln.split()[1]))
+            return {
+                "t_first_s": t_first,
+                "answer": answer,
+                "recompiles": counts.get("simon_jax_recompiles_total", -1),
+                "hits": counts.get("simon_aot_store_hit_total", 0),
+                "saves": counts.get("simon_aot_store_save_total", 0),
+            }
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    try:
+        cold = one_process()
+        assert cold["saves"] >= 1, "cold process persisted no artifacts"
+        warm = one_process()
+        # THE gate: a warm store means the second process's first
+        # answer costs zero new XLA compiles
+        assert warm["recompiles"] == 0, (
+            f"warm cold-start recompiled {warm['recompiles']} times"
+        )
+        assert warm["hits"] >= 1, "warm process never hit the store"
+        assert warm["answer"] == cold["answer"], "answers diverged"
+    finally:
+        shutil.rmtree(store, ignore_errors=True)
+    return {
+        "cold_first_200_s": round(cold["t_first_s"], 3),
+        "warm_first_200_s": round(warm["t_first_s"], 3),
+        "speedup_x": round(cold["t_first_s"] / warm["t_first_s"], 2),
+        "warm_recompiles": warm["recompiles"],
+        "warm_store_hits": warm["hits"],
+        "cold_saves": cold["saves"],
+    }
+
+
 def run_timeline(n_arrivals=1000, n_nodes=48) -> dict:
     """SIMON_BENCH=timeline: the discrete-event timeline
     (docs/TIMELINE.md) playing a 1000-arrival seeded synthetic trace
@@ -1763,6 +2018,20 @@ def _parse_args(argv=None):
         "--p95-tolerance", type=float, default=0.5,
         help="fractional slack on per-site latency p95s",
     )
+    p.add_argument(
+        "--suffix-tolerance", type=float, default=0.5,
+        help="fractional slack on the incremental suffix fraction "
+        "(regresses up)",
+    )
+    p.add_argument(
+        "--store-tolerance", type=float, default=0.5,
+        help="fractional slack on the artifact-store hit rate "
+        "(regresses down)",
+    )
+    p.add_argument(
+        "--store-reject-tolerance", type=int, default=0,
+        help="absolute slack on artifact-store rejects (default 0)",
+    )
     return p.parse_args(argv)
 
 
@@ -1988,6 +2257,42 @@ def main():
             "query_p95_ms": td["query_p95_ms"],
             "warm_recompiles": td["warm_recompiles"],
         }
+    elif scenario == "delta-resim":
+        dr = run_delta_resim()
+        out = {
+            "metric": f"committed-journal deltas/s on a {dr['nodes']}-node "
+            f"cluster, {dr['pods']} committed pods x {dr['deltas']}-pod "
+            f"delta stream (suffix fraction {dr['suffix_fraction']}, "
+            f"{dr['per_delta_ms']}ms/delta vs {dr['full_rescan_s']}s full "
+            f"re-scan = {dr['speedup_x']}x; committed state dict-identical "
+            f"to full re-scan; warm what-if p50 {dr['whatif_p50_ms']}ms at "
+            f"zero recompiles)",
+            "value": dr["deltas_per_sec"],
+            "unit": "deltas/s",
+            "vs_baseline": None,
+            "suffix_fraction": dr["suffix_fraction"],
+            "speedup_x": dr["speedup_x"],
+            "per_delta_ms": dr["per_delta_ms"],
+            "whatif_p50_ms": dr["whatif_p50_ms"],
+            "warm_recompiles": dr["warm_recompiles"],
+        }
+    elif scenario == "cold-start":
+        cs = run_cold_start()
+        out = {
+            "metric": f"serve warm-store time-to-first-200 "
+            f"({cs['warm_first_200_s']}s vs {cs['cold_first_200_s']}s cold "
+            f"store = {cs['speedup_x']}x; {cs['warm_store_hits']} artifacts "
+            f"loaded, ZERO new XLA compiles before the first answer; "
+            f"{cs['cold_saves']} artifacts persisted by the cold run)",
+            "value": cs["warm_first_200_s"],
+            "unit": "s",
+            "vs_baseline": None,
+            "cold_first_200_s": cs["cold_first_200_s"],
+            "warm_first_200_s": cs["warm_first_200_s"],
+            "speedup_x": cs["speedup_x"],
+            "warm_recompiles": cs["warm_recompiles"],
+            "warm_store_hits": cs["warm_store_hits"],
+        }
     elif scenario == "timeline":
         tl = run_timeline()
         out = {
@@ -2100,6 +2405,8 @@ def main():
         tl = isolated(run_timeline)
         td = isolated(run_twin_delta)
         ms = isolated(run_mesh_scan)
+        dr = isolated(run_delta_resim)
+        cs = isolated(run_cold_start)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -2149,7 +2456,14 @@ def main():
             f"nodes x {ms['devices']} devices (best-cell {ms['speedup_x']}x vs 1 "
             f"device, efficiency {ms['efficiency']} of "
             f"{ms['effective_parallelism']} effective, node-axis "
-            f"conformance {ms['node_axis_conformance']}); "
+            f"conformance {ms['node_axis_conformance']}), "
+            f"delta-resim {dr['deltas_per_sec']:.1f} deltas/s onto a "
+            f"{dr['pods']}-pod committed journal (suffix fraction "
+            f"{dr['suffix_fraction']}, {dr['speedup_x']}x vs full re-scan, "
+            f"dict-identical state), "
+            f"cold-start warm-store first-200 {cs['warm_first_200_s']}s vs "
+            f"{cs['cold_first_200_s']}s cold ({cs['speedup_x']}x, zero new "
+            f"compiles); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"

@@ -640,7 +640,11 @@ def cmd_serve(args) -> int:
         config = SimonConfig.from_file(args.simon_config)
         applier = Applier(config)
         cluster = applier.load_cluster()
-        session = Session(cluster)
+        # the artifact store must be armed BEFORE the warmup request
+        # compiles anything: a warm store then serves every warmup
+        # shape and the daemon's first answer costs zero new compiles
+        _arm_store(args)
+        session = Session(cluster, incremental=not args.no_incremental)
         daemon = ServeDaemon(
             session,
             host=args.host,
@@ -1208,6 +1212,9 @@ def cmd_twin(args) -> int:
                 enable_breaker_recovery(args.breaker_cooldown)
         config = SimonConfig.from_file(args.simon_config)
         applier = Applier(config)
+        # arm the artifact store before the mirror bootstrap compiles
+        # its first warm scan (zero-compile cold start, serve posture)
+        _arm_store(args)
         if args.feed:
             cluster = applier.load_cluster()
             fp = cluster_fingerprint(cluster)
@@ -1558,6 +1565,31 @@ def _add_obs_flags(p: argparse.ArgumentParser):
     )
 
 
+def _add_store_flag(p: argparse.ArgumentParser):
+    """Persistent compile-artifact store flag shared by the resident
+    daemons (incremental/store.py, docs/PERFORMANCE.md): a warm store
+    lets a fresh process answer its first request with zero new XLA
+    compiles."""
+    p.add_argument(
+        "--aot-store", default="", metavar="DIR",
+        help="persist AOT-compiled executables to this directory and "
+        "load them at startup (content-addressed by shape-signature + "
+        "toolchain digest; corrupt/stale entries refused loudly and "
+        "recompiled; SIMON_AOT_STORE env is the flagless form)",
+    )
+
+
+def _arm_store(args) -> None:
+    """Configure the process-wide artifact store from --aot-store
+    BEFORE any jit site compiles (cold-start loads happen at the
+    daemon's warmup dispatches)."""
+    store_dir = getattr(args, "aot_store", "")
+    if store_dir:
+        from .incremental.store import configure_store
+
+        configure_store(store_dir)
+
+
 def _add_inject_flag(p: argparse.ArgumentParser):
     """Chaos fault-injection flag shared by every guarded command
     (runtime/inject.py, docs/ROBUSTNESS.md failure-mode matrix)."""
@@ -1882,6 +1914,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="append session admit/evict/drain records to this "
         "crash-safe JSONL snapshot journal (resumed across restarts; "
         "torn tail recovered, interior damage refused)",
+    )
+    _add_store_flag(p_serve)
+    p_serve.add_argument(
+        "--no-incremental", action="store_true",
+        help="disable delta re-simulation: every tick re-scans the "
+        "whole roster instead of dispatching only the request suffix "
+        "against the resident committed scan (docs/PERFORMANCE.md)",
     )
     _add_inject_flag(p_serve)
     _add_obs_flags(p_serve)
@@ -2256,6 +2295,7 @@ def build_parser() -> argparse.ArgumentParser:
         "apiserver endpoints (SIMON_BREAKER_COOLDOWN wins when set; "
         "0 disables recovery)",
     )
+    _add_store_flag(p_twin)
     _add_obs_flags(p_twin)
     _add_telemetry_flags(p_twin)
     p_twin.set_defaults(func=cmd_twin)
@@ -2346,6 +2386,22 @@ def build_parser() -> argparse.ArgumentParser:
     p_doctor.add_argument(
         "--p95-tolerance", type=float, default=0.5, metavar="FRAC",
         help="fractional slack on per-site latency p95s",
+    )
+    p_doctor.add_argument(
+        "--suffix-tolerance", type=float, default=0.5, metavar="FRAC",
+        help="fractional slack on the incremental suffix fraction "
+        "(regresses up: a growing fraction re-scans reusable rows)",
+    )
+    p_doctor.add_argument(
+        "--store-tolerance", type=float, default=0.5, metavar="FRAC",
+        help="fractional slack on the artifact-store hit rate "
+        "(regresses down: cold starts paying avoidable compiles)",
+    )
+    p_doctor.add_argument(
+        "--store-reject-tolerance", type=int, default=0, metavar="N",
+        help="absolute slack on artifact-store rejects (default 0: a "
+        "reject is a corrupt/stale entry, worth a look even though "
+        "the recovery is clean)",
     )
     p_doctor.add_argument(
         "--format", choices=["text", "json"], default="text",

@@ -1,0 +1,28 @@
+"""Incremental execution: millisecond warm paths, zero-compile cold starts.
+
+ROADMAP item 3, in two halves:
+
+- ``store.py`` — a persistent, content-addressed on-disk store for the
+  AOT-compiled executables the observatory already builds per
+  shape-signature (obs/profile.py / obs/costs.py). A fresh ``simon
+  serve`` / ``simon twin`` pointed at a warm store answers its first
+  request with ZERO new XLA compiles; stale / corrupt / wrong-toolchain
+  entries are refused loudly and recompiled.
+
+- ``resim.py`` — delta re-simulation over the committed placement
+  journal: a warm serve session keeps its cluster pods COMMITTED in a
+  resident oracle (the "committed scan"), so a what-if request
+  dispatches only its own few pods (the suffix) instead of re-scanning
+  the whole roster, and a ``/v1/cluster-delta`` re-simulates only the
+  journal suffix its conservative dependency rule says could change —
+  placements stay byte-identical to a full re-scan (conformance-gated).
+"""
+
+from .resim import CommittedScan, SuffixDecision, suffix_for_delta  # noqa: F401
+from .store import (  # noqa: F401
+    ArtifactStore,
+    aot_store_block,
+    configure_store,
+    current_store,
+    incremental_block,
+)

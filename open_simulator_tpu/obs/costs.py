@@ -137,11 +137,20 @@ class CostRegistry:
         self._lock = threading.Lock()
         self._records: Dict[str, Dict[object, CostRecord]] = {}
 
-    def record(self, site: str, sig, rec: CostRecord) -> None:
+    def record(self, site: str, sig, rec: CostRecord, loaded: bool = False) -> None:
+        """``loaded`` marks a record rehydrated from the persistent
+        artifact store (incremental/store.py): the executable exists
+        without a compile having happened in THIS process, so it counts
+        in ``jax_cost_store_loads_total`` instead of the compile
+        counters — `simon doctor`'s recompile dimension stays exact."""
         with self._lock:
             self._records.setdefault(site, {})[sig] = rec
-        COUNTERS.inc("jax_cost_compiles_total")
-        COUNTERS.inc(f"jax_cost_compiles_{site}")
+        if loaded:
+            COUNTERS.inc("jax_cost_store_loads_total")
+            COUNTERS.inc(f"jax_cost_store_loads_{site}")
+        else:
+            COUNTERS.inc("jax_cost_compiles_total")
+            COUNTERS.inc(f"jax_cost_compiles_{site}")
         # last-compiled cost per site as gauges: the newest signature
         # is almost always the workload's live shape
         COUNTERS.gauge(f"jax_cost_flops_{site}", rec.flops)

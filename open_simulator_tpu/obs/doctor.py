@@ -47,6 +47,13 @@ class Thresholds:
     recompile_abs: int = 0
     hbm_frac: float = 0.5
     p95_frac: float = 0.5
+    # incremental families (r15): the suffix fraction regresses UP (a
+    # bigger fraction = re-scanning rows the journal should have
+    # reused); the store hit rate regresses DOWN (cold starts paying
+    # compiles a warm store should have served)
+    suffix_frac: float = 0.5
+    store_frac: float = 0.5
+    store_reject_abs: int = 0
 
     @classmethod
     def from_args(cls, args) -> "Thresholds":
@@ -56,6 +63,9 @@ class Thresholds:
             recompile_abs=getattr(args, "recompile_tolerance", 0),
             hbm_frac=getattr(args, "hbm_tolerance", 0.5),
             p95_frac=getattr(args, "p95_tolerance", 0.5),
+            suffix_frac=getattr(args, "suffix_tolerance", 0.5),
+            store_frac=getattr(args, "store_tolerance", 0.5),
+            store_reject_abs=getattr(args, "store_reject_tolerance", 0),
         )
 
 
@@ -212,6 +222,40 @@ def diff_records(
         _num(cand, "obs", "ledger", "peak_bytes"),
         th.hbm_frac,
         note="peak device memory (obs/ledger.py watermark)",
+    )
+    # incremental / artifact-store families (r15): optional blocks —
+    # absent from BOTH sides means the scenario never exercised them
+    # (silently not-applicable, not a noteworthy skip); absent from
+    # ONE side reports as skipped like every other dimension
+    def opt(row_fn, dim, b, c, tol, **kw):
+        if b is None and c is None:
+            return
+        row_fn(dim, b, c, tol, **kw)
+
+    opt(
+        frac_row,
+        "incremental.suffix_fraction",
+        _num(base, "obs", "incremental", "suffix_fraction"),
+        _num(cand, "obs", "incremental", "suffix_fraction"),
+        th.suffix_frac,
+        note="re-dispatched rows / (re-dispatched + prefix-reused)",
+    )
+    opt(
+        frac_row,
+        "aot_store.hit_rate",
+        _num(base, "obs", "aot_store", "hit_rate"),
+        _num(cand, "obs", "aot_store", "hit_rate"),
+        th.store_frac,
+        higher_is_better=True,
+        note="store loads / (loads + compile misses)",
+    )
+    opt(
+        abs_row,
+        "aot_store.rejects",
+        _num(base, "obs", "aot_store", "rejects"),
+        _num(cand, "obs", "aot_store", "rejects"),
+        th.store_reject_abs,
+        note="corrupt/stale store entries refused (each one recompiles)",
     )
     # per-site latency p95s: every site present in BOTH records
     bh = base.get("obs", {}).get("histograms")

@@ -71,6 +71,17 @@ def _aot_enabled() -> bool:
     return os.environ.get("SIMON_AOT", "1") != "0"
 
 
+def _artifact_store():
+    """The armed persistent artifact store, or None. Lazy sibling
+    import: the obs package must load without incremental/ (and the
+    store is consulted only on the rare compile path)."""
+    try:
+        from ..incremental.store import current_store
+    except ImportError:
+        return None
+    return current_store()
+
+
 def _ledger_enabled() -> bool:
     return os.environ.get("SIMON_LEDGER", "1") != "0"
 
@@ -183,8 +194,28 @@ class InstrumentedJit:
         failure retires the signature to the plain path (logged —
         never silent, never fatal). ``_lock`` owns the signature cache
         (`_aot`/`_aot_on`); ``_fn``/``name`` are immutable after
-        construction and stay out of the locked region."""
+        construction and stay out of the locked region.
+
+        When a persistent artifact store is armed (``--aot-store`` /
+        SIMON_AOT_STORE, incremental/store.py), a verified store entry
+        is loaded INSTEAD of compiling — the zero-compile cold start:
+        the recompile counter does not move, the load is counted
+        (``aot_store_hit_total``). Fresh compiles are serialized back
+        (outside the lock: the save fsyncs). A rejected/corrupt entry
+        was already counted and logged by the store; it lands here as
+        a plain compile."""
         fn, name = self._fn, self.name
+        with self._lock:
+            entry = self._aot.get(key, _UNSET)
+        if entry is not _UNSET:
+            # raced: another thread already compiled/loaded/retired it —
+            # skip the store probe (a second full deserialization would
+            # also double-count the hit)
+            return entry
+        lead_dim = self._lead_dim(args)
+        store = _artifact_store()
+        loaded = store.load(name, key) if store is not None else None
+        to_save = None
         with self._lock:
             entry = self._aot.get(key, _UNSET)
             if entry is not _UNSET:
@@ -197,6 +228,12 @@ class InstrumentedJit:
                 )
                 self._aot_on = False
                 return None
+            if loaded is not None:
+                compiled, rec = loaded
+                COSTS.record(name, key, rec, loaded=True)
+                entry = (compiled, rec)
+                self._aot[key] = entry
+                return entry
             try:
                 compiled = fn.lower(*args).compile()
             except Exception as e:  # noqa: BLE001 - AOT is an optimization: any lowering/compile fault falls back to the plain jit call, which surfaces real errors itself
@@ -209,13 +246,14 @@ class InstrumentedJit:
                 return None
             COUNTERS.inc("jax_recompiles_total")
             COUNTERS.inc(f"jax_recompiles_{name}")
-            rec = extract_record(
-                name, compiled, lead_dim=self._lead_dim(args)
-            )
+            rec = extract_record(name, compiled, lead_dim=lead_dim)
             COSTS.record(name, key, rec)
             entry = (compiled, rec)
             self._aot[key] = entry
-            return entry
+            to_save = entry
+        if store is not None and to_save is not None:
+            store.save(name, key, to_save[0], to_save[1])
+        return entry
 
     # -- dispatch -----------------------------------------------------------
 

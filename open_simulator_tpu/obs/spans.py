@@ -503,6 +503,20 @@ def observatory_block() -> dict:
     per_device = LEDGER.device_summary()
     if per_device:
         out["per_device"] = per_device
+    # incremental re-simulation + persistent artifact-store counters
+    # (incremental/: ROADMAP item 3) — suffix_fraction and hit_rate
+    # are doctor-gated dimensions (obs/doctor.py)
+    try:
+        from ..incremental.store import aot_store_block, incremental_block
+    except ImportError:  # pragma: no cover - partial install
+        aot_store_block = incremental_block = None
+    if incremental_block is not None:
+        inc = incremental_block()
+        if inc:
+            out["incremental"] = inc
+        store = aot_store_block()
+        if store:
+            out["aot_store"] = store
     if RECORDER.dropped:
         out["spans_dropped"] = RECORDER.dropped
     return out

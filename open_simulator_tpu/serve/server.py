@@ -468,6 +468,59 @@ def _observatory_lines(snap: dict) -> List[str]:
         "Ahead-of-time compiles (one per new shape-signature per site).",
         counts.get("jax_cost_compiles_total", 0),
     )
+    # -- persistent artifact store (incremental/store.py)
+    metric(
+        "simon_aot_store_hit_total", "counter",
+        "Executables loaded from the persistent artifact store instead "
+        "of compiling (--aot-store).",
+        counts.get("aot_store_hit_total", 0),
+    )
+    metric(
+        "simon_aot_store_miss_total", "counter",
+        "Store probes that found no entry (first compile of a shape).",
+        counts.get("aot_store_miss_total", 0),
+    )
+    metric(
+        "simon_aot_store_reject_total", "counter",
+        "Store entries refused loudly: corrupt, torn, or wrong "
+        "toolchain digest — each one recompiled cleanly.",
+        counts.get("aot_store_reject_total", 0),
+    )
+    metric(
+        "simon_aot_store_save_total", "counter",
+        "Fresh compiles serialized back to the store (tmp+rename).",
+        counts.get("aot_store_save_total", 0),
+    )
+    # -- delta re-simulation (incremental/resim.py)
+    metric(
+        "simon_incremental_suffix_pods_total", "counter",
+        "Pod rows actually re-dispatched by incremental paths (what-if "
+        "suffixes, delta re-simulations, timeline window free rows).",
+        counts.get("incremental_suffix_pods_total", 0),
+    )
+    metric(
+        "simon_incremental_prefix_reused_pods_total", "counter",
+        "Pod rows whose committed placements were reused instead of "
+        "re-scanned.",
+        counts.get("incremental_prefix_reused_pods_total", 0),
+    )
+    metric(
+        "simon_incremental_resims_total", "counter",
+        "Suffix re-simulations applied to a committed scan.",
+        counts.get("incremental_resims_total", 0),
+    )
+    metric(
+        "simon_incremental_full_rebuilds_total", "counter",
+        "Committed-scan full re-scans (conservative rule or degraded "
+        "fault path; results identical either way).",
+        counts.get("incremental_full_rebuilds_total", 0),
+    )
+    metric(
+        "simon_incremental_fallbacks_total", "counter",
+        "Classified faults that degraded an incremental path to the "
+        "full one.",
+        counts.get("incremental_fallbacks_total", 0),
+    )
     metric(
         "simon_jax_cost_flops_dispatched_total", "counter",
         "FLOPs itemized across every AOT dispatch.",
@@ -935,6 +988,12 @@ class ServeDaemon:
                     ("X-Simon-Batch-Size", str(reply.meta.get("batchSize", ""))),
                     rid_header,
                 ]
+                if reply.meta.get("incremental"):
+                    # diagnostic only: the body is byte-identical to the
+                    # full path; this names the suffix-dispatch route
+                    headers.append(
+                        ("X-Simon-Incremental", str(reply.meta["incremental"]))
+                    )
                 if want_trace:
                     headers.append(
                         ("X-Simon-Trace", json.dumps(reply.meta, sort_keys=True))

@@ -119,27 +119,30 @@ def result_payload(result: SimulateResult) -> bytes:
     return json.dumps(out, sort_keys=True, separators=(",", ":")).encode()
 
 
-def _own_pod(p: dict) -> dict:
-    """Shallow-clone the mutation surface of a pod dict (bind writes
-    spec.nodeName / status.phase / metadata.annotations) so replaying a
-    scenario never pollutes the shared cluster pods or a request's
-    expansion — the next batch re-encodes those dicts and a stale
-    nodeName would read as a pin."""
-    q = dict(p)
-    q["spec"] = dict(p.get("spec") or {})
-    meta = dict(p.get("metadata") or {})
-    if meta.get("annotations") is not None:
-        meta["annotations"] = dict(meta["annotations"])
-    q["metadata"] = meta
-    if isinstance(q.get("status"), dict):
-        q["status"] = dict(q["status"])
-    return q
+# Shallow-clone of a pod's mutation surface (bind writes spec.nodeName
+# / status.phase / metadata.annotations) so replaying a scenario never
+# pollutes the shared cluster pods or a request's expansion — the next
+# batch re-encodes those dicts and a stale nodeName would read as a
+# pin. ONE definition, shared with the committed-scan machinery: the
+# mutation surface must never diverge between the two replay paths.
+from ..incremental.resim import own_pod as _own_pod  # noqa: E402
 
 
 class Session:
-    """One warm cluster + the machinery to answer request batches."""
+    """One warm cluster + the machinery to answer request batches.
 
-    def __init__(self, cluster: ResourceTypes):
+    With ``incremental`` (the default; ``--no-incremental`` disables),
+    the session keeps its cluster pods COMMITTED in a resident oracle
+    (incremental/resim.CommittedScan): each what-if tick then scans
+    ONLY the request pods (the suffix) against that warm state instead
+    of re-scanning the whole roster per scenario row, and a
+    ``/v1/cluster-delta`` re-simulates only the journal suffix the
+    conservative dependency rule says could change. Bodies stay
+    byte-identical to the full path (conformance-gated); ineligible
+    clusters (priority, plugins) and classified faults degrade to the
+    full path, counted and trace-noted."""
+
+    def __init__(self, cluster: ResourceTypes, incremental: bool = True):
         import threading
 
         from ..scheduler.engine import TpuEngine
@@ -147,6 +150,9 @@ class Session:
         from ..utils.trace import phase
 
         self.cluster = cluster
+        self.incremental = bool(incremental)
+        self._committed = None  # CommittedScan, built lazily
+        self._committed_broken = False  # classified build fault: stay full
         self.fingerprint = config_fingerprint(
             {k: getattr(cluster, k) for k in sorted(vars(cluster))}
         )
@@ -304,14 +310,22 @@ class Session:
         # one pod axis for the whole tick: cluster pods first (active
         # in every scenario), then each request's pods (active only in
         # its own row) — scenario r's scan order equals the standalone
-        # run's schedule order
-        all_pods = list(self.cluster_pods)
+        # run's schedule order. With a committed scan resident
+        # (incremental/resim.py) the cluster pods are ALREADY committed
+        # in its warm oracle, so the pod axis carries only the request
+        # pods (the suffix) and the roster is never re-scanned — the
+        # sequential-commit property keeps placements identical
+        # (exactly the multi-batch contract of schedule_app)
+        committed = self._committed_scan()
+        scan_engine = committed.engine if committed is not None else self.engine
+        scan_oracle = committed.oracle if committed is not None else self.oracle
+        all_pods = [] if committed is not None else list(self.cluster_pods)
         req_span = {}
         for r_i in batched:
             lo = len(all_pods)
             all_pods.extend(expanded[r_i])
             req_span[r_i] = (lo, len(all_pods))
-        node_index = self.oracle.node_index
+        node_index = scan_oracle.node_index
         # pods pinned to unknown nodes never reach the scheduler
         # (begin_batch contract; reference simulator.go:221-229)
         pos_of = np.full(len(all_pods), -1, dtype=np.int64)
@@ -323,7 +337,9 @@ class Session:
             pos_of[i] = len(batch_idx)
             batch_idx.append(i)
         n_batch = len(batch_idx)
-        n_cluster = len(self.cluster_pods)
+        n_cluster = len(all_pods) - sum(
+            hi - lo for lo, hi in req_span.values()
+        )
 
         bidx_arr = np.asarray(batch_idx, dtype=np.int64)
         actives = np.zeros((len(batched), n_batch), dtype=bool)
@@ -332,18 +348,27 @@ class Session:
             actives[row] = (bidx_arr < n_cluster) | (
                 (bidx_arr >= lo) & (bidx_arr < hi)
             )
+        if committed is not None:
+            # suffix accounting: this tick dispatched only the request
+            # pods; the committed roster rode along as warm state
+            COUNTERS.inc("incremental_suffix_pods_total", n_batch)
+            COUNTERS.inc(
+                "incremental_prefix_reused_pods_total", committed.total
+            )
 
         if n_batch:
             with phase("serve/encode"):
-                self.engine.begin_batch([all_pods[i] for i in batch_idx])
+                scan_engine.begin_batch([all_pods[i] for i in batch_idx])
 
             def evaluate(lo, hi):
                 COUNTERS.inc("serve_device_dispatches_total")
-                rows = self.engine.scan_scenarios(actives[lo:hi])
+                rows = scan_engine.scan_scenarios(actives[lo:hi])
                 return [np.asarray(r) for r in rows]
 
             def serial_fallback(i):
-                return self._serial_placements(actives[i], batch_idx, all_pods)
+                return self._serial_placements(
+                    actives[i], batch_idx, all_pods, base=committed
+                )
 
             from ..obs.costs import COSTS
 
@@ -365,13 +390,224 @@ class Session:
                     (i, all_pods[i])
                     for i in list(range(n_cluster)) + list(range(lo, hi))
                 ]
-                result = self._replay(scenario_pods, rows[row], pos_of)
+                meta = {"engine": "coalesced-scan"}
+                if committed is not None:
+                    result = self._assemble_incremental(
+                        committed, scenario_pods, rows[row], pos_of
+                    )
+                    # same coalesced contract, suffix-only dispatch;
+                    # the body stays byte-identical — only this
+                    # diagnostic header differs
+                    meta["incremental"] = "suffix"
+                else:
+                    result = self._replay(scenario_pods, rows[row], pos_of)
                 replies[r_i] = WhatIfReply(
-                    status=200,
-                    body=result_payload(result),
-                    meta={"engine": "coalesced-scan"},
+                    status=200, body=result_payload(result), meta=meta
                 )
         return replies
+
+    # -- incremental committed state (incremental/resim.py) -----------------
+
+    def _committed_scan(self):
+        """The resident CommittedScan, built lazily at the first
+        eligible batched tick (so daemon warm-up pays the one full
+        scan, not the first caller). None = run the full per-tick
+        path: incremental off, cluster ineligible (serial reasons),
+        or a classified fault latched the degradation."""
+        if (
+            not self.incremental
+            or self.force_serial_reason
+            or self._committed_broken
+        ):
+            return None
+        if self._committed is None:
+            from ..incremental.resim import CommittedScan
+            from ..runtime.errors import (
+                BackendUnavailable,
+                CompileFailure,
+                DeviceOOM,
+                ExternalIOError,
+            )
+            from ..utils.trace import GLOBAL
+
+            try:
+                self._committed = CommittedScan(
+                    self.cluster.nodes, self.cluster_pods
+                )
+            except (
+                DeviceOOM, CompileFailure, BackendUnavailable,
+                ExternalIOError,
+            ) as e:
+                import logging
+
+                COUNTERS.inc("incremental_fallbacks_total")
+                GLOBAL.note(
+                    "incremental-degraded",
+                    f"committed build: {type(e).__name__}",
+                )
+                logging.getLogger(__name__).warning(
+                    "incremental committed scan unavailable (%s); serving "
+                    "the full per-tick scan path", e,
+                )
+                self._committed_broken = True
+                return None
+        return self._committed
+
+    def _update_committed(self, kind, positions=(), insert_position=None):
+        """Delta follow-up: re-simulate the affected journal suffix of
+        the resident committed scan (suffix_for_delta's conservative
+        rule), falling back to the full re-scan — identical results —
+        on a classified fault. Caller holds the delta lock."""
+        if self._committed is None:
+            return
+        if self.force_serial_reason:
+            # the delta made the cluster scan-ineligible (priority):
+            # every later request routes serial; drop the warm state
+            self._committed = None
+            return
+        from ..incremental.resim import CommittedScan, suffix_for_delta
+        from ..runtime.errors import (
+            BackendUnavailable,
+            CompileFailure,
+            DeviceOOM,
+            ExternalIOError,
+        )
+        from ..utils.trace import GLOBAL
+
+        committed = self._committed
+        decision = suffix_for_delta(
+            kind,
+            len(self.cluster_pods),
+            positions=positions,
+            insert_position=insert_position,
+            has_side_effects=not committed.bulk_eligible,
+        )
+        try:
+            if decision.trivial:
+                return
+            if decision.full:
+                GLOBAL.note("incremental-full-rescan", decision.reason)
+                COUNTERS.inc("incremental_full_rebuilds_total")
+                self._committed = CommittedScan(
+                    self.cluster.nodes, self.cluster_pods
+                )
+            else:
+                self._committed = committed.resimulate(
+                    self.cluster_pods, decision.start
+                )
+        except (
+            DeviceOOM, CompileFailure, BackendUnavailable, ExternalIOError,
+        ) as e:
+            import logging
+
+            COUNTERS.inc("incremental_fallbacks_total")
+            GLOBAL.note(
+                "incremental-degraded", f"{kind}: {type(e).__name__}"
+            )
+            logging.getLogger(__name__).warning(
+                "incremental suffix re-simulation degraded to a full "
+                "re-scan (%s)", e,
+            )
+            try:
+                COUNTERS.inc("incremental_full_rebuilds_total")
+                self._committed = CommittedScan(
+                    self.cluster.nodes, self.cluster_pods
+                )
+            except (
+                DeviceOOM, CompileFailure, BackendUnavailable,
+                ExternalIOError,
+            ):
+                # even the full re-scan is faulting: revert to the
+                # (guard-laddered) per-tick path until a reload
+                self._committed = None
+                self._committed_broken = True
+
+    def _assemble_incremental(
+        self, committed, scenario_pods, placements, pos_of
+    ) -> SimulateResult:
+        """One scenario's SimulateResult on top of the committed
+        prefix. All-placed scenarios (the warm common case) append the
+        request placements to the committed node lists — zero host
+        replay of the roster. A scenario with failures takes the
+        exact-reasons path: a scratch oracle seeded from the committed
+        state, request pods replayed per the engine-replay contract,
+        so reasons read their own step's state — still no device
+        work. Committed-pod failures carry their build-time reasons
+        (same prefix state, deterministic formula)."""
+        oracle = committed.oracle
+        has_failure = False
+        for i, pod in scenario_pods:
+            pos = int(pos_of[i])
+            if pos < 0:
+                continue
+            place = int(placements[pos])
+            if place == INACTIVE:
+                continue
+            if place < 0 and not (pod.get("spec") or {}).get("nodeName"):
+                has_failure = True
+                break
+        if has_failure:
+            return self._replay_on_committed(
+                committed, scenario_pods, placements, pos_of
+            )
+        appended = {}
+        for i, pod in scenario_pods:
+            pos = int(pos_of[i])
+            if pos < 0:
+                continue  # dangling: tracked, absent from node status
+            place = int(placements[pos])
+            if place == INACTIVE:  # pragma: no cover - defensive
+                continue
+            name = (pod.get("spec") or {}).get("nodeName")
+            idx = oracle.node_index[name] if name else place
+            appended.setdefault(int(idx), []).append(pod)
+        status = [
+            NodeStatus(
+                node=ns.node,
+                pods=list(ns.pods) + appended.get(idx, []),
+            )
+            for idx, ns in enumerate(oracle.nodes)
+        ]
+        return SimulateResult(
+            unscheduled_pods=list(committed.failed), node_status=status
+        )
+
+    def _replay_on_committed(
+        self, committed, scenario_pods, placements, pos_of
+    ) -> SimulateResult:
+        """Exact-reasons scenario replay: scratch oracle holding the
+        committed state (host-only place_existing walk over the
+        committed node lists — the twin's _scratch_oracle pattern),
+        then the request pods in scan order."""
+        oracle = Oracle([ns.node for ns in committed.oracle.nodes])
+        for ns in committed.oracle.nodes:
+            for p in ns.pods:
+                oracle.place_existing_pod(_own_pod(p))
+        failed: List[UnscheduledPod] = list(committed.failed)
+        for i, pod in scenario_pods:
+            pos = int(pos_of[i])
+            if pos < 0:
+                continue
+            place = int(placements[pos])
+            if place == INACTIVE:  # pragma: no cover - defensive
+                continue
+            pod2 = _own_pod(pod)
+            if (pod.get("spec") or {}).get("nodeName"):
+                oracle.place_existing_pod(pod2)
+            elif place < 0:
+                _, reasons, _ = oracle._find_feasible(pod2)
+                failed.append(
+                    UnscheduledPod(
+                        pod=pod2,
+                        reason=Oracle._failure_message(pod2, reasons),
+                    )
+                )
+            else:
+                oracle._reserve_and_bind(pod2, oracle.nodes[place])
+        status = [
+            NodeStatus(node=ns.node, pods=list(ns.pods)) for ns in oracle.nodes
+        ]
+        return SimulateResult(unscheduled_pods=failed, node_status=status)
 
     def _replay(self, scenario_pods, placements, pos_of) -> SimulateResult:
         """Mirror one scenario's placements into a fresh host oracle in
@@ -408,12 +644,21 @@ class Session:
         ]
         return SimulateResult(unscheduled_pods=failed, node_status=status)
 
-    def _serial_placements(self, active, batch_idx, all_pods) -> np.ndarray:
+    def _serial_placements(
+        self, active, batch_idx, all_pods, base=None
+    ) -> np.ndarray:
         """Deterministic host-oracle evaluation of ONE scenario row —
         the guard ladder's floor when even a single-scenario dispatch
         dies on the device. Same conventions as the scan: node index,
-        -1 unschedulable, INACTIVE for masked-off positions."""
+        -1 unschedulable, INACTIVE for masked-off positions. ``base``
+        (a CommittedScan) seeds the scratch with the committed state
+        first — the incremental path's rows carry only request pods,
+        so the roster must arrive through the prefix."""
         oracle = Oracle([ns.node for ns in self.oracle.nodes])
+        if base is not None:
+            for ns in base.oracle.nodes:
+                for p in ns.pods:
+                    oracle.place_existing_pod(_own_pod(p))
         node_index = self.oracle.node_index
         out = np.full(len(batch_idx), INACTIVE, dtype=np.int64)
         for pos, i in enumerate(batch_idx):
@@ -497,8 +742,9 @@ class Session:
             # re-arrival of a live key replaces the stale entry (its
             # roster slot moves to the section end — the order a cold
             # reload of the mutated cluster.pods list would expand)
-            self._remove_roster_pod(delta.pod_key)
+            removed_at = self._remove_roster_pod(delta.pod_key)
             valid = wl.pod_from_pod(copy.deepcopy(raw))
+            insert_at = self._bare_end
             self.cluster.pods.append(raw)
             self.cluster_pods.insert(self._bare_end, valid)
             self._bare_end += 1
@@ -506,13 +752,16 @@ class Session:
                 valid, self._resolver
             ):
                 self.force_serial_reason = "cluster pods carry priority"
+            self._update_committed(
+                kind, positions=(removed_at,), insert_position=insert_at
+            )
             return dl.APPLIED
         if kind in (dl.POD_EVICT, dl.POD_DELETE):
-            return (
-                dl.APPLIED
-                if self._remove_roster_pod(delta.pod_key)
-                else dl.SKIPPED
-            )
+            removed_at = self._remove_roster_pod(delta.pod_key)
+            if removed_at is None:
+                return dl.SKIPPED
+            self._update_committed(kind, positions=(removed_at,))
+            return dl.APPLIED
         if kind == dl.NODE_JOIN:
             if any(
                 (n.get("metadata") or {}).get("name") == delta.node_name
@@ -523,6 +772,7 @@ class Session:
             if self.cluster.daemon_sets:
                 return self._reload()
             self.oracle.add_node(delta.node)
+            self._update_committed(kind)
             return dl.APPLIED
         # node_drain: node identity is baked into every encoding
         from ..models.validation import InputError
@@ -541,11 +791,13 @@ class Session:
         ]
         return self._reload()
 
-    def _remove_roster_pod(self, key) -> bool:
+    def _remove_roster_pod(self, key) -> Optional[int]:
         """Drop a bare-section roster pod (and its cluster.pods source
-        entry) by (namespace, name). Workload-expanded replicas are out
-        of scope: their source object is the workload, which a delta
-        stream cannot partially shrink — counted skip instead."""
+        entry) by (namespace, name); returns the roster position it
+        held (the suffix rule's touch point) or None when the key is
+        unknown. Workload-expanded replicas are out of scope: their
+        source object is the workload, which a delta stream cannot
+        partially shrink — counted skip instead."""
         for i in range(self._bare_end):
             meta = self.cluster_pods[i].get("metadata") or {}
             if (meta.get("namespace") or "default", meta.get("name", "")) == key:
@@ -559,8 +811,8 @@ class Session:
                     ) == key:
                         self.cluster.pods.pop(j)
                         break
-                return True
-        return False
+                return i
+        return None
 
     def _reload(self) -> str:
         """Counted session rebuild over the mutated cluster: the
@@ -575,7 +827,7 @@ class Session:
 
         fp = self.fingerprint
         seq, reloads = self.delta_seq, self.delta_reloads
-        self.__init__(self.cluster)
+        self.__init__(self.cluster, incremental=self.incremental)
         self.fingerprint = fp
         self.delta_seq, self.delta_reloads = seq, reloads + 1
         return RELOADED

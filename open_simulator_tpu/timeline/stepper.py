@@ -441,6 +441,17 @@ class TimelineStepper:
                         for r in range(len(work))
                     ])
             self.dispatches += 1
+            # incremental accounting (ROADMAP item 3 vocabulary): each
+            # window re-decides only its FREE pods — rows placed in
+            # earlier windows ride along as pins, the reused prefix
+            from ..utils.trace import COUNTERS
+
+            free_rows = int(
+                sum(self._free_mask(self.states[k]).sum() for k in work)
+            )
+            pinned_rows = int((pins >= 0).sum())
+            COUNTERS.inc("incremental_suffix_pods_total", free_rows)
+            COUNTERS.inc("incremental_prefix_reused_pods_total", pinned_rows)
             for r, k in enumerate(work):
                 rows[k] = np.asarray(placements[r], dtype=np.int64)
             if self.journal is not None:

@@ -91,6 +91,17 @@ def _scan_pods(mirror, pods: List[dict], valid: Optional[np.ndarray]) -> np.ndar
     engine = mirror.engine
     if engine is not None:
         COUNTERS.inc("twin_query_dispatches_total")
+        # the twin IS the incremental design: the mirror's committed
+        # pods are warm state, the query pods are the dispatched
+        # suffix — account them in the same counter family the serve
+        # committed scan feeds (incremental/store.incremental_block).
+        # The O(nodes) pod-count walk is noise next to the query's own
+        # scratch replay (which re-places every committed pod)
+        COUNTERS.inc("incremental_suffix_pods_total", len(batch_idx))
+        COUNTERS.inc(
+            "incremental_prefix_reused_pods_total",
+            sum(len(ns.pods) for ns in oracle.nodes),
+        )
         engine.begin_batch([pods[i] for i in batch_idx])
         placements = engine.scan_active(
             np.ones(len(batch_idx), dtype=bool), valid=valid

@@ -115,6 +115,15 @@ MESH_CELLS = [
     ("CompileFailure", "mesh", "jit.mesh_*=compile@1"),
 ]
 
+# the incremental seams (incremental/: ROADMAP item 3): a fault at the
+# artifact-store load degrades to a loud reject + clean recompile; a
+# fault at the suffix re-simulation degrades to the full re-scan —
+# results identical either way, both trace-noted
+INCR_CELLS = [
+    ("ExternalIOError", "incremental", "aot.store_load=exio@1x*"),
+    ("ExternalIOError", "incremental", "incremental.suffix=exio@1x*"),
+]
+
 #: taxonomy class name -> matrix cell ids proving its injection
 #: coverage. simonlint RT002 statically requires every GuardError
 #: subtype to appear here; test_registry_is_closed_over_cells keeps
@@ -136,6 +145,7 @@ INJECTION_COVERAGE = {
     ],
     "ExternalIOError": [
         "ExternalIOError/io", "ExternalIOError/io", "ExternalIOError/twin",
+        "ExternalIOError/incremental", "ExternalIOError/incremental",
     ],
     "ConformanceError": [
         "ConformanceError/apply", "ConformanceError/serve",
@@ -164,6 +174,7 @@ def test_registry_is_closed_over_cells():
     live |= {f"{e}/{s}" for e, s, *_ in IO_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in TWIN_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in MESH_CELLS}
+    live |= {f"{e}/{s}" for e, s, *_ in INCR_CELLS}
     registered = {cid for ids in INJECTION_COVERAGE.values() for cid in ids}
     assert registered == live, (
         f"registry drift: only-registered={sorted(registered - live)} "
@@ -729,3 +740,94 @@ def test_mesh_cell_fault_degrades_to_single_device(error, _subsystem, spec):
     assert any("mesh-scenario -> xla-scan" in str(v) for v in notes.values()), (
         "downgrade not trace-noted", notes,
     )
+
+
+# ------------------------------------------------------- incremental cells
+
+
+def test_incremental_cell_store_load_fault_degrades_to_recompile(tmp_path):
+    """ExternalIOError/incremental (aot.store_load seam): with a warm
+    artifact store on disk, an injected I/O fault at the load seam is a
+    counted loud reject; the site recompiles cleanly and the dispatch
+    answers IDENTICALLY — a bad store can cost a compile, never an
+    answer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from open_simulator_tpu.incremental.store import configure_store
+    from open_simulator_tpu.obs import profile
+
+    configure_store(str(tmp_path))
+    try:
+        warm = profile.instrument_jit(jax.jit(lambda x: x * 3 + 1), "chaosstore")
+        want = np.asarray(warm(jnp.arange(16.0)))
+        assert COUNTERS.get("aot_store_save_total") >= 1, "no entry persisted"
+        rejects0 = COUNTERS.get("aot_store_reject_total")
+        recompiles0 = COUNTERS.get("jax_recompiles_total")
+        INJECT.configure(INCR_CELLS[0][2])
+        try:
+            cold = profile.instrument_jit(
+                jax.jit(lambda x: x * 3 + 1), "chaosstore"
+            )
+            got = np.asarray(cold(jnp.arange(16.0)))
+        finally:
+            INJECT.clear()
+        assert np.array_equal(got, want)
+        assert COUNTERS.get("aot_store_reject_total") > rejects0, (
+            "store fault was not a counted reject"
+        )
+        assert COUNTERS.get("jax_recompiles_total") > recompiles0, (
+            "degradation must recompile, not serve a stale artifact"
+        )
+    finally:
+        configure_store(None)
+
+
+def test_incremental_cell_suffix_fault_degrades_to_full_rescan():
+    """ExternalIOError/incremental (incremental.suffix seam): a fault
+    at the suffix re-simulation degrades the delta to a FULL re-scan —
+    committed state identical to an uninjected control, the fallback
+    counted and trace-noted, and the session keeps answering."""
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.serve.session import Session
+    from open_simulator_tpu.testing import make_fake_node, make_fake_pod
+    from open_simulator_tpu.twin.deltas import POD_EVICT, ClusterDelta
+    from open_simulator_tpu.utils.trace import GLOBAL
+
+    def build():
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            make_fake_node(f"inc-n{i}", "8", "16Gi") for i in range(6)
+        ]
+        cluster.pods = [
+            make_fake_pod(f"inc-p{i:02d}", "default", "500m", "1Gi")
+            for i in range(20)
+        ]
+        return Session(cluster)
+
+    delta = ClusterDelta(kind=POD_EVICT, namespace="default", name="inc-p15")
+
+    control = build()
+    assert control._committed_scan() is not None
+    assert control.apply_delta(delta) == "applied"
+    want = control._committed_scan().state_digest()
+
+    injected = build()
+    assert injected._committed_scan() is not None
+    fallbacks0 = COUNTERS.get("incremental_fallbacks_total")
+    INJECT.configure(INCR_CELLS[1][2])
+    try:
+        assert injected.apply_delta(delta) == "applied"
+    finally:
+        INJECT.clear()
+    assert COUNTERS.get("incremental_fallbacks_total") > fallbacks0, (
+        "suffix fault was not a counted fallback"
+    )
+    got = injected._committed_scan()
+    assert got is not None, "full-rescan fallback must restore the scan"
+    assert got.state_digest() == want, "degraded path changed the answer"
+    notes = GLOBAL.as_dict().get("notes") or {}
+    assert any(
+        "incremental-degraded" in str(k) for k in notes
+    ), ("fallback not trace-noted", notes)

@@ -1,0 +1,513 @@
+"""Delta re-simulation + persistent compile artifacts (incremental/,
+docs/PERFORMANCE.md "Incremental re-simulation").
+
+Contracts gated here:
+
+- the artifact store round-trips compiled executables across registry
+  instances at ZERO new compiles, refuses corrupt / torn / stale /
+  wrong-toolchain entries LOUDLY, and recovers by recompiling +
+  rewriting crash-safely;
+- suffix selection is CONSERVATIVE: priority tiers (and with them
+  preemption), side-effect plugin classes (gpushare / open-local
+  storage), and node joins all force the correct wider suffix — the
+  rule may widen, never narrow;
+- delta re-simulation over seeded random delta streams is dict-equal
+  to the from-scratch full re-scan after EVERY delta;
+- serve's incremental path answers byte-identically to the full
+  per-tick path (success and failure bodies), and repeated warm delta
+  shapes stay at zero jit-cache misses.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.incremental.resim import (
+    S_SIDE,
+    CommittedScan,
+    suffix_for_delta,
+)
+from open_simulator_tpu.incremental.store import (
+    ArtifactStore,
+    configure_store,
+    render_signature,
+)
+from open_simulator_tpu.models.decode import ResourceTypes
+from open_simulator_tpu.scheduler.core import AppResource
+from open_simulator_tpu.serve.session import Session, WhatIfRequest
+from open_simulator_tpu.testing import make_fake_node, make_fake_pod
+from open_simulator_tpu.twin.deltas import (
+    NODE_JOIN,
+    POD_ARRIVE,
+    POD_DELETE,
+    POD_EVICT,
+    ClusterDelta,
+)
+from open_simulator_tpu.utils.trace import COUNTERS
+
+
+@pytest.fixture(autouse=True)
+def _no_store():
+    """Tests arm the store explicitly; never inherit one from the
+    environment or a previous test."""
+    configure_store(None)
+    yield
+    configure_store(None)
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _nodes(n=8, cpu="8", mem="16Gi"):
+    return [make_fake_node(f"n{i:02d}", cpu, mem) for i in range(n)]
+
+
+def _pods(n, prefix="p", cpu="500m", mem="1Gi"):
+    return [
+        make_fake_pod(f"{prefix}{i:03d}", "default", cpu, mem)
+        for i in range(n)
+    ]
+
+
+def _cluster(nodes, pods):
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    cluster.pods = pods
+    return cluster
+
+
+def _request(name="req", n=2, cpu="250m", mem="256Mi"):
+    app = ResourceTypes()
+    app.pods = [
+        make_fake_pod(f"{name}-{i}", "default", cpu, mem) for i in range(n)
+    ]
+    return WhatIfRequest(apps=[AppResource(name, app)])
+
+
+# ----------------------------------------------------------- store contract
+
+
+def test_store_round_trip_zero_compiles(tmp_path):
+    """A second jit-site instance (a fresh process's registry) loads
+    the persisted executable instead of compiling: recompile counter
+    unmoved, results identical."""
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.obs import profile
+
+    configure_store(str(tmp_path))
+    warm = profile.instrument_jit(jax.jit(lambda x: x * 2 + 1), "storert")
+    want = np.asarray(warm(jnp.arange(32.0)))
+    assert COUNTERS.get("aot_store_save_total") >= 1
+
+    r0 = COUNTERS.get("jax_recompiles_total")
+    h0 = COUNTERS.get("aot_store_hit_total")
+    cold = profile.instrument_jit(jax.jit(lambda x: x * 2 + 1), "storert")
+    got = np.asarray(cold(jnp.arange(32.0)))
+    assert np.array_equal(got, want)
+    assert COUNTERS.get("jax_recompiles_total") == r0, "store hit recompiled"
+    assert COUNTERS.get("aot_store_hit_total") == h0 + 1
+
+
+def test_store_corrupt_payload_refused_and_recompiled(tmp_path):
+    """Flipped payload bytes: the sha256 gate refuses the entry loudly
+    (reject counted, warning logged) BEFORE any deserialization, the
+    site recompiles, and the fresh save overwrites the bad entry."""
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.obs import profile
+
+    configure_store(str(tmp_path))
+    warm = profile.instrument_jit(jax.jit(lambda x: x - 7), "storecorrupt")
+    want = np.asarray(warm(jnp.arange(16.0)))
+    entries = list(tmp_path.glob("*.aotx"))
+    assert len(entries) == 1
+    blob = entries[0].read_bytes()
+    entries[0].write_bytes(blob[:-32] + b"\x00" * 32)
+
+    r0 = COUNTERS.get("jax_recompiles_total")
+    j0 = COUNTERS.get("aot_store_reject_total")
+    s0 = COUNTERS.get("aot_store_save_total")
+    cold = profile.instrument_jit(jax.jit(lambda x: x - 7), "storecorrupt")
+    got = np.asarray(cold(jnp.arange(16.0)))
+    assert np.array_equal(got, want)
+    assert COUNTERS.get("aot_store_reject_total") == j0 + 1
+    assert COUNTERS.get("jax_recompiles_total") == r0 + 1
+    assert COUNTERS.get("aot_store_save_total") == s0 + 1, (
+        "recovery must rewrite the entry"
+    )
+    # the rewritten entry verifies again
+    h0 = COUNTERS.get("aot_store_hit_total")
+    third = profile.instrument_jit(jax.jit(lambda x: x - 7), "storecorrupt")
+    assert np.array_equal(np.asarray(third(jnp.arange(16.0))), want)
+    assert COUNTERS.get("aot_store_hit_total") == h0 + 1
+
+
+def test_store_torn_write_refused(tmp_path):
+    """A torn entry (truncated mid-payload, the crash shape tmp+rename
+    exists to prevent from ever being the LIVE file) is refused as
+    loudly as corruption."""
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.obs import profile
+
+    configure_store(str(tmp_path))
+    warm = profile.instrument_jit(jax.jit(lambda x: x * x), "storetorn")
+    want = np.asarray(warm(jnp.arange(8.0)))
+    entry = next(tmp_path.glob("*.aotx"))
+    blob = entry.read_bytes()
+    entry.write_bytes(blob[: len(blob) // 3])
+
+    j0 = COUNTERS.get("aot_store_reject_total")
+    cold = profile.instrument_jit(jax.jit(lambda x: x * x), "storetorn")
+    assert np.array_equal(np.asarray(cold(jnp.arange(8.0))), want)
+    assert COUNTERS.get("aot_store_reject_total") == j0 + 1
+
+
+def test_store_tool_digest_mismatch_refused(tmp_path):
+    """An entry whose header names a different toolchain digest (other
+    jax/jaxlib/backend — or a schema bump) is stale: refused, never
+    offered to this process. The tamper rewrites the header with a
+    wrong tool digest but a CORRECT payload sha, so only the digest
+    check can catch it."""
+    import struct
+
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.incremental import store as store_mod
+    from open_simulator_tpu.obs import profile
+
+    configure_store(str(tmp_path))
+    warm = profile.instrument_jit(jax.jit(lambda x: x + 100), "storestale")
+    want = np.asarray(warm(jnp.arange(4.0)))
+    entry = next(tmp_path.glob("*.aotx"))
+    blob = entry.read_bytes()
+    off = len(store_mod._MAGIC)
+    (hlen,) = struct.unpack(">I", blob[off:off + 4])
+    header = json.loads(blob[off + 4:off + 4 + hlen])
+    header["tool"] = "deadbeef" * 3
+    hbytes = json.dumps(header, sort_keys=True).encode()
+    entry.write_bytes(
+        blob[:off] + struct.pack(">I", len(hbytes)) + hbytes
+        + blob[off + 4 + hlen:]
+    )
+
+    j0 = COUNTERS.get("aot_store_reject_total")
+    cold = profile.instrument_jit(jax.jit(lambda x: x + 100), "storestale")
+    assert np.array_equal(np.asarray(cold(jnp.arange(4.0))), want)
+    assert COUNTERS.get("aot_store_reject_total") == j0 + 1
+
+
+def test_store_unkeyable_signature_never_persists(tmp_path):
+    """A static leaf whose repr leaks an object identity cannot key a
+    cross-process entry — the signature stays in-process (no file, no
+    wrong hit)."""
+
+    class Opaque:
+        pass
+
+    sig = (None, (("static", Opaque()),))
+    assert render_signature("site", sig) is None
+    store = ArtifactStore(str(tmp_path))
+    assert store.entry_path("site", sig) is None
+
+
+def test_store_atomic_write_leaves_no_tmp(tmp_path):
+    """Entry writes are tmp+rename: after a save the directory holds
+    exactly the entry, no lingering tmp files (the crash-safety
+    discipline of the PR-2 journals)."""
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.obs import profile
+
+    configure_store(str(tmp_path))
+    jitfn = profile.instrument_jit(jax.jit(lambda x: x / 2), "storeatomic")
+    jitfn(jnp.arange(4.0))
+    names = [p.name for p in tmp_path.iterdir()]
+    assert any(n.endswith(".aotx") for n in names)
+    assert not any(".tmp." in n for n in names), names
+
+
+# ------------------------------------------------- suffix-rule conservatism
+
+
+def test_suffix_rule_evict_starts_at_position():
+    d = suffix_for_delta(POD_EVICT, 100, positions=[40])
+    assert (d.start, d.full) == (40, False)
+
+
+def test_suffix_rule_arrive_takes_min_of_replace_and_insert():
+    d = suffix_for_delta(POD_ARRIVE, 100, positions=[12], insert_position=90)
+    assert (d.start, d.full) == (12, False)
+    d = suffix_for_delta(POD_ARRIVE, 100, positions=[None], insert_position=90)
+    assert (d.start, d.full) == (90, False)
+
+
+def test_suffix_rule_priority_forces_full():
+    """Priority tiers couple arbitrary positions (preemption can evict
+    anything earlier) — the rule must refuse to narrow."""
+    d = suffix_for_delta(POD_EVICT, 100, positions=[90], has_priority=True)
+    assert d.full and "priority" in d.reason
+
+
+def test_suffix_rule_side_effects_force_full():
+    """Gpushare/storage/extender classes thread allocator state through
+    commit order — any delta on such a roster is a full re-scan."""
+    d = suffix_for_delta(POD_EVICT, 100, positions=[90], has_side_effects=True)
+    assert d.full and "side-effect" in d.reason
+
+
+def test_suffix_rule_node_join_forces_full():
+    d = suffix_for_delta(NODE_JOIN, 100)
+    assert d.full
+
+
+def test_suffix_rule_untouched_is_trivial():
+    d = suffix_for_delta(POD_EVICT, 100, positions=[])
+    assert d.trivial
+
+
+def test_gpushare_roster_journals_side_effect_rows():
+    """A committed scan over gpushare pods marks their rows
+    side-effectful, so bulk_eligible is False and resimulate() falls
+    back to the full re-scan — with identical state."""
+    gi = 1024 ** 3
+    nodes = []
+    for i in range(4):
+        node = make_fake_node(f"g{i}", "16", "64Gi")
+        # gpu-count/gpu-mem live in CAPACITY (the open-gpu-share rule)
+        node["status"]["capacity"] = {
+            "alibabacloud.com/gpu-count": "2",
+            "alibabacloud.com/gpu-mem": str(2 * 32 * gi),
+        }
+        nodes.append(node)
+    pods = _pods(6)
+    for i in (1, 4):
+        pods[i]["metadata"]["annotations"] = {
+            "alibabacloud.com/gpu-mem": str(4 * gi)
+        }
+    scan = CommittedScan(nodes, pods)
+    assert bool((scan.codes == S_SIDE).any()), "gpu rows must journal as side-effect"
+    assert not scan.bulk_eligible
+    full0 = COUNTERS.get("incremental_full_rebuilds_total")
+    roster2 = pods[:5]
+    out = scan.resimulate(roster2, 5)
+    assert COUNTERS.get("incremental_full_rebuilds_total") == full0 + 1
+    assert out.state_digest() == CommittedScan(nodes, roster2).state_digest()
+
+
+def test_priority_roster_refuses_prefix_reuse():
+    """A committed scan whose window saw priority (and with it the
+    preemption machinery — evicted victims requeue out of roster
+    order) can never seed a positional prefix replay: resimulate()
+    must take the full path, with identical state."""
+    from open_simulator_tpu.testing import with_priority
+
+    nodes = _nodes(6)
+    pods = _pods(12)
+    pods[2] = make_fake_pod("prio-p", "default", "500m", "1Gi", with_priority(50))
+    scan = CommittedScan(nodes, pods)
+    assert not scan.bulk_eligible
+    full0 = COUNTERS.get("incremental_full_rebuilds_total")
+    roster2 = pods[:8] + pods[9:]
+    out = scan.resimulate(roster2, 8)
+    assert COUNTERS.get("incremental_full_rebuilds_total") == full0 + 1
+    assert out.state_digest() == CommittedScan(nodes, roster2).state_digest()
+
+
+def test_pinned_pods_survive_prefix_reuse_and_suffix_rescan():
+    """Pinned pods journal as pin rows: in a reused prefix they replay
+    through place_existing_pod, in a re-scanned suffix they re-commit
+    — both byte-equal to the full re-scan. A pin to an unknown node
+    stays dangling."""
+    nodes = _nodes(6)
+    pods = _pods(20)
+    pods[3]["spec"]["nodeName"] = "n04"   # prefix pin
+    pods[15]["spec"]["nodeName"] = "n01"  # suffix pin
+    pods[17]["spec"]["nodeName"] = "ghost"  # dangling
+    scan = CommittedScan(nodes, pods)
+    roster2 = pods[:10] + pods[11:]  # evict position 10
+    out = scan.resimulate(roster2, 10)
+    assert out.state_digest() == CommittedScan(nodes, roster2).state_digest()
+    digest = out.state_digest()
+    assert digest["journal"][3] == ("pinned", "n04")
+    assert ("pinned", "n01") in digest["journal"]
+    assert ("dangling", "ghost") in digest["journal"]
+
+
+# --------------------------------------- seeded delta streams == full rescan
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_seeded_delta_stream_equals_full_rescan(seed):
+    """Random evict/arrive/delete streams: after EVERY delta the
+    resimulated committed state is dict-equal to a from-scratch full
+    re-scan of the mutated roster, and the serve session's answer
+    bytes match a cold incremental session AND a full-path session."""
+    rng = np.random.RandomState(seed)
+    nodes = _nodes(8, cpu="4", mem="8Gi")
+    pods = _pods(30, cpu="900m", mem="1Gi")  # tight: some failures too
+    session = Session(_cluster(nodes, [dict(p) for p in pods]))
+    assert session._committed_scan() is not None
+    arrivals = 0
+    for step in range(12):
+        kind = rng.choice([POD_EVICT, POD_ARRIVE, POD_DELETE])
+        if kind == POD_ARRIVE:
+            arrivals += 1
+            delta = ClusterDelta(
+                kind=POD_ARRIVE,
+                pod=make_fake_pod(
+                    f"arr-{seed}-{arrivals}", "default", "900m", "1Gi"
+                ),
+            )
+        else:
+            bare = session.cluster_pods[: session._bare_end]
+            if not bare:
+                continue
+            pick = bare[rng.randint(len(bare))]
+            delta = ClusterDelta(
+                kind=kind,
+                namespace="default",
+                name=(pick.get("metadata") or {}).get("name", ""),
+            )
+        session.apply_delta(delta)
+        committed = session._committed_scan()
+        assert committed is not None
+        fresh = CommittedScan(session.cluster.nodes, session.cluster_pods)
+        assert committed.state_digest() == fresh.state_digest(), (
+            f"step {step} ({delta.kind}) diverged from the full re-scan"
+        )
+    # end-to-end answer conformance over the drifted cluster
+    req = _request("drift", n=2)
+    warm_reply = session.evaluate_batch([req])[0]
+    cold_inc = Session(session.cluster).evaluate_batch([req])[0]
+    cold_full = Session(session.cluster, incremental=False).evaluate_batch(
+        [req]
+    )[0]
+    assert warm_reply.body == cold_inc.body == cold_full.body
+
+
+# ------------------------------------------------- serve path conformance
+
+
+def test_serve_incremental_bytes_identical_to_full_path():
+    """Same cluster, same requests: the incremental (suffix-dispatch)
+    session and the full per-tick session answer byte-identically —
+    including a request that FAILS (own-step reasons) and a coalesced
+    multi-request tick."""
+    nodes = _nodes(6, cpu="4", mem="8Gi")
+    pods = _pods(12, cpu="1", mem="2Gi")
+    reqs = [
+        _request("fits", n=2),
+        _request("huge", n=2, cpu="64", mem="1Gi"),  # unschedulable
+        _request("more", n=3),
+    ]
+    inc = Session(_cluster(nodes, [dict(p) for p in pods]))
+    full = Session(
+        _cluster(nodes, [dict(p) for p in pods]), incremental=False
+    )
+    inc_replies = inc.evaluate_batch(reqs)
+    full_replies = full.evaluate_batch(reqs)
+    for a, b in zip(inc_replies, full_replies):
+        assert a.status == b.status == 200
+        assert a.body == b.body
+    assert inc_replies[0].meta.get("incremental") == "suffix"
+    assert "incremental" not in full_replies[0].meta
+    # failure bodies carry reasons — and they match the full path's
+    assert not json.loads(inc_replies[1].body)["success"]
+
+
+def test_committed_cluster_failures_reported_in_every_reply():
+    """Cluster pods that cannot place report their cached build-time
+    reasons in every answer, byte-equal to the full path's per-tick
+    recomputation."""
+    nodes = _nodes(3, cpu="2", mem="4Gi")
+    pods = _pods(4, cpu="3", mem="1Gi")  # none fit (3 > 2 cpu)
+    inc = Session(_cluster(nodes, [dict(p) for p in pods]))
+    full = Session(
+        _cluster(nodes, [dict(p) for p in pods]), incremental=False
+    )
+    req = _request("tiny", n=1, cpu="100m", mem="64Mi")
+    a = inc.evaluate_batch([req])[0]
+    b = full.evaluate_batch([req])[0]
+    assert a.body == b.body
+    doc = json.loads(a.body)
+    assert not doc["success"]
+    assert len(doc["unscheduledPods"]) == 4
+
+
+def test_warm_delta_path_zero_recompiles():
+    """Repeated same-shape deltas + queries ride the jit cache: after
+    the first arrival delta + query compiled their shapes, the second
+    identical round moves NO recompile counter — the millisecond warm
+    path the ROADMAP names."""
+    from open_simulator_tpu.obs import profile
+
+    nodes = _nodes(6)
+    session = Session(_cluster(nodes, [dict(p) for p in _pods(20)]))
+    assert session._committed_scan() is not None  # build before deltas
+    req = _request("warm", n=1)
+
+    def round_trip(i):
+        session.apply_delta(
+            ClusterDelta(
+                kind=POD_ARRIVE,
+                pod=make_fake_pod(f"warm-arr-{i}", "default", "200m", "256Mi"),
+            )
+        )
+        return session.evaluate_batch([req])[0]
+
+    first = round_trip(0)  # compiles the suffix + query shapes
+    prof0 = profile.snapshot()
+    second = round_trip(1)
+    prof = profile.delta(prof0)
+    assert prof["jax_recompiles_total"] == 0, (
+        f"warm delta path recompiled: {prof}"
+    )
+    assert first.status == second.status == 200
+    resims0 = COUNTERS.get("incremental_resims_total")
+    assert resims0 > 0
+
+
+def test_priority_arrival_drops_committed_and_routes_serial():
+    """A delta that makes the cluster scan-ineligible (priority pod)
+    drops the warm committed state; later requests route serial — and
+    still answer identically to a cold session over the same
+    cluster."""
+    from open_simulator_tpu.testing import with_priority
+
+    nodes = _nodes(6)
+    session = Session(_cluster(nodes, [dict(p) for p in _pods(8)]))
+    assert session._committed_scan() is not None
+    session.apply_delta(
+        ClusterDelta(
+            kind=POD_ARRIVE,
+            pod=make_fake_pod(
+                "prio-arr", "default", "200m", "256Mi", with_priority(100)
+            ),
+        )
+    )
+    assert session.force_serial_reason
+    assert session._committed_scan() is None
+    req = _request("after-prio", n=1)
+    warm = session.evaluate_batch([req])[0]
+    cold = Session(session.cluster).evaluate_batch([req])[0]
+    assert warm.body == cold.body
+    assert warm.meta.get("engine") == "serial"
+
+
+def test_no_incremental_flag_disables_the_path():
+    nodes = _nodes(4)
+    session = Session(
+        _cluster(nodes, [dict(p) for p in _pods(5)]), incremental=False
+    )
+    assert session._committed_scan() is None
+    reply = session.evaluate_batch([_request("plain", n=1)])[0]
+    assert reply.status == 200
+    assert "incremental" not in reply.meta

@@ -55,6 +55,10 @@ Scenarios (SIMON_BENCH env):
   decision log of simon's own placements on the warm single-pod scan
   probe — steps/s, agreement rate (gated at 1.0), dispatches per step,
   zero warm jit-cache misses asserted (r7).
+- `fleet-qps`: the `simon fleet` router over 1/2/4 serve replica
+  subprocesses sharing one AOT store — aggregate req/s per fleet size
+  plus the live kill -9 failover: rerouted first-200 and full
+  journal-replay recovery, gated at zero new XLA compiles (r16).
 - `all`: capacity headline with the others embedded in the metric
   string (one scenario per BASELINE.json config).
 
@@ -961,6 +965,228 @@ def run_cold_start(config="example/simon-config.yaml") -> dict:
         "warm_recompiles": warm["recompiles"],
         "warm_store_hits": warm["hits"],
         "cold_saves": cold["saves"],
+    }
+
+
+def run_fleet_qps(
+    n_clients=8, per_client=4, cluster_dir="example/cluster/demo"
+) -> dict:
+    """SIMON_BENCH=fleet-qps: the `simon fleet` router in front of
+    1/2/4 supervised serve replica subprocesses (docs/FLEET.md), all
+    sharing one AOT artifact store. Per fleet size: a balanced-tenancy
+    client storm through the router (one warm storm first; replicas
+    are separate processes, so N replicas should buy roughly Nx
+    aggregate throughput on N spare cores). On the 2-replica fleet the
+    headline failover is measured live: kill -9 the replica that owns
+    a tenant's warm session after it has journaled a cluster delta,
+    then time both the rerouted first-200 (the zero-loss path — same
+    request id, next ring slot) and the full recovery (supervision
+    pass detects the death, respawns into the slot, replays the dead
+    replica's snapshot journal) — gated inline at zero new XLA
+    compiles and deltaSeq parity on the replacement."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+    import urllib.request
+
+    from open_simulator_tpu.fleet.replica import ReplicaProcess, serve_argv
+    from open_simulator_tpu.fleet.router import FleetRouter
+
+    root = tempfile.mkdtemp(prefix="simon-fleet-bench-")
+    store = os.path.join(root, "store")
+    # replica children run with cwd=fleet_dir, so the config they load
+    # must name its cluster dir absolutely
+    cfg = os.path.join(root, "simon-config.yaml")
+    with open(cfg, "w", encoding="utf-8") as f:
+        f.write(
+            "apiVersion: simon/v1alpha1\n"
+            "kind: Config\n"
+            "metadata:\n"
+            "  name: fleet-bench\n"
+            "spec:\n"
+            "  cluster:\n"
+            f"    customConfig: {os.path.abspath(cluster_dir)}\n"
+        )
+    app = {
+        "kind": "Deployment",
+        "metadata": {"name": "fq", "namespace": "bench", "labels": {"app": "fq"}},
+        "spec": {
+            "replicas": 50,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img-fq",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    }
+    body = json.dumps(
+        {"apps": [{"name": "fq", "yaml": json.dumps(app)}]}
+    ).encode()
+
+    def post(url, data=body, tenant=None, timeout=600):
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers["X-Simon-Tenant"] = tenant
+        req = urllib.request.Request(url, data=data, headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+
+    def balanced_tenants(router, slots, count):
+        # one tenant per client, ring owners round-robined over the
+        # slots: the fleet measures capacity, not hash-placement luck
+        out, j = [], 0
+        for i in range(count):
+            want = slots[i % len(slots)]
+            while True:
+                t = f"bench-tenant-{j}"
+                j += 1
+                if router.ring.route_order(t)[0] == want:
+                    out.append(t)
+                    break
+        return out
+
+    def storm(base, tenants):
+        errors = []
+
+        def client(tenant):
+            try:
+                for _ in range(per_client):
+                    post(base + "/v1/simulate", tenant=tenant)
+            except Exception as e:  # noqa: BLE001 - surfaced via the raise below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(t,)) for t in tenants
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"fleet-qps client failed: {errors[0]}")
+
+    def measure_failover(router, base, victim):
+        # a tenant whose warm session lives on the victim's slot
+        tenant = next(
+            t
+            for t in (f"victim-tenant-{i}" for i in range(256))
+            if router.ring.route_order(t)[0] == victim.slot
+        )
+        # journal a roster delta on the victim and warm the post-delta
+        # shape into the shared store, so the replacement has a real
+        # delta stream to replay and nothing left to compile
+        delta = json.dumps(
+            {"kind": "node_join", "node": _make_node("fq-joined", 8, 32)}
+        ).encode()
+        status, _ = post(base + "/v1/cluster-delta", data=delta, tenant=tenant)
+        assert status == 200, "cluster delta refused"
+        post(base + "/v1/simulate", tenant=tenant)
+
+        t_kill = time.perf_counter()
+        os.kill(victim.pid, _signal.SIGKILL)
+        victim.proc.wait(timeout=30)
+        # the zero-loss path: the orphaned tenant's next request
+        # reroutes to the next ring slot and still answers 200
+        status, _ = post(base + "/v1/simulate", tenant=tenant)
+        assert status == 200, "rerouted request did not answer 200"
+        rerouted_s = time.perf_counter() - t_kill
+        # full recovery: one supervision pass detects the death and
+        # respawns into the slot (journal replay + store-warm boot),
+        # then the replacement answers its first direct request
+        router.probe_once()
+        assert victim.alive() and victim.restarts == 1, "respawn failed"
+        status, _ = post(victim.url + "/v1/simulate", tenant=tenant)
+        assert status == 200, "replacement did not answer 200"
+        recovery_s = time.perf_counter() - t_kill
+
+        recompiles = -1
+        with urllib.request.urlopen(
+            victim.url + "/metrics", timeout=60
+        ) as resp:
+            for ln in resp.read().decode().splitlines():
+                if ln.startswith("simon_jax_recompiles_total "):
+                    recompiles = int(float(ln.split()[1]))
+        assert recompiles == 0, (
+            f"replacement paid {recompiles} XLA compiles; the shared "
+            "store must serve them all"
+        )
+        with urllib.request.urlopen(
+            victim.url + "/v1/state-digest", timeout=60
+        ) as resp:
+            digest = json.loads(resp.read().decode())
+        assert digest["deltaSeq"] == 1, "replacement replayed no deltas"
+        return {
+            "failover_first_200_s": round(rerouted_s, 3),
+            "failover_seconds": round(recovery_s, 3),
+            "replacement_recompiles": recompiles,
+            "replayed_delta_seq": digest["deltaSeq"],
+        }
+
+    qps = {}
+    failover = {}
+    try:
+        for n in (1, 2, 4):
+            fleet_dir = os.path.join(root, f"fleet-{n}")
+            os.makedirs(fleet_dir)
+            reps = []
+            for i in range(n):
+                slot = f"r{i}"
+                snap = os.path.join(fleet_dir, f"{slot}.snapshot.jsonl")
+                reps.append(
+                    ReplicaProcess(
+                        slot,
+                        serve_argv(
+                            cfg,
+                            aot_store=store,
+                            snapshot_path=snap,
+                            extra=["--drain-timeout", "10"],
+                        ),
+                        fleet_dir,
+                    )
+                )
+            router = FleetRouter(
+                reps, port=0, probe_interval_s=0, forward_timeout_s=600.0
+            )
+            router.start()  # started first so the finally can drain
+            try:
+                for r in reps:
+                    r.spawn()  # serial: the first run populates the store
+                base = f"http://{router.host}:{router.port}"
+                slots = sorted(s for s in router.replicas)
+                tenants = balanced_tenants(router, slots, n_clients)
+                storm(base, tenants)  # warm: compile once, store-hit after
+                t0 = time.perf_counter()
+                storm(base, tenants)
+                elapsed = time.perf_counter() - t0
+                qps[n] = round(n_clients * per_client / elapsed, 2)
+                if n == 2:
+                    failover = measure_failover(router, base, reps[0])
+            finally:
+                router.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    # what replication bought: the best fleet vs the 1-replica fleet
+    # (on a core-starved box the best fleet may be smaller than the
+    # largest one — report what the hardware actually delivered)
+    q1 = qps[1]
+    n_best = max(qps, key=lambda k: qps[k])
+    return {
+        "qps_by_replicas": {str(k): v for k, v in sorted(qps.items())},
+        "qps_1": q1,
+        "qps_max": qps[n_best],
+        "replicas_max": n_best,
+        "qps_scaling": round(qps[n_best] / q1, 2),
+        "requests_per_fleet": n_clients * per_client,
+        **failover,
     }
 
 
@@ -2032,6 +2258,11 @@ def _parse_args(argv=None):
         "--store-reject-tolerance", type=int, default=0,
         help="absolute slack on artifact-store rejects (default 0)",
     )
+    p.add_argument(
+        "--fleet-tolerance", type=float, default=0.5,
+        help="fractional slack on the fleet qps-scaling factor "
+        "(regresses down) and failover seconds (regresses up)",
+    )
     return p.parse_args(argv)
 
 
@@ -2069,6 +2300,7 @@ def main():
     obs_before = obs_profile.snapshot()
 
     scenario = os.environ.get("SIMON_BENCH", "all")
+    fq = None  # fleet stats ride out["obs"]["fleet"] when the fleet ran
     if scenario == "default":
         nodes, pods = build_scenario()
         r = _scan_rate(nodes, pods, "default")
@@ -2293,6 +2525,25 @@ def main():
             "warm_recompiles": cs["warm_recompiles"],
             "warm_store_hits": cs["warm_store_hits"],
         }
+    elif scenario == "fleet-qps":
+        fq = run_fleet_qps()
+        out = {
+            "metric": f"fleet router req/s over 1/2/4 serve replicas "
+            f"({fq['qps_by_replicas']['1']}/{fq['qps_by_replicas']['2']}/"
+            f"{fq['qps_by_replicas']['4']} req/s = {fq['qps_scaling']}x at "
+            f"{fq['replicas_max']} replicas; kill -9 failover: rerouted "
+            f"first-200 in {fq['failover_first_200_s']}s with the original "
+            f"request id, replacement respawned + journal-replayed in "
+            f"{fq['failover_seconds']}s at ZERO new XLA compiles)",
+            "value": fq["qps_max"],
+            "unit": "req/s",
+            "vs_baseline": None,
+            "qps_by_replicas": fq["qps_by_replicas"],
+            "qps_scaling": fq["qps_scaling"],
+            "failover_first_200_s": fq["failover_first_200_s"],
+            "failover_seconds": fq["failover_seconds"],
+            "replacement_recompiles": fq["replacement_recompiles"],
+        }
     elif scenario == "timeline":
         tl = run_timeline()
         out = {
@@ -2407,6 +2658,7 @@ def main():
         ms = isolated(run_mesh_scan)
         dr = isolated(run_delta_resim)
         cs = isolated(run_cold_start)
+        fq = isolated(run_fleet_qps)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -2463,7 +2715,12 @@ def main():
             f"dict-identical state), "
             f"cold-start warm-store first-200 {cs['warm_first_200_s']}s vs "
             f"{cs['cold_first_200_s']}s cold ({cs['speedup_x']}x, zero new "
-            f"compiles); "
+            f"compiles), "
+            f"fleet-qps {fq['qps_by_replicas']['1']}/"
+            f"{fq['qps_by_replicas']['2']}/{fq['qps_by_replicas']['4']} req/s "
+            f"at 1/2/4 replicas ({fq['qps_scaling']}x; kill -9 failover "
+            f"rerouted first-200 {fq['failover_first_200_s']}s, full "
+            f"recovery {fq['failover_seconds']}s, zero new compiles); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"
@@ -2499,6 +2756,16 @@ def main():
             "agree": COUNTERS.get("shadow_agree_total"),
             "divergences": COUNTERS.get("shadow_divergence_total"),
             "warm_recompiles": COUNTERS.get("shadow_warm_recompiles_total"),
+        }
+    # fleet block: the dimensions `simon doctor` gates on
+    # (fleet.qps_scaling regresses down, fleet.failover_seconds up)
+    if fq is not None:
+        out["obs"]["fleet"] = {
+            "qps_scaling": fq["qps_scaling"],
+            "failover_seconds": fq["failover_seconds"],
+            "failover_first_200_s": fq["failover_first_200_s"],
+            "qps_by_replicas": fq["qps_by_replicas"],
+            "replacement_recompiles": fq["replacement_recompiles"],
         }
     print(json.dumps(out))
     if args.against:

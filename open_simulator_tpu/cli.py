@@ -645,6 +645,8 @@ def cmd_serve(args) -> int:
         # shape and the daemon's first answer costs zero new compiles
         _arm_store(args)
         session = Session(cluster, incremental=not args.no_incremental)
+        if getattr(args, "replay_snapshot", False) and not args.snapshot:
+            raise InputError("--replay-snapshot requires --snapshot PATH")
         daemon = ServeDaemon(
             session,
             host=args.host,
@@ -674,7 +676,33 @@ def cmd_serve(args) -> int:
         # cluster static encode + scenario-scan jit are warm, so the
         # first real request pays traffic-shape compile only
         session.warm()
+    replay_summary = None
+    if getattr(args, "replay_snapshot", False) and os.path.exists(args.snapshot):
+        # failover bootstrap (fleet/replay.py): replay the delta stream
+        # a dead replica had absorbed BEFORE listening, so the first
+        # answer comes from dict-identical warm state. Deliberately
+        # AFTER warm(): warm compiles the pre-delta roster (a shape the
+        # dead replica stored), and the post-delta shape loads from the
+        # store on the first request — the replacement's compile history
+        # mirrors the dead replica's exactly, so a warm shared store
+        # makes the whole bootstrap zero-compile. Read-only here; the
+        # daemon resumes the same journal for append (truncating any
+        # torn tail durably)
+        from .fleet.replay import replay_into_session
+
+        replay_summary = replay_into_session(session, args.snapshot)
     daemon.start()
+    if replay_summary is not None:
+        logging.info(
+            "replayed %d cluster delta(s) from %s "
+            "(applied=%d skipped=%d reloads=%d torn-tail-dropped=%d)",
+            replay_summary["deltas"],
+            args.snapshot,
+            replay_summary["applied"],
+            replay_summary["skipped"],
+            replay_summary["reloads"],
+            replay_summary["dropped"],
+        )
     if session.force_serial_reason:
         logging.warning(
             "cluster cannot ride the batched scan (%s); every request "
@@ -709,6 +737,138 @@ def cmd_serve(args) -> int:
         # to standalone runs — the serve conformance contract)
         _print_explanations(args, out=sys.stderr)
     return code
+
+
+@_with_obs("fleet")
+def cmd_fleet(args) -> int:
+    """N-replica serve fleet behind one consistent-hash router
+    (fleet/; docs/FLEET.md): spawn N `simon serve` replicas sharing
+    one AOT store, route tenant-affine, probe /healthz, and fail over
+    on replica death — the replacement resumes its slot's snapshot
+    journal and replays the dead replica's delta stream, answering
+    its first request at zero new XLA compiles. Exit 0 after a clean
+    SIGTERM drain of every replica, 3 when one had to be killed, 2 on
+    input/startup errors."""
+    from .fleet.replica import DoubleSpawnError, ReplicaProcess, serve_argv
+    from .fleet.router import FleetRouter
+    from .models.validation import InputError
+    from .runtime.errors import GuardError
+
+    replicas = []
+    try:
+        if args.replicas < 1:
+            raise InputError("--replicas must be >= 1")
+        if args.probe_interval <= 0:
+            raise InputError("--probe-interval must be > 0 seconds")
+        if args.probe_timeout <= 0:
+            raise InputError("--probe-timeout must be > 0 seconds")
+        if args.drain_timeout < 0:
+            raise InputError("--drain-timeout must be >= 0 seconds")
+        if args.spawn_attempts < 1:
+            raise InputError("--spawn-attempts must be >= 1")
+        slo_engine = _build_slo_engine(args)
+        if not os.path.isfile(args.simon_config):
+            raise InputError(f"config file not found: {args.simon_config}")
+        fleet_dir = os.path.abspath(args.fleet_dir)
+        os.makedirs(fleet_dir, exist_ok=True)
+        # replicas share ONE content-addressed store: the first spawn
+        # populates it, every later spawn (and every failover
+        # replacement) boots zero-compile from it
+        store = (
+            os.path.abspath(args.aot_store)
+            if args.aot_store
+            else os.path.join(fleet_dir, "aot-store")
+        )
+        extra = []
+        if args.max_batch is not None:
+            extra += ["--max-batch", str(args.max_batch)]
+        if args.queue_depth is not None:
+            extra += ["--queue-depth", str(args.queue_depth)]
+        if args.default_deadline is not None:
+            extra += ["--default-deadline", str(args.default_deadline)]
+        if args.tick_budget is not None:
+            extra += ["--tick-budget", str(args.tick_budget)]
+        if args.drain_timeout:
+            extra += ["--drain-timeout", str(args.drain_timeout)]
+        if args.no_incremental:
+            extra += ["--no-incremental"]
+        config_path = os.path.abspath(args.simon_config)
+        for i in range(args.replicas):
+            slot = f"r{i}"
+            rep = ReplicaProcess(
+                slot,
+                [],  # argv bound below, once the snapshot path exists
+                fleet_dir,
+                probe_timeout_s=args.probe_timeout,
+            )
+            rep.argv = serve_argv(
+                config_path,
+                aot_store=store,
+                snapshot_path=rep.snapshot_path,
+                extra=extra,
+            )
+            replicas.append(rep)
+        # first replica spawns alone (it pays the compiles that warm
+        # the shared store), the rest spawn concurrently and boot warm
+        replicas[0].spawn(attempts=args.spawn_attempts)
+        if len(replicas) > 1:
+            import threading as _threading
+
+            errors = []
+
+            def _spawn(rep):
+                try:
+                    rep.spawn(attempts=args.spawn_attempts)
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    errors.append((rep.slot, e))
+
+            threads = [
+                _threading.Thread(target=_spawn, args=(r,))
+                for r in replicas[1:]
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                # surface the first concurrent-spawn failure with its
+                # original (taxonomy-typed) class intact
+                raise errors[0][1]
+        router = FleetRouter(
+            replicas,
+            host=args.host,
+            port=args.port,
+            probe_interval_s=args.probe_interval,
+            drain_timeout_s=args.drain_timeout,
+            slo_engine=slo_engine,
+            obs_cadence_s=args.obs_cadence,
+            spawn_attempts=args.spawn_attempts,
+        )
+    except (
+        OSError,
+        ValueError,
+        RuntimeError,
+        GuardError,
+        DoubleSpawnError,
+        InputError,
+    ) as e:
+        print(f"error: {e}", file=sys.stderr)
+        for rep in replicas:
+            rep.kill()
+            rep.release()
+        return 2
+    from .obs.telemetry import arm_flight_recorder
+
+    arm_flight_recorder()
+    router.start()
+    # machine-parsable readiness line (tests and the CI smoke step
+    # read the bound port from it — --port 0 binds an ephemeral one)
+    print(
+        f"simon fleet listening on http://{router.host}:{router.port} "
+        f"({len(replicas)} replicas)",
+        flush=True,
+    )
+    return router.run_until_signaled()
 
 
 @_with_obs("shadow")
@@ -1603,7 +1763,8 @@ def _add_inject_flag(p: argparse.ArgumentParser):
         "'jit.scenario_scan=oom@2' (device OOM at the 2nd dispatch) or "
         "'io.kube*=reset@1x3' (3 connection resets). Sites: jit.<site>, "
         "io.<label>, journal.fsync.<subsystem>, budget.check, "
-        "ledger.predict_fit, serve.tick, shadow.poll, timeline.tick. "
+        "ledger.predict_fit, serve.tick, shadow.poll, timeline.tick, "
+        "fleet.route, fleet.probe, fleet.replay, fleet.spawn. "
         "Production paths are unmodified when unset "
         "(docs/ROBUSTNESS.md)",
     )
@@ -1915,6 +2076,14 @@ def build_parser() -> argparse.ArgumentParser:
         "crash-safe JSONL snapshot journal (resumed across restarts; "
         "torn tail recovered, interior damage refused)",
     )
+    p_serve.add_argument(
+        "--replay-snapshot", action="store_true",
+        help="before listening, replay the --snapshot journal's "
+        "cluster-delta stream into the fresh session (the fleet "
+        "failover bootstrap: a replacement replica rejoins with the "
+        "dead replica's warm state, dict-identical and — with a warm "
+        "--aot-store — at zero new XLA compiles; docs/FLEET.md)",
+    )
     _add_store_flag(p_serve)
     p_serve.add_argument(
         "--no-incremental", action="store_true",
@@ -1926,6 +2095,88 @@ def build_parser() -> argparse.ArgumentParser:
     _add_obs_flags(p_serve)
     _add_telemetry_flags(p_serve)
     p_serve.set_defaults(func=cmd_serve)
+
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="N-replica serve fleet behind one consistent-hash router",
+        description="Spawn N `simon serve` replicas sharing one "
+        "content-addressed AOT store and route requests tenant-affine "
+        "over a consistent-hash ring (docs/FLEET.md). The router "
+        "probes each replica's /healthz, honors degraded Retry-After "
+        "hints, and fails over on replica death: in-flight requests "
+        "reroute with their ORIGINAL X-Simon-Request-Id (503 + "
+        "Retry-After when no replica can answer, never a silent "
+        "drop), and the replacement replica resumes its slot's "
+        "snapshot journal, replays the dead replica's cluster-delta "
+        "stream, and answers its first request at zero new XLA "
+        "compiles. Fleet-aggregated /metrics carries per-replica "
+        "labels from a cardinality-bounded allowlist. SIGTERM drains "
+        "every replica then exits 0.",
+    )
+    p_fleet.add_argument(
+        "-f", "--simon-config", required=True,
+        help="simon config file served by every replica",
+    )
+    p_fleet.add_argument(
+        "--replicas", type=int, default=2, metavar="N",
+        help="serve replicas to spawn and supervise (default 2)",
+    )
+    p_fleet.add_argument("--host", default="127.0.0.1", help="bind address")
+    p_fleet.add_argument(
+        "--port", type=int, default=8080,
+        help="router bind port (0 = ephemeral; the readiness line "
+        "prints it; replicas always bind ephemeral ports)",
+    )
+    p_fleet.add_argument(
+        "--fleet-dir", default="simon-fleet", metavar="DIR",
+        help="fleet state directory: per-slot snapshot journals, "
+        "slot lock files, replica logs, and (unless --aot-store is "
+        "set) the shared artifact store (default ./simon-fleet)",
+    )
+    p_fleet.add_argument(
+        "--probe-interval", type=float, default=2.0, metavar="SECONDS",
+        help="health-probe cadence per replica; a degraded replica's "
+        "Retry-After hint stretches its own cadence (default 2.0)",
+    )
+    p_fleet.add_argument(
+        "--probe-timeout", type=float, default=5.0, metavar="SECONDS",
+        help="per-probe HTTP timeout (default 5.0)",
+    )
+    p_fleet.add_argument(
+        "--drain-timeout", type=float, default=30.0, metavar="SECONDS",
+        help="SIGTERM drain bound per replica; a replica still up "
+        "after this is killed and the fleet exits 3 instead of 0",
+    )
+    p_fleet.add_argument(
+        "--spawn-attempts", type=int, default=4, metavar="N",
+        help="spawn attempts per replica (capped-exponential backoff "
+        "between attempts) before a boot or failover gives up",
+    )
+    p_fleet.add_argument(
+        "--max-batch", type=int, default=None, metavar="B",
+        help="forwarded to every replica (see `simon serve`)",
+    )
+    p_fleet.add_argument(
+        "--queue-depth", type=int, default=None, metavar="N",
+        help="forwarded to every replica (see `simon serve`)",
+    )
+    p_fleet.add_argument(
+        "--default-deadline", type=float, default=None, metavar="SECONDS",
+        help="forwarded to every replica (see `simon serve`)",
+    )
+    p_fleet.add_argument(
+        "--tick-budget", type=float, default=None, metavar="SECONDS",
+        help="forwarded to every replica (see `simon serve`)",
+    )
+    p_fleet.add_argument(
+        "--no-incremental", action="store_true",
+        help="forwarded to every replica (see `simon serve`)",
+    )
+    _add_store_flag(p_fleet)
+    _add_inject_flag(p_fleet)
+    _add_obs_flags(p_fleet)
+    _add_telemetry_flags(p_fleet)
+    p_fleet.set_defaults(func=cmd_fleet)
 
     p_shadow = sub.add_parser(
         "shadow",
@@ -2396,6 +2647,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--store-tolerance", type=float, default=0.5, metavar="FRAC",
         help="fractional slack on the artifact-store hit rate "
         "(regresses down: cold starts paying avoidable compiles)",
+    )
+    p_doctor.add_argument(
+        "--fleet-tolerance", type=float, default=0.5, metavar="FRAC",
+        help="fractional slack on the fleet dimensions: qps_scaling "
+        "(regresses down: lost horizontal scaling) and "
+        "failover_seconds (regresses up: slower recovery after a "
+        "replica kill)",
     )
     p_doctor.add_argument(
         "--store-reject-tolerance", type=int, default=0, metavar="N",

@@ -1,0 +1,37 @@
+"""N-replica serve/twin fleet: consistent-hash routing, replica
+supervision, and journal-replay failover.
+
+ROADMAP item 2's scale-OUT layer. One process is the hard ceiling no
+matter how fast the warm path gets; every scale-out primitive already
+exists in the repo — the content-addressed AOT store makes a new
+replica zero-compile, crash-safe session snapshots plus the
+cluster-delta journal make warm state replayable, and request IDs +
+SLO burn rates make a fleet observable. This package composes them so
+a replica can die without a user noticing:
+
+- ``hashing``  — slot-affine consistent-hash ring (tenant-affine
+  routing; a replacement replica inherits its slot, so failover moves
+  ZERO keys).
+- ``replica``  — supervised serve subprocesses: spawn, /healthz
+  probing, restart-with-backoff (the PR-2 retry discipline), slot
+  lock files that refuse split-brain double-spawns.
+- ``replay``   — bootstrap a replacement session from the dead
+  replica's session-snapshot + cluster-delta journal, torn tail
+  tolerated, interior damage refused loudly.
+- ``router``   — the thin HTTP router daemon behind ``simon fleet``:
+  failover reroutes carry their ORIGINAL request IDs (429/503 +
+  Retry-After when saturated, never silent drops), fleet-aggregated
+  /metrics with cardinality-bounded per-replica labels, fleet
+  /healthz + telemetry for ``simon top``.
+
+Injection seams ``fleet.route``, ``fleet.probe``, ``fleet.replay``,
+``fleet.spawn`` join the runtime/inject.py grammar so the chaos
+matrix (tests/test_chaos_matrix.py FLEET_CELLS) can drive kill-9
+mid-burst, torn-journal handoff, split-brain double-spawn, and
+probe-flap scenarios to documented degradations.
+"""
+
+from .hashing import HashRing  # noqa: F401
+from .replay import read_session_events, replay_into_session  # noqa: F401
+from .replica import DoubleSpawnError, ReplicaProcess, SlotLock  # noqa: F401
+from .router import FleetRouter, render_fleet_metrics  # noqa: F401

@@ -1,0 +1,149 @@
+"""Journal-replay bootstrap for a replacement replica.
+
+A serve replica journals every applied cluster delta to its session
+snapshot (serve/sessions.py ``record_delta``). When the replica dies,
+its warm in-memory state — the roster mutations absorbed since boot —
+is exactly the delta stream in that journal. A replacement bootstraps
+by building a fresh Session from the same config, then replaying the
+dead replica's journal through ``Session.apply_delta`` before it
+answers its first request:
+
+- compiled executables come from the shared content-addressed AOT
+  store (zero new XLA compiles — the store was populated by the
+  replica being replaced, and store hits do not count as recompiles);
+- roster state comes from this replay (dict-identical committed scan
+  digest and the same ``delta_seq`` as the dead replica — pinned by
+  tests/test_fleet.py).
+
+Reading follows the runtime/journal.py recovery discipline: header
+fingerprint validated FIRST, complete records replayed, a torn final
+line (the replica died mid-append) dropped and counted, interior
+damage refused loudly (``JournalMismatch`` — serving un-replayed
+state would answer requests wrongly, which is worse than refusing to
+boot). The read is strictly read-only: the serve daemon itself
+resumes the same file for append afterwards (and truncates the torn
+tail durably); replay must not race that by holding the file open.
+
+Injection seam ``fleet.replay`` fires once per replay so the chaos
+matrix can drive bootstrap faults to their documented degradation.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+from ..runtime import inject as _inject
+from ..runtime.journal import JOURNAL_VERSION, JournalMismatch
+from ..utils.trace import COUNTERS
+
+
+def read_session_events(path: str, fingerprint: str) -> Tuple[List[dict], int]:
+    """Read a session snapshot journal read-only. Returns
+    ``(records, dropped)``: every complete non-header record in append
+    order, and the count of torn trailing lines discarded. Raises
+    ``JournalMismatch`` on header/fingerprint mismatch or interior
+    damage — the same refusals as ``Journal.resume``."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise JournalMismatch(f"cannot replay from {path}: {e}") from e
+    lines = raw.split(b"\n")
+    if not lines or not lines[0].strip():
+        raise JournalMismatch(f"{path}: empty journal, nothing to replay")
+    try:
+        header = json.loads(lines[0])
+    except ValueError as e:
+        raise JournalMismatch(f"{path}: unreadable journal header: {e}") from e
+    if not isinstance(header, dict) or header.get("kind") != "header":
+        raise JournalMismatch(f"{path}: first record is not a journal header")
+    if header.get("version") != JOURNAL_VERSION:
+        raise JournalMismatch(
+            f"{path}: journal version {header.get('version')!r} != "
+            f"{JOURNAL_VERSION}"
+        )
+    if header.get("fingerprint") != fingerprint:
+        raise JournalMismatch(
+            f"{path}: journal fingerprint {header.get('fingerprint')!r} does "
+            f"not match the expected snapshot format ({fingerprint!r}); "
+            "refusing to replay a journal from a different subsystem"
+        )
+    body, tail = lines[1:-1], lines[-1]
+    records: List[dict] = []
+    for i, line in enumerate(body):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            raise JournalMismatch(
+                f"{path}: corrupt journal record on line {i + 2}: {e}"
+            ) from e
+        if not isinstance(rec, dict):
+            raise JournalMismatch(
+                f"{path}: corrupt journal record on line {i + 2}: "
+                "record is not an object"
+            )
+        records.append(rec)
+    dropped = 0
+    if tail.strip():
+        # no trailing newline: the replica died mid-append. Keep the
+        # record only if it parses whole; else it is the torn tail —
+        # expected damage, dropped and counted, never fatal.
+        try:
+            rec = json.loads(tail)
+        except ValueError:
+            rec = None
+        if isinstance(rec, dict):
+            records.append(rec)
+        else:
+            dropped = 1
+    return records, dropped
+
+
+def replay_into_session(session, path: str) -> dict:
+    """Replay the delta stream journaled at ``path`` into ``session``
+    (deltas recorded against other cluster fingerprints are skipped —
+    a multi-session snapshot replays only the primary's stream).
+    Returns a summary dict: ``deltas`` seen for this fingerprint,
+    ``applied``/``skipped``/``reloads`` from ``apply_delta``,
+    ``dropped`` torn-tail lines, and the journaled ``requestIds`` (the
+    X-Simon-Request-Id correlation carried across the failover)."""
+    from ..serve.sessions import SNAPSHOT_VERSION
+    from ..runtime.journal import config_fingerprint
+    from ..twin.deltas import ClusterDelta
+
+    _inject.fire("fleet.replay", path=path)
+    fp = config_fingerprint(
+        {"format": "serve-session-snapshot", "version": SNAPSHOT_VERSION}
+    )
+    records, dropped = read_session_events(path, fp)
+    summary = {
+        "deltas": 0,
+        "applied": 0,
+        "skipped": 0,
+        "reloads": 0,
+        "dropped": dropped,
+        "requestIds": [],
+    }
+    for rec in records:
+        if rec.get("kind") != "session" or rec.get("event") != "delta":
+            continue
+        if rec.get("fingerprint") != session.fingerprint:
+            continue
+        summary["deltas"] += 1
+        rid = rec.get("requestId")
+        if rid:
+            summary["requestIds"].append(rid)
+        out = session.apply_delta(ClusterDelta.from_record(rec["delta"]))
+        if out == "skipped":
+            summary["skipped"] += 1
+        else:
+            summary["applied"] += 1
+            if out == "reloaded":
+                summary["reloads"] += 1
+    COUNTERS.inc("fleet_replayed_deltas_total", summary["deltas"])
+    if dropped:
+        COUNTERS.inc("fleet_replay_torn_tail_total", dropped)
+    return summary

@@ -54,6 +54,10 @@ class Thresholds:
     suffix_frac: float = 0.5
     store_frac: float = 0.5
     store_reject_abs: int = 0
+    # fleet families (r16): qps_scaling regresses DOWN (lost
+    # horizontal scaling), failover_seconds regresses UP (slower
+    # recovery after a replica kill)
+    fleet_frac: float = 0.5
 
     @classmethod
     def from_args(cls, args) -> "Thresholds":
@@ -66,6 +70,7 @@ class Thresholds:
             suffix_frac=getattr(args, "suffix_tolerance", 0.5),
             store_frac=getattr(args, "store_tolerance", 0.5),
             store_reject_abs=getattr(args, "store_reject_tolerance", 0),
+            fleet_frac=getattr(args, "fleet_tolerance", 0.5),
         )
 
 
@@ -256,6 +261,23 @@ def diff_records(
         _num(cand, "obs", "aot_store", "rejects"),
         th.store_reject_abs,
         note="corrupt/stale store entries refused (each one recompiles)",
+    )
+    opt(
+        frac_row,
+        "fleet.qps_scaling",
+        _num(base, "obs", "fleet", "qps_scaling"),
+        _num(cand, "obs", "fleet", "qps_scaling"),
+        th.fleet_frac,
+        higher_is_better=True,
+        note="aggregate fleet QPS at max replicas / single-replica QPS",
+    )
+    opt(
+        frac_row,
+        "fleet.failover_seconds",
+        _num(base, "obs", "fleet", "failover_seconds"),
+        _num(cand, "obs", "fleet", "failover_seconds"),
+        th.fleet_frac,
+        note="kill-9 to next 200 through the router (reroute latency)",
     )
     # per-site latency p95s: every site present in BOTH records
     bh = base.get("obs", {}).get("histograms")

@@ -27,7 +27,9 @@ Spec grammar (';'-separated clauses)::
 - ``SITE``: an ``fnmatch`` glob over the injection-point name
   (``jit.scenario_scan``, ``io.kube LIST /api/v1/pods``,
   ``journal.fsync.apply``, ``serve.tick``, ``shadow.poll``,
-  ``timeline.tick``, ``budget.check``, ``ledger.predict_fit``).
+  ``timeline.tick``, ``budget.check``, ``ledger.predict_fit``,
+  and the fleet router seams ``fleet.route``, ``fleet.probe``,
+  ``fleet.replay``, ``fleet.spawn``).
 - ``FAULT``: what happens when the clause triggers (table below).
 - ``@N``: first hit of the site to fire at (1-based, default 1).
 - ``xCOUNT``: consecutive hits to fire for (default 1; ``x*`` =

@@ -176,6 +176,16 @@ class AdmissionController:
                     )
         return Verdict("admit")
 
+    def retry_after_hint(self, queue_depth: int = 0) -> int:
+        """The backoff hint a degraded /healthz advertises, derived
+        from the SAME latency prediction as the 429 shed path (p95
+        coalescer tick x ticks queued ahead, floored at one second) —
+        probers and load balancers back off uniformly with shed
+        clients instead of hot-looping a degraded replica."""
+        tick_s = self._predicted_tick_s()
+        ticks_ahead = queue_depth // self.max_batch + 1
+        return max(1, math.ceil(tick_s * ticks_ahead))
+
 
 def estimate_request_pods(req) -> int:
     """Cheap pre-expansion pod-count estimate of a WhatIfRequest:

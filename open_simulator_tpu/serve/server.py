@@ -675,6 +675,17 @@ class ServeDaemon:
             def do_GET(self):
                 if self.path == "/healthz":
                     status, reasons = daemon.readiness()
+                    # degraded readiness advertises the SAME backoff
+                    # hint as the admission 429 path, so probers (the
+                    # fleet router, external LBs) back off uniformly
+                    # with shed clients instead of hot-looping
+                    hdrs = ()
+                    retry_after = None
+                    if reasons:
+                        retry_after = daemon.admission.retry_after_hint(
+                            daemon.coalescer.depth
+                        )
+                        hdrs = (("Retry-After", str(retry_after)),)
                     self._send(
                         200,
                         json.dumps(
@@ -683,6 +694,7 @@ class ServeDaemon:
                                 "status": status,
                                 "degraded": bool(reasons),
                                 "reasons": reasons,
+                                "retryAfterSeconds": retry_after,
                                 "cluster": daemon.session.fingerprint,
                                 "deltaSeq": daemon.session.delta_seq,
                                 "queueDepth": daemon.coalescer.depth,
@@ -694,6 +706,22 @@ class ServeDaemon:
                                 ),
                                 "draining": daemon._shutdown.is_set(),
                             }
+                        ).encode(),
+                        headers=hdrs,
+                    )
+                elif self.path == "/v1/state-digest":
+                    # the fleet dict-identity gate (docs/FLEET.md): a
+                    # replacement replica is correct iff this triple
+                    # matches the replica it replaced
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "fingerprint": daemon.session.fingerprint,
+                                "deltaSeq": daemon.session.delta_seq,
+                                "stateDigest": daemon.session.state_digest(),
+                            },
+                            sort_keys=True,
                         ).encode(),
                     )
                 elif self.path == "/metrics":

@@ -207,6 +207,24 @@ class Session:
                 self.force_serial_reason = "cluster pods carry priority"
             self._pod_uses_priority = pod_uses_priority
 
+    def state_digest(self) -> str:
+        """Canonical digest of the delta-mutated session state (node
+        set + pod roster) — the fleet dict-identity gate
+        (docs/FLEET.md): a journal-replayed replacement replica must
+        report the SAME digest as the replica it replaced. Cheap on
+        purpose: no committed-scan build, no device work, so
+        GET /v1/state-digest is safe to poll."""
+        from ..runtime.journal import config_fingerprint
+
+        with self._delta_lock:
+            return config_fingerprint(
+                [
+                    (n.get("metadata") or {}).get("name")
+                    for n in self.cluster.nodes
+                ],
+                self.cluster_pods,
+            )
+
     def warm(self):
         """Pre-compile the scan for a small request shape and build the
         ClusterStatic encoding, so the first real request does not pay

@@ -242,18 +242,32 @@ class TwinDaemon:
             def do_GET(self):
                 if self.path == "/healthz":
                     status, reasons = daemon.readiness()
+                    # degraded readiness advertises the SAME backoff
+                    # hint as the admission 429 path (p95 query time x
+                    # queries in flight), so probers and LBs back off
+                    # uniformly with shed clients
+                    hdrs = ()
+                    retry_after = None
+                    if reasons:
+                        with daemon._inflight_lock:
+                            waiting = max(daemon._inflight, 0)
+                        retry_after = daemon.admission.retry_after_hint(
+                            waiting
+                        )
+                        hdrs = (("Retry-After", str(retry_after)),)
                     self._send(200, canonical_body({
                         "ok": True,
                         "status": status,
                         "degraded": bool(reasons),
                         "reasons": reasons,
+                        "retryAfterSeconds": retry_after,
                         "sloAlerting": (
                             daemon.slo_engine.alerting()
                             if daemon.slo_engine is not None
                             else []
                         ),
                         "mirror": daemon.mirror.stats(),
-                    }))
+                    }), headers=hdrs)
                 elif self.path == "/metrics":
                     self._send(
                         200,

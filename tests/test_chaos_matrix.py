@@ -124,6 +124,18 @@ INCR_CELLS = [
     ("ExternalIOError", "incremental", "incremental.suffix=exio@1x*"),
 ]
 
+# the fleet router's four seams (fleet/): a route fault is a transport
+# fault — mark down + reroute with the ORIGINAL request id, exhaustion
+# sheds 503 + Retry-After; a probe fault is a counted flap below the
+# death threshold; a replay fault propagates LOUDLY (a half-replayed
+# bootstrap must never serve); a spawn fault retries with backoff
+FLEET_CELLS = [
+    ("ExternalIOError", "fleet", "fleet.route=exio@1"),
+    ("ExternalIOError", "fleet", "fleet.probe=exio%3"),
+    ("ConformanceError", "fleet", "fleet.replay=conformance@1"),
+    ("BackendUnavailable", "fleet", "fleet.spawn=backend@1"),
+]
+
 #: taxonomy class name -> matrix cell ids proving its injection
 #: coverage. simonlint RT002 statically requires every GuardError
 #: subtype to appear here; test_registry_is_closed_over_cells keeps
@@ -141,15 +153,16 @@ INJECTION_COVERAGE = {
     ],
     "BackendUnavailable": [
         "BackendUnavailable/apply", "BackendUnavailable/timeline",
-        "BackendUnavailable/serve",
+        "BackendUnavailable/serve", "BackendUnavailable/fleet",
     ],
     "ExternalIOError": [
         "ExternalIOError/io", "ExternalIOError/io", "ExternalIOError/twin",
         "ExternalIOError/incremental", "ExternalIOError/incremental",
+        "ExternalIOError/fleet", "ExternalIOError/fleet",
     ],
     "ConformanceError": [
         "ConformanceError/apply", "ConformanceError/serve",
-        "ConformanceError/twin",
+        "ConformanceError/twin", "ConformanceError/fleet",
     ],
     "ExecutionHalted": ["ExecutionHalted/apply", "ExecutionHalted/timeline"],
     "DeadlineExceeded": [
@@ -175,6 +188,7 @@ def test_registry_is_closed_over_cells():
     live |= {f"{e}/{s}" for e, s, *_ in TWIN_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in MESH_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in INCR_CELLS}
+    live |= {f"{e}/{s}" for e, s, *_ in FLEET_CELLS}
     registered = {cid for ids in INJECTION_COVERAGE.values() for cid in ids}
     assert registered == live, (
         f"registry drift: only-registered={sorted(registered - live)} "
@@ -831,3 +845,272 @@ def test_incremental_cell_suffix_fault_degrades_to_full_rescan():
     assert any(
         "incremental-degraded" in str(k) for k in notes
     ), ("fallback not trace-noted", notes)
+
+
+# --------------------------------------------------------------- fleet cells
+
+
+def _fleet_stub_router():
+    from test_fleet import StubReplica
+
+    from open_simulator_tpu.fleet.router import FleetRouter
+
+    replicas = [StubReplica("fx0"), StubReplica("fx1")]
+    router = FleetRouter(
+        replicas,
+        port=0,
+        probe_interval_s=0,  # tests drive probe_once deterministically
+        forward_timeout_s=10.0,
+    )
+    router.start()
+    return router, replicas
+
+
+def _fleet_stub_stop(router, replicas):
+    for r in replicas:
+        try:
+            r.stop()
+        except OSError:
+            pass
+    router.httpd.shutdown()
+    router.httpd.server_close()
+    router.telemetry.stop()
+
+
+def _fleet_post(base, payload, rid, tenant=None, timeout=10):
+    import urllib.error
+    import urllib.request
+
+    headers = {"Content-Type": "application/json", "X-Simon-Request-Id": rid}
+    if tenant:
+        headers["X-Simon-Tenant"] = tenant
+    req = urllib.request.Request(
+        base + "/v1/simulate", data=payload, headers=headers
+    )
+    try:
+        return urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        return e
+
+
+def test_fleet_cell_route_fault_reroutes_then_sheds():
+    """ExternalIOError/fleet (fleet.route seam): a classified fault on
+    the forwarding hop is a transport fault — the slot is marked down
+    and the request reroutes with its ORIGINAL id; with EVERY hop
+    faulted, exhaustion sheds the machine-readable 503 + Retry-After.
+    Never a silent drop either way."""
+    router, replicas = _fleet_stub_router()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        reroutes0 = COUNTERS.get("fleet_reroutes_total")
+        INJECT.configure(FLEET_CELLS[0][2])  # exio@1: first hop only
+        resp = _fleet_post(base, b"{}", "cell-rid-1", tenant="cell-t")
+        INJECT.clear()
+        assert resp.status == 200
+        assert resp.headers["X-Simon-Request-Id"] == "cell-rid-1"
+        assert json.loads(resp.read())["requestId"] == "cell-rid-1"
+        assert COUNTERS.get("fleet_reroutes_total") > reroutes0
+
+        shed0 = COUNTERS.get("fleet_shed_total")
+        INJECT.configure("fleet.route=exio@1x*")
+        resp = _fleet_post(base, b"{}", "cell-rid-2", tenant="cell-t2")
+        INJECT.clear()
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        body = json.loads(resp.read())
+        assert body["partial"] is True and body["requestId"] == "cell-rid-2"
+        assert COUNTERS.get("fleet_shed_total") > shed0
+    finally:
+        INJECT.clear()
+        _fleet_stub_stop(router, replicas)
+
+
+def test_fleet_cell_probe_fault_is_counted_flap_not_a_kill():
+    """ExternalIOError/fleet (fleet.probe seam): an intermittent probe
+    fault is a counted flap — below the consecutive-failure threshold
+    no replica is declared dead, none restarts, and requests keep
+    routing."""
+    from open_simulator_tpu.fleet.replica import PROBE_FAILURE_THRESHOLD
+
+    router, replicas = _fleet_stub_router()
+    try:
+        fails0 = COUNTERS.get("fleet_probe_failures_total")
+        INJECT.configure(FLEET_CELLS[1][2])  # exio%3 alternates victims
+        now = 0.0
+        for _ in range(6):
+            now += 1.0
+            router.probe_once(now=now)
+        INJECT.clear()
+        assert COUNTERS.get("fleet_probe_failures_total") > fails0, (
+            "probe fault never fired"
+        )
+        for r in replicas:
+            assert r.probe_failures < PROBE_FAILURE_THRESHOLD
+            assert r.restarts == 0
+        assert "down" not in router._health.values()
+        resp = _fleet_post(base=f"http://{router.host}:{router.port}",
+                           payload=b"{}", rid="after-flap")
+        assert resp.status == 200
+    finally:
+        INJECT.clear()
+        _fleet_stub_stop(router, replicas)
+
+
+def test_fleet_cell_replay_fault_propagates_loudly(tmp_path):
+    """ConformanceError/fleet (fleet.replay seam): a fault during the
+    bootstrap replay propagates LOUDLY — a half-replayed replacement
+    must refuse to serve, never answer from silently-wrong state."""
+    from open_simulator_tpu.fleet.replay import replay_into_session
+    from open_simulator_tpu.serve.sessions import open_snapshot
+
+    session, _ = _serve_session()
+    path = str(tmp_path / "cell.snapshot.jsonl")
+    open_snapshot(path).close()
+    INJECT.configure(FLEET_CELLS[2][2])
+    try:
+        with pytest.raises(ConformanceError):
+            replay_into_session(session, path)
+    finally:
+        INJECT.clear()
+
+
+def test_fleet_cell_spawn_fault_retries_with_backoff(tmp_path):
+    """BackendUnavailable/fleet (fleet.spawn seam): a classified fault
+    on a spawn attempt is retried with the capped-exponential backoff
+    and the next attempt launches — counted, never an unsupervised
+    crash, and never a second live process on the slot."""
+    import sys
+
+    from open_simulator_tpu.fleet.replica import ReplicaProcess
+
+    rep = ReplicaProcess(
+        "cell-slot",
+        [
+            sys.executable,
+            "-u",
+            "-c",
+            "import time; "
+            "print('stub listening on http://127.0.0.1:9', flush=True); "
+            "time.sleep(60)",
+        ],
+        str(tmp_path),
+    )
+    sleeps = []
+    retries0 = COUNTERS.get("fleet_spawn_retry_total")
+    INJECT.configure(FLEET_CELLS[3][2])
+    try:
+        url = rep.spawn(attempts=3, sleep=sleeps.append)
+    finally:
+        INJECT.clear()
+        rep.kill()
+        rep.release()
+    assert url == "http://127.0.0.1:9"
+    assert len(sleeps) == 1 and sleeps[0] > 0
+    assert COUNTERS.get("fleet_spawn_retry_total") - retries0 == 1
+
+
+def test_fleet_headline_kill9_midburst_zero_loss_byte_identical(tmp_path):
+    """THE headline fleet cell (docs/FLEET.md): kill -9 a REAL serve
+    replica mid-burst behind the router — every request in the burst
+    answers 200 with its ORIGINAL request id (zero dropped), the
+    supervision pass respawns the slot from the shared store + its
+    journal, and the rejoining replica answers byte-identically to the
+    survivor."""
+    import os
+    import threading
+    import urllib.request
+
+    from open_simulator_tpu.fleet.replica import ReplicaProcess, serve_argv
+    from open_simulator_tpu.fleet.router import FleetRouter
+
+    cfg = _write_cli_config(tmp_path, tag="fleet")
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    reps = []
+    for slot in ("f0", "f1"):
+        rep = ReplicaProcess(slot, [], str(fleet_dir))
+        rep.argv = serve_argv(
+            cfg,
+            aot_store=str(fleet_dir / "store"),
+            snapshot_path=rep.snapshot_path,
+        )
+        reps.append(rep)
+    router = None
+    try:
+        reps[0].spawn()  # serial: f0 pays the compiles into the store
+        reps[1].spawn()
+        router = FleetRouter(
+            reps, port=0, probe_interval_s=0, forward_timeout_s=60.0
+        )
+        router.start()
+        base = f"http://{router.host}:{router.port}"
+        payload = json.dumps(
+            {"apps": [{"name": "web", "yaml": json.dumps(_deploy("web", 3))}]}
+        ).encode()
+
+        n = 12
+        results = [None] * n
+        errors = []
+
+        def one(i):
+            req = urllib.request.Request(
+                base + "/v1/simulate",
+                data=payload,
+                headers={
+                    "Content-Type": "application/json",
+                    "X-Simon-Request-Id": f"burst-{i}",
+                    "X-Simon-Tenant": f"tenant-{i}",
+                },
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    results[i] = (
+                        resp.status,
+                        resp.headers.get("X-Simon-Request-Id"),
+                        resp.read(),
+                    )
+            except Exception as e:  # noqa: BLE001 - the assertion below reports it
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == n // 2:
+                os.kill(reps[0].pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=CELL_TIMEOUT_S)
+        assert not errors, f"dropped requests: {errors}"
+        assert all(r is not None for r in results), "a request hung"
+        for i, (status, rid, _body) in enumerate(results):
+            assert status == 200, f"burst-{i} answered {status}"
+            assert rid == f"burst-{i}", (
+                "request id not preserved across the reroute"
+            )
+
+        # the supervision pass notices the death and respawns the slot
+        router.probe_once()
+        assert reps[0].alive(), "failover did not respawn the slot"
+        assert reps[0].restarts == 1
+
+        def direct(url):
+            req = urllib.request.Request(
+                url + "/v1/simulate",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.read()
+
+        assert direct(reps[0].url) == direct(reps[1].url), (
+            "rejoining replica must answer byte-identically"
+        )
+    finally:
+        if router is not None:
+            router.httpd.shutdown()
+            router.httpd.server_close()
+            router.telemetry.stop()
+        for rep in reps:
+            rep.terminate()
+            rep.wait(10)
+            rep.kill()
+            rep.release()

@@ -1,0 +1,688 @@
+"""`simon fleet` — N-replica routing, supervision, and failover
+(fleet/; docs/FLEET.md).
+
+The load-bearing guarantees:
+
+- CONSISTENT HASHING: tenant keys are stable under replica join/leave
+  (only the arriving/departing slot's keys move), and a failover
+  moves ZERO keys (slot identity survives the replacement).
+- JOURNAL-REPLAY BOOTSTRAP: a replacement session replayed from the
+  dead replica's snapshot journal is dict-identical (same
+  state_digest, same delta_seq), a torn journal tail is recovered
+  (dropped + counted, replay succeeds on the prefix), and interior
+  damage refuses loudly.
+- ZERO-LOSS REROUTE: a replica killed mid-burst never drops a
+  request — every request answers 200 through the router with its
+  ORIGINAL X-Simon-Request-Id; exhaustion sheds 503 + Retry-After,
+  never a silent drop.
+- SPLIT-BRAIN REFUSAL: a second spawn against a slot whose lock
+  holder is alive raises DoubleSpawnError; a stale lock (holder
+  dead) is reclaimed — that is the failover path.
+- DEGRADED BACKOFF: serve and twin /healthz carry a Retry-After hint
+  when degraded, consistent with the admission 429 path, so the
+  router (and any external LB) backs off instead of hot-looping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from open_simulator_tpu.fleet.hashing import HashRing
+from open_simulator_tpu.fleet.replay import (
+    read_session_events,
+    replay_into_session,
+)
+from open_simulator_tpu.fleet.replica import DoubleSpawnError, SlotLock
+from open_simulator_tpu.fleet.router import FleetRouter, render_fleet_metrics
+from open_simulator_tpu.obs import telemetry
+from open_simulator_tpu.runtime.journal import JournalMismatch
+from open_simulator_tpu.serve.sessions import SessionCache, open_snapshot
+from open_simulator_tpu.serve.session import Session
+from open_simulator_tpu.twin.deltas import ClusterDelta
+from open_simulator_tpu.utils.trace import COUNTERS
+
+from test_serve import build_cluster, deployment, make_node
+
+
+# -- consistent hashing ------------------------------------------------------
+
+
+def test_hash_ring_minimal_movement_on_join_and_leave():
+    ring = HashRing(["r0", "r1", "r2"])
+    keys = [f"tenant-{i}" for i in range(2000)]
+    before = {k: ring.route(k) for k in keys}
+
+    ring.add("r3")
+    after_join = {k: ring.route(k) for k in keys}
+    moved = [k for k in keys if before[k] != after_join[k]]
+    # every moved key moved TO the new slot, and roughly its fair
+    # share of the keyspace (1/4), never a reshuffle
+    assert moved, "a new slot must take some keys"
+    assert all(after_join[k] == "r3" for k in moved)
+    assert len(moved) < len(keys) * 0.5
+
+    ring.remove("r3")
+    assert {k: ring.route(k) for k in keys} == before, (
+        "leave must restore the exact prior mapping (slot identity: a "
+        "failover replacement inherits the slot and moves zero keys)"
+    )
+
+
+def test_hash_ring_route_order_is_stable_failover_preference():
+    ring = HashRing(["r0", "r1", "r2"])
+    order = ring.route_order("tenant-x")
+    assert sorted(order) == ["r0", "r1", "r2"]
+    assert order[0] == ring.route("tenant-x")
+    # deterministic: a second ring with the same slots agrees, so
+    # rerouted requests from any router instance land together
+    assert HashRing(["r0", "r1", "r2"]).route_order("tenant-x") == order
+
+
+def test_hash_ring_routing_is_deterministic_across_instances():
+    a, b = HashRing(["r0", "r1"]), HashRing(["r1", "r0"])
+    for i in range(200):
+        assert a.route(f"k{i}") == b.route(f"k{i}")
+
+
+# -- journal-replay bootstrap ------------------------------------------------
+
+
+def _delta_records():
+    """A small stream exercising three delta kinds."""
+    pod = {
+        "kind": "Pod",
+        "metadata": {"name": "replayed", "namespace": "d"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "x",
+                    "resources": {
+                        "requests": {"cpu": "500m", "memory": "1Gi"}
+                    },
+                }
+            ]
+        },
+    }
+    return [
+        {"kind": "node_join", "node": make_node("joined-n", 8, 32)},
+        {"kind": "pod_bind", "pod": pod, "node": "joined-n"},
+        {"kind": "node_drain", "name": "serve-n-3"},
+    ]
+
+
+def _journal_deltas(tmp_path, session, records, request_ids=None):
+    """Apply ``records`` to ``session`` and journal them the way the
+    serve daemon does (SessionCache.record_delta per applied delta)."""
+    path = str(tmp_path / "snapshot.jsonl")
+    snap = open_snapshot(path)
+    cache = SessionCache(snapshot=snap)
+    cache.add(session, pinned=True)
+    for i, rec in enumerate(records):
+        session.apply_delta(ClusterDelta.from_record(rec))
+        rid = (request_ids or {}).get(i, f"rid-{i}")
+        cache.record_delta(session.fingerprint, rec, request_id=rid)
+    snap.close()
+    return path
+
+
+def test_bootstrap_replay_is_dict_identical_to_the_dead_replica(tmp_path):
+    dead = Session(build_cluster(), incremental=True)
+    path = _journal_deltas(tmp_path, dead, _delta_records())
+
+    replacement = Session(build_cluster(), incremental=True)
+    assert replacement.state_digest() != dead.state_digest()
+    summary = replay_into_session(replacement, path)
+
+    assert summary["deltas"] == 3
+    assert summary["applied"] + summary["skipped"] == 3
+    assert summary["dropped"] == 0
+    assert summary["requestIds"] == ["rid-0", "rid-1", "rid-2"]
+    # the dict-identity gate: same digest, same delta_seq
+    assert replacement.state_digest() == dead.state_digest()
+    assert replacement.delta_seq == dead.delta_seq
+
+
+def test_replay_skips_other_fingerprints(tmp_path):
+    """A multi-session snapshot replays only the session's own
+    stream."""
+    dead = Session(build_cluster(), incremental=True)
+    path = _journal_deltas(tmp_path, dead, _delta_records())
+    # rewrite the journal's delta fingerprints to a foreign session
+    lines = open(path, encoding="utf-8").read().splitlines()
+    out = []
+    for line in lines:
+        rec = json.loads(line)
+        if rec.get("event") == "delta":
+            rec["fingerprint"] = "not-this-session"
+        out.append(json.dumps(rec, separators=(",", ":")))
+    open(path, "w", encoding="utf-8").write("\n".join(out) + "\n")
+
+    replacement = Session(build_cluster(), incremental=True)
+    before = replacement.state_digest()
+    summary = replay_into_session(replacement, path)
+    assert summary["deltas"] == 0
+    assert replacement.state_digest() == before
+
+
+def test_torn_journal_tail_recovered_on_handoff(tmp_path):
+    """The replica died mid-append: the torn final line is dropped and
+    counted, the complete prefix replays fine — zero-loss handoff."""
+    dead = Session(build_cluster(), incremental=True)
+    path = _journal_deltas(tmp_path, dead, _delta_records())
+    with open(path, "ab") as f:  # torn append, no trailing newline
+        f.write(b'{"kind":"session","event":"delta","finge')
+
+    replacement = Session(build_cluster(), incremental=True)
+    summary = replay_into_session(replacement, path)
+    assert summary["dropped"] == 1
+    assert summary["deltas"] == 3
+    assert replacement.state_digest() == dead.state_digest()
+
+
+def test_replay_refuses_interior_damage_loudly(tmp_path):
+    dead = Session(build_cluster(), incremental=True)
+    path = _journal_deltas(tmp_path, dead, _delta_records())
+    raw = open(path, "rb").read().splitlines(keepends=True)
+    raw[2] = b'{"corrupt": \n'  # damage BEFORE the last line
+    open(path, "wb").write(b"".join(raw))
+
+    replacement = Session(build_cluster(), incremental=True)
+    with pytest.raises(JournalMismatch):
+        replay_into_session(replacement, path)
+
+
+def test_replay_refuses_foreign_journal(tmp_path):
+    path = str(tmp_path / "foreign.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(
+            json.dumps(
+                {"kind": "header", "version": 1, "fingerprint": "f" * 32}
+            )
+            + "\n"
+        )
+    with pytest.raises(JournalMismatch):
+        read_session_events(path, "e" * 32)
+
+
+# -- the router: zero-loss reroute -------------------------------------------
+
+
+class StubReplica:
+    """An HTTP-backed fleet replica stub: answers /v1/simulate with a
+    body derived purely from (slot-independent) request content plus
+    the original request id, so reroutes are detectable AND
+    byte-comparable. No spawn()/alive(): the router treats it as an
+    externally-managed replica (no respawn supervision)."""
+
+    def __init__(self, slot: str):
+        self.slot = slot
+        self.restarts = 0
+        self.probe_failures = 0
+        self.retry_after_s = 0
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, status, body, headers=()):
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._send(
+                    200,
+                    json.dumps(
+                        {"ok": True, "status": "ok", "degraded": False}
+                    ).encode(),
+                )
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = self.rfile.read(length)
+                rid = self.headers.get(telemetry.REQUEST_ID_HEADER) or ""
+                body = json.dumps(
+                    {
+                        "echo": json.loads(payload.decode() or "{}"),
+                        "requestId": rid,
+                    },
+                    sort_keys=True,
+                ).encode()
+                self._send(
+                    200, body, headers=((telemetry.REQUEST_ID_HEADER, rid),)
+                )
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        self._t = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._t.start()
+
+    def probe(self):
+        return {"probeOk": True, "degraded": False}
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def stub_fleet():
+    replicas = [StubReplica("r0"), StubReplica("r1")]
+    router = FleetRouter(
+        replicas,
+        port=0,
+        probe_interval_s=0,  # no probe thread: tests drive probe_once
+        forward_timeout_s=10.0,
+    )
+    router.start()
+    yield router, replicas
+    for r in replicas:
+        try:
+            r.stop()
+        except OSError:
+            pass
+    router.httpd.shutdown()
+    router.httpd.server_close()
+    router.telemetry.stop()
+
+
+def _post_router(router, payload, rid=None, tenant=None, timeout=10):
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers[telemetry.REQUEST_ID_HEADER] = rid
+    if tenant:
+        headers["X-Simon-Tenant"] = tenant
+    req = urllib.request.Request(
+        f"http://{router.host}:{router.port}/v1/simulate",
+        data=json.dumps(payload).encode(),
+        headers=headers,
+    )
+    try:
+        return urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        return e
+
+
+def test_router_keeps_original_request_ids_across_midburst_kill(stub_fleet):
+    router, replicas = stub_fleet
+    # find a tenant routed to r0 so the kill hits the owner
+    victim_tenant = next(
+        f"t{i}"
+        for i in range(100)
+        if router.ring.route(f"t{i}") == "r0"
+    )
+    base = COUNTERS.get("fleet_reroutes_total")
+    results = {}
+    stop_at = 24
+
+    def burst(i):
+        rid = f"burst-rid-{i}"
+        resp = _post_router(
+            router, {"n": i}, rid=rid, tenant=victim_tenant
+        )
+        body = json.loads(resp.read().decode())
+        results[i] = (resp.status, resp.headers.get(
+            telemetry.REQUEST_ID_HEADER), body)
+
+    threads = []
+    for i in range(stop_at):
+        if i == stop_at // 2:
+            replicas[0].stop()  # mid-burst death of the owner
+        t = threading.Thread(target=burst, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=30)
+
+    assert len(results) == stop_at, "no request may be silently dropped"
+    for i, (status, rid_header, body) in sorted(results.items()):
+        assert status == 200, f"request {i} answered {status}"
+        assert rid_header == f"burst-rid-{i}", (
+            "the reroute must carry the ORIGINAL request id"
+        )
+        assert body["requestId"] == f"burst-rid-{i}"
+    assert COUNTERS.get("fleet_reroutes_total") > base, (
+        "the kill must have rerouted at least one request"
+    )
+
+
+def test_router_tenant_affinity_routes_one_tenant_to_one_replica(stub_fleet):
+    router, _ = stub_fleet
+    seen = set()
+    for _ in range(8):
+        resp = _post_router(router, {"q": 1}, tenant="affine-tenant")
+        assert resp.status == 200
+        seen.add(resp.headers.get("X-Simon-Fleet-Replica"))
+    assert len(seen) == 1, "one tenant must stay on one replica"
+
+
+def test_router_sheds_503_with_retry_after_when_no_replica_lives(stub_fleet):
+    router, replicas = stub_fleet
+    for r in replicas:
+        r.stop()
+        router._mark(r.slot, "down")
+    resp = _post_router(router, {"q": 1}, rid="shed-rid")
+    assert resp.status == 503
+    assert int(resp.headers["Retry-After"]) >= 1
+    body = json.loads(resp.read().decode())
+    assert body["requestId"] == "shed-rid"
+    assert body["partial"] is True and body["reason"] == "fleet"
+
+
+def test_router_healthz_aggregates_and_hints_backoff(stub_fleet):
+    router, replicas = stub_fleet
+    with urllib.request.urlopen(
+        f"http://{router.host}:{router.port}/healthz", timeout=10
+    ) as resp:
+        doc = json.loads(resp.read().decode())
+        assert doc["status"] == "ok"
+        assert {r["id"] for r in doc["replicas"]} == {"r0", "r1"}
+        assert resp.headers.get("Retry-After") is None
+    router._mark("r0", "down")
+    with urllib.request.urlopen(
+        f"http://{router.host}:{router.port}/healthz", timeout=10
+    ) as resp:
+        doc = json.loads(resp.read().decode())
+        assert doc["status"] == "degraded"
+        assert any("r0" in r for r in doc["reasons"])
+        assert int(resp.headers["Retry-After"]) >= 1
+
+
+def test_fleet_metrics_exposition_is_unique_and_bounded(stub_fleet):
+    router, _ = stub_fleet
+    _post_router(router, {"q": 1}, tenant="m-tenant").read()
+    text = render_fleet_metrics(router).decode()
+    helps = [l for l in text.splitlines() if l.startswith("# HELP")]
+    names = [h.split()[2] for h in helps]
+    assert len(names) == len(set(names)), "duplicate metric families"
+    # per-replica labels stay cardinality-bounded: only fleet-minted
+    # families carry a replica label, never tenant/request labels
+    for line in text.splitlines():
+        if "{" in line and not line.startswith("#"):
+            assert 'replica="' in line
+            assert "tenant=" not in line
+    up = [l for l in text.splitlines()
+          if l.startswith("simon_fleet_replica_up{")]
+    assert len(up) == 2
+
+
+def test_probe_once_honors_flap_threshold_and_marks_down(stub_fleet):
+    from open_simulator_tpu.fleet.replica import PROBE_FAILURE_THRESHOLD
+
+    router, replicas = stub_fleet
+    replicas[1].stop()
+
+    def failing_probe():
+        replicas[1].probe_failures += 1
+        return {"probeOk": False, "error": "connection refused"}
+
+    replicas[1].probe = failing_probe
+    for i in range(PROBE_FAILURE_THRESHOLD):
+        router._next_probe["r1"] = 0.0
+        router.probe_once()
+        if i < PROBE_FAILURE_THRESHOLD - 1:
+            assert router._health["r1"] != "down", (
+                "one flaky probe must not kill a replica"
+            )
+    assert router._health["r1"] == "down"
+
+
+# -- split-brain double-spawn refusal ----------------------------------------
+
+
+def test_double_spawn_refused_while_holder_lives(tmp_path):
+    lock = SlotLock(str(tmp_path / "r0.lock"))
+    lock.acquire(owner_pid=os.getpid())
+    other = SlotLock(str(tmp_path / "r0.lock"))
+    with pytest.raises(DoubleSpawnError):
+        other.acquire(owner_pid=2)  # pid 2 != the live holder
+    lock.release()
+    assert not os.path.exists(lock.path)
+
+
+def test_stale_slot_lock_is_reclaimed(tmp_path):
+    """A lock whose holder died is the failover path: reclaimed
+    silently, never refused."""
+    path = str(tmp_path / "r0.lock")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"pid": 2 ** 22 + 12345}, f)  # almost surely dead
+    lock = SlotLock(path)
+    lock.acquire(owner_pid=os.getpid())
+    assert lock.held
+    lock.release()
+
+
+def test_same_supervisor_reacquires_its_own_lock(tmp_path):
+    lock = SlotLock(str(tmp_path / "r0.lock"))
+    lock.acquire()
+    again = SlotLock(str(tmp_path / "r0.lock"))
+    again.acquire()  # same pid: idempotent, not a double-spawn
+    lock.release()
+
+
+# -- kill -9 failover: zero-compile, dict-identical bootstrap ----------------
+
+
+def _write_fleet_config(tmp_path):
+    cluster_dir = tmp_path / "cluster"
+    cluster_dir.mkdir()
+    (cluster_dir / "nodes.yaml").write_text(
+        json.dumps(make_node("fleet-node", 8, 32))
+    )
+    cfg = tmp_path / "fleet-config.yaml"
+    cfg.write_text(
+        "apiVersion: simon/v1alpha1\n"
+        "kind: Config\n"
+        "metadata: {name: fleet-test}\n"
+        "spec:\n"
+        f"  cluster: {{customConfig: {cluster_dir} }}\n"
+    )
+    return cfg
+
+
+def _http(url, payload=None, timeout=60):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode() or "{}")
+
+
+def _scrape_counter(url, name):
+    with urllib.request.urlopen(url + "/metrics", timeout=60) as resp:
+        for line in resp.read().decode().splitlines():
+            if line.startswith(name + " "):
+                return float(line.split()[-1])
+    return None
+
+
+def test_kill9_replacement_is_zero_compile_and_dict_identical(tmp_path):
+    """The acceptance gate end to end, cross-process: kill -9 a
+    replica that had absorbed a cluster delta; the replacement resumes
+    the slot's snapshot journal + shared AOT store and answers its
+    first request with session state dict-identical to the dead
+    replica at ZERO new XLA compiles."""
+    import signal as _signal
+
+    from open_simulator_tpu.fleet.replica import ReplicaProcess, serve_argv
+
+    cfg = _write_fleet_config(tmp_path)
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    rep = ReplicaProcess(
+        "r0",
+        [],
+        str(fleet_dir),
+    )
+    rep.argv = serve_argv(
+        str(cfg),
+        aot_store=str(fleet_dir / "store"),
+        snapshot_path=rep.snapshot_path,
+        extra=["--drain-timeout", "5"],
+    )
+    sim_payload = {
+        "apps": [{"name": "fleet-app", "yaml": json.dumps(deployment("fleet-app", 2))}]
+    }
+    try:
+        url = rep.spawn()
+        # warm the store across the delta boundary: answer the shape
+        # both before and after the roster mutation, so the
+        # replacement's replayed roster has a stored executable too
+        status, first_body = _http(url + "/v1/simulate", sim_payload)
+        assert status == 200
+        status, _ = _http(
+            url + "/v1/cluster-delta",
+            {"kind": "node_join", "node": make_node("joined-n", 8, 32)},
+        )
+        assert status == 200
+        status, post_delta_body = _http(url + "/v1/simulate", sim_payload)
+        assert status == 200
+        _, digest_before = _http(url + "/v1/state-digest")
+        assert digest_before["deltaSeq"] == 1
+
+        os.kill(rep.pid, _signal.SIGKILL)
+        rep.proc.wait(timeout=30)
+        rep.release()  # the supervisor's reclaim on confirmed death
+        rep.restarts += 1
+
+        url2 = rep.spawn()
+        _, digest_after = _http(url2 + "/v1/state-digest")
+        assert digest_after == digest_before, (
+            "replacement must be dict-identical to the dead replica"
+        )
+        status, replay_body = _http(url2 + "/v1/simulate", sim_payload)
+        assert status == 200
+        assert replay_body == post_delta_body, (
+            "the rejoining replica must answer identically"
+        )
+        recompiles = _scrape_counter(url2, "simon_jax_recompiles_total")
+        assert recompiles == 0, (
+            f"replacement paid {recompiles} XLA compiles; the shared "
+            "store must serve them all"
+        )
+        assert _scrape_counter(url2, "simon_aot_store_hit_total") > 0
+    finally:
+        rep.terminate()
+        rep.wait(30)
+        rep.kill()
+        rep.release()
+
+
+# -- degraded /healthz Retry-After (serve + twin) ----------------------------
+
+
+def _degrade_with_open_breaker():
+    from open_simulator_tpu.runtime.retry import breaker_for
+
+    b = breaker_for("fleet-test-endpoint")
+    for _ in range(b.threshold):
+        b.record_failure()
+    assert b.opened
+
+
+def test_serve_healthz_degraded_carries_retry_after(tmp_path):
+    from open_simulator_tpu.runtime.retry import reset_io_state
+    from open_simulator_tpu.serve.server import ServeDaemon
+
+    reset_io_state()
+    session = Session(build_cluster())
+    d = ServeDaemon(session, port=0, max_batch=4, drain_timeout_s=5.0)
+    d.start()
+    try:
+        base = f"http://{d.host}:{d.port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+            assert doc["degraded"] is False
+            assert resp.headers.get("Retry-After") is None
+            assert doc["retryAfterSeconds"] is None
+        _degrade_with_open_breaker()
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+            assert doc["degraded"] is True
+            hint = int(resp.headers["Retry-After"])
+            assert hint >= 1
+            assert doc["retryAfterSeconds"] == hint
+            # consistent with the admission 429 path: same predictor
+            assert hint == d.admission.retry_after_hint(d.coalescer.depth)
+    finally:
+        d.shutdown()
+        reset_io_state()
+
+
+def test_serve_state_digest_endpoint_tracks_deltas():
+    from open_simulator_tpu.serve.server import ServeDaemon
+
+    session = Session(build_cluster())
+    d = ServeDaemon(session, port=0, max_batch=4, drain_timeout_s=5.0)
+    d.start()
+    try:
+        base = f"http://{d.host}:{d.port}"
+        with urllib.request.urlopen(
+            base + "/v1/state-digest", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["fingerprint"] == session.fingerprint
+        assert doc["deltaSeq"] == 0
+        assert doc["stateDigest"] == session.state_digest()
+        session.apply_delta(
+            ClusterDelta.from_record(
+                {"kind": "node_join", "node": make_node("dig-n", 8, 32)}
+            )
+        )
+        with urllib.request.urlopen(
+            base + "/v1/state-digest", timeout=10
+        ) as resp:
+            doc2 = json.loads(resp.read().decode())
+        assert doc2["deltaSeq"] == 1
+        assert doc2["stateDigest"] != doc["stateDigest"]
+    finally:
+        d.shutdown()
+
+
+def test_twin_healthz_degraded_carries_retry_after(tmp_path):
+    from open_simulator_tpu.runtime.retry import reset_io_state
+    from open_simulator_tpu.twin.mirror import ClusterMirror, FeedSource
+    from open_simulator_tpu.twin.server import TwinDaemon
+
+    reset_io_state()
+    mirror = ClusterMirror(
+        build_cluster(), FeedSource([], batch=8), engine="oracle"
+    )
+    mirror.bootstrap()
+    d = TwinDaemon(mirror, port=0, poll_interval_s=0.05)
+    d.start()
+    try:
+        base = f"http://{d.host}:{d.port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+            assert doc["degraded"] is False
+            assert resp.headers.get("Retry-After") is None
+        _degrade_with_open_breaker()
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+            assert doc["degraded"] is True
+            assert int(resp.headers["Retry-After"]) >= 1
+            assert doc["retryAfterSeconds"] >= 1
+    finally:
+        d.shutdown()
+        reset_io_state()

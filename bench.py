@@ -1190,6 +1190,197 @@ def run_fleet_qps(
     }
 
 
+def run_failover_aged(
+    levels=(0, 10_000, 50_000), interval=1_500, keep=2,
+    n_nodes=64, n_pods=32,
+) -> dict:
+    """SIMON_BENCH=failover-aged: bounded-recovery restore cost as a
+    replica AGES (runtime/checkpoint.py, docs/ROBUSTNESS.md). A serve
+    session absorbs 0/10k/50k journaled deltas, then a replacement
+    replica bootstraps from the snapshot two ways — full journal
+    replay (checkpointing off) vs checkpoint restore + suffix replay
+    (--checkpoint-interval {interval}) — and the time to the first
+    what-if 200 after the kill (the in-process failover_first_200_s
+    analogue; the XLA shape is warmed once up front so the cells
+    measure recovery, not compiles — cold-start owns the compile
+    story). Gated inline: the checkpointed replica's replayed suffix
+    stays under ONE checkpoint interval at every aging level
+    (fleet_replay_deltas_total, the acceptance bound — full replay
+    grows as O(age), checkpointed recovery does not), every replica's
+    state-digest triple is identical to the live session it replaces,
+    and the aged cells add zero XLA recompiles after the warmup."""
+    import shutil
+    import tempfile
+
+    from open_simulator_tpu.fleet.replay import replay_into_session
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.obs import profile as obs_profile
+    from open_simulator_tpu.runtime.checkpoint import (
+        CheckpointManager,
+        checkpoint_dir,
+    )
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.serve.session import (
+        Session,
+        WhatIfRequest,
+        session_checkpoint_state,
+        verify_payload_digest,
+    )
+    from open_simulator_tpu.serve.sessions import (
+        SessionCache,
+        open_snapshot,
+        serve_keep_record,
+    )
+    from open_simulator_tpu.testing import make_fake_pod
+    from open_simulator_tpu.twin.deltas import (
+        POD_ARRIVE,
+        POD_EVICT,
+        ClusterDelta,
+    )
+    from open_simulator_tpu.utils.trace import COUNTERS
+
+    def build_cluster():
+        cluster = ResourceTypes()
+        cluster.nodes = [
+            _make_node(f"aged-n-{i:03d}", 64, 256, {"zone": f"z{i % 4}"})
+            for i in range(n_nodes)
+        ]
+        cluster.pods = [
+            make_fake_pod(f"aged-p{i:03d}", "default", "250m", "512Mi")
+            for i in range(n_pods)
+        ]
+        return cluster
+
+    app = ResourceTypes()
+    app.pods = [make_fake_pod("aged-query", "default", "250m", "512Mi")]
+    req = WhatIfRequest(apps=[AppResource("aged-query", app)])
+    # warm the what-if shape on a throwaway session (NOT the live ones:
+    # materializing a committed scan there would turn every journaled
+    # delta into an incremental re-simulation and measure the wrong
+    # thing — aging cost is journal arithmetic, not device work)
+    Session(build_cluster()).evaluate_batch([req])
+    prof0 = obs_profile.snapshot()
+
+    cells = {}
+    root = tempfile.mkdtemp(prefix="simon-aged-")
+    try:
+        for n_deltas in levels:
+            cell = {}
+            for arm in ("full_replay", "checkpoint"):
+                session = Session(build_cluster())
+                path = os.path.join(
+                    root, f"aged-{n_deltas}-{arm}.snapshot.jsonl"
+                )
+                journal = open_snapshot(path)
+                cache = SessionCache(capacity=2, snapshot=journal)
+                mgr = None
+                if arm == "checkpoint":
+                    mgr = CheckpointManager(
+                        checkpoint_dir(path),
+                        interval=interval,
+                        keep=keep,
+                        capture=lambda s=session: session_checkpoint_state(s),
+                        materialized_digest=(
+                            lambda p, s=session: verify_payload_digest(s, p)
+                        ),
+                        journal=journal,
+                        keep_record=serve_keep_record(session.fingerprint),
+                        label="bench-aged",
+                        synchronous=True,
+                    )
+                # age the replica: arrive/evict pairs, journaled with
+                # their sequence numbers exactly as the serve delta
+                # handler records them (roster returns to the base
+                # shape, so every cell's first answer is shape-warm)
+                for i in range(n_deltas // 2):
+                    name = f"aged-churn-{i:05d}"
+                    pod = make_fake_pod(name, "default", "250m", "512Mi")
+                    for d in (
+                        ClusterDelta(kind=POD_ARRIVE, pod=pod),
+                        ClusterDelta(
+                            kind=POD_EVICT, namespace="default", name=name
+                        ),
+                    ):
+                        out, seq = session.apply_delta_seq(d)
+                        assert out == "applied", f"delta not applied: {out}"
+                        cache.record_delta(
+                            session.fingerprint, d.as_record(), seq=seq
+                        )
+                        if mgr is not None:
+                            mgr.note_delta(seq)
+                if mgr is not None:
+                    assert mgr.last_error is None, mgr.last_error
+                journal.close()
+                # the kill: a replacement replica bootstraps from the
+                # snapshot and answers its first what-if
+                ctr0 = COUNTERS.get("fleet_replay_deltas_total")
+                t0 = time.perf_counter()
+                replica = Session(build_cluster())
+                summary = replay_into_session(
+                    replica, path, use_checkpoints=(arm == "checkpoint")
+                )
+                restore_s = time.perf_counter() - t0
+                replies = replica.evaluate_batch([req])
+                first_200_s = time.perf_counter() - t0
+                assert replies[0].status == 200, replies[0].status
+                replayed = COUNTERS.get("fleet_replay_deltas_total") - ctr0
+                # dict-identity gate: the replacement reports the same
+                # state-digest triple the dead replica would have
+                assert (
+                    replica.fingerprint,
+                    replica.delta_seq,
+                    replica.state_digest(),
+                ) == (
+                    session.fingerprint,
+                    session.delta_seq,
+                    session.state_digest(),
+                ), f"aged replica diverged at {n_deltas}/{arm}"
+                if arm == "checkpoint":
+                    # the acceptance bound: recovery replays at most one
+                    # checkpoint interval of deltas, however old the
+                    # replica — counter-gated, not summary-trusted
+                    assert replayed <= interval, (
+                        f"replayed {replayed} deltas > interval {interval}"
+                    )
+                cell[arm] = {
+                    "restore_s": round(restore_s, 4),
+                    "first_200_s": round(first_200_s, 4),
+                    "replayed_deltas": replayed,
+                    "skipped_prefix": summary["skippedPrefix"],
+                    "restored_seq": (
+                        summary["checkpoint"]["deltaSeq"]
+                        if summary["checkpoint"]
+                        else 0
+                    ),
+                }
+            cells[str(n_deltas)] = cell
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    prof = obs_profile.delta(prof0)
+    assert prof["jax_recompiles_total"] == 0, (
+        f"aged failover recompiled: {prof['jax_recompiles_total']}"
+    )
+    worst = cells[str(max(levels))]
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "levels": list(levels),
+        "interval": interval,
+        "keep": keep,
+        "cells": cells,
+        "restore_seconds": worst["checkpoint"]["restore_s"],
+        "first_200_s": worst["checkpoint"]["first_200_s"],
+        "full_replay_first_200_s": worst["full_replay"]["first_200_s"],
+        "replayed_deltas": worst["checkpoint"]["replayed_deltas"],
+        "speedup_x": round(
+            worst["full_replay"]["first_200_s"]
+            / max(worst["checkpoint"]["first_200_s"], 1e-9),
+            2,
+        ),
+        "warm_recompiles": prof["jax_recompiles_total"],
+    }
+
+
 def run_timeline(n_arrivals=1000, n_nodes=48) -> dict:
     """SIMON_BENCH=timeline: the discrete-event timeline
     (docs/TIMELINE.md) playing a 1000-arrival seeded synthetic trace
@@ -2263,6 +2454,11 @@ def _parse_args(argv=None):
         help="fractional slack on the fleet qps-scaling factor "
         "(regresses down) and failover seconds (regresses up)",
     )
+    p.add_argument(
+        "--ckpt-tolerance", type=float, default=0.5,
+        help="fractional slack on the aged-failover checkpoint "
+        "restore seconds (regresses up)",
+    )
     return p.parse_args(argv)
 
 
@@ -2301,6 +2497,7 @@ def main():
 
     scenario = os.environ.get("SIMON_BENCH", "all")
     fq = None  # fleet stats ride out["obs"]["fleet"] when the fleet ran
+    fa = None  # aged-failover stats ride out["obs"]["ckpt"] when run
     if scenario == "default":
         nodes, pods = build_scenario()
         r = _scan_rate(nodes, pods, "default")
@@ -2544,6 +2741,29 @@ def main():
             "failover_seconds": fq["failover_seconds"],
             "replacement_recompiles": fq["replacement_recompiles"],
         }
+    elif scenario == "failover-aged":
+        fa = run_failover_aged()
+        w0 = fa["cells"][str(fa["levels"][-1])]
+        out = {
+            "metric": f"aged failover first-200 after "
+            f"{fa['levels'][-1]} absorbed deltas: {fa['first_200_s']}s "
+            f"with checkpoints (--checkpoint-interval {fa['interval']}, "
+            f"restore {fa['restore_seconds']}s, {fa['replayed_deltas']} "
+            f"deltas replayed < one interval) vs "
+            f"{fa['full_replay_first_200_s']}s full journal replay "
+            f"({w0['full_replay']['replayed_deltas']} deltas) = "
+            f"{fa['speedup_x']}x; state-digest triples identical, zero "
+            f"warm recompiles; cells at {fa['levels']} deltas",
+            "value": fa["first_200_s"],
+            "unit": "s",
+            "vs_baseline": None,
+            "cells": fa["cells"],
+            "interval": fa["interval"],
+            "restore_seconds": fa["restore_seconds"],
+            "full_replay_first_200_s": fa["full_replay_first_200_s"],
+            "replayed_deltas": fa["replayed_deltas"],
+            "speedup_x": fa["speedup_x"],
+        }
     elif scenario == "timeline":
         tl = run_timeline()
         out = {
@@ -2659,6 +2879,7 @@ def main():
         dr = isolated(run_delta_resim)
         cs = isolated(run_cold_start)
         fq = isolated(run_fleet_qps)
+        fa = isolated(run_failover_aged)
         out = {
             "metric": f"capacity plan e2e wall-clock, {c['pods']} pods x "
             f"{c['nodes']} nodes, north star <10s (plan: +{c['new_node_count']} nodes; "
@@ -2720,7 +2941,13 @@ def main():
             f"{fq['qps_by_replicas']['2']}/{fq['qps_by_replicas']['4']} req/s "
             f"at 1/2/4 replicas ({fq['qps_scaling']}x; kill -9 failover "
             f"rerouted first-200 {fq['failover_first_200_s']}s, full "
-            f"recovery {fq['failover_seconds']}s, zero new compiles); "
+            f"recovery {fq['failover_seconds']}s, zero new compiles), "
+            f"failover-aged first-200 {fa['first_200_s']}s after "
+            f"{fa['levels'][-1]} absorbed deltas with checkpoints "
+            f"(restore {fa['restore_seconds']}s, {fa['replayed_deltas']} "
+            f"deltas replayed < interval {fa['interval']}) vs "
+            f"{fa['full_replay_first_200_s']}s full replay "
+            f"({fa['speedup_x']}x, digest-identical); "
             f"all pods/s medians of {TIMED_RUNS}; "
             + (
                 f"on-device conformance fuzz: {z['checked']} placements ok)"
@@ -2766,6 +2993,19 @@ def main():
             "failover_first_200_s": fq["failover_first_200_s"],
             "qps_by_replicas": fq["qps_by_replicas"],
             "replacement_recompiles": fq["replacement_recompiles"],
+        }
+    # checkpoint block: the aged-failover dimensions `simon doctor`
+    # gates on (ckpt.restore_seconds regresses up — a slower restore
+    # from the newest generation + suffix means bounded recovery is
+    # no longer bounded)
+    if fa is not None:
+        out["obs"]["ckpt"] = {
+            "restore_seconds": fa["restore_seconds"],
+            "first_200_s": fa["first_200_s"],
+            "full_replay_first_200_s": fa["full_replay_first_200_s"],
+            "replayed_deltas": fa["replayed_deltas"],
+            "interval": fa["interval"],
+            "warm_recompiles": fa["warm_recompiles"],
         }
     print(json.dumps(out))
     if args.against:

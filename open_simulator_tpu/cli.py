@@ -625,6 +625,12 @@ def cmd_serve(args) -> int:
             raise InputError("--max-request-pods must be >= 1")
         if args.max_sessions < 1:
             raise InputError("--max-sessions must be >= 1")
+        if args.checkpoint_interval is not None and args.checkpoint_interval < 1:
+            raise InputError("--checkpoint-interval must be >= 1 delta")
+        if args.keep_checkpoints < 1:
+            raise InputError("--keep-checkpoints must be >= 1")
+        if args.checkpoint_interval and not args.snapshot:
+            raise InputError("--checkpoint-interval requires --snapshot PATH")
         # declarative SLOs + telemetry cadence: a bad --slo-config or
         # --obs-cadence raises InputError here (the daemon constructor
         # validates the cadence) -> exit 2 before listening
@@ -659,6 +665,8 @@ def cmd_serve(args) -> int:
             max_request_pods=args.max_request_pods,
             max_sessions=args.max_sessions,
             snapshot_path=args.snapshot or None,
+            checkpoint_interval=args.checkpoint_interval,
+            keep_checkpoints=args.keep_checkpoints,
             slo_engine=slo_engine,
             obs_cadence_s=args.obs_cadence,
         )
@@ -691,8 +699,23 @@ def cmd_serve(args) -> int:
         from .fleet.replay import replay_into_session
 
         replay_summary = replay_into_session(session, args.snapshot)
+        if daemon.checkpoints is not None and replay_summary["checkpoint"]:
+            # the restored generation is current: the next checkpoint
+            # is due one full interval PAST it, not immediately
+            daemon.checkpoints.note_restored(
+                replay_summary["checkpoint"]["deltaSeq"]
+            )
     daemon.start()
     if replay_summary is not None:
+        restored = replay_summary.get("checkpoint")
+        if restored:
+            logging.info(
+                "restored checkpoint %s (deltaSeq=%d); %d absorbed "
+                "journal record(s) skipped",
+                restored["path"],
+                restored["deltaSeq"],
+                replay_summary["skippedPrefix"],
+            )
         logging.info(
             "replayed %d cluster delta(s) from %s "
             "(applied=%d skipped=%d reloads=%d torn-tail-dropped=%d)",
@@ -766,6 +789,10 @@ def cmd_fleet(args) -> int:
             raise InputError("--drain-timeout must be >= 0 seconds")
         if args.spawn_attempts < 1:
             raise InputError("--spawn-attempts must be >= 1")
+        if args.checkpoint_interval is not None and args.checkpoint_interval < 1:
+            raise InputError("--checkpoint-interval must be >= 1 delta")
+        if args.keep_checkpoints is not None and args.keep_checkpoints < 1:
+            raise InputError("--keep-checkpoints must be >= 1")
         slo_engine = _build_slo_engine(args)
         if not os.path.isfile(args.simon_config):
             raise InputError(f"config file not found: {args.simon_config}")
@@ -805,6 +832,8 @@ def cmd_fleet(args) -> int:
                 config_path,
                 aot_store=store,
                 snapshot_path=rep.snapshot_path,
+                checkpoint_interval=args.checkpoint_interval,
+                keep_checkpoints=args.keep_checkpoints,
                 extra=extra,
             )
             replicas.append(rep)
@@ -1363,6 +1392,14 @@ def cmd_twin(args) -> int:
                 "--max-catchup must be >= 1 (0 would never apply the "
                 "backlog and the mirror would stop advancing)"
             )
+        if getattr(args, "replay_snapshot", False) and not args.snapshot:
+            raise InputError("--replay-snapshot requires --snapshot PATH")
+        if args.checkpoint_interval is not None and args.checkpoint_interval < 1:
+            raise InputError("--checkpoint-interval must be >= 1 step")
+        if args.keep_checkpoints < 1:
+            raise InputError("--keep-checkpoints must be >= 1")
+        if args.checkpoint_interval and not args.snapshot:
+            raise InputError("--checkpoint-interval requires --snapshot PATH")
         slo_engine = _build_slo_engine(args)
         # resident service: breakers recover (the serve posture)
         from .runtime.retry import BREAKER_COOLDOWN_ENV, enable_breaker_recovery
@@ -1402,7 +1439,19 @@ def cmd_twin(args) -> int:
         mirror = ClusterMirror(
             cluster, source, engine=args.engine, max_catchup=args.max_catchup
         )
+        twin_replay = None
+        if getattr(args, "replay_snapshot", False) and os.path.exists(
+            args.snapshot
+        ):
+            from .twin.mirror import replay_mirror_journal
+
+            twin_replay = replay_mirror_journal(mirror, args.snapshot)
         mirror.bootstrap()
+        if args.snapshot:
+            # attach AFTER replay: replayed steps must not re-append
+            from .twin.mirror import open_twin_snapshot
+
+            mirror.journal = open_twin_snapshot(args.snapshot)
         daemon = TwinDaemon(
             mirror,
             host=args.host,
@@ -1414,7 +1463,16 @@ def cmd_twin(args) -> int:
             drain_timeout_s=args.drain_timeout,
             slo_engine=slo_engine,
             obs_cadence_s=args.obs_cadence,
+            snapshot_path=args.snapshot or None,
+            checkpoint_interval=args.checkpoint_interval,
+            keep_checkpoints=args.keep_checkpoints,
         )
+        if daemon.checkpoints is not None and twin_replay and twin_replay.get(
+            "checkpoint"
+        ):
+            daemon.checkpoints.note_restored(
+                twin_replay["checkpoint"]["deltaSeq"]
+            )
     except (OSError, ValueError, ExternalIOError, InputError) as e:
         if client is not None:
             client.close()
@@ -1432,6 +1490,19 @@ def cmd_twin(args) -> int:
         f"source {'feed' if args.feed else 'tail'})",
         flush=True,
     )
+    if twin_replay is not None:
+        ckpt = twin_replay.get("checkpoint")
+        print(
+            f"simon twin replay: {twin_replay['steps']} step(s) replayed, "
+            f"{twin_replay['skippedPrefix']} absorbed by checkpoint "
+            + (
+                f"(restored seq {ckpt['deltaSeq']} from {ckpt['path']})"
+                if ckpt
+                else "(no usable checkpoint)"
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
     try:
         code = daemon.run_until_signaled()
     finally:
@@ -2084,6 +2155,23 @@ def build_parser() -> argparse.ArgumentParser:
         "dead replica's warm state, dict-identical and — with a warm "
         "--aot-store — at zero new XLA compiles; docs/FLEET.md)",
     )
+    p_serve.add_argument(
+        "--checkpoint-interval", type=int, default=None, metavar="DELTAS",
+        help="write a verified, content-addressed checkpoint of the "
+        "committed session every N applied deltas (requires "
+        "--snapshot); a restore then replays at most N journal "
+        "deltas instead of the daemon's whole history, and the "
+        "replayed prefix is compacted away only AFTER the snapshot's "
+        "state digest verifies against a fresh materialization "
+        "(docs/ROBUSTNESS.md; default: off)",
+    )
+    p_serve.add_argument(
+        "--keep-checkpoints", type=int, default=2, metavar="N",
+        help="checkpoint generations retained; a corrupt newest "
+        "generation falls back loudly to the previous one plus a "
+        "longer journal replay, never a silent wrong state "
+        "(default 2)",
+    )
     _add_store_flag(p_serve)
     p_serve.add_argument(
         "--no-incremental", action="store_true",
@@ -2170,6 +2258,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_fleet.add_argument(
         "--no-incremental", action="store_true",
+        help="forwarded to every replica (see `simon serve`)",
+    )
+    p_fleet.add_argument(
+        "--checkpoint-interval", type=int, default=None, metavar="DELTAS",
+        help="forwarded to every replica: checkpoint the committed "
+        "session every N deltas so a failover replays at most N "
+        "journal deltas (bounded recovery; see `simon serve` and "
+        "docs/FLEET.md)",
+    )
+    p_fleet.add_argument(
+        "--keep-checkpoints", type=int, default=None, metavar="N",
         help="forwarded to every replica (see `simon serve`)",
     )
     _add_store_flag(p_fleet)
@@ -2546,6 +2645,40 @@ def build_parser() -> argparse.ArgumentParser:
         "apiserver endpoints (SIMON_BREAKER_COOLDOWN wins when set; "
         "0 disables recovery)",
     )
+    p_twin.add_argument(
+        "--snapshot",
+        default="",
+        metavar="PATH",
+        help="append every applied mirror step to this crash-safe "
+        "JSONL snapshot journal (resumed across restarts; the twin "
+        "analogue of `simon serve --snapshot`)",
+    )
+    p_twin.add_argument(
+        "--replay-snapshot",
+        action="store_true",
+        help="before tailing, restore the newest verified checkpoint "
+        "and replay the --snapshot journal's step suffix into the "
+        "mirror (bounded twin failover; docs/TWIN.md)",
+    )
+    p_twin.add_argument(
+        "--checkpoint-interval",
+        type=int,
+        default=None,
+        metavar="STEPS",
+        help="write a verified checkpoint of the mirrored cluster "
+        "every N applied steps (requires --snapshot); restore then "
+        "replays at most N journal steps and the absorbed prefix is "
+        "compacted only after the digest verifies "
+        "(docs/ROBUSTNESS.md; default: off)",
+    )
+    p_twin.add_argument(
+        "--keep-checkpoints",
+        type=int,
+        default=2,
+        metavar="N",
+        help="checkpoint generations retained; a corrupt newest "
+        "generation falls back loudly to the previous one (default 2)",
+    )
     _add_store_flag(p_twin)
     _add_obs_flags(p_twin)
     _add_telemetry_flags(p_twin)
@@ -2654,6 +2787,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(regresses down: lost horizontal scaling) and "
         "failover_seconds (regresses up: slower recovery after a "
         "replica kill)",
+    )
+    p_doctor.add_argument(
+        "--ckpt-tolerance", type=float, default=0.5, metavar="FRAC",
+        help="fractional slack on the aged-failover checkpoint "
+        "restore seconds (regresses up: recovery time growing with "
+        "absorbed-delta age means the bounded-recovery contract broke)",
     )
     p_doctor.add_argument(
         "--store-reject-tolerance", type=int, default=0, metavar="N",

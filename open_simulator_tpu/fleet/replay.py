@@ -1,12 +1,28 @@
-"""Journal-replay bootstrap for a replacement replica.
+"""Snapshot-then-suffix bootstrap for a replacement replica.
 
 A serve replica journals every applied cluster delta to its session
-snapshot (serve/sessions.py ``record_delta``). When the replica dies,
-its warm in-memory state — the roster mutations absorbed since boot —
-is exactly the delta stream in that journal. A replacement bootstraps
-by building a fresh Session from the same config, then replaying the
-dead replica's journal through ``Session.apply_delta`` before it
-answers its first request:
+snapshot (serve/sessions.py ``record_delta``) and — with
+``--checkpoint-interval`` — periodically writes a verified checkpoint
+of the committed session (runtime/checkpoint.py). When the replica
+dies, a replacement bootstraps in two stages:
+
+1. **Restore** (``restore_into_session``): walk the retained
+   checkpoint generations newest → oldest; the first one whose header
+   validates AND whose payload re-materializes to the recorded state
+   digest is adopted wholesale (``Session.restore_state``). A refused
+   generation — torn, corrupt, stale toolchain, digest mismatch — is
+   counted (``ckpt_restore_fallback_total``) and logged, and the walk
+   falls back to the previous generation: a longer replay, never a
+   silent wrong state. No usable generation means full-journal replay
+   (the pre-checkpoint posture).
+2. **Suffix replay**: the journal's delta records with ``seq`` past
+   the restored checkpoint replay through ``Session.apply_delta``;
+   the absorbed prefix is skipped by sequence (correct even when the
+   compactor never got to truncate it). Replay cost is therefore
+   O(--checkpoint-interval), not O(daemon lifetime).
+
+Without checkpoints the original contract is unchanged — a fresh
+Session from the same config, then the full delta stream:
 
 - compiled executables come from the shared content-addressed AOT
   store (zero new XLA compiles — the store was populated by the
@@ -31,11 +47,15 @@ matrix can drive bootstrap faults to their documented degradation.
 from __future__ import annotations
 
 import json
-from typing import List, Tuple
+import logging
+import time
+from typing import List, Optional, Tuple
 
 from ..runtime import inject as _inject
 from ..runtime.journal import JOURNAL_VERSION, JournalMismatch
 from ..utils.trace import COUNTERS
+
+log = logging.getLogger("simon.fleet")
 
 
 def read_session_events(path: str, fingerprint: str) -> Tuple[List[dict], int]:
@@ -102,19 +122,92 @@ def read_session_events(path: str, fingerprint: str) -> Tuple[List[dict], int]:
     return records, dropped
 
 
-def replay_into_session(session, path: str) -> dict:
-    """Replay the delta stream journaled at ``path`` into ``session``
-    (deltas recorded against other cluster fingerprints are skipped —
-    a multi-session snapshot replays only the primary's stream).
-    Returns a summary dict: ``deltas`` seen for this fingerprint,
-    ``applied``/``skipped``/``reloads`` from ``apply_delta``,
+def restore_into_session(session, snapshot_path: str) -> Optional[dict]:
+    """Adopt the newest TRUSTABLE checkpoint generation for
+    ``snapshot_path`` into ``session``. Returns
+    ``{"deltaSeq", "stateDigest", "path"}`` on success, None when no
+    generation exists or every one was refused (the caller replays the
+    full journal). The trust ladder per generation, newest first:
+    header validation (kind/version/toolchain/fingerprint/sha256,
+    ``load_checkpoint``), then the payload re-materialized to a fresh
+    roster expansion whose digest must equal the header's
+    ``stateDigest`` — all BEFORE the session is touched, under one
+    delta-lock hold, so a refused generation leaves the session
+    exactly as it was."""
+    from ..runtime.checkpoint import (
+        CheckpointMismatch,
+        checkpoint_dir,
+        list_checkpoints,
+        load_checkpoint,
+    )
+    from ..serve.session import (
+        cluster_from_payload,
+        materialized_state_digest,
+    )
+
+    generations = list_checkpoints(checkpoint_dir(snapshot_path))
+    for seq, path in generations:
+        try:
+            header, payload = load_checkpoint(
+                path, expect_fingerprint=session.fingerprint
+            )
+            # _delta_lock is an RLock (session.py): restore_state
+            # re-acquiring it under this hold is reentrant, not a
+            # deadlock — the outer hold makes verify+swap one atomic cut
+            with session._delta_lock:  # simonlint: disable=CONC002
+                cluster = cluster_from_payload(payload)
+                fresh = materialized_state_digest(cluster)
+                if fresh != header["stateDigest"]:
+                    raise CheckpointMismatch(
+                        f"{path}: payload re-materializes to digest "
+                        f"{fresh!r}, header claims "
+                        f"{header['stateDigest']!r}; refusing this "
+                        "generation"
+                    )
+                session.restore_state(cluster, header["deltaSeq"])
+        except CheckpointMismatch as e:
+            COUNTERS.inc("ckpt_restore_fallback_total")
+            log.warning(
+                "checkpoint generation refused, falling back to the "
+                "previous one (longer replay, never silent wrong state): %s",
+                e,
+            )
+            continue
+        COUNTERS.inc("ckpt_restore_total")
+        return {
+            "deltaSeq": int(header["deltaSeq"]),
+            "stateDigest": header["stateDigest"],
+            "path": path,
+        }
+    if generations:
+        log.warning(
+            "all %d checkpoint generation(s) under %s refused; "
+            "recovering by full journal replay",
+            len(generations),
+            checkpoint_dir(snapshot_path),
+        )
+    return None
+
+
+def replay_into_session(session, path: str, use_checkpoints: bool = True) -> dict:
+    """Bootstrap ``session`` from the snapshot at ``path``: checkpoint
+    restore first (``use_checkpoints``), then replay the journal's
+    delta suffix (deltas recorded against other cluster fingerprints
+    are skipped — a multi-session snapshot replays only the primary's
+    stream). Returns a summary dict: ``deltas`` REPLAYED for this
+    fingerprint, ``applied``/``skipped``/``reloads`` from
+    ``apply_delta``, ``skippedPrefix`` records absorbed by the restored
+    checkpoint, ``checkpoint`` (the restore summary or None),
     ``dropped`` torn-tail lines, and the journaled ``requestIds`` (the
     X-Simon-Request-Id correlation carried across the failover)."""
     from ..serve.sessions import SNAPSHOT_VERSION
     from ..runtime.journal import config_fingerprint
     from ..twin.deltas import ClusterDelta
 
+    t0 = time.perf_counter()
     _inject.fire("fleet.replay", path=path)
+    restored = restore_into_session(session, path) if use_checkpoints else None
+    base_seq = restored["deltaSeq"] if restored else 0
     fp = config_fingerprint(
         {"format": "serve-session-snapshot", "version": SNAPSHOT_VERSION}
     )
@@ -124,6 +217,8 @@ def replay_into_session(session, path: str) -> dict:
         "applied": 0,
         "skipped": 0,
         "reloads": 0,
+        "skippedPrefix": 0,
+        "checkpoint": restored,
         "dropped": dropped,
         "requestIds": [],
     }
@@ -132,6 +227,27 @@ def replay_into_session(session, path: str) -> dict:
             continue
         if rec.get("fingerprint") != session.fingerprint:
             continue
+        seq = rec.get("seq")
+        if base_seq:
+            if isinstance(seq, int):
+                if seq <= base_seq:
+                    summary["skippedPrefix"] += 1
+                    continue
+            else:
+                # a pre-checkpoint-era record with no sequence: it was
+                # in the journal when the checkpoint captured the
+                # session, so it is absorbed — blind-applying it on
+                # top of the restore would double-apply. Skipped LOUDLY.
+                summary["skippedPrefix"] += 1
+                COUNTERS.inc("fleet_replay_unsequenced_skipped_total")
+                log.warning(
+                    "unsequenced delta record in %s skipped after a "
+                    "checkpoint restore at seq %d (absorbed by the "
+                    "snapshot; re-applying would double-count)",
+                    path,
+                    base_seq,
+                )
+                continue
         summary["deltas"] += 1
         rid = rec.get("requestId")
         if rid:
@@ -144,6 +260,15 @@ def replay_into_session(session, path: str) -> dict:
             if out == "reloaded":
                 summary["reloads"] += 1
     COUNTERS.inc("fleet_replayed_deltas_total", summary["deltas"])
+    COUNTERS.inc("fleet_replay_deltas_total", summary["deltas"])
+    if summary["skippedPrefix"]:
+        COUNTERS.inc(
+            "ckpt_restore_deltas_skipped_total", summary["skippedPrefix"]
+        )
     if dropped:
         COUNTERS.inc("fleet_replay_torn_tail_total", dropped)
+    if restored:
+        COUNTERS.gauge(
+            "ckpt_restore_seconds", round(time.perf_counter() - t0, 6)
+        )
     return summary

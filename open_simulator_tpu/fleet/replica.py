@@ -337,12 +337,17 @@ def serve_argv(
     *,
     aot_store: str,
     snapshot_path: str,
+    checkpoint_interval: Optional[int] = None,
+    keep_checkpoints: Optional[int] = None,
     extra: List[str] = (),
 ) -> List[str]:
     """The canonical replica command line: ephemeral port, shared AOT
     store, the slot's snapshot journal, and journal replay on boot —
-    the zero-compile warm-bootstrap contract in one argv."""
-    return [
+    the zero-compile warm-bootstrap contract in one argv. With
+    ``checkpoint_interval`` the replica also writes verified state
+    checkpoints, so its replacement's replay is bounded by the
+    interval instead of the slot's lifetime (runtime/checkpoint.py)."""
+    argv = [
         sys.executable,
         "-m",
         "open_simulator_tpu.cli",
@@ -356,5 +361,10 @@ def serve_argv(
         "--snapshot",
         snapshot_path,
         "--replay-snapshot",
-        *extra,
     ]
+    if checkpoint_interval:
+        argv += ["--checkpoint-interval", str(int(checkpoint_interval))]
+    if keep_checkpoints:
+        argv += ["--keep-checkpoints", str(int(keep_checkpoints))]
+    argv += list(extra)
+    return argv

@@ -58,6 +58,10 @@ class Thresholds:
     # horizontal scaling), failover_seconds regresses UP (slower
     # recovery after a replica kill)
     fleet_frac: float = 0.5
+    # checkpoint family (r17): restore_seconds on the AGED failover
+    # cells regresses UP — recovery time growing with absorbed-delta
+    # age means the checkpoint + compacted-suffix bound broke
+    ckpt_frac: float = 0.5
 
     @classmethod
     def from_args(cls, args) -> "Thresholds":
@@ -71,6 +75,7 @@ class Thresholds:
             store_frac=getattr(args, "store_tolerance", 0.5),
             store_reject_abs=getattr(args, "store_reject_tolerance", 0),
             fleet_frac=getattr(args, "fleet_tolerance", 0.5),
+            ckpt_frac=getattr(args, "ckpt_tolerance", 0.5),
         )
 
 
@@ -278,6 +283,14 @@ def diff_records(
         _num(cand, "obs", "fleet", "failover_seconds"),
         th.fleet_frac,
         note="kill-9 to next 200 through the router (reroute latency)",
+    )
+    opt(
+        frac_row,
+        "ckpt.restore_seconds",
+        _num(base, "obs", "ckpt", "restore_seconds"),
+        _num(cand, "obs", "ckpt", "restore_seconds"),
+        th.ckpt_frac,
+        note="aged-failover restore: newest checkpoint + journal suffix",
     )
     # per-site latency p95s: every site present in BOTH records
     bh = base.get("obs", {}).get("histograms")

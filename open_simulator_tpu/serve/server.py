@@ -407,6 +407,27 @@ def _resilience_lines(snap: dict) -> List[str]:
         "Warm sessions evicted (capacity + ledger pressure).",
         counts.get("serve_session_evictions_total", 0),
     )
+    # -- bounded-recovery checkpoints (runtime/checkpoint.py)
+    for key, help_text in (
+        ("ckpt_writes_total", "Verified checkpoint generations written."),
+        ("ckpt_write_errors_total", "Checkpoint attempts that failed (write or verify); the previous generation stays authoritative."),
+        ("ckpt_verify_failures_total", "Written snapshots whose digest did NOT re-materialize — refused and deleted, never compacted against."),
+        ("ckpt_compactions_total", "Journal compactions after a verified checkpoint."),
+        ("ckpt_compacted_records_total", "Journal records truncated as absorbed by a verified checkpoint."),
+        ("ckpt_compact_errors_total", "Compaction failures (journal left intact; replay stays seq-bounded)."),
+        ("ckpt_pruned_total", "Old checkpoint generations removed by the --keep-checkpoints policy."),
+        ("ckpt_restore_total", "Bootstraps that restored from a verified checkpoint."),
+        ("ckpt_restore_fallback_total", "Checkpoint generations refused at restore (torn/corrupt/stale) — fell back to an older one or full replay."),
+        ("ckpt_restore_deltas_skipped_total", "Journal delta records skipped at restore as absorbed by the checkpoint."),
+        ("fleet_replay_deltas_total", "Journal delta records actually replayed at restore (the bounded suffix)."),
+    ):
+        metric(f"simon_{key}", "counter", help_text, counts.get(key, 0))
+    for key, help_text in (
+        ("ckpt_restore_seconds", "Wall-clock of the last checkpoint restore (snapshot load + verify + suffix replay)."),
+        ("ckpt_write_seconds", "Wall-clock of the last checkpoint write + verify."),
+    ):
+        if key in gauges:
+            metric(f"simon_{key}", "gauge", help_text, gauges[key])
     # -- fault injection (runtime/inject.py): nonzero only when armed
     metric(
         "simon_inject_fired_total", "counter",
@@ -616,6 +637,8 @@ class ServeDaemon:
         max_request_pods: Optional[int] = None,
         max_sessions: int = 8,
         snapshot_path: Optional[str] = None,
+        checkpoint_interval: Optional[int] = None,
+        keep_checkpoints: int = 2,
         slo_engine=None,
         obs_cadence_s: float = 1.0,
     ):
@@ -636,6 +659,28 @@ class ServeDaemon:
         )
         snapshot = open_snapshot(snapshot_path) if snapshot_path else None
         self.sessions = SessionCache(capacity=max_sessions, snapshot=snapshot)
+        # bounded-recovery checkpoints (runtime/checkpoint.py): verified
+        # snapshots of the committed session every --checkpoint-interval
+        # deltas, journal compacted to the unabsorbed suffix — replay on
+        # the NEXT bootstrap is O(interval), not O(lifetime)
+        self.checkpoints = None
+        if snapshot is not None and checkpoint_interval:
+            from ..runtime.checkpoint import CheckpointManager, checkpoint_dir
+            from .session import session_checkpoint_state, verify_payload_digest
+            from .sessions import serve_keep_record
+
+            self.checkpoints = CheckpointManager(
+                checkpoint_dir(snapshot_path),
+                interval=checkpoint_interval,
+                keep=keep_checkpoints,
+                capture=lambda: session_checkpoint_state(self.session),
+                materialized_digest=lambda payload: verify_payload_digest(
+                    self.session, payload
+                ),
+                journal=snapshot,
+                keep_record=serve_keep_record(session.fingerprint),
+                label="serve",
+            )
         # the configured cluster is pinned: ledger pressure and
         # capacity evict secondaries only (serve/sessions.py)
         self.sessions.add(session, pinned=True)
@@ -703,6 +748,11 @@ class ServeDaemon:
                                     daemon.slo_engine.alerting()
                                     if daemon.slo_engine is not None
                                     else []
+                                ),
+                                "checkpoint": (
+                                    daemon.checkpoints.stats()
+                                    if daemon.checkpoints is not None
+                                    else None
                                 ),
                                 "draining": daemon._shutdown.is_set(),
                             }
@@ -866,10 +916,15 @@ class ServeDaemon:
                 counts = {"applied": 0, "skipped": 0, "reloads": 0}
                 try:
                     for d, rec in zip(deltas, recs):
-                        out = daemon.session.apply_delta(d)
+                        out, seq = daemon.session.apply_delta_seq(d)
                         daemon.sessions.record_delta(
-                            daemon.session.fingerprint, rec, request_id=rid
+                            daemon.session.fingerprint,
+                            rec,
+                            request_id=rid,
+                            seq=seq,
                         )
+                        if daemon.checkpoints is not None:
+                            daemon.checkpoints.note_delta(seq)
                         if out == "skipped":
                             counts["skipped"] += 1
                         else:
@@ -1041,6 +1096,8 @@ class ServeDaemon:
     def start(self):
         self.telemetry.start()
         self.coalescer.start()
+        if self.checkpoints is not None:
+            self.checkpoints.start()
         self._server_thread.start()
         log.info("simon serve listening on %s:%d", self.host, self.port)
 
@@ -1070,6 +1127,8 @@ class ServeDaemon:
             )
         if self.slo_engine is not None:
             reasons.extend(self.slo_engine.reasons())
+        if self.checkpoints is not None:
+            reasons.extend(self.checkpoints.degraded_reasons())
         return ("degraded" if reasons else "ok"), reasons
 
     def begin_shutdown(self):
@@ -1087,6 +1146,10 @@ class ServeDaemon:
         # handler threads to finish WRITING those answers (bounded: a
         # wedged client socket must not hold the exit hostage)
         self._inflight_zero.wait(timeout=min(self.drain_timeout_s, 10.0))
+        if self.checkpoints is not None:
+            # the worker must not race the journal close below (drain
+            # appends, then closes the snapshot the compactor rewrites)
+            self.checkpoints.stop()
         self.sessions.drain()  # journal surviving warm sessions
         self.telemetry.stop()  # one final sample so dumps see the end
         self.httpd.shutdown()

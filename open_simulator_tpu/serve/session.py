@@ -735,11 +735,22 @@ class Session:
         conformance contract (tests/test_twin.py, CI-gated): after any
         delta stream, this session answers byte-identically to a fresh
         Session over its mutated ``self.cluster``."""
+        return self.apply_delta_seq(delta)[0]
+
+    def apply_delta_seq(self, delta) -> "tuple[str, int]":
+        """``apply_delta`` returning ``(outcome, seq)`` where ``seq``
+        is the EXACT delta sequence this apply was assigned under the
+        lock. The journal record must be stamped with this value, not
+        a later read of ``self.delta_seq`` — under concurrent handler
+        threads the later read can observe another thread's apply, and
+        a misstamped record would double-apply (or drop) a delta on
+        snapshot-then-suffix restore."""
         from ..twin.deltas import RELOADED, SKIPPED
 
         with self._delta_lock:
             out = self._apply_delta(delta)
             self.delta_seq += 1
+            seq = self.delta_seq
             COUNTERS.inc(f"serve_delta_{delta.kind}_total")
             if out == SKIPPED:
                 COUNTERS.inc("serve_delta_skips_total")
@@ -747,6 +758,21 @@ class Session:
                 COUNTERS.inc("serve_deltas_applied_total")
                 if out == RELOADED:
                     COUNTERS.inc("serve_delta_reloads_total")
+        return out, seq
+
+    def restore_state(self, cluster: ResourceTypes, delta_seq: int) -> str:
+        """Adopt a checkpointed cluster as this session's committed
+        state (runtime/checkpoint.py): swap the cluster in, rebuild via
+        ``_reload`` (fresh expansion/oracle/engine — identical to a
+        cold load of the mutated cluster), and advance ``delta_seq`` to
+        the checkpoint's sequence so the journal suffix replay skips
+        exactly the absorbed prefix. The caller verifies the payload
+        digest BEFORE calling this (fleet/replay.restore_into_session);
+        a refused checkpoint must leave the session untouched."""
+        with self._delta_lock:
+            self.cluster = cluster
+            out = self._reload()
+            self.delta_seq = int(delta_seq)
         return out
 
     def _apply_delta(self, delta) -> str:
@@ -849,3 +875,76 @@ class Session:
         self.fingerprint = fp
         self.delta_seq, self.delta_reloads = seq, reloads + 1
         return RELOADED
+
+
+# -- checkpoint capture / materialization (runtime/checkpoint.py) -----------
+
+
+def cluster_payload(cluster: ResourceTypes) -> dict:
+    """The delta-mutated cluster as a JSON-clean checkpoint payload:
+    one key per ResourceTypes field, deep-copied so the snapshot writer
+    never aliases the live roster the handler threads keep mutating."""
+    return {
+        f: copy.deepcopy(getattr(cluster, f))
+        for f in cluster.__dataclass_fields__
+    }
+
+
+def cluster_from_payload(payload: dict) -> ResourceTypes:
+    """Inverse of ``cluster_payload``; unknown keys (a future field
+    this build does not model) are refused by the caller's toolchain
+    gate before this runs, so plain field assignment suffices."""
+    cluster = ResourceTypes()
+    for f in cluster.__dataclass_fields__:
+        setattr(cluster, f, copy.deepcopy(payload.get(f, [])))
+    return cluster
+
+
+def materialized_state_digest(cluster: ResourceTypes) -> str:
+    """``Session.state_digest()`` of a FRESH expansion over a cluster,
+    WITHOUT building a Session (no oracle, no engine, no device work).
+    By the warm==cold conformance contract the warm roster order equals
+    the cold expansion order of the mutated cluster — so this digest
+    matching a live session's proves the checkpoint payload
+    re-materializes to the same committed state. Callers verifying
+    against a LIVE session must hold that session's ``_delta_lock``:
+    the generated-name counter this expansion saves/restores is global
+    and is otherwise raced by request expansion."""
+    saved = wl.name_counter_state()
+    try:
+        wl.reset_name_counter()
+        pods: List[dict] = []
+        pods.extend(wl.pods_excluding_daemon_sets(cluster))
+        for ds in cluster.daemon_sets:
+            pods.extend(wl.pods_from_daemon_set(ds, cluster.nodes))
+    finally:
+        wl.set_name_counter(saved)
+    return config_fingerprint(
+        [(n.get("metadata") or {}).get("name") for n in cluster.nodes],
+        pods,
+    )
+
+
+def verify_payload_digest(session: Session, payload: dict) -> str:
+    """The CheckpointManager ``materialized_digest`` hook for a serve
+    session: re-materialize the payload cluster and digest it, under
+    the session's delta lock (the name-counter race documented on
+    ``materialized_state_digest``)."""
+    with session._delta_lock:
+        return materialized_state_digest(cluster_from_payload(payload))
+
+
+def session_checkpoint_state(session: Session):
+    """The CheckpointManager ``capture`` hook: one consistent cut of
+    the committed session — the ``/v1/state-digest`` triple plus the
+    full mutated cluster — taken under the delta lock so the captured
+    ``delta_seq`` counts exactly the deltas the payload absorbed."""
+    from ..runtime.checkpoint import CheckpointState
+
+    with session._delta_lock:
+        return CheckpointState(
+            fingerprint=session.fingerprint,
+            delta_seq=session.delta_seq,
+            state_digest=session.state_digest(),
+            payload=cluster_payload(session.cluster),
+        )

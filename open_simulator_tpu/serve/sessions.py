@@ -59,6 +59,31 @@ def open_snapshot(path: str) -> SessionSnapshotJournal:
     return SessionSnapshotJournal.open(path, fp)
 
 
+def serve_keep_record(fingerprint: str):
+    """The serve snapshot's checkpoint-compaction predicate: a verified
+    checkpoint at delta seq N absorbs every journaled delta for this
+    cluster with ``seq <= N``, so compaction drops exactly those.
+    Non-delta records (admit/evict/drain), other clusters' deltas, and
+    deltas past N are retained. Deltas journaled WITHOUT a seq (a
+    pre-checkpoint-era journal) are also dropped: they were present
+    when the checkpoint captured the session, hence absorbed by
+    definition — and restore refuses to blind-apply unsequenced
+    records on top of a checkpoint anyway (fleet/replay.py counts
+    them loudly instead)."""
+
+    def keep(rec: dict, upto_seq: int) -> bool:
+        if (
+            rec.get("kind") != "session"
+            or rec.get("event") != "delta"
+            or rec.get("fingerprint") != fingerprint
+        ):
+            return True
+        seq = rec.get("seq")
+        return isinstance(seq, int) and seq > upto_seq
+
+    return keep
+
+
 class SessionCache:
     """Fingerprint-keyed LRU of warm Sessions. All mutation under one
     lock; eviction never runs device work (dropping references is the
@@ -90,20 +115,27 @@ class SessionCache:
         )
 
     def record_delta(
-        self, fingerprint: str, delta_record: dict, request_id: str = ""
+        self,
+        fingerprint: str,
+        delta_record: dict,
+        request_id: str = "",
+        seq: Optional[int] = None,
     ):
         """Journal one applied cluster delta (POST /v1/cluster-delta):
         the snapshot then carries not just WHICH clusters were warm at
         a crash but what delta stream their warm state had absorbed —
         fsync'd per append like every session event. ``request_id``
         correlates the journal line with the HTTP request that carried
-        the delta (the X-Simon-Request-Id contract)."""
+        the delta (the X-Simon-Request-Id contract); ``seq`` is the
+        exact session delta sequence the apply assigned — the handle
+        checkpoint compaction and snapshot-then-suffix replay filter
+        on (fleet/replay.py)."""
+        extra = {}
         if request_id:
-            self._record(
-                "delta", fingerprint, delta=delta_record, requestId=request_id
-            )
-        else:
-            self._record("delta", fingerprint, delta=delta_record)
+            extra["requestId"] = request_id
+        if seq is not None:
+            extra["seq"] = int(seq)
+        self._record("delta", fingerprint, delta=delta_record, **extra)
 
     # -- membership ----------------------------------------------------------
 

@@ -38,17 +38,49 @@ the staleness visible rather than dying mid-shift.
 from __future__ import annotations
 
 import collections
+import copy
 import threading
 import time
 from typing import List, Optional, Tuple
 
 from ..models.validation import InputError
 from ..runtime.errors import GuardError
+from ..runtime.journal import Journal, config_fingerprint
 from ..utils.trace import COUNTERS
 from .deltas import MirrorApplicator  # noqa: F401  (re-export for callers)
 
 #: backlog depth past which /healthz reports the mirror degraded
 BACKLOG_DEGRADED = 4096
+
+TWIN_SNAPSHOT_VERSION = 1
+
+
+class TwinSnapshotJournal(Journal):
+    """The twin's durable step journal (``--snapshot``): same crash-
+    safe JSONL format/recovery as every other journal, its own
+    fault-injection crash point. One record per successfully applied
+    mirror step — the delta stream a restarted twin replays (after a
+    checkpoint restore bounds the suffix, runtime/checkpoint.py)."""
+
+    inject_site = "journal.fsync.twin"
+
+
+def open_twin_snapshot(path: str) -> TwinSnapshotJournal:
+    """Create-or-resume the twin step journal at ``path``."""
+    fp = config_fingerprint(
+        {"format": "twin-mirror-snapshot", "version": TWIN_SNAPSHOT_VERSION}
+    )
+    return TwinSnapshotJournal.open(path, fp)
+
+
+def twin_keep_record(rec: dict, upto_seq: int) -> bool:
+    """Checkpoint-compaction predicate for the twin journal: a
+    verified checkpoint at seq N absorbs every journaled step with
+    ``seq <= N``; everything else is retained."""
+    if rec.get("kind") != "mirror" or rec.get("event") != "step":
+        return True
+    seq = rec.get("seq")
+    return not (isinstance(seq, int) and seq <= upto_seq)
 
 
 class LiveSource:
@@ -134,6 +166,13 @@ class ClusterMirror:
         self.polls = 0
         self.flaps = 0
         self.apply_errors = 0
+        # the externally checkable applied-step sequence (the twin
+        # analogue of serve's deltaSeq, exposed at /healthz and
+        # /v1/state-digest) — restore identity is verified against it
+        self.delta_seq = 0
+        # durable step journal (attach AFTER any replay: replayed
+        # steps are already on disk and must not re-append)
+        self.journal: Optional[Journal] = None
         self.started_at = time.monotonic()
 
     # -- locking (query engines hold the mirror across one evaluation) --
@@ -166,7 +205,9 @@ class ClusterMirror:
         nodes, steps = self.source.bootstrap()
         with self._lock:
             for st in steps:
-                self._apply_step(st)
+                # the journal append inside must be atomic with the state
+                # mutation: a step must never be applied-but-unjournaled
+                self._apply_step(st)  # simonlint: disable=CONC002
         self._export()
         return nodes
 
@@ -203,7 +244,8 @@ class ClusterMirror:
                 if budget is not None:
                     budget.check(f"twin tail (poll {poll_no}, catch-up)")
                 _obs, st = self._backlog.popleft()
-                self._apply_step(st)
+                # journal append atomic with the mutation (see bootstrap)
+                self._apply_step(st)  # simonlint: disable=CONC002
                 applied += 1
             if self._backlog:
                 COUNTERS.inc(
@@ -221,12 +263,13 @@ class ClusterMirror:
                 if budget is not None:
                     budget.check("twin tail (final catch-up)")
                 _obs, st = self._backlog.popleft()
-                self._apply_step(st)
+                # journal append atomic with the mutation (see bootstrap)
+                self._apply_step(st)  # simonlint: disable=CONC002
                 applied += 1
         self._export()
         return applied
 
-    def _apply_step(self, st):  # simonlint: disable=CONC001 - callers hold self._lock (poll_once/drain_backlog/bootstrap)
+    def _apply_step(self, st):  # simonlint: disable=CONC001 - callers hold self._lock (poll_once/drain_backlog/bootstrap/replay)
         try:
             self.replayer.step(st)
         except (GuardError, InputError) as e:
@@ -239,6 +282,17 @@ class ClusterMirror:
 
             GLOBAL.append_note(
                 "twin-apply-error", f"step {getattr(st, 'seq', '?')}: {str(e)[:120]}"
+            )
+            return
+        self.delta_seq += 1
+        if self.journal is not None:
+            self.journal.append(
+                {
+                    "kind": "mirror",
+                    "event": "step",
+                    "seq": self.delta_seq,
+                    "step": st.as_record(),
+                }
             )
 
     # -- observability ------------------------------------------------------
@@ -257,6 +311,21 @@ class ClusterMirror:
     def agreement_rate(self) -> float:
         with self._lock:
             return self.replayer.report.agreement_rate
+
+    def state_digest(self) -> str:
+        """Canonical digest of the mirrored capacity state (the
+        delta-substrate ``state_dict`` — twin/deltas.py), the twin's
+        ``/v1/state-digest`` value: a restored or replacement mirror
+        is correct iff its digest equals the one it replaced. Cheap:
+        no device work, safe to poll."""
+        from .deltas import state_dict
+
+        with self._lock:
+            return config_fingerprint(state_dict(self.replayer._app))
+
+    def applied_seq(self) -> int:
+        with self._lock:
+            return self.delta_seq
 
     def _export(self):
         with self._lock:
@@ -306,6 +375,7 @@ class ClusterMirror:
                 "reloads": rep.reloads,
                 "deltasApplied": app.applied,
                 "deltaSkips": app.skips,
+                "deltaSeq": self.delta_seq,
                 "applyErrors": self.apply_errors,
                 "pendingPods": len(app.pending),
                 "nodes": len(app.oracle.nodes),
@@ -333,3 +403,195 @@ class ClusterMirror:
         cluster.pod_disruption_budgets = list(base.pod_disruption_budgets)
         cluster.priority_classes = list(base.priority_classes)
         return cluster
+
+
+# -- checkpoint capture / materialization (runtime/checkpoint.py) -----------
+
+
+def capture_mirror(mirror: ClusterMirror):
+    """The CheckpointManager ``capture`` hook for a twin mirror: one
+    consistent cut under the mirror lock — identity (the base-cluster
+    fingerprint the divergence report carries), the applied-step
+    sequence, the capacity-state digest, and a payload that
+    re-materializes the applicator: nodes, bound pods (per-node, in
+    placement order, each stamped with its node), pending pods, and
+    the pdb/priority context the oracle rebuild needs."""
+    from ..runtime.checkpoint import CheckpointState
+    from .deltas import state_dict
+
+    with mirror.lock:
+        app = mirror.applicator
+        bound = []
+        for ns in app.oracle.nodes:
+            for p in ns.pods:
+                pod = copy.deepcopy(p)
+                pod.setdefault("spec", {})["nodeName"] = ns.name
+                bound.append(pod)
+        payload = {
+            "nodes": [copy.deepcopy(ns.node) for ns in app.oracle.nodes],
+            "bound": bound,
+            "pending": [copy.deepcopy(p) for p in app.pending.values()],
+            "pdbs": copy.deepcopy(app.cluster.pod_disruption_budgets),
+            "priorityClasses": copy.deepcopy(app.cluster.priority_classes),
+        }
+        return CheckpointState(
+            fingerprint=mirror.replayer.report.fingerprint,
+            delta_seq=mirror.delta_seq,
+            state_digest=config_fingerprint(state_dict(app)),
+            payload=payload,
+        )
+
+
+def twin_materialized_digest(payload: dict) -> str:
+    """State digest of a FRESH materialization of a twin checkpoint
+    payload: a new oracle-engine applicator over the payload nodes,
+    every bound pod re-placed, the pending queue refilled —
+    ``state_dict`` is engine-independent (it reads only oracle
+    NodeStates), so this digest matching the live mirror's proves the
+    payload restores to the same capacity state."""
+    from ..models.decode import ResourceTypes
+    from .deltas import _own_pod, _pod_key, state_dict
+
+    cold = ResourceTypes()
+    cold.nodes = [copy.deepcopy(n) for n in payload.get("nodes", [])]
+    cold.pod_disruption_budgets = copy.deepcopy(payload.get("pdbs", []))
+    cold.priority_classes = copy.deepcopy(payload.get("priorityClasses", []))
+    app = MirrorApplicator(cold, engine="oracle")
+    for pod in payload.get("bound", []):
+        p = _own_pod(pod)
+        app.oracle.place_existing_pod(p)
+        app._bound[_pod_key(p)] = (p.get("spec") or {}).get("nodeName") or ""
+    for pod in payload.get("pending", []):
+        app.pending[_pod_key(pod)] = _own_pod(pod)
+    return config_fingerprint(state_dict(app))
+
+
+def restore_mirror_state(mirror: ClusterMirror, payload: dict, seq: int):
+    """Adopt a VERIFIED checkpoint payload as the mirror's state (the
+    caller has already proven ``twin_materialized_digest(payload)``
+    equals the checkpoint header's digest): rebuild the applicator's
+    oracle over the payload nodes, re-place the bound pods, refill the
+    pending queue and the bound index, and pin ``delta_seq`` so the
+    journal suffix replay skips exactly the absorbed prefix."""
+    from .deltas import _own_pod, _pod_key
+
+    with mirror.lock:
+        app = mirror.applicator
+        app._build([copy.deepcopy(n) for n in payload.get("nodes", [])])
+        app.pending.clear()
+        app._bound.clear()
+        for pod in payload.get("bound", []):
+            p = _own_pod(pod)
+            app.oracle.place_existing_pod(p)
+            app._bound[_pod_key(p)] = (
+                (p.get("spec") or {}).get("nodeName") or ""
+            )
+        for pod in payload.get("pending", []):
+            app.pending[_pod_key(pod)] = _own_pod(pod)
+        mirror.delta_seq = int(seq)
+
+
+def replay_mirror_journal(mirror: ClusterMirror, path: str) -> dict:
+    """Snapshot-then-suffix bootstrap for a restarted twin (the twin
+    analogue of fleet/replay.replay_into_session): restore the newest
+    trustable checkpoint generation (refused generations fall back
+    loudly, ``ckpt_restore_fallback_total``), then replay the
+    journal's step records with ``seq`` past the restored sequence.
+    Read-only on the journal file — the caller attaches the mirror's
+    append journal (``open_twin_snapshot``) AFTER this returns, so
+    replayed steps never re-append."""
+    from ..fleet.replay import read_session_events
+    from ..runtime.checkpoint import (
+        CheckpointMismatch,
+        checkpoint_dir,
+        list_checkpoints,
+        load_checkpoint,
+    )
+    from ..shadow.log import Step
+
+    t0 = time.monotonic()
+    restored = None
+    generations = list_checkpoints(checkpoint_dir(path))
+    for seq, gen_path in generations:
+        try:
+            header, payload = load_checkpoint(
+                gen_path, expect_fingerprint=mirror.replayer.report.fingerprint
+            )
+            fresh = twin_materialized_digest(payload)
+            if fresh != header["stateDigest"]:
+                raise CheckpointMismatch(
+                    f"{gen_path}: payload re-materializes to digest "
+                    f"{fresh!r}, header claims {header['stateDigest']!r}; "
+                    "refusing this generation"
+                )
+            restore_mirror_state(mirror, payload, header["deltaSeq"])
+        except CheckpointMismatch as e:
+            COUNTERS.inc("ckpt_restore_fallback_total")
+            import logging
+
+            logging.getLogger("simon.twin").warning(
+                "twin checkpoint generation refused, falling back to the "
+                "previous one (longer replay, never silent wrong state): %s",
+                e,
+            )
+            continue
+        COUNTERS.inc("ckpt_restore_total")
+        restored = {
+            "deltaSeq": int(header["deltaSeq"]),
+            "stateDigest": header["stateDigest"],
+            "path": gen_path,
+        }
+        break
+    base_seq = restored["deltaSeq"] if restored else 0
+    fp = config_fingerprint(
+        {"format": "twin-mirror-snapshot", "version": TWIN_SNAPSHOT_VERSION}
+    )
+    try:
+        records, dropped = read_session_events(path, fp)
+    except InputError:
+        if restored is None:
+            raise
+        # checkpoint restored but the journal is unreadable: serve the
+        # verified snapshot state rather than dying (the suffix since
+        # the checkpoint is lost and SAID so)
+        records, dropped = [], 0
+    summary = {
+        "steps": 0,
+        "skippedPrefix": 0,
+        "checkpoint": restored,
+        "dropped": dropped,
+    }
+    with mirror.lock:
+        for rec in records:
+            if rec.get("kind") != "mirror" or rec.get("event") != "step":
+                continue
+            seq = rec.get("seq")
+            if isinstance(seq, int) and seq <= base_seq:
+                summary["skippedPrefix"] += 1
+                continue
+            mirror._apply_step(Step.from_record(rec["step"]))
+            if isinstance(seq, int):
+                # pin to the journaled sequence (an apply error must
+                # not let replayed seqs drift from the recorded ones)
+                mirror.delta_seq = int(seq)
+            summary["steps"] += 1
+    COUNTERS.inc("fleet_replay_deltas_total", summary["steps"])
+    if summary["skippedPrefix"]:
+        COUNTERS.inc(
+            "ckpt_restore_deltas_skipped_total", summary["skippedPrefix"]
+        )
+    if dropped:
+        COUNTERS.inc("fleet_replay_torn_tail_total", dropped)
+    if restored:
+        COUNTERS.gauge(
+            "ckpt_restore_seconds", round(time.monotonic() - t0, 6)
+        )
+    if generations and restored is None:
+        import logging
+
+        logging.getLogger("simon.twin").warning(
+            "all %d twin checkpoint generation(s) refused; recovering by "
+            "full journal replay",
+            len(generations),
+        )
+    return summary

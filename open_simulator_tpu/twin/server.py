@@ -196,6 +196,9 @@ class TwinDaemon:
         budget=None,
         slo_engine=None,
         obs_cadence_s: float = 1.0,
+        snapshot_path: Optional[str] = None,
+        checkpoint_interval: Optional[int] = None,
+        keep_checkpoints: int = 2,
     ):
         if poll_interval_s <= 0:
             raise InputError(
@@ -215,6 +218,30 @@ class TwinDaemon:
             tick_budget_s=tick_budget_s,
             max_request_pods=max_request_pods,
         )
+        # bounded-recovery checkpoints (runtime/checkpoint.py): the
+        # same ladder serve runs — verified mirror snapshots every
+        # --checkpoint-interval applied steps, journal compacted to
+        # the unabsorbed suffix (the mirror's journal was attached by
+        # the CLI before this daemon was built)
+        self.checkpoints = None
+        if snapshot_path and checkpoint_interval:
+            from ..runtime.checkpoint import CheckpointManager, checkpoint_dir
+            from .mirror import (
+                capture_mirror,
+                twin_keep_record,
+                twin_materialized_digest,
+            )
+
+            self.checkpoints = CheckpointManager(
+                checkpoint_dir(snapshot_path),
+                interval=checkpoint_interval,
+                keep=keep_checkpoints,
+                capture=lambda: capture_mirror(self.mirror),
+                materialized_digest=twin_materialized_digest,
+                journal=mirror.journal,
+                keep_record=twin_keep_record,
+                label="twin",
+            )
         self._stop = threading.Event()
         self._tail_done = threading.Event()
         self._inflight = 0
@@ -266,8 +293,27 @@ class TwinDaemon:
                             if daemon.slo_engine is not None
                             else []
                         ),
+                        # serve-parity identity (docs/FLEET.md): the
+                        # fields fleet-style supervision of twin
+                        # replicas verifies restore identity against
+                        "cluster": daemon.mirror.replayer.report.fingerprint,
+                        "deltaSeq": daemon.mirror.applied_seq(),
+                        "checkpoint": (
+                            daemon.checkpoints.stats()
+                            if daemon.checkpoints is not None
+                            else None
+                        ),
                         "mirror": daemon.mirror.stats(),
                     }), headers=hdrs)
+                elif self.path == "/v1/state-digest":
+                    # the same dict-identity triple serve exposes: a
+                    # replacement twin is correct iff this matches the
+                    # mirror it replaced
+                    self._send(200, canonical_body({
+                        "fingerprint": daemon.mirror.replayer.report.fingerprint,
+                        "deltaSeq": daemon.mirror.applied_seq(),
+                        "stateDigest": daemon.mirror.state_digest(),
+                    }))
                 elif self.path == "/metrics":
                     self._send(
                         200,
@@ -482,9 +528,13 @@ class TwinDaemon:
                     # recorded feeds run dry; the mirror stays
                     # queryable at its final state until signaled
                     self.mirror.drain_backlog(budget=self.budget)
+                    if self.checkpoints is not None:
+                        self.checkpoints.note_delta(self.mirror.applied_seq())
                     break
                 applied = self.mirror.poll_once(budget=self.budget)
                 polls += 1
+                if applied > 0 and self.checkpoints is not None:
+                    self.checkpoints.note_delta(self.mirror.applied_seq())
                 if applied < 0:
                     flaps += 1
                     delay = min(
@@ -504,6 +554,8 @@ class TwinDaemon:
 
     def start(self):
         self.telemetry.start()
+        if self.checkpoints is not None:
+            self.checkpoints.start()
         self._server_thread.start()
         self._tail_thread.start()
         log.info("simon twin listening on %s:%d", self.host, self.port)
@@ -517,6 +569,8 @@ class TwinDaemon:
                 reasons.append(f"circuit breaker open: {endpoint}")
         if self.slo_engine is not None:
             reasons.extend(self.slo_engine.reasons())
+        if self.checkpoints is not None:
+            reasons.extend(self.checkpoints.degraded_reasons())
         return ("degraded" if reasons else "ok"), reasons
 
     def begin_shutdown(self):
@@ -526,6 +580,11 @@ class TwinDaemon:
         self.begin_shutdown()
         self._tail_done.wait(timeout=self.drain_timeout_s)
         self._inflight_zero.wait(timeout=min(self.drain_timeout_s, 10.0))
+        if self.checkpoints is not None:
+            # stop the worker before the journal closes underneath it
+            self.checkpoints.stop()
+        if self.mirror.journal is not None:
+            self.mirror.journal.close()
         self.telemetry.stop()
         self.httpd.shutdown()
         self.httpd.server_close()

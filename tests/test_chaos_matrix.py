@@ -124,6 +124,18 @@ INCR_CELLS = [
     ("ExternalIOError", "incremental", "incremental.suffix=exio@1x*"),
 ]
 
+# the checkpoint ladder's three seams (runtime/checkpoint.py): a write
+# fault is a counted degradation (the journal still has everything — a
+# missed checkpoint costs replay length, never state); a verify fault
+# refuses the generation LOUDLY (unlinked, restore falls back to the
+# previous one); a compact fault leaves the journal whole, and the
+# restored-seq filter keeps un-truncated journals replaying correctly
+CKPT_CELLS = [
+    ("ExternalIOError", "ckpt", "ckpt.write=exio@1"),
+    ("ConformanceError", "ckpt", "ckpt.verify=conformance@1"),
+    ("ExternalIOError", "ckpt", "ckpt.compact=exio@1"),
+]
+
 # the fleet router's four seams (fleet/): a route fault is a transport
 # fault — mark down + reroute with the ORIGINAL request id, exhaustion
 # sheds 503 + Retry-After; a probe fault is a counted flap below the
@@ -159,10 +171,12 @@ INJECTION_COVERAGE = {
         "ExternalIOError/io", "ExternalIOError/io", "ExternalIOError/twin",
         "ExternalIOError/incremental", "ExternalIOError/incremental",
         "ExternalIOError/fleet", "ExternalIOError/fleet",
+        "ExternalIOError/ckpt", "ExternalIOError/ckpt",
     ],
     "ConformanceError": [
         "ConformanceError/apply", "ConformanceError/serve",
         "ConformanceError/twin", "ConformanceError/fleet",
+        "ConformanceError/ckpt",
     ],
     "ExecutionHalted": ["ExecutionHalted/apply", "ExecutionHalted/timeline"],
     "DeadlineExceeded": [
@@ -188,6 +202,7 @@ def test_registry_is_closed_over_cells():
     live |= {f"{e}/{s}" for e, s, *_ in TWIN_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in MESH_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in INCR_CELLS}
+    live |= {f"{e}/{s}" for e, s, *_ in CKPT_CELLS}
     live |= {f"{e}/{s}" for e, s, *_ in FLEET_CELLS}
     registered = {cid for ids in INJECTION_COVERAGE.values() for cid in ids}
     assert registered == live, (
@@ -845,6 +860,177 @@ def test_incremental_cell_suffix_fault_degrades_to_full_rescan():
     assert any(
         "incremental-degraded" in str(k) for k in notes
     ), ("fallback not trace-noted", notes)
+
+
+# ---------------------------------------------------------------- ckpt cells
+
+
+def _ckpt_rig(tmp_path, interval=2):
+    """A serve session + snapshot journal + SYNCHRONOUS checkpoint
+    manager (faults surface on the caller's stack, deterministic), plus
+    a pristine deepcopy of the cluster for building restore targets."""
+    import copy
+
+    from open_simulator_tpu.runtime.checkpoint import (
+        CheckpointManager,
+        checkpoint_dir,
+    )
+    from open_simulator_tpu.serve.session import (
+        Session,
+        session_checkpoint_state,
+        verify_payload_digest,
+    )
+    from open_simulator_tpu.serve.sessions import (
+        SessionCache,
+        open_snapshot,
+        serve_keep_record,
+    )
+    from open_simulator_tpu.testing import make_fake_pod
+
+    cluster = _build_serve_cluster()
+    cluster.pods = [
+        make_fake_pod(f"ck-p{i:02d}", "default", "250m", "512Mi")
+        for i in range(8)
+    ]
+    cluster0 = copy.deepcopy(cluster)
+    session = Session(cluster)
+    path = str(tmp_path / "ckpt-cell.snapshot.jsonl")
+    journal = open_snapshot(path)
+    cache = SessionCache(capacity=2, snapshot=journal)
+    mgr = CheckpointManager(
+        checkpoint_dir(path),
+        interval=interval,
+        keep=2,
+        capture=lambda: session_checkpoint_state(session),
+        materialized_digest=lambda p: verify_payload_digest(session, p),
+        journal=journal,
+        keep_record=serve_keep_record(session.fingerprint),
+        label="serve",
+        synchronous=True,
+    )
+    return session, cluster0, cache, journal, mgr, path
+
+
+def _ckpt_apply(session, cache, mgr, name):
+    """Apply one evict delta the way the serve handler does: seq from
+    the apply itself, journaled with it, then offered to the manager."""
+    from open_simulator_tpu.twin.deltas import POD_EVICT, ClusterDelta
+
+    d = ClusterDelta(kind=POD_EVICT, namespace="default", name=name)
+    out, seq = session.apply_delta_seq(d)
+    assert out == "applied"
+    cache.record_delta(session.fingerprint, d.as_record(), seq=seq)
+    mgr.note_delta(seq)
+    return seq
+
+
+def test_ckpt_cell_write_fault_is_counted_degradation(tmp_path):
+    """ExternalIOError/ckpt (ckpt.write seam): a failed checkpoint
+    write is a counted degradation — no generation appears, the
+    manager reports degraded, the journal still holds every delta, and
+    the NEXT interval's attempt recovers on its own."""
+    from open_simulator_tpu.runtime.checkpoint import (
+        checkpoint_dir,
+        list_checkpoints,
+    )
+
+    session, _cluster0, cache, journal, mgr, path = _ckpt_rig(tmp_path)
+    errors0 = COUNTERS.get("ckpt_write_errors_total")
+    INJECT.configure(CKPT_CELLS[0][2])
+    try:
+        _ckpt_apply(session, cache, mgr, "ck-p00")
+        _ckpt_apply(session, cache, mgr, "ck-p01")  # seq 2 -> attempt
+    finally:
+        INJECT.clear()
+    assert COUNTERS.get("ckpt_write_errors_total") > errors0
+    assert mgr.last_error is not None and mgr.degraded_reasons()
+    assert list_checkpoints(checkpoint_dir(path)) == []
+    # self-healing: the failed attempt did not advance last_seq, so the
+    # very next delta re-crosses the interval and checkpoints cleanly
+    seq = _ckpt_apply(session, cache, mgr, "ck-p02")
+    assert mgr.last_error is None and mgr.last_seq == seq == 3
+    assert len(list_checkpoints(checkpoint_dir(path))) == 1
+    journal.close()
+
+
+def test_ckpt_cell_verify_fault_refuses_generation_falls_back(tmp_path):
+    """ConformanceError/ckpt (ckpt.verify seam): a generation that
+    fails verification is unlinked and counted — the journal is NOT
+    compacted past it, and a restore lands on the previous verified
+    generation plus a longer replay, ending dict-identical to the live
+    session. Never a silent wrong state."""
+    from open_simulator_tpu.fleet.replay import replay_into_session
+    from open_simulator_tpu.runtime.checkpoint import (
+        checkpoint_dir,
+        list_checkpoints,
+    )
+    from open_simulator_tpu.serve.session import Session
+
+    session, cluster0, cache, journal, mgr, path = _ckpt_rig(tmp_path)
+    _ckpt_apply(session, cache, mgr, "ck-p00")
+    _ckpt_apply(session, cache, mgr, "ck-p01")  # seq 2: verified gen
+    assert mgr.last_seq == 2
+    fails0 = COUNTERS.get("ckpt_verify_failures_total")
+    INJECT.configure(CKPT_CELLS[1][2])
+    try:
+        _ckpt_apply(session, cache, mgr, "ck-p02")
+        _ckpt_apply(session, cache, mgr, "ck-p03")  # seq 4: refused gen
+    finally:
+        INJECT.clear()
+    assert COUNTERS.get("ckpt_verify_failures_total") > fails0
+    assert mgr.last_seq == 2, "refused generation must not advance trust"
+    gens = list_checkpoints(checkpoint_dir(path))
+    assert [s for s, _p in gens] == [2], "refused generation not unlinked"
+    journal.close()
+
+    replica = Session(cluster0)
+    summary = replay_into_session(replica, path)
+    assert summary["checkpoint"]["deltaSeq"] == 2
+    # the verified gen-2 checkpoint already compacted seqs 1-2 away;
+    # the refused gen-4 compacted NOTHING, so seqs 3-4 replay as suffix
+    assert summary["skippedPrefix"] == 0 and summary["deltas"] == 2
+    assert replica.delta_seq == session.delta_seq == 4
+    assert replica.state_digest() == session.state_digest()
+
+
+def test_ckpt_cell_compact_fault_journal_still_replays(tmp_path):
+    """ExternalIOError/ckpt (ckpt.compact seam): a fault between the
+    verified snapshot and the journal truncation degrades — the
+    checkpoint stays trusted, the journal keeps its absorbed prefix,
+    and a restore replays correctly anyway (the seq filter skips the
+    prefix instead of double-applying it)."""
+    from open_simulator_tpu.fleet.replay import (
+        read_session_events,
+        replay_into_session,
+    )
+    from open_simulator_tpu.serve.session import Session
+    from open_simulator_tpu.serve.sessions import SNAPSHOT_VERSION
+    from open_simulator_tpu.runtime.journal import config_fingerprint
+
+    session, cluster0, cache, journal, mgr, path = _ckpt_rig(tmp_path)
+    errors0 = COUNTERS.get("ckpt_compact_errors_total")
+    INJECT.configure(CKPT_CELLS[2][2])
+    try:
+        _ckpt_apply(session, cache, mgr, "ck-p00")
+        _ckpt_apply(session, cache, mgr, "ck-p01")  # seq 2 -> attempt
+    finally:
+        INJECT.clear()
+    assert COUNTERS.get("ckpt_compact_errors_total") > errors0
+    assert mgr.last_seq == 2, "a compact fault must not un-verify"
+    journal.close()
+    fp = config_fingerprint(
+        {"format": "serve-session-snapshot", "version": SNAPSHOT_VERSION}
+    )
+    records, _dropped = read_session_events(path, fp)
+    deltas = [r for r in records if r.get("event") == "delta"]
+    assert len(deltas) == 2, "compact fault must leave the journal whole"
+
+    replica = Session(cluster0)
+    summary = replay_into_session(replica, path)
+    assert summary["checkpoint"]["deltaSeq"] == 2
+    assert summary["skippedPrefix"] == 2 and summary["deltas"] == 0
+    assert replica.delta_seq == session.delta_seq
+    assert replica.state_digest() == session.state_digest()
 
 
 # --------------------------------------------------------------- fleet cells

@@ -1,0 +1,723 @@
+"""Bounded-recovery checkpoints (runtime/checkpoint.py +
+docs/ROBUSTNESS.md): generation rotation under --keep-checkpoints,
+loud refusal of torn/corrupt/stale generations, compaction-equivalence
+(a compacted journal replays to the SAME state a full journal does,
+over seeded delta streams), replayed-delta counts bounded by the
+checkpoint interval, crash seams mid-write and pre-compaction, and a
+zero-recompile restore on a warm artifact store."""
+
+import copy
+import json
+import os
+import random
+
+import pytest
+
+from open_simulator_tpu.runtime import InjectedCrash
+from open_simulator_tpu.runtime.checkpoint import (
+    CheckpointManager,
+    CheckpointMismatch,
+    CheckpointState,
+    checkpoint_dir,
+    list_checkpoints,
+    load_checkpoint,
+    prune_checkpoints,
+    toolchain_digest,
+    write_checkpoint,
+)
+from open_simulator_tpu.runtime.inject import INJECT
+from open_simulator_tpu.utils.trace import COUNTERS
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _node(name):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name,
+                     "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"}
+        },
+    }
+
+
+def _build_cluster(pods=8):
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.testing import make_fake_pod
+
+    cluster = ResourceTypes()
+    cluster.nodes = [_node(f"ck-n-{i}") for i in range(3)]
+    cluster.pods = [
+        make_fake_pod(f"ck-p{i:02d}", "default", "250m", "512Mi")
+        for i in range(pods)
+    ]
+    return cluster
+
+
+def _rig(tmp_path, interval=2, keep=2, tag="ckpt"):
+    """Serve session + snapshot journal + SYNCHRONOUS manager, plus a
+    pristine cluster deepcopy for building restore replicas."""
+    from open_simulator_tpu.serve.session import (
+        Session,
+        session_checkpoint_state,
+        verify_payload_digest,
+    )
+    from open_simulator_tpu.serve.sessions import (
+        SessionCache,
+        open_snapshot,
+        serve_keep_record,
+    )
+
+    cluster = _build_cluster()
+    cluster0 = copy.deepcopy(cluster)
+    session = Session(cluster)
+    path = str(tmp_path / f"{tag}.snapshot.jsonl")
+    journal = open_snapshot(path)
+    cache = SessionCache(capacity=2, snapshot=journal)
+    mgr = CheckpointManager(
+        checkpoint_dir(path),
+        interval=interval,
+        keep=keep,
+        capture=lambda: session_checkpoint_state(session),
+        materialized_digest=lambda p: verify_payload_digest(session, p),
+        journal=journal,
+        keep_record=serve_keep_record(session.fingerprint),
+        label="serve",
+        synchronous=True,
+    )
+    return session, cluster0, cache, journal, mgr, path
+
+
+def _evict(session, cache, mgr, name):
+    from open_simulator_tpu.twin.deltas import POD_EVICT, ClusterDelta
+
+    d = ClusterDelta(kind=POD_EVICT, namespace="default", name=name)
+    out, seq = session.apply_delta_seq(d)
+    assert out == "applied"
+    cache.record_delta(session.fingerprint, d.as_record(), seq=seq)
+    mgr.note_delta(seq)
+    return seq
+
+
+def _arrive(session, cache, mgr, name):
+    from open_simulator_tpu.testing import make_fake_pod
+    from open_simulator_tpu.twin.deltas import POD_ARRIVE, ClusterDelta
+
+    d = ClusterDelta(
+        kind=POD_ARRIVE, pod=make_fake_pod(name, "default", "250m", "512Mi")
+    )
+    out, seq = session.apply_delta_seq(d)
+    assert out == "applied"
+    cache.record_delta(session.fingerprint, d.as_record(), seq=seq)
+    mgr.note_delta(seq)
+    return seq
+
+
+def _journal_delta_seqs(path):
+    """Delta-record seqs currently in the snapshot journal file."""
+    seqs = []
+    with open(path) as f:
+        for line in f.read().splitlines()[1:]:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            if rec.get("kind") == "session" and rec.get("event") == "delta":
+                seqs.append(rec.get("seq"))
+    return seqs
+
+
+def _replay_replica(cluster0, path):
+    from open_simulator_tpu.fleet.replay import replay_into_session
+    from open_simulator_tpu.serve.session import Session
+
+    replica = Session(copy.deepcopy(cluster0))
+    return replica, replay_into_session(replica, path)
+
+
+# ------------------------------------------------- format-level refusals
+
+
+def _format_state():
+    return CheckpointState(
+        fingerprint="fp-unit",
+        delta_seq=7,
+        state_digest="digest-unit",
+        payload={"nodes": ["a"], "bound": []},
+    )
+
+
+def test_write_load_roundtrip(tmp_path):
+    d = str(tmp_path / "gens")
+    path = write_checkpoint(d, _format_state())
+    assert os.path.basename(path).startswith("gen-0000000007-")
+    header, payload = load_checkpoint(path, expect_fingerprint="fp-unit")
+    assert header["deltaSeq"] == 7
+    assert header["stateDigest"] == "digest-unit"
+    assert header["toolchain"] == toolchain_digest()
+    assert payload == {"nodes": ["a"], "bound": []}
+    assert list_checkpoints(d) == [(7, path)]
+
+
+def test_torn_payload_refused(tmp_path):
+    d = str(tmp_path / "gens")
+    path = write_checkpoint(d, _format_state())
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-6])  # tear the payload tail
+    with pytest.raises(CheckpointMismatch, match="sha256"):
+        load_checkpoint(path)
+
+
+def test_header_only_refused(tmp_path):
+    d = str(tmp_path / "gens")
+    path = write_checkpoint(d, _format_state())
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw.split(b"\n", 1)[0])  # header, no payload line
+    with pytest.raises(CheckpointMismatch, match="torn checkpoint"):
+        load_checkpoint(path)
+
+
+def test_corrupt_payload_refused(tmp_path):
+    d = str(tmp_path / "gens")
+    path = write_checkpoint(d, _format_state())
+    raw = bytearray(open(path, "rb").read())
+    raw[-4] ^= 0xFF  # flip a payload byte
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(CheckpointMismatch, match="sha256"):
+        load_checkpoint(path)
+
+
+def test_stale_toolchain_refused(tmp_path):
+    d = str(tmp_path / "gens")
+    path = write_checkpoint(d, _format_state())
+    with pytest.raises(CheckpointMismatch, match="toolchain"):
+        load_checkpoint(path, expect_toolchain="deadbeef")
+
+
+def test_foreign_fingerprint_refused(tmp_path):
+    d = str(tmp_path / "gens")
+    path = write_checkpoint(d, _format_state())
+    with pytest.raises(CheckpointMismatch, match="fingerprint"):
+        load_checkpoint(path, expect_fingerprint="someone-else")
+
+
+def test_wrong_version_refused(tmp_path):
+    d = str(tmp_path / "gens")
+    path = write_checkpoint(d, _format_state())
+    header_line, payload_line = open(path, "rb").read().split(b"\n", 1)
+    header = json.loads(header_line)
+    header["version"] = 99
+    with open(path, "wb") as f:
+        f.write(json.dumps(header).encode() + b"\n" + payload_line)
+    with pytest.raises(CheckpointMismatch, match="version"):
+        load_checkpoint(path)
+
+
+def test_prune_ignores_foreign_names_and_clears_tmp_litter(tmp_path):
+    d = str(tmp_path / "gens")
+    for seq in (3, 5, 9, 11):
+        write_checkpoint(
+            d,
+            CheckpointState(
+                fingerprint="fp", delta_seq=seq, state_digest="x",
+                payload={"seq": seq},
+            ),
+        )
+    open(os.path.join(d, ".gen-crashed.ckpt.tmp"), "w").close()
+    open(os.path.join(d, "README"), "w").close()
+    removed = prune_checkpoints(d, keep=2)
+    assert len(removed) == 2  # seqs 3 and 5 rotate out
+    assert [s for s, _p in list_checkpoints(d)] == [11, 9]
+    assert not any(n.endswith(".tmp") for n in os.listdir(d))
+    assert "README" in os.listdir(d)  # foreign files untouched
+
+
+# -------------------------------------------------- rotation + compaction
+
+
+def test_generation_rotation_under_keep(tmp_path):
+    """--keep-checkpoints N: every interval crossing writes a verified
+    generation, old ones rotate out, and the journal keeps exactly the
+    suffix past the OLDEST retained generation (so a fallback restore
+    still has its full replay)."""
+    session, _c0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=1, keep=2
+    )
+    for i in range(5):
+        _evict(session, cache, mgr, f"ck-p{i:02d}")
+    gens = list_checkpoints(checkpoint_dir(path))
+    assert [s for s, _p in gens] == [5, 4]
+    assert mgr.writes == 5 and mgr.last_seq == 5
+    assert mgr.stats()["generations"] == 2
+    # compacted up to the OLDEST retained generation (seq 4)
+    assert _journal_delta_seqs(path) == [5]
+    assert mgr.compactions >= 1
+    journal.close()
+
+
+def test_fallback_generation_keeps_full_suffix(tmp_path):
+    """The rotation invariant pays off: corrupt the NEWEST generation
+    and the restore falls back one generation — the journal still has
+    every delta since THAT one, so the replica converges to the exact
+    live state (longer replay, zero loss)."""
+    session, cluster0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=2, keep=2
+    )
+    for i in range(4):
+        _evict(session, cache, mgr, f"ck-p{i:02d}")
+    gens = list_checkpoints(checkpoint_dir(path))
+    assert [s for s, _p in gens] == [4, 2]
+    journal.close()
+    # corrupt the newest generation's payload
+    newest = gens[0][1]
+    raw = bytearray(open(newest, "rb").read())
+    raw[-4] ^= 0xFF
+    with open(newest, "wb") as f:
+        f.write(bytes(raw))
+
+    fallbacks0 = COUNTERS.get("ckpt_restore_fallback_total")
+    replica, summary = _replay_replica(cluster0, path)
+    assert COUNTERS.get("ckpt_restore_fallback_total") == fallbacks0 + 1
+    assert summary["checkpoint"]["deltaSeq"] == 2
+    assert summary["deltas"] == 2  # seqs 3-4 replayed from the journal
+    assert replica.delta_seq == session.delta_seq == 4
+    assert replica.state_digest() == session.state_digest()
+
+
+def test_compaction_equivalence_seeded_streams(tmp_path):
+    """THE compaction contract: over a seeded random delta stream, a
+    snapshot-then-suffix replay of the COMPACTED journal ends
+    dict-identical (state-digest triple) to a full-journal replay of an
+    uncompacted twin session — and to the live session itself."""
+    rng = random.Random(1113)
+    session_a, cluster0, cache_a, journal_a, mgr_a, path_a = _rig(
+        tmp_path, interval=3, keep=2, tag="compacted"
+    )
+    # twin rig with checkpointing OFF: same deltas, full journal
+    from open_simulator_tpu.serve.session import Session
+    from open_simulator_tpu.serve.sessions import SessionCache, open_snapshot
+
+    session_b = Session(copy.deepcopy(cluster0))
+    path_b = str(tmp_path / "full.snapshot.jsonl")
+    journal_b = open_snapshot(path_b)
+    cache_b = SessionCache(capacity=2, snapshot=journal_b)
+
+    live = [f"ck-p{i:02d}" for i in range(8)]
+    born = 0
+    for step in range(17):
+        if live and rng.random() < 0.5:
+            name = live.pop(rng.randrange(len(live)))
+            seq = _evict(session_a, cache_a, mgr_a, name)
+            from open_simulator_tpu.twin.deltas import (
+                POD_EVICT,
+                ClusterDelta,
+            )
+
+            d = ClusterDelta(kind=POD_EVICT, namespace="default", name=name)
+            out, seq_b = session_b.apply_delta_seq(d)
+            assert out == "applied" and seq_b == seq
+            cache_b.record_delta(session_b.fingerprint, d.as_record(),
+                                 seq=seq_b)
+        else:
+            name = f"ck-new-{born:02d}"
+            born += 1
+            live.append(name)
+            seq = _arrive(session_a, cache_a, mgr_a, name)
+            from open_simulator_tpu.testing import make_fake_pod
+            from open_simulator_tpu.twin.deltas import (
+                POD_ARRIVE,
+                ClusterDelta,
+            )
+
+            d = ClusterDelta(
+                kind=POD_ARRIVE,
+                pod=make_fake_pod(name, "default", "250m", "512Mi"),
+            )
+            out, seq_b = session_b.apply_delta_seq(d)
+            assert out == "applied" and seq_b == seq
+            cache_b.record_delta(session_b.fingerprint, d.as_record(),
+                                 seq=seq_b)
+    journal_a.close()
+    journal_b.close()
+    assert session_a.state_digest() == session_b.state_digest()
+    # the compacted journal is materially shorter than the full one
+    assert len(_journal_delta_seqs(path_a)) < len(_journal_delta_seqs(path_b))
+
+    replica_a, summary_a = _replay_replica(cluster0, path_a)
+    replica_b, summary_b = _replay_replica(cluster0, path_b)
+    assert summary_a["checkpoint"] is not None
+    assert summary_b["checkpoint"] is None  # no generations: full replay
+    assert summary_b["deltas"] == 17
+    assert replica_a.delta_seq == replica_b.delta_seq == 17
+    assert (
+        replica_a.state_digest()
+        == replica_b.state_digest()
+        == session_a.state_digest()
+    )
+    assert replica_a.fingerprint == session_a.fingerprint
+
+
+def test_replayed_deltas_bounded_by_interval(tmp_path):
+    """The acceptance gate: with --checkpoint-interval N, the restore
+    replays FEWER than N journal deltas (counter-gated via
+    fleet_replay_deltas_total), however long the daemon lived."""
+    interval = 5
+    session, cluster0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=interval, keep=2
+    )
+    for i in range(23):
+        _arrive(session, cache, mgr, f"ck-aged-{i:03d}")
+    journal.close()
+    replayed0 = COUNTERS.get("fleet_replay_deltas_total")
+    replica, summary = _replay_replica(cluster0, path)
+    replayed = COUNTERS.get("fleet_replay_deltas_total") - replayed0
+    assert replayed == summary["deltas"]
+    assert replayed < interval, (
+        f"replayed {replayed} deltas; the checkpoint interval "
+        f"({interval}) must bound recovery"
+    )
+    assert replica.delta_seq == session.delta_seq == 23
+    assert replica.state_digest() == session.state_digest()
+
+
+# ------------------------------------------------------------ crash seams
+
+
+def test_crash_mid_checkpoint_write_leaves_no_generation(tmp_path):
+    """ckpt.write crash mid-fsync: the torn tmp file is INVISIBLE to
+    list_checkpoints, the previous generation restores, and the next
+    clean attempt sweeps the litter."""
+    session, cluster0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=2, keep=2
+    )
+    _evict(session, cache, mgr, "ck-p00")
+    _evict(session, cache, mgr, "ck-p01")  # seq 2: clean generation
+    assert mgr.last_seq == 2
+    INJECT.configure("ckpt.write=crash:0.5@2")
+    try:
+        _evict(session, cache, mgr, "ck-p02")
+        with pytest.raises(InjectedCrash):
+            _evict(session, cache, mgr, "ck-p03")  # seq 4: dies mid-write
+    finally:
+        INJECT.clear()
+    gen_dir = checkpoint_dir(path)
+    litter = [n for n in os.listdir(gen_dir) if n.endswith(".tmp")]
+    assert litter, "the crash must leave a durable torn tmp file"
+    assert [s for s, _p in list_checkpoints(gen_dir)] == [2], (
+        "a torn tmp file must never surface as a generation"
+    )
+    # the journal still has the suffix; a replica restores gen 2 + replay
+    journal.close()
+    replica, summary = _replay_replica(cluster0, path)
+    assert summary["checkpoint"]["deltaSeq"] == 2
+    assert replica.delta_seq == session.delta_seq == 4
+    assert replica.state_digest() == session.state_digest()
+    # a later clean attempt sweeps the litter and rotates normally
+    from open_simulator_tpu.serve.sessions import open_snapshot
+
+    journal2 = open_snapshot(path)
+    mgr.journal = journal2
+    mgr.run_once()
+    assert [s for s, _p in list_checkpoints(gen_dir)] == [4, 2]
+    assert not any(n.endswith(".tmp") for n in os.listdir(gen_dir))
+    journal2.close()
+
+
+def test_crash_between_snapshot_and_compaction(tmp_path):
+    """ckpt.compact crash: the generation is already verified but the
+    journal was never truncated — restore skips the absorbed prefix by
+    seq and converges to the exact live state anyway."""
+    session, cluster0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=2, keep=2
+    )
+    INJECT.configure("ckpt.compact=crash@1")
+    try:
+        _evict(session, cache, mgr, "ck-p00")
+        with pytest.raises(InjectedCrash):
+            _evict(session, cache, mgr, "ck-p01")  # seq 2: dies pre-compact
+    finally:
+        INJECT.clear()
+    assert mgr.last_seq == 2, "the generation itself was verified"
+    assert _journal_delta_seqs(path) == [1, 2], (
+        "a pre-compaction crash must leave the journal whole"
+    )
+    journal.close()
+    skipped0 = COUNTERS.get("ckpt_restore_deltas_skipped_total")
+    replica, summary = _replay_replica(cluster0, path)
+    assert summary["checkpoint"]["deltaSeq"] == 2
+    assert summary["skippedPrefix"] == 2 and summary["deltas"] == 0
+    assert COUNTERS.get("ckpt_restore_deltas_skipped_total") == skipped0 + 2
+    assert replica.delta_seq == session.delta_seq == 2
+    assert replica.state_digest() == session.state_digest()
+
+
+def test_all_generations_refused_falls_back_to_full_replay(tmp_path):
+    """Belt and braces: when EVERY retained generation is corrupt the
+    replica replays the remaining journal from scratch — shorter than
+    the full history (compaction already ran) but never wrong; with the
+    journal ALSO compacted this is a detected-degraded posture, and
+    here the un-compacted suffix covers the whole stream."""
+    session, cluster0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=2, keep=2
+    )
+    INJECT.configure("ckpt.compact=exio@1x*")  # keep the journal whole
+    try:
+        for i in range(4):
+            _evict(session, cache, mgr, f"ck-p{i:02d}")
+    finally:
+        INJECT.clear()
+    journal.close()
+    gens = list_checkpoints(checkpoint_dir(path))
+    assert len(gens) == 2
+    for _seq, gen_path in gens:
+        raw = bytearray(open(gen_path, "rb").read())
+        raw[-4] ^= 0xFF
+        with open(gen_path, "wb") as f:
+            f.write(bytes(raw))
+    fallbacks0 = COUNTERS.get("ckpt_restore_fallback_total")
+    replica, summary = _replay_replica(cluster0, path)
+    assert COUNTERS.get("ckpt_restore_fallback_total") == fallbacks0 + 2
+    assert summary["checkpoint"] is None
+    assert summary["deltas"] == 4  # full journal replay
+    assert replica.delta_seq == session.delta_seq
+    assert replica.state_digest() == session.state_digest()
+
+
+# -------------------------------------------------------- zero recompiles
+
+
+def test_restore_zero_new_compiles_on_warm_store(tmp_path):
+    """The failover cost model: with the shared artifact store warm
+    (populated by the replica being replaced), a snapshot-then-suffix
+    restore boots and answers at ZERO new XLA compilations."""
+    from open_simulator_tpu.incremental.store import configure_store
+
+    configure_store(str(tmp_path / "store"))
+    try:
+        session, cluster0, cache, journal, mgr, path = _rig(
+            tmp_path, interval=2, keep=2
+        )
+        assert session._committed_scan() is not None  # pays the compiles
+        for i in range(5):
+            _evict(session, cache, mgr, f"ck-p{i:02d}")
+        journal.close()
+        recompiles0 = COUNTERS.get("jax_recompiles_total")
+        replica, summary = _replay_replica(cluster0, path)
+        assert replica._committed_scan() is not None
+        assert summary["checkpoint"] is not None
+        assert COUNTERS.get("jax_recompiles_total") == recompiles0, (
+            "restore on a warm store must not recompile"
+        )
+        assert replica.state_digest() == session.state_digest()
+    finally:
+        configure_store(None)
+
+
+# ------------------------------------------------------------ twin mirror
+
+
+def _twin_pair(tmp_path, interval=2):
+    from open_simulator_tpu.models.decode import ResourceTypes
+    from open_simulator_tpu.scheduler.core import AppResource
+    from open_simulator_tpu.shadow.record import record_simulation
+    from open_simulator_tpu.testing import make_fake_node
+    from open_simulator_tpu.twin.mirror import ClusterMirror, FeedSource
+
+    cluster = ResourceTypes()
+    cluster.nodes = [
+        make_fake_node(f"tw-{i}", cpu="8", memory="16Gi") for i in range(2)
+    ]
+    res = ResourceTypes()
+    res.pods = [
+        {
+            "kind": "Pod",
+            "metadata": {"name": f"tw-p-{i}", "namespace": "m"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "img",
+                        "resources": {
+                            "requests": {"cpu": "250m", "memory": "256Mi"}
+                        },
+                    }
+                ]
+            },
+        }
+        for i in range(6)
+    ]
+    cold = copy.deepcopy(cluster)
+    steps = record_simulation(cluster, [AppResource("m", res)])
+    mirror = ClusterMirror(
+        copy.deepcopy(cold), FeedSource(steps, batch=3), engine="oracle",
+        max_catchup=64,
+    )
+    mirror.bootstrap()
+    return mirror, cold
+
+
+def test_twin_checkpoint_restore_roundtrip(tmp_path):
+    """The twin mirror gets the same ladder serve has: journaled steps,
+    interval checkpoints (verified against a fresh oracle
+    materialization), and a snapshot-then-suffix replay whose restored
+    mirror matches the live one's /v1/state-digest triple exactly."""
+    from open_simulator_tpu.twin.mirror import (
+        ClusterMirror,
+        FeedSource,
+        capture_mirror,
+        open_twin_snapshot,
+        replay_mirror_journal,
+        twin_keep_record,
+        twin_materialized_digest,
+    )
+
+    interval = 2
+    mirror, cold = _twin_pair(tmp_path, interval=interval)
+    path = str(tmp_path / "twin.snapshot.jsonl")
+    mirror.journal = open_twin_snapshot(path)
+    mgr = CheckpointManager(
+        checkpoint_dir(path),
+        interval=interval,
+        keep=2,
+        capture=lambda: capture_mirror(mirror),
+        materialized_digest=twin_materialized_digest,
+        journal=mirror.journal,
+        keep_record=twin_keep_record,
+        label="twin",
+        synchronous=True,
+    )
+    while not (mirror.stats()["feedExhausted"]
+               and mirror.stats()["backlog"] == 0):
+        mirror.poll_once()
+        mgr.note_delta(mirror.applied_seq())
+    mirror.journal.close()
+    assert mirror.applied_seq() > interval
+    assert mgr.writes >= 1 and mgr.last_seq > 0
+    gens = list_checkpoints(checkpoint_dir(path))
+    assert gens, "no twin generation written"
+
+    replica = ClusterMirror(
+        copy.deepcopy(cold), FeedSource([], batch=1), engine="oracle"
+    )
+    summary = replay_mirror_journal(replica, path)
+    replica.bootstrap()
+    assert summary["checkpoint"] is not None
+    assert summary["steps"] < interval + 1  # bounded suffix
+    assert replica.applied_seq() == mirror.applied_seq()
+    assert replica.state_digest() == mirror.state_digest()
+    # identity triple matches: same base-cluster fingerprint
+    assert (
+        replica.replayer.report.fingerprint
+        == mirror.replayer.report.fingerprint
+    )
+
+
+def test_twin_corrupt_generation_falls_back(tmp_path):
+    """Twin fallback parity with serve: a corrupt newest generation is
+    refused loudly and the previous one + a longer step replay restores
+    the identical mirror state."""
+    from open_simulator_tpu.twin.mirror import (
+        ClusterMirror,
+        FeedSource,
+        capture_mirror,
+        open_twin_snapshot,
+        replay_mirror_journal,
+        twin_keep_record,
+        twin_materialized_digest,
+    )
+
+    mirror, cold = _twin_pair(tmp_path)
+    path = str(tmp_path / "twin-fb.snapshot.jsonl")
+    mirror.journal = open_twin_snapshot(path)
+    mgr = CheckpointManager(
+        checkpoint_dir(path),
+        interval=2,
+        keep=2,
+        capture=lambda: capture_mirror(mirror),
+        materialized_digest=twin_materialized_digest,
+        journal=mirror.journal,
+        keep_record=twin_keep_record,
+        label="twin",
+        synchronous=True,
+    )
+    while not (mirror.stats()["feedExhausted"]
+               and mirror.stats()["backlog"] == 0):
+        mirror.poll_once()
+        mgr.note_delta(mirror.applied_seq())
+    mirror.journal.close()
+    gens = list_checkpoints(checkpoint_dir(path))
+    assert len(gens) >= 2, "need two generations to prove fallback"
+    raw = bytearray(open(gens[0][1], "rb").read())
+    raw[-4] ^= 0xFF
+    with open(gens[0][1], "wb") as f:
+        f.write(bytes(raw))
+
+    fallbacks0 = COUNTERS.get("ckpt_restore_fallback_total")
+    replica = ClusterMirror(
+        copy.deepcopy(cold), FeedSource([], batch=1), engine="oracle"
+    )
+    summary = replay_mirror_journal(replica, path)
+    replica.bootstrap()
+    assert COUNTERS.get("ckpt_restore_fallback_total") == fallbacks0 + 1
+    assert summary["checkpoint"]["deltaSeq"] == gens[1][0]
+    assert replica.applied_seq() == mirror.applied_seq()
+    assert replica.state_digest() == mirror.state_digest()
+
+
+# --------------------------------------------------------------- manager
+
+
+def test_manager_validates_inputs(tmp_path):
+    from open_simulator_tpu.models.validation import InputError
+
+    with pytest.raises(InputError, match="checkpoint-interval"):
+        CheckpointManager(
+            str(tmp_path), interval=0, capture=lambda: None,
+            materialized_digest=lambda p: "",
+        )
+    with pytest.raises(InputError, match="keep-checkpoints"):
+        CheckpointManager(
+            str(tmp_path), interval=1, keep=0, capture=lambda: None,
+            materialized_digest=lambda p: "",
+        )
+
+
+def test_manager_background_worker_checkpoints(tmp_path):
+    """The daemon path: note_delta is an int compare on the hot path;
+    the write happens on the simon-ckpt worker thread."""
+    import time as _time
+
+    session, _c0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=2, keep=2
+    )
+    mgr.synchronous = False
+    mgr.start()
+    try:
+        _evict(session, cache, mgr, "ck-p00")
+        _evict(session, cache, mgr, "ck-p01")
+        deadline = _time.monotonic() + 30
+        while mgr.last_seq < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert mgr.last_seq == 2
+        assert [s for s, _p in list_checkpoints(checkpoint_dir(path))] == [2]
+    finally:
+        mgr.stop()
+        journal.close()
+
+
+def test_note_restored_defers_next_checkpoint(tmp_path):
+    session, _c0, cache, journal, mgr, path = _rig(
+        tmp_path, interval=3, keep=2
+    )
+    mgr.note_restored(6)
+    session.delta_seq = 6  # as a bootstrap restore would set
+    _evict(session, cache, mgr, "ck-p00")  # seq 7: 7-6 < 3, no attempt
+    assert mgr.writes == 0
+    _evict(session, cache, mgr, "ck-p01")
+    seq = _evict(session, cache, mgr, "ck-p02")  # seq 9: due
+    assert seq == 9 and mgr.writes == 1 and mgr.last_seq == 9
+    journal.close()

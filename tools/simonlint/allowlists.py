@@ -151,6 +151,8 @@ EXC001_ALLOW: Set[Key] = {
     # flow (caught as ValueError in the same function to classify a
     # torn tail vs interior damage)
     ("open_simulator_tpu/runtime/journal.py", "resume"),
+    ("open_simulator_tpu/runtime/journal.py", "rewrite"),
+    ("open_simulator_tpu/runtime/checkpoint.py", "load_checkpoint"),
     ("open_simulator_tpu/shadow/log.py", "read_decision_log"),
     ("open_simulator_tpu/shadow/log.py", "from_record"),
     # API-contract preconditions on the scan entry points (caller bug,

@@ -990,6 +990,7 @@ def run_fleet_qps(
     import threading
     import urllib.request
 
+    from open_simulator_tpu.fleet.audit import FailoverAudit
     from open_simulator_tpu.fleet.replica import ReplicaProcess, serve_argv
     from open_simulator_tpu.fleet.router import FleetRouter
 
@@ -1107,6 +1108,20 @@ def run_fleet_qps(
         status, _ = post(victim.url + "/v1/simulate", tenant=tenant)
         assert status == 200, "replacement did not answer 200"
         recovery_s = time.perf_counter() - t_kill
+        # close the audit episode honestly: the first 2xx answered
+        # THROUGH the router from the respawned slot is the timeline's
+        # first_200 checkpoint (router._note_answer -> audit)
+        status, _ = post(base + "/v1/simulate", tenant=tenant)
+        assert status == 200, "router did not answer from respawned slot"
+        phases = {}
+        if router.audit is not None and router.audit.completed:
+            from open_simulator_tpu.fleet.audit import validate_audit_log
+
+            validate_audit_log(router.audit.path)
+            summary = router.audit.completed[-1]
+            phases = {
+                k: round(float(v), 3) for k, v in summary["phases"].items()
+            }
 
         recompiles = -1
         with urllib.request.urlopen(
@@ -1127,6 +1142,7 @@ def run_fleet_qps(
         return {
             "failover_first_200_s": round(rerouted_s, 3),
             "failover_seconds": round(recovery_s, 3),
+            "failover_phases": phases,
             "replacement_recompiles": recompiles,
             "replayed_delta_seq": digest["deltaSeq"],
         }
@@ -1153,8 +1169,15 @@ def run_fleet_qps(
                         fleet_dir,
                     )
                 )
+            # audit timeline (fleet/audit.py): every supervision event
+            # lands in a fsync'd JSONL so measure_failover can report
+            # the per-phase breakdown simon doctor gates on
+            audit = FailoverAudit(
+                os.path.join(fleet_dir, "failover-audit.jsonl")
+            )
             router = FleetRouter(
-                reps, port=0, probe_interval_s=0, forward_timeout_s=600.0
+                reps, port=0, probe_interval_s=0, forward_timeout_s=600.0,
+                audit=audit,
             )
             router.start()  # started first so the finally can drain
             try:
@@ -2994,6 +3017,10 @@ def main():
             "qps_by_replicas": fq["qps_by_replicas"],
             "replacement_recompiles": fq["replacement_recompiles"],
         }
+        # audited per-phase breakdown (fleet/audit.py): lets the
+        # doctor name the slow phase when failover_seconds regresses
+        if fq.get("failover_phases"):
+            out["obs"]["fleet"]["failover_phases"] = fq["failover_phases"]
     # checkpoint block: the aged-failover dimensions `simon doctor`
     # gates on (ckpt.restore_seconds regresses up — a slower restore
     # from the newest generation + suffix means bounded recovery is

@@ -863,6 +863,16 @@ def cmd_fleet(args) -> int:
                 # surface the first concurrent-spawn failure with its
                 # original (taxonomy-typed) class intact
                 raise errors[0][1]
+        # failover audit timeline (fleet/audit.py): fsync'd JSONL in
+        # the fleet dir unless pointed elsewhere (or disabled)
+        audit = None
+        if not args.no_audit_log:
+            from .fleet.audit import FailoverAudit
+
+            audit = FailoverAudit(
+                args.audit_log
+                or os.path.join(fleet_dir, "failover-audit.jsonl")
+            )
         router = FleetRouter(
             replicas,
             host=args.host,
@@ -872,6 +882,7 @@ def cmd_fleet(args) -> int:
             slo_engine=slo_engine,
             obs_cadence_s=args.obs_cadence,
             spawn_attempts=args.spawn_attempts,
+            audit=audit,
         )
     except (
         OSError,
@@ -1599,15 +1610,35 @@ def cmd_top(args) -> int:
         return 2
     names = list(args.series or ())
 
+    fleet = bool(getattr(args, "fleet", False))
+
     def fetch():
         from urllib.parse import quote
 
         snapshot = _fetch_json(f"{url}/v1/obs/snapshot", args.timeout)
-        want = names or [
-            n
-            for n in _tm.TOP_DEFAULT_SERIES
-            if n in (snapshot.get("latest") or {})
-        ]
+        if names:
+            want = list(names)
+        elif fleet:
+            # fleet frame: router-wide signals that exist, plus the
+            # per-slot panes for every slot the router reports — a
+            # slot whose series are missing (stale TTL cache, fresh
+            # respawn) renders as gaps, never an error (the series
+            # endpoint answers unknown names with empty lists)
+            want = [
+                n
+                for n in _tm.FLEET_TOP_DEFAULT_SERIES
+                if n in (snapshot.get("latest") or {})
+            ]
+            for slot in sorted(snapshot.get("replicas") or {}):
+                want.extend(_tm.fleet_slot_series(str(slot)))
+        else:
+            want = [
+                n
+                for n in _tm.TOP_DEFAULT_SERIES
+                if n in (snapshot.get("latest") or {})
+            ]
+        # slot-labeled names carry ':' and '/': percent-encode every
+        # name so the query string round-trips them verbatim
         qs = "&".join(f"name={quote(n, safe='')}" for n in want)
         series = (
             _fetch_json(
@@ -1624,16 +1655,17 @@ def cmd_top(args) -> int:
     except ExternalIOError as e:
         print(f"simon top: {e}", file=sys.stderr)
         return 1
+    render = _tm.render_fleet_top_frame if fleet else _tm.render_top_frame
     if args.format == "json":
         print(_json.dumps({"snapshot": snapshot, "series": series}, indent=2))
         return 0
     if args.once:
-        print(_tm.render_top_frame(snapshot, series, url))
+        print(render(snapshot, series, url))
         return 0
     try:
         while True:
             # ANSI home+clear per frame: a live dashboard, not a scroll
-            print("\x1b[2J\x1b[H" + _tm.render_top_frame(snapshot, series, url), flush=True)
+            print("\x1b[2J\x1b[H" + render(snapshot, series, url), flush=True)
             time.sleep(args.interval)
             try:
                 snapshot, series = fetch()
@@ -1664,10 +1696,11 @@ def _add_telemetry_flags(p: argparse.ArgumentParser):
         default="",
         metavar="PATH",
         help="declarative SLO objectives (JSON or YAML; kinds: "
-        "availability, latency, gauge_min, counter_budget) evaluated "
-        "over the resident series store with multi-window burn-rate "
-        "alerts — alert states export as simon_slo_* metrics and "
-        "/healthz reasons",
+        "availability, latency, gauge_min, counter_budget, plus the "
+        "router-side fleet_availability, fleet_imbalance, and "
+        "fleet_failover) evaluated over the resident series store "
+        "with multi-window burn-rate alerts — alert states export as "
+        "simon_slo_* metrics and /healthz reasons",
     )
     p.add_argument(
         "--obs-cadence",
@@ -2271,6 +2304,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--keep-checkpoints", type=int, default=None, metavar="N",
         help="forwarded to every replica (see `simon serve`)",
     )
+    p_fleet.add_argument(
+        "--audit-log", default="", metavar="PATH",
+        help="failover audit timeline path (fsync'd JSONL: probe_flap "
+        "-> declared_dead -> lock_reclaim -> respawn -> replay_progress "
+        "-> first_200 per failover, validated by "
+        "tools/validate_audit.py; default <fleet-dir>/"
+        "failover-audit.jsonl)",
+    )
+    p_fleet.add_argument(
+        "--no-audit-log", action="store_true",
+        help="disable the failover audit timeline",
+    )
     _add_store_flag(p_fleet)
     _add_inject_flag(p_fleet)
     _add_obs_flags(p_fleet)
@@ -2714,6 +2759,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--series", action="append", metavar="NAME",
         help="render this series instead of the curated defaults "
         "(repeatable; names as listed by GET /v1/obs/series)",
+    )
+    p_top.add_argument(
+        "--fleet", action="store_true",
+        help="render the fleet-router frame against a `simon fleet` "
+        "endpoint: per-slot panes (up/degraded/down, request rate, "
+        "forward p95) plus the fleet-wide counters and SLO burn "
+        "table; slots whose series are missing or TTL-stale render "
+        "as gaps, never errors",
     )
     p_top.add_argument(
         "--once", action="store_true",

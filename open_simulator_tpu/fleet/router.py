@@ -51,6 +51,8 @@ from typing import Dict, List, Optional
 
 from ..models.validation import InputError
 from ..obs import telemetry
+from ..obs.histo import HISTOS
+from ..obs.spans import RECORDER
 from ..runtime import inject as _inject
 from ..runtime.errors import EXIT_OK, EXIT_PARTIAL_DEADLINE, GuardError
 from ..utils.trace import COUNTERS
@@ -120,9 +122,14 @@ class FleetRouter:
         obs_cadence_s: float = 1.0,
         supervise: bool = True,
         spawn_attempts: int = 4,
+        audit=None,
     ):
         if not replicas:
             raise InputError("a fleet needs at least one replica")
+        # failover audit timeline (fleet/audit.py) — optional: probe
+        # flaps, death declarations, respawns, and the first 200 after
+        # a failover are appended as fsync'd JSONL events
+        self.audit = audit
         self.replicas = {r.slot: r for r in replicas}
         if len(self.replicas) != len(replicas):
             raise InputError("replica slots must be unique")
@@ -197,6 +204,11 @@ class FleetRouter:
                     )
                 elif self.path.startswith("/v1/obs/series"):
                     status, doc = telemetry.series_endpoint(self.path)
+                    self._send(status, json.dumps(doc, sort_keys=True).encode())
+                elif self.path.startswith("/v1/fleet/trace"):
+                    from .trace import trace_endpoint
+
+                    status, doc = trace_endpoint(router, self.path)
                     self._send(status, json.dumps(doc, sort_keys=True).encode())
                 elif self.path == "/v1/obs/snapshot":
                     self._send(
@@ -287,81 +299,171 @@ class FleetRouter:
         unreachable replica is marked down and the NEXT slot gets the
         same body with the same request id. Returns
         ``(status, body, header_tuples)``. Exhaustion sheds 503 +
-        Retry-After — the caller always gets an answer."""
+        Retry-After — the caller always gets an answer.
+
+        The whole attempt sequence is one ``fleet/request`` span tree
+        under the request's id: each live forward is a
+        ``fleet/forward`` child (its span id crosses the wire in
+        ``X-Simon-Trace-Context`` so the replica's ``serve/request``
+        subtree stitches under it — fleet/trace.py), each failed
+        attempt a ``fleet/reroute`` sibling, an exhaustion shed a
+        ``fleet/shed`` leaf."""
         COUNTERS.inc("fleet_requests_total")
         key = self.routing_key(headers, body)
         order = self.ring.route_order(key)
         rid_header = (telemetry.REQUEST_ID_HEADER, rid)
-        attempted = 0
-        for slot in order:
-            replica = self.replicas.get(slot)
-            if replica is None or not replica.url:
-                continue
-            if self._health.get(slot) == "down":
-                continue
-            if slot != order[0] or attempted:
-                # not the key's owner (owner down/skipped) or a retry
-                # after a failed forward — either way a reroute
-                COUNTERS.inc("fleet_reroutes_total")
-            attempted += 1
-            try:
-                _inject.fire("fleet.route", slot=slot, key=key)
-                return self._forward(replica, method, path, body, headers, rid)
-            except (OSError, urllib.error.URLError, GuardError) as e:
-                # connection-level failure (or a classified fault fired
-                # at the fleet.route seam): the replica never produced
-                # an HTTP answer, so retrying elsewhere cannot double-
-                # apply anything. Mark it down; the probe loop will
-                # confirm death and respawn into the slot.
-                log.warning(
-                    "replica %s unreachable (%s); rerouting %s", slot, e, rid
-                )
-                self._mark(slot, "down")
-                COUNTERS.inc("fleet_forward_failures_total")
-                continue
-        COUNTERS.inc("fleet_shed_total")
-        return (
-            503,
-            _shed_body(
-                "fleet",
-                "no live replica could answer (fleet saturated or "
-                "restarting); retry after the hinted delay",
-                rid,
-            ),
-            (rid_header, ("Retry-After", str(self.retry_after_s()))),
+        # a chained router hop arrives with its own trace context:
+        # remember it as the root's remote parent and keep counting hops
+        in_parent, in_hop = telemetry.parse_trace_context(
+            headers.get(telemetry.TRACE_CONTEXT_HEADER)
         )
+        root_attrs = {"method": method, "key": key}
+        if in_parent is not None:
+            root_attrs["remote_parent"] = in_parent
+            root_attrs["fleet_hop"] = in_hop
+        attempted = 0
+        with telemetry.request_scope(rid), RECORDER.span(
+            "fleet/request", **root_attrs
+        ) as root:
+            for slot in order:
+                replica = self.replicas.get(slot)
+                if replica is None or not replica.url:
+                    continue
+                if self._health.get(slot) == "down":
+                    continue
+                if slot != order[0] or attempted:
+                    # not the key's owner (owner down/skipped) or a retry
+                    # after a failed forward — either way a reroute
+                    COUNTERS.inc("fleet_reroutes_total")
+                attempted += 1
+                t_attempt = time.perf_counter()
+                try:
+                    _inject.fire("fleet.route", slot=slot, key=key)
+                    return self._forward(
+                        replica, method, path, body, headers, rid,
+                        hop=in_hop + 1, attempt=attempted,
+                    )
+                except (OSError, urllib.error.URLError, GuardError) as e:
+                    # connection-level failure (or a classified fault fired
+                    # at the fleet.route seam): the replica never produced
+                    # an HTTP answer, so retrying elsewhere cannot double-
+                    # apply anything. Mark it down; the probe loop will
+                    # confirm death and respawn into the slot.
+                    log.warning(
+                        "replica %s unreachable (%s); rerouting %s",
+                        slot, e, rid,
+                    )
+                    RECORDER.record_span(
+                        "fleet/reroute",
+                        t_attempt,
+                        time.perf_counter(),
+                        parent_id=root,
+                        slot=slot,
+                        attempt=attempted,
+                        error=type(e).__name__,
+                    )
+                    self._mark(slot, "down")
+                    COUNTERS.inc("fleet_forward_failures_total")
+                    continue
+            COUNTERS.inc("fleet_shed_total")
+            t_shed = time.perf_counter()
+            RECORDER.record_span(
+                "fleet/shed", t_shed, t_shed,
+                parent_id=root, attempts=attempted,
+            )
+            return (
+                503,
+                _shed_body(
+                    "fleet",
+                    "no live replica could answer (fleet saturated or "
+                    "restarting); retry after the hinted delay",
+                    rid,
+                ),
+                (rid_header, ("Retry-After", str(self.retry_after_s()))),
+            )
 
-    def _forward(self, replica, method, path, body, headers, rid):
+    def _forward(
+        self, replica, method, path, body, headers, rid, hop=1, attempt=1
+    ):
         """One proxied hop. HTTP error statuses are ANSWERS (a 429's
         Retry-After must reach the client untouched), so urllib's
-        HTTPError is converted, never retried."""
+        HTTPError is converted, never retried. The forward span's id
+        crosses the wire as trace context; the reply always carries
+        the request id back even when the replica's answer (a proxied
+        GET, an old replica) didn't echo it."""
         fwd = {
             k: v
             for k, v in headers.items()
             if k.lower() not in _HOP_HEADERS
         }
         fwd[telemetry.REQUEST_ID_HEADER] = rid
-        req = urllib.request.Request(
-            replica.url + path,
-            data=body if method == "POST" else None,
-            headers=fwd,
-            method=method,
-        )
-        try:
-            resp = urllib.request.urlopen(req, timeout=self.forward_timeout_s)
-        except urllib.error.HTTPError as e:
-            resp = e  # an answered error status, not a transport fault
-        with resp:
-            out_body = resp.read()
-            out_headers = [
-                (k, v)
-                for k, v in resp.headers.items()
-                if k.lower() not in _HOP_HEADERS
-                and k.lower() != "content-type"
-            ]
-        out_headers.append(("X-Simon-Fleet-Replica", replica.slot))
-        COUNTERS.inc(f"fleet_replica_requests:{replica.slot}")
-        return resp.status, out_body, tuple(out_headers)
+        t0 = time.perf_counter()
+        with RECORDER.span(
+            "fleet/forward", slot=replica.slot, attempt=attempt
+        ) as fwd_sid:
+            if fwd_sid is not None:
+                fwd[telemetry.TRACE_CONTEXT_HEADER] = (
+                    telemetry.format_trace_context(fwd_sid, hop=hop)
+                )
+            else:
+                fwd.pop(telemetry.TRACE_CONTEXT_HEADER, None)
+            req = urllib.request.Request(
+                replica.url + path,
+                data=body if method == "POST" else None,
+                headers=fwd,
+                method=method,
+            )
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.forward_timeout_s
+                )
+            except urllib.error.HTTPError as e:
+                resp = e  # an answered error status, not a transport fault
+            with resp:
+                out_body = resp.read()
+                out_headers = [
+                    (k, v)
+                    for k, v in resp.headers.items()
+                    if k.lower() not in _HOP_HEADERS
+                    and k.lower() != "content-type"
+                ]
+            out_headers.append(("X-Simon-Fleet-Replica", replica.slot))
+            if not any(
+                k.lower() == telemetry.REQUEST_ID_HEADER.lower()
+                for k, _ in out_headers
+            ):
+                out_headers.append((telemetry.REQUEST_ID_HEADER, rid))
+            COUNTERS.inc(f"fleet_replica_requests:{replica.slot}")
+            HISTOS.observe(
+                f"fleet/forward/{replica.slot}", time.perf_counter() - t0
+            )
+            self._update_imbalance_gauge()
+            self._note_answer(replica.slot, resp.status)
+            return resp.status, out_body, tuple(out_headers)
+
+    def _update_imbalance_gauge(self) -> None:
+        """``fleet_slot_imbalance`` gauge: max over slots of
+        (slot's cumulative answered requests / fleet mean) − 1 — 0.0
+        is a perfectly balanced ring, 1.0 means the hottest slot
+        carries double the mean. Sampled into the series store each
+        telemetry cadence, judged by the ``fleet_imbalance`` SLO
+        kind."""
+        counts = [
+            COUNTERS.get(f"fleet_replica_requests:{slot}")
+            for slot in self.replicas
+        ]
+        total = sum(counts)
+        if total <= 0 or not counts:
+            return
+        mean = total / len(counts)
+        COUNTERS.gauge("fleet_slot_imbalance", max(counts) / mean - 1.0)
+
+    def _note_answer(self, slot: str, status: int) -> None:
+        """Audit hook: the first 2xx answered through a slot with a
+        pending failover closes that slot's audit timeline."""
+        audit = getattr(self, "audit", None)
+        if audit is not None and 200 <= int(status) < 300:
+            audit.note_first_200(slot)
 
     # -- health / supervision ------------------------------------------------
 
@@ -418,6 +520,7 @@ class FleetRouter:
             if now < self._next_probe.get(slot, 0.0):
                 continue
             dead = hasattr(replica, "alive") and not replica.alive()
+            dead_reason = "process exited" if dead else ""
             if not dead:
                 try:
                     _inject.fire("fleet.probe", slot=slot)
@@ -429,19 +532,30 @@ class FleetRouter:
                 if doc.get("probeOk"):
                     state = "degraded" if doc.get("degraded") else "up"
                     self._mark(slot, state)
+                    if self.audit is not None:
+                        self.audit.note_probe_ok(slot)
                     hint = getattr(replica, "retry_after_s", 0)
                     wait = max(self.probe_interval_s, float(hint or 0))
                     self._next_probe[slot] = now + wait
                     continue
+                if self.audit is not None:
+                    self.audit.note_probe_flap(
+                        slot, failures=replica.probe_failures
+                    )
                 dead = (
                     replica.probe_failures >= PROBE_FAILURE_THRESHOLD
                     or (hasattr(replica, "alive") and not replica.alive())
+                )
+                dead_reason = (
+                    f"{replica.probe_failures} consecutive probe failures"
                 )
                 if not dead:
                     # flaky probe: keep routing to it, probe again soon
                     self._next_probe[slot] = now + self.probe_interval_s
                     continue
             self._mark(slot, "down")
+            if self.audit is not None:
+                self.audit.note_declared_dead(slot, reason=dead_reason)
             if not (self.supervise and hasattr(replica, "spawn")):
                 self._next_probe[slot] = now + self.probe_interval_s
                 continue
@@ -457,6 +571,8 @@ class FleetRouter:
         log.warning("replica %s is down; respawning into its slot", slot)
         replica.kill()  # reap a half-dead process before reclaiming
         replica.release()
+        if self.audit is not None:
+            self.audit.note_lock_reclaim(slot)
         replica.restarts += 1
         replica.probe_failures = 0
         try:
@@ -464,9 +580,33 @@ class FleetRouter:
         except Exception as e:  # noqa: BLE001 - the loop retries next pass
             log.error("respawn of %s failed: %s", slot, e)
             COUNTERS.inc("fleet_respawn_failures_total")
+            if self.audit is not None:
+                self.audit.note_respawn(slot, ok=False, error=str(e))
             return
         self._mark(slot, "up")
         COUNTERS.inc("fleet_respawns_total")
+        if self.audit is not None:
+            self.audit.note_respawn(
+                slot, ok=True, pid=getattr(replica, "pid", None)
+            )
+            self.audit.note_replay_progress(
+                slot, delta_seq=self._fetch_delta_seq(replica)
+            )
+
+    def _fetch_delta_seq(self, replica) -> Optional[int]:
+        """The replacement's replayed delta sequence from its
+        state-digest endpoint — audit evidence that journal replay
+        finished, best-effort (None when unreachable)."""
+        if not replica.url:
+            return None
+        try:
+            with urllib.request.urlopen(
+                replica.url + "/v1/state-digest", timeout=5.0
+            ) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+            return int(doc.get("deltaSeq"))
+        except (OSError, urllib.error.URLError, ValueError, TypeError):
+            return None
 
     def _probe_loop(self):
         while not self._shutdown.is_set():
@@ -540,6 +680,8 @@ class FleetRouter:
             if hasattr(r, "release"):
                 r.release()
         self.telemetry.stop()
+        if self.audit is not None:
+            self.audit.close()
         self.httpd.shutdown()
         self.httpd.server_close()
         return EXIT_OK if clean else EXIT_PARTIAL_DEADLINE
@@ -693,4 +835,59 @@ def render_fleet_metrics(router: FleetRouter) -> bytes:
         )
         lines.append(f"# TYPE simon_fleet_{short} untyped")
         lines.extend(scraped[name])
+    # staleness of the TTL-cached aggregation itself: age of the OLDEST
+    # cached replica scrape (0 with an empty cache). Also pushed as a
+    # gauge so the series store / SLO engine can watch it.
+    now = time.monotonic()
+    ages = [now - t for (t, _) in router._scrape_cache.values()]
+    cache_age = round(max(ages), 3) if ages else 0.0
+    COUNTERS.gauge("fleet_metrics_cache_age_seconds", cache_age)
+    metric(
+        "simon_fleet_metrics_cache_age_seconds", "gauge",
+        "Age of the oldest cached replica /metrics scrape (TTL "
+        f"{SCRAPE_TTL_S}s).",
+        cache_age,
+    )
+    metric(
+        "simon_fleet_slot_imbalance", "gauge",
+        "Hottest slot's answered-request share over the fleet mean, "
+        "minus one (0 = balanced).",
+        round(snap["gauges"].get("fleet_slot_imbalance", 0.0), 6),
+    )
+    metric(
+        "simon_fleet_failovers_audited_total", "counter",
+        "Failover episodes closed by the audit timeline.",
+        counts.get("fleet_failovers_audited_total", 0),
+    )
+    metric(
+        "simon_fleet_failover_ms_total", "counter",
+        "Cumulative audited failover duration (integer milliseconds).",
+        counts.get("fleet_failover_ms_total", 0),
+    )
+    metric(
+        "simon_fleet_failover_seconds", "gauge",
+        "Total duration of the most recently audited failover episode.",
+        round(snap["gauges"].get("fleet_failover_seconds", 0.0), 6),
+    )
+    # per-phase breakdown of the last audited episode (bounded: the
+    # fixed 5-phase partition, absent until a failover has been audited)
+    from .audit import PHASE_DURATIONS
+
+    phase_lines = []
+    for phase in PHASE_DURATIONS:
+        v = snap["gauges"].get(f"fleet_failover_phase_seconds:{phase}")
+        if v is not None:
+            phase_lines.append(
+                "simon_fleet_failover_phase_seconds"
+                f'{{phase="{_escape_label(phase)}"}} {round(v, 6)}'
+            )
+    if phase_lines:
+        lines.append(
+            "# HELP simon_fleet_failover_phase_seconds Last audited "
+            "episode's per-phase durations (they partition the total)."
+        )
+        lines.append("# TYPE simon_fleet_failover_phase_seconds gauge")
+        lines.extend(phase_lines)
+    if router.slo_engine is not None:
+        lines.extend(router.slo_engine.prometheus_lines())
     return ("\n".join(lines) + "\n").encode()

@@ -284,6 +284,19 @@ def diff_records(
         th.fleet_frac,
         note="kill-9 to next 200 through the router (reroute latency)",
     )
+    # the audited per-phase breakdown of that total (fleet/audit.py):
+    # a regressed total names its slow phase instead of one number.
+    # Older baselines (BENCH_r13-era) predate the audit — absent from
+    # both sides, the rows silently skip and the total is still gated.
+    for phase in ("detect", "reclaim", "respawn", "replay", "first_200"):
+        opt(
+            frac_row,
+            f"fleet.failover_phases.{phase}",
+            _num(base, "obs", "fleet", "failover_phases", phase),
+            _num(cand, "obs", "fleet", "failover_phases", phase),
+            th.fleet_frac,
+            note="audited failover phase (fleet/audit.py timeline)",
+        )
     opt(
         frac_row,
         "ckpt.restore_seconds",

@@ -25,6 +25,20 @@ sampling cadence. Four kinds, all reduced to one vocabulary — a
   absolute ``maxPerWindow`` allowance (recompile budget: 0 means ANY
   growth burns).
 
+Three FLEET kinds judge the router as one service (the router runs
+its own engine over the aggregated ``fleet_*`` counters — PR 18):
+
+- ``fleet_availability``: availability over the router's counters,
+  defaulting to ``fleet_requests_total`` / ``fleet_shed_total`` — a
+  rerouted-but-answered request is GOOD (reroutes are the fleet doing
+  its job), only an exhaustion shed spends budget.
+- ``fleet_imbalance``: fraction of window samples of the
+  ``fleet_slot_imbalance`` gauge ABOVE ``max`` (hottest slot's load
+  over the fleet mean, minus one); ``eb = budget``.
+- ``fleet_failover``: seconds of audited failover time
+  (``fleet_failover_ms_total``, fleet/audit.py) per fast window
+  against a ``maxSecondsPerWindow`` allowance.
+
 Alert states export as ``simon_slo_*`` metrics on ``/metrics``, surface
 in ``/healthz`` ``reasons[]``, and ride ``/v1/obs/snapshot`` and the
 ``/debug/dump`` body. The PR-11 inject seams drive them in chaos CI:
@@ -45,7 +59,15 @@ from ..models.validation import InputError
 from ..utils.trace import COUNTERS
 from . import telemetry
 
-KINDS = ("availability", "latency", "gauge_min", "counter_budget")
+KINDS = (
+    "availability",
+    "latency",
+    "gauge_min",
+    "counter_budget",
+    "fleet_availability",
+    "fleet_imbalance",
+    "fleet_failover",
+)
 
 DEFAULT_FAST_WINDOW_S = 300.0
 DEFAULT_SLOW_WINDOW_S = 3600.0
@@ -72,8 +94,9 @@ class Objective:
     threshold_ms: float = 0.0  # latency: bad past this
     gauge: str = ""  # gauge_min: gauge name (twin_agreement_rate, ...)
     min_value: float = 0.0  # gauge_min: bad below this
-    counter: str = ""  # counter_budget: cumulative counter name
+    counter: str = ""  # counter_budget/fleet_failover: counter name
     max_per_window: float = 0.0  # counter_budget: fast-window allowance
+    max_value: float = 0.0  # fleet_imbalance: bad above this
     budget: float = DEFAULT_BUDGET  # latency/gauge_min error budget
     fast_window_s: float = DEFAULT_FAST_WINDOW_S
     slow_window_s: float = DEFAULT_SLOW_WINDOW_S
@@ -81,16 +104,16 @@ class Objective:
 
     def series_name(self) -> str:
         """The ring series this objective's bad-ratio reads."""
-        if self.kind == "availability":
+        if self.kind in ("availability", "fleet_availability"):
             return f"counter/{self.bad}"
         if self.kind == "latency":
             return f"histo/{self.site}/p{self.percentile}_ms"
-        if self.kind == "gauge_min":
+        if self.kind in ("gauge_min", "fleet_imbalance"):
             return f"gauge/{self.gauge}"
         return f"counter/{self.counter}"
 
     def error_budget(self) -> float:
-        if self.kind == "availability":
+        if self.kind in ("availability", "fleet_availability"):
             return max(1.0 - self.target, 1e-9)
         return max(self.budget, 1e-9)
 
@@ -101,7 +124,7 @@ class Objective:
     ) -> Optional[float]:
         """Burn rate over one window; None until enough data exists
         (an objective with no history neither fires nor clears)."""
-        if self.kind == "availability":
+        if self.kind in ("availability", "fleet_availability"):
             total = series.delta(f"counter/{self.total}", window_s, now)
             bad = series.delta(f"counter/{self.bad}", window_s, now)
             if total is None:
@@ -112,6 +135,23 @@ class Objective:
                 # no traffic: an empty window spends no budget
                 return 0.0 if bad <= 0 else BURN_SATURATED
             return min((bad / total) / self.error_budget(), BURN_SATURATED)
+        if self.kind == "fleet_imbalance":
+            frac = series.frac_beyond(
+                self.series_name(), self.max_value, window_s, now
+            )
+            if frac is None:
+                return None
+            return min(frac / self.error_budget(), BURN_SATURATED)
+        if self.kind == "fleet_failover":
+            # the audited-failover counter is milliseconds (Counters
+            # increments are integral); the allowance is seconds
+            delta_ms = series.delta(self.series_name(), window_s, now)
+            if delta_ms is None:
+                return None
+            spent_s = delta_ms / 1e3
+            if self.max_per_window <= 0:
+                return 0.0 if spent_s <= 0 else BURN_SATURATED
+            return min(spent_s / self.max_per_window, BURN_SATURATED)
         if self.kind == "latency":
             frac = series.frac_beyond(
                 self.series_name(), self.threshold_ms, window_s, now
@@ -143,7 +183,7 @@ class Objective:
             "slowWindowSeconds": self.slow_window_s,
             "burnThreshold": self.burn_threshold,
         }
-        if self.kind == "availability":
+        if self.kind in ("availability", "fleet_availability"):
             out.update(target=self.target, total=self.total, bad=self.bad)
         elif self.kind == "latency":
             out.update(
@@ -155,6 +195,15 @@ class Objective:
         elif self.kind == "gauge_min":
             out.update(
                 gauge=self.gauge, min=self.min_value, budget=self.budget
+            )
+        elif self.kind == "fleet_imbalance":
+            out.update(
+                gauge=self.gauge, max=self.max_value, budget=self.budget
+            )
+        elif self.kind == "fleet_failover":
+            out.update(
+                counter=self.counter,
+                maxSecondsPerWindow=self.max_per_window,
             )
         else:
             out.update(
@@ -370,9 +419,14 @@ def parse_objective(rec: dict) -> Objective:
             f"be >= fastWindowSeconds ({o.fast_window_s:g})"
         )
     o.burn_threshold = num("burnThreshold", DEFAULT_BURN_THRESHOLD, lo=0.0)
-    if kind == "availability":
-        o.total = str(rec.get("total") or "")
-        o.bad = str(rec.get("bad") or "")
+    if kind in ("availability", "fleet_availability"):
+        # fleet_availability defaults to the router's own counters: a
+        # rerouted-but-answered request never spends budget, only an
+        # exhaustion shed does
+        dflt_total = "fleet_requests_total" if kind.startswith("fleet") else ""
+        dflt_bad = "fleet_shed_total" if kind.startswith("fleet") else ""
+        o.total = str(rec.get("total") or dflt_total)
+        o.bad = str(rec.get("bad") or dflt_bad)
         if not o.total or not o.bad:
             raise InputError(
                 f"slo {name!r}: availability needs 'total' and 'bad' "
@@ -381,8 +435,23 @@ def parse_objective(rec: dict) -> Objective:
         o.target = num("target", None, lo=0.0, hi=1.0)
         if o.target is None or o.target >= 1.0:
             raise InputError(
-                f"slo {name!r}: availability needs target in [0, 1)"
+                f"slo {name!r}: {kind} needs target in [0, 1)"
             )
+    elif kind == "fleet_imbalance":
+        o.gauge = str(rec.get("gauge") or "fleet_slot_imbalance")
+        v = num("max", None, lo=0.0)
+        if v is None:
+            raise InputError(f"slo {name!r}: fleet_imbalance needs 'max'")
+        o.max_value = v
+        o.budget = num("budget", DEFAULT_BUDGET, lo=1e-9, hi=1.0)
+    elif kind == "fleet_failover":
+        o.counter = str(rec.get("counter") or "fleet_failover_ms_total")
+        v = num("maxSecondsPerWindow", None, lo=0.0)
+        if v is None:
+            raise InputError(
+                f"slo {name!r}: fleet_failover needs 'maxSecondsPerWindow'"
+            )
+        o.max_per_window = v
     elif kind == "latency":
         o.site = str(rec.get("site") or "")
         if not o.site:

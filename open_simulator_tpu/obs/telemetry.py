@@ -100,6 +100,45 @@ def request_scope(rid: str):
 _spans.set_request_id_provider(current_request_id)
 
 
+# ------------------------------------------------------------- trace context
+
+#: cross-process trace propagation (fleet router -> replica): the
+#: router stamps its forward span's id plus the fleet hop count on
+#: every forwarded request; the replica records the id as a
+#: ``remote_parent`` ATTRIBUTE on its ``serve/request`` root (span ids
+#: are process-local, so a remote parent can never be a structural
+#: ``parent_id`` — the stitcher in fleet/trace.py remaps both id
+#: spaces into one tree). Format: ``parent=<span_id>;hop=<n>``.
+TRACE_CONTEXT_HEADER = "X-Simon-Trace-Context"
+#: hop ceiling: a forwarded request that has already crossed this many
+#: fleet hops is parsed as context-free (a loop or a forged header
+#: must not grow unbounded attrs)
+MAX_TRACE_HOPS = 8
+
+_TRACE_CTX_RE = re.compile(r"^parent=(\d{1,19});hop=(\d{1,3})$")
+
+
+def format_trace_context(parent_span_id: int, hop: int = 1) -> str:
+    """Header value carrying the router-side parent span id and the
+    fleet hop count of the receiving process."""
+    return f"parent={int(parent_span_id)};hop={int(hop)}"
+
+
+def parse_trace_context(raw: Optional[str]) -> tuple:
+    """``(parent_span_id, hop)`` from a header value, or ``(None, 0)``
+    on absence or ANY malformation — a garbled trace context degrades
+    to an uncorrelated request, it never fails the request."""
+    if not raw:
+        return None, 0
+    m = _TRACE_CTX_RE.match(str(raw).strip())
+    if m is None:
+        return None, 0
+    parent, hop = int(m.group(1)), int(m.group(2))
+    if hop < 1 or hop > MAX_TRACE_HOPS:
+        return None, 0
+    return parent, hop
+
+
 # ---------------------------------------------------------------- series ring
 
 
@@ -665,6 +704,21 @@ TOP_DEFAULT_SERIES = (
     "counter/spans_dropped_total",
 )
 
+#: fleet-router series `simon top --fleet` shows by default (same
+#: existence-filtering as TOP_DEFAULT_SERIES — a router that has not
+#: failed over yet simply has no failover gauges to draw)
+FLEET_TOP_DEFAULT_SERIES = (
+    "counter/fleet_requests_total",
+    "counter/fleet_reroutes_total",
+    "counter/fleet_shed_total",
+    "counter/fleet_forward_failures_total",
+    "counter/fleet_failovers_total",
+    "counter/fleet_failover_ms_total",
+    "gauge/fleet_slot_imbalance",
+    "gauge/fleet_metrics_cache_age_seconds",
+    "gauge/fleet_failover_seconds",
+)
+
 _SPARK_CHARS = "▁▂▃▄▅▆▇█"
 
 
@@ -755,6 +809,66 @@ def render_top_frame(
                 f"{label[:40]:<40} {_fmt_value(name, last):>10}  "
                 f"{sparkline(vals, width)}"
             )
+    return "\n".join(lines)
+
+
+def fleet_slot_series(slot: str) -> List[str]:
+    """The per-slot series names a fleet top frame reads (the caller
+    URL-encodes them for the query string — slot labels ride inside
+    series names)."""
+    return [
+        f"counter/fleet_replica_requests:{slot}",
+        f"histo/fleet/forward/{slot}/p95_ms",
+    ]
+
+
+def render_fleet_top_frame(
+    snapshot: dict, series_doc: dict, url: str, width: int = 40
+) -> str:
+    """One `simon top --fleet` frame from the ROUTER'S snapshot and
+    series payloads: the fleet header + SLO burn table (shared with
+    render_top_frame), then a per-slot pane — up/degraded/down, the
+    slot's per-interval request rate, its forward p95 — and the
+    fleet-wide signal sparklines. Tolerant BY CONSTRUCTION: a slot
+    whose series are missing (TTL-cached scrape not refreshed yet, a
+    replica that answered nothing this window) renders gaps ('-'),
+    never a crash."""
+    lines = [render_top_frame(snapshot, {"series": {}}, url, width=width)]
+    series = series_doc.get("series") or {}
+    replicas = snapshot.get("replicas") or {}
+    if replicas:
+        lines.append("")
+        lines.append(
+            f"{'slot':<12} {'state':<9} {'req Δ':>8} {'p95 ms':>8}  history"
+        )
+        for slot in sorted(replicas):
+            reqs = series.get(f"counter/fleet_replica_requests:{slot}") or []
+            p95 = series.get(f"histo/fleet/forward/{slot}/p95_ms") or []
+            vals = [p[1] for p in reqs]
+            deltas = [max(b - a, 0.0) for a, b in zip(vals, vals[1:])]
+            rate = _fmt_value("", deltas[-1]) if deltas else "-"
+            p95_last = _fmt_value("", p95[-1][1]) if p95 else "-"
+            lines.append(
+                f"{str(slot)[:12]:<12} {str(replicas[slot])[:9]:<9} "
+                f"{rate:>8} {p95_last:>8}  "
+                f"{sparkline(deltas, width) if deltas else ''}"
+            )
+    fleet_series = {
+        name: pts
+        for name, pts in series.items()
+        if not name.startswith("counter/fleet_replica_requests:")
+        and not name.startswith("histo/fleet/forward/")
+    }
+    if fleet_series:
+        body = render_top_frame(
+            {"daemon": "", "recorder": {}},
+            {"series": fleet_series},
+            url,
+            width=width,
+        )
+        # drop the duplicate header line; keep the signal table
+        lines.append("")
+        lines.extend(body.splitlines()[1:])
     return "\n".join(lines)
 
 

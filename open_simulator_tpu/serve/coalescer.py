@@ -89,6 +89,12 @@ class PendingRequest:
     # echoed in reply headers/shed bodies, stamped on the request's
     # span subtree, distinct per member of a coalesced batch
     request_id: str = ""
+    # cross-process trace context (X-Simon-Trace-Context): the fleet
+    # router's forward-span id + hop count. Span ids are process-local,
+    # so the remote parent rides the serve/request root as an ATTR
+    # (fleet/trace.py stitches the two id spaces into one tree)
+    trace_parent: Optional[int] = None
+    trace_hop: int = 0
     enqueued_at: float = field(default_factory=time.monotonic)
     # perf_counter twin of enqueued_at: synthesized per-request spans
     # (queue_wait/evaluate) must live in the recorder's clock domain
@@ -415,6 +421,9 @@ class Coalescer:
             attrs["batch_span"] = batch_span
         if engine:
             attrs["engine"] = engine
+        if pending.trace_parent is not None:
+            attrs["remote_parent"] = pending.trace_parent
+            attrs["fleet_hop"] = pending.trace_hop
         if not evaluated:
             attrs["shed"] = True
         root = RECORDER.record_span(

@@ -1026,6 +1026,11 @@ class ServeDaemon:
                         ),
                     )
                     return
+                # cross-process trace context (fleet router hop): a
+                # malformed header degrades to (None, 0), never a 4xx
+                trace_parent, trace_hop = telemetry.parse_trace_context(
+                    self.headers.get(telemetry.TRACE_CONTEXT_HEADER)
+                )
                 pending = PendingRequest(
                     request=req,
                     budget=Budget(deadline),
@@ -1033,6 +1038,8 @@ class ServeDaemon:
                     tenant=tenant,
                     route_reason=verdict.reason,
                     request_id=rid,
+                    trace_parent=trace_parent,
+                    trace_hop=trace_hop,
                 )
                 if not daemon.coalescer.submit(pending):
                     draining = daemon._shutdown.is_set()

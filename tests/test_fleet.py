@@ -387,6 +387,84 @@ def test_router_sheds_503_with_retry_after_when_no_replica_lives(stub_fleet):
     assert body["partial"] is True and body["reason"] == "fleet"
 
 
+def test_router_stamps_request_id_header_on_every_status(stub_fleet):
+    """The response header contract (docs/OBSERVABILITY.md): EVERY
+    router answer carries X-Simon-Request-Id — a forwarded POST, a
+    proxied GET whose replica echoed nothing, and the 503 shed."""
+    router, replicas = stub_fleet
+    resp = _post_router(router, {"q": 1}, rid="hdr-rid")
+    assert resp.status == 200
+    assert resp.headers[telemetry.REQUEST_ID_HEADER] == "hdr-rid"
+    # proxied GET: the stub's GET answer has no id header — the
+    # router must add the request's id itself
+    req = urllib.request.Request(
+        f"http://{router.host}:{router.port}/v1/state-digest",
+        headers={telemetry.REQUEST_ID_HEADER: "hdr-get"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.headers[telemetry.REQUEST_ID_HEADER] == "hdr-get"
+    for r in replicas:
+        r.stop()
+        router._mark(r.slot, "down")
+    resp = _post_router(router, {"q": 2}, rid="hdr-shed")
+    assert resp.status == 503
+    assert resp.headers[telemetry.REQUEST_ID_HEADER] == "hdr-shed"
+
+
+def test_fleet_metrics_carry_cache_age_and_imbalance_gauges(stub_fleet):
+    """The aggregation's own staleness is a metric: the cache-age
+    gauge reports the oldest TTL-cached replica scrape (and lands in
+    the counters registry for the SLO engine), and the slot-imbalance
+    gauge tracks the hottest slot's share over the mean."""
+    router, _ = stub_fleet
+    _post_router(router, {"q": 1}, tenant="age-tenant").read()
+    text = render_fleet_metrics(router).decode()
+    age_lines = [
+        l for l in text.splitlines()
+        if l.startswith("simon_fleet_metrics_cache_age_seconds ")
+    ]
+    assert len(age_lines) == 1
+    assert float(age_lines[0].split()[1]) >= 0.0
+    assert COUNTERS.get("fleet_metrics_cache_age_seconds") >= 0.0
+    # a second render reads the now-aged cache entries
+    import time as _time
+
+    _time.sleep(0.05)
+    text = render_fleet_metrics(router).decode()
+    age = float(
+        next(
+            l for l in text.splitlines()
+            if l.startswith("simon_fleet_metrics_cache_age_seconds ")
+        ).split()[1]
+    )
+    assert age >= 0.05
+    imb = [
+        l for l in text.splitlines()
+        if l.startswith("simon_fleet_slot_imbalance ")
+    ]
+    assert len(imb) == 1
+    # the counters registry is process-global (earlier tests may have
+    # loaded either slot), so assert the invariant, not a fixed value:
+    # max/mean - 1 is bounded by [0, n_slots - 1]
+    assert 0.0 <= float(imb[0].split()[1]) <= 1.0
+    # audit families render even before any failover (zero defaults);
+    # the per-phase partition appears once the audit publishes it
+    assert "simon_fleet_failovers_audited_total " in text
+    assert "simon_fleet_failover_ms_total " in text
+    assert "simon_fleet_failover_seconds " in text
+    from open_simulator_tpu.fleet.audit import PHASE_DURATIONS
+
+    for phase in PHASE_DURATIONS:
+        COUNTERS.gauge(f"fleet_failover_phase_seconds:{phase}", 0.5)
+    text = render_fleet_metrics(router).decode()
+    phase_lines = [
+        l for l in text.splitlines()
+        if l.startswith("simon_fleet_failover_phase_seconds{")
+    ]
+    assert len(phase_lines) == len(PHASE_DURATIONS)
+    assert all('phase="' in l for l in phase_lines)
+
+
 def test_router_healthz_aggregates_and_hints_backoff(stub_fleet):
     router, replicas = stub_fleet
     with urllib.request.urlopen(
@@ -413,11 +491,14 @@ def test_fleet_metrics_exposition_is_unique_and_bounded(stub_fleet):
     helps = [l for l in text.splitlines() if l.startswith("# HELP")]
     names = [h.split()[2] for h in helps]
     assert len(names) == len(set(names)), "duplicate metric families"
-    # per-replica labels stay cardinality-bounded: only fleet-minted
-    # families carry a replica label, never tenant/request labels
+    # labels stay cardinality-bounded: replica (N slots), phase (the
+    # fixed 5-phase audit partition), slo (configured objectives) —
+    # never tenant/request labels
     for line in text.splitlines():
         if "{" in line and not line.startswith("#"):
-            assert 'replica="' in line
+            assert any(
+                k in line for k in ('replica="', 'phase="', 'slo="')
+            ), line
             assert "tenant=" not in line
     up = [l for l in text.splitlines()
           if l.startswith("simon_fleet_replica_up{")]

@@ -1,0 +1,191 @@
+"""Failover audit timeline (fleet/audit.py; docs/OBSERVABILITY.md).
+
+``fleet.failover_seconds`` is one opaque number; the audit log is its
+explanation. The load-bearing guarantees:
+
+- a full kill->respawn episode writes the six phases in causal order
+  and the summary's five durations PARTITION the episode — they sum
+  to totalSeconds by construction, and the validator enforces it;
+- flap noise that recovers closes as ``recovered`` and never counts
+  as a failover; a failed spawn attempt is an event, not a
+  checkpoint (the phase clock runs until a spawn succeeds);
+- the log is journal-disciplined: fsync'd JSONL with a validated
+  header, a torn TAIL is dropped + counted, interior damage refuses
+  loudly (the fleet/replay.py posture);
+- closing an episode publishes the gauges/counters the router's
+  series store and the ``fleet_failover`` SLO kind consume.
+"""
+
+import json
+
+import pytest
+
+from open_simulator_tpu.fleet.audit import (
+    PHASE_DURATIONS,
+    FailoverAudit,
+    read_audit_log,
+    validate_audit_log,
+)
+from open_simulator_tpu.models.validation import InputError
+from open_simulator_tpu.utils.trace import COUNTERS
+
+
+class FakeClock:
+    """A hand-cranked monotonic clock: tests assert exact durations."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _drive_full_episode(audit, clock, slot="r0"):
+    audit.note_probe_flap(slot, failures=1)
+    clock.tick(0.5)
+    audit.note_declared_dead(slot, reason="3 consecutive probe failures")
+    clock.tick(0.25)
+    audit.note_lock_reclaim(slot)
+    clock.tick(1.0)
+    audit.note_respawn(slot, ok=True, pid=4242)
+    clock.tick(0.125)
+    audit.note_replay_progress(slot, delta_seq=7)
+    clock.tick(2.0)
+    return audit.note_first_200(slot)
+
+
+def test_complete_episode_partitions_total_into_phases(tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "audit.jsonl")
+    audit = FailoverAudit(path, clock=clock)
+    summary = _drive_full_episode(audit, clock)
+    audit.close()
+    assert summary is not None
+    assert summary["totalSeconds"] == pytest.approx(3.875)
+    assert summary["phases"] == {
+        "detect": pytest.approx(0.5),
+        "reclaim": pytest.approx(0.25),
+        "respawn": pytest.approx(1.0),
+        "replay": pytest.approx(0.125),
+        "first_200": pytest.approx(2.0),
+    }
+    assert sum(summary["phases"].values()) == pytest.approx(
+        summary["totalSeconds"]
+    )
+    report = validate_audit_log(path)
+    assert report["complete"] == 1
+    assert report["tornTail"] == 0
+    assert report["slots"] == ["r0"]
+    # the episode published the series the SLO engine consumes
+    assert COUNTERS.get("fleet_failover_ms_total") >= 3875
+    snap = COUNTERS.snapshot()["gauges"]
+    assert snap["fleet_failover_seconds"] == pytest.approx(3.875)
+    assert snap["fleet_failover_phase_seconds:replay"] == pytest.approx(
+        0.125
+    )
+
+
+def test_flap_that_recovers_is_not_a_failover(tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "audit.jsonl")
+    audit = FailoverAudit(path, clock=clock)
+    audit.note_probe_flap("r1", failures=1)
+    clock.tick(0.1)
+    audit.note_probe_ok("r1")
+    # a healthy slot's first_200 is a no-op, not a phantom episode
+    assert audit.note_first_200("r1") is None
+    audit.close()
+    events, torn = read_audit_log(path)
+    assert [e["phase"] for e in events] == ["probe_flap", "recovered"]
+    assert torn == 0
+    assert validate_audit_log(path)["complete"] == 0
+
+
+def test_failed_respawn_is_an_event_not_a_checkpoint(tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "audit.jsonl")
+    audit = FailoverAudit(path, clock=clock)
+    audit.note_declared_dead("r0", reason="process exited")
+    clock.tick(0.5)
+    audit.note_lock_reclaim("r0")
+    clock.tick(1.0)
+    audit.note_respawn("r0", ok=False, error="port in use")
+    clock.tick(3.0)  # the retry pass succeeds much later
+    audit.note_respawn("r0", ok=True, pid=99)
+    clock.tick(0.5)
+    summary = audit.note_first_200("r0")
+    audit.close()
+    # the respawn phase charges the WHOLE retry wait, and the failed
+    # attempt is on the record
+    assert summary["phases"]["respawn"] == pytest.approx(4.0)
+    events, _ = read_audit_log(path)
+    assert "respawn_failed" in [e["phase"] for e in events]
+    assert validate_audit_log(path)["complete"] == 1
+
+
+def test_torn_tail_tolerated_interior_damage_refused(tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "audit.jsonl")
+    audit = FailoverAudit(path, clock=clock)
+    _drive_full_episode(audit, clock)
+    audit.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"slot": "r0", "phase": "probe_fl')  # crash mid-append
+    report = validate_audit_log(path)
+    assert report["tornTail"] == 1
+    assert report["complete"] == 1
+    # interior damage is NOT a torn tail: refuse loudly
+    lines = open(path, encoding="utf-8").read().splitlines()
+    lines[2] = lines[2][:10]
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(InputError):
+        read_audit_log(path)
+
+
+def test_validator_refuses_bad_header_and_broken_arithmetic(tmp_path):
+    bad_header = tmp_path / "not-audit.jsonl"
+    bad_header.write_text('{"kind": "something-else", "version": 1}\n')
+    with pytest.raises(InputError):
+        validate_audit_log(str(bad_header))
+
+    clock = FakeClock()
+    path = str(tmp_path / "audit.jsonl")
+    audit = FailoverAudit(path, clock=clock)
+    summary = _drive_full_episode(audit, clock)
+    audit.close()
+    # tamper with one duration so the partition no longer sums
+    lines = open(path, encoding="utf-8").read().splitlines()
+    doc = json.loads(lines[-1])
+    assert doc["phase"] == "failover_complete"
+    doc["phases"]["replay"] = summary["phases"]["replay"] + 1.0
+    lines[-1] = json.dumps(doc, sort_keys=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(InputError, match="sum"):
+        validate_audit_log(path)
+    assert set(PHASE_DURATIONS) == set(doc["phases"])
+
+
+def test_reopened_log_appends_no_second_header(tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "audit.jsonl")
+    audit = FailoverAudit(path, clock=clock)
+    _drive_full_episode(audit, clock, slot="r0")
+    audit.close()
+    audit2 = FailoverAudit(path, clock=clock)
+    _drive_full_episode(audit2, clock, slot="r1")
+    audit2.close()
+    headers = [
+        ln
+        for ln in open(path, encoding="utf-8").read().splitlines()
+        if '"simon-fleet-audit"' in ln
+    ]
+    assert len(headers) == 1
+    report = validate_audit_log(path)
+    assert report["complete"] == 2
+    assert report["slots"] == ["r0", "r1"]

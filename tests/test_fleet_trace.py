@@ -1,0 +1,340 @@
+"""Fleet observatory: cross-process trace stitching (fleet/trace.py).
+
+A request through the fleet router leaves spans in two id spaces —
+the router's ``fleet/request`` -> ``fleet/forward`` chain and the
+answering replica's ``serve/request`` subtree (correlated by the
+``X-Simon-Trace-Context`` header, carried as a ``remote_parent``
+attribute because span ids are process-local). The collector must
+stitch them into ONE tree per request:
+
+- a held burst through a 2-replica fleet yields one stitched tree per
+  request id — router root, forward hop, the replica's serve subtree
+  under that hop — deep enough for ``tools/validate_trace.py`` and at
+  ZERO new jit-cache misses on an identical repeat burst;
+- a mid-burst replica death shows the failed attempt as a
+  ``fleet/reroute`` SIBLING of the answering forward under the same
+  root — failovers are visible in the tree by construction, not by
+  log archaeology.
+
+The replicas here are in-process ServeDaemons sharing one recorder:
+the stitcher's slot check (forward.slot must match the dump's slot)
+is what keeps that shared-recorder double from stitching a subtree
+twice — exercised here on purpose.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from open_simulator_tpu.fleet.router import FleetRouter
+from open_simulator_tpu.fleet.trace import (
+    collect_request_trace,
+    stitch_request_trace,
+)
+from open_simulator_tpu.obs import spans as spans_mod
+from open_simulator_tpu.obs import telemetry as tm
+from open_simulator_tpu.serve.server import ServeDaemon
+from open_simulator_tpu.serve.session import Session
+from open_simulator_tpu.utils.trace import COUNTERS
+
+from test_request_id import _cluster, _request
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _pristine_recorder():
+    rec = spans_mod.RECORDER
+    yield
+    rec.disable()
+    rec.ring = False
+    rec.max_spans = rec.MAX_SPANS
+    rec.reset()
+    tm.SERIES.reset()
+
+
+class DaemonReplica:
+    """A fleet-replica shim over an in-process ServeDaemon: enough
+    surface (slot/url/probe) for the router to route, probe, and dump
+    it; no spawn()/alive(), so no respawn supervision."""
+
+    def __init__(self, slot: str, daemon: ServeDaemon):
+        self.slot = slot
+        self.daemon = daemon
+        self.restarts = 0
+        self.probe_failures = 0
+        self.retry_after_s = 0
+        self.url = f"http://{daemon.host}:{daemon.port}"
+
+    def probe(self):
+        return {"probeOk": True, "degraded": False}
+
+    def stop(self):
+        self.daemon.begin_shutdown()
+        self.daemon.shutdown()
+
+
+@pytest.fixture
+def daemon_fleet():
+    spans_mod.RECORDER.enable()
+    replicas = []
+    for i in range(2):
+        daemon = ServeDaemon(Session(_cluster()), port=0, max_batch=4)
+        daemon.coalescer.hold = threading.Event()
+        daemon.start()
+        replicas.append(DaemonReplica(f"r{i}", daemon))
+    router = FleetRouter(
+        replicas, port=0, probe_interval_s=0, forward_timeout_s=120.0
+    )
+    router.start()
+    yield router, replicas
+    for r in replicas:
+        try:
+            r.stop()
+        except OSError:
+            pass
+    router.httpd.shutdown()
+    router.httpd.server_close()
+    router.telemetry.stop()
+
+
+def _tenant_for(router, slot):
+    return next(
+        f"tt-{i}" for i in range(256) if router.ring.route(f"tt-{i}") == slot
+    )
+
+
+def _body(name):
+    return json.dumps(
+        {
+            "apps": [
+                {
+                    "name": name,
+                    "yaml": json.dumps(
+                        _request(name).apps[0].resource.deployments[0]
+                    ),
+                }
+            ]
+        }
+    ).encode()
+
+
+def _post(router, body, rid, tenant):
+    headers = {
+        "Content-Type": "application/json",
+        tm.REQUEST_ID_HEADER: rid,
+        "X-Simon-Tenant": tenant,
+    }
+    req = urllib.request.Request(
+        f"http://{router.host}:{router.port}/v1/simulate",
+        data=body,
+        headers=headers,
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=120)
+    except urllib.error.HTTPError as e:
+        resp = e
+    return resp.status, dict(resp.headers), resp.read()
+
+
+def _events_by_name(doc):
+    by_name = {}
+    for e in doc["traceEvents"]:
+        by_name.setdefault(e["name"], []).append(e)
+    return by_name
+
+
+def _burst(router, replicas, tenants, tag, n=6):
+    """A held burst: both replicas queue, then answer together."""
+    results = {}
+
+    def client(i):
+        tenant = tenants[i % 2]
+        results[i] = _post(
+            router, _body(f"tr-{tenant}"), f"{tag}-{i}", tenant
+        )
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # let the burst queue behind the holds
+    for r in replicas:
+        r.daemon.coalescer.hold.set()
+    for t in threads:
+        t.join(timeout=120)
+    for r in replicas:
+        r.daemon.coalescer.hold = threading.Event()
+    return results
+
+
+def test_held_burst_stitches_one_tree_per_request(daemon_fleet, tmp_path):
+    """The acceptance gate: every request of a held burst through a
+    2-replica fleet collects into ONE stitched tree — fleet/request
+    root -> fleet/forward -> the answering replica's serve/request
+    subtree — that tools/validate_trace.py accepts, and an identical
+    repeat burst costs zero new jit-cache misses."""
+    router, replicas = daemon_fleet
+    tenants = (_tenant_for(router, "r0"), _tenant_for(router, "r1"))
+    n = 6
+    results = _burst(router, replicas, tenants, "stitch", n=n)
+    assert len(results) == n
+    for i, (status, headers, _) in sorted(results.items()):
+        assert status == 200, f"request {i} answered {status}"
+
+    for i in range(n):
+        rid = f"stitch-{i}"
+        doc = collect_request_trace(router, rid)
+        by_name = _events_by_name(doc)
+        assert len(by_name.get("fleet/request", [])) == 1, rid
+        assert len(by_name.get("serve/request", [])) == 1, (
+            "the shared-recorder double must not stitch twice"
+        )
+        root = by_name["fleet/request"][0]
+        assert root["args"]["parent_id"] is None
+        serve = by_name["serve/request"][0]
+        # the serve subtree hangs under the forward that answered,
+        # and that forward names the replica the response header named
+        answered = results[i][1]["X-Simon-Fleet-Replica"]
+        fwd = next(
+            e
+            for e in by_name["fleet/forward"]
+            if e["args"]["span_id"] == serve["args"]["parent_id"]
+        )
+        assert fwd["args"]["slot"] == answered
+        assert fwd["args"]["parent_id"] == root["args"]["span_id"]
+        # the replica-side phases survived the stitch under the root
+        ids = {serve["args"]["span_id"]}
+        assert any(
+            e["args"]["parent_id"] in ids
+            for e in by_name.get("serve/request/queue_wait", [])
+        )
+        # the exported document is the validator's contract
+        out = tmp_path / f"trace-{i}.json"
+        out.write_text(json.dumps(doc))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "tools" / "validate_trace.py"),
+                str(out),
+                "--min-depth",
+                "3",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # identical repeat burst: stitching is host bookkeeping, never a
+    # recompile
+    r0 = COUNTERS.get("jax_recompiles_total")
+    results2 = _burst(router, replicas, tenants, "stitch2", n=n)
+    assert all(s == 200 for s, _h, _b in results2.values())
+    assert COUNTERS.get("jax_recompiles_total") == r0
+
+
+def test_reroute_is_a_sibling_attempt_in_the_stitched_tree(daemon_fleet):
+    """Kill the owner, answer via the next slot: the stitched tree
+    shows the failed attempt (fleet/reroute) and the answering
+    fleet/forward as SIBLINGS under one fleet/request root, with the
+    survivor's serve subtree under the forward."""
+    router, replicas = daemon_fleet
+    for r in replicas:
+        r.daemon.coalescer.hold.set()  # no held burst here
+    victim_tenant = _tenant_for(router, "r0")
+    replicas[0].stop()  # owner dies; the router finds out on forward
+    status, headers, _ = _post(
+        router, _body("tr-reroute"), "reroute-1", victim_tenant
+    )
+    assert status == 200
+    assert headers["X-Simon-Request-Id"] == "reroute-1"
+    assert headers["X-Simon-Fleet-Replica"] == "r1"
+
+    doc = collect_request_trace(router, "reroute-1")
+    by_name = _events_by_name(doc)
+    root = by_name["fleet/request"][0]
+    rid_root = root["args"]["span_id"]
+    reroutes = by_name.get("fleet/reroute", [])
+    assert reroutes, "the failed attempt must be visible in the tree"
+    assert all(e["args"]["parent_id"] == rid_root for e in reroutes)
+    assert reroutes[0]["args"]["slot"] == "r0"
+    answering = [
+        e
+        for e in by_name["fleet/forward"]
+        if e["args"]["parent_id"] == rid_root
+        and e["args"]["slot"] == "r1"
+    ]
+    assert len(answering) == 1
+    serve = by_name["serve/request"][0]
+    assert serve["args"]["parent_id"] == answering[0]["args"]["span_id"]
+
+
+def test_stitch_is_pure_and_ignores_foreign_and_direct_spans():
+    """stitch_request_trace on synthetic dumps: spans of other request
+    ids, serve roots with no matching forward (direct requests), and a
+    wrong-slot dump (the shared-recorder double) all stay out."""
+    router_events = [
+        {
+            "id": 1, "parent": None, "name": "fleet/request",
+            "t0": 10.0, "t1": 10.5, "tid": 1,
+            "attrs": {"request_id": "a"},
+        },
+        {
+            "id": 2, "parent": 1, "name": "fleet/forward",
+            "t0": 10.1, "t1": 10.4, "tid": 1,
+            "attrs": {"request_id": "a", "slot": "r1"},
+        },
+        {
+            "id": 3, "parent": None, "name": "fleet/request",
+            "t0": 11.0, "t1": 11.5, "tid": 1,
+            "attrs": {"request_id": "other"},
+        },
+    ]
+    replica_root = {
+        "id": 7, "parent": None, "name": "serve/request",
+        "t0": 500.0, "t1": 500.2, "tid": 9,
+        "attrs": {"request_id": "a", "remote_parent": 2, "fleet_hop": 1},
+    }
+    replica_child = {
+        "id": 8, "parent": 7, "name": "serve/request/evaluate",
+        "t0": 500.05, "t1": 500.15, "tid": 9,
+        "attrs": {"request_id": "a"},
+    }
+    direct = {
+        "id": 9, "parent": None, "name": "serve/request",
+        "t0": 501.0, "t1": 501.1, "tid": 9,
+        "attrs": {"request_id": "a"},  # no remote_parent: direct hit
+    }
+    # the same dump handed to BOTH slots: only the slot the forward
+    # names may stitch it
+    dump = [replica_root, replica_child, direct]
+    stitched = stitch_request_trace(
+        "a", router_events, {"r0": dump, "r1": dump}
+    )
+    names = [s["name"] for s in stitched]
+    assert names.count("serve/request") == 1
+    assert names.count("serve/request/evaluate") == 1
+    assert "fleet/request" in names
+    serve = next(s for s in stitched if s["name"] == "serve/request")
+    fwd = next(s for s in stitched if s["name"] == "fleet/forward")
+    assert serve["parent"] == fwd["id"]
+    # re-based into the router clock: subtree starts at the forward
+    assert serve["t0"] == pytest.approx(fwd["t0"])
+    child = next(
+        s for s in stitched if s["name"] == "serve/request/evaluate"
+    )
+    assert child["parent"] == serve["id"]
+    assert child["t0"] == pytest.approx(fwd["t0"] + 0.05)
+    # the other request's root stayed out
+    assert not any(
+        s["attrs"].get("request_id") == "other" for s in stitched
+    )

@@ -1,0 +1,64 @@
+"""Validate a fleet failover audit log (CI artifact gate).
+
+The router's supervision loop appends an fsync'd JSONL timeline of
+every failover (fleet/audit.py): probe_flap -> declared_dead ->
+lock_reclaim -> respawn -> replay_progress -> first_200, closed by a
+``failover_complete`` summary whose per-phase durations partition the
+episode. This gate proves the artifact is structurally sound AND
+arithmetically consistent: header intact, phases known and causally
+ordered, every complete episode's durations summing to its
+``totalSeconds``.
+
+    python tools/validate_audit.py AUDIT.jsonl [--min-complete 1]
+
+Exit 0 on success (prints a one-line summary), 1 with a diagnostic
+otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from open_simulator_tpu.fleet.audit import validate_audit_log  # noqa: E402
+from open_simulator_tpu.models.validation import InputError  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("audit", help="failover audit JSONL from `simon fleet`")
+    ap.add_argument(
+        "--min-complete",
+        type=int,
+        default=0,
+        help="fail unless at least this many COMPLETE failover episodes "
+        "are recorded (CI smoke: proof the kill-9 was audited end-to-end)",
+    )
+    args = ap.parse_args()
+    try:
+        summary = validate_audit_log(args.audit)
+    except (OSError, InputError, ValueError) as e:
+        print(f"{args.audit}: INVALID — {e}", file=sys.stderr)
+        return 1
+    if summary["complete"] < args.min_complete:
+        print(
+            f"{args.audit}: INVALID — {summary['complete']} complete "
+            f"episode(s) < required {args.min_complete}",
+            file=sys.stderr,
+        )
+        return 1
+    torn = "; WARNING: torn tail dropped" if summary["tornTail"] else ""
+    print(
+        f"{args.audit}: OK — {summary['events']} event(s), "
+        f"{summary['episodes']} episode(s) ({summary['complete']} "
+        f"complete) across slots {', '.join(summary['slots']) or '-'}"
+        f"{torn}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
